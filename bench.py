@@ -1,0 +1,98 @@
+"""Benchmark: GPT-2 125M-class training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric of record (BASELINE.md): tokens/sec/chip; vs_baseline is MFU relative
+to the 40% MFU north-star target (reference publishes no absolute numbers —
+BASELINE.json published: {}).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from megatronapp_tpu.config.parallel_config import ParallelConfig
+    from megatronapp_tpu.config.training_config import OptimizerConfig
+    from megatronapp_tpu.config.transformer_config import TransformerConfig
+    from megatronapp_tpu.models.gpt import gpt_loss, init_gpt_params
+    from megatronapp_tpu.parallel.mesh import build_mesh
+    from megatronapp_tpu.training.optimizer import get_optimizer
+    from megatronapp_tpu.training.train_state import setup_train_state
+    from megatronapp_tpu.training.train_step import make_train_step
+    from megatronapp_tpu.utils.flops import TPU_PEAK_FLOPS, flops_per_token
+
+    # GPT-2 125M (reference run_single_gpt.sh class model).
+    cfg = TransformerConfig(
+        num_layers=12, hidden_size=768, num_attention_heads=12,
+        vocab_size=50304, max_position_embeddings=1024,
+        remat_policy="selective",
+    )
+    seq, micro_bs, n_micro = 1024, 4, 1
+    par = ParallelConfig()
+    ctx = build_mesh(par, devices=jax.devices()[:1])
+
+    opt_cfg = OptimizerConfig(lr=1e-4)
+    optimizer = get_optimizer(opt_cfg, 100)
+    state, shardings, _ = setup_train_state(
+        jax.random.PRNGKey(0), lambda k: init_gpt_params(k, cfg),
+        optimizer, ctx)
+
+    def loss_fn(params, micro):
+        loss, m = gpt_loss(params, micro["tokens"], micro["labels"],
+                           micro["loss_mask"], cfg)
+        return loss, m
+
+    step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
+                              100, check_nan=False)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size,
+                          (n_micro, micro_bs, seq)).astype(np.int32)
+    batch = {
+        "tokens": tokens,
+        "labels": np.roll(tokens, -1, axis=-1),
+        "loss_mask": np.ones_like(tokens, dtype=np.float32),
+        "position_ids": np.tile(np.arange(seq, dtype=np.int32),
+                                (n_micro, micro_bs, 1)),
+    }
+
+    with ctx.mesh:
+        # Differential timing: the tunneled platform's block_until_ready does
+        # not wait, and a device_get round-trip has fixed latency; timing two
+        # windows and differencing cancels the constant.
+        state, metrics = step_fn(state, batch)  # compile + warmup
+        _ = jax.device_get(metrics["loss"])
+        times = {}
+        for n_steps in (5, 25):
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                state, metrics = step_fn(state, batch)
+            _ = jax.device_get(metrics["loss"])
+            times[n_steps] = time.perf_counter() - t0
+        n_steps = 25 - 5
+        dt = times[25] - times[5]
+
+    tokens_per_step = micro_bs * n_micro * seq
+    tok_per_sec = tokens_per_step * n_steps / dt
+    platform = jax.devices()[0].platform
+    kind = getattr(jax.devices()[0], "device_kind", platform).lower()
+    peak = next((v for k, v in TPU_PEAK_FLOPS.items() if k in kind),
+                TPU_PEAK_FLOPS.get(platform, 1e12))
+    mfu = tok_per_sec * flops_per_token(cfg, seq) / peak
+
+    print(json.dumps({
+        "metric": "gpt2_125m_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"mfu": round(mfu, 4), "device": kind,
+                  "step_ms": round(dt / n_steps * 1e3, 2)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

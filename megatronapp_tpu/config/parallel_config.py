@@ -1,0 +1,99 @@
+"""Parallelism configuration for the TPU device mesh.
+
+TPU-native replacement for the reference's process-group bookkeeping in
+``parallel_state.py`` (/root/reference/megatron/core/parallel_state.py:1272
+``initialize_model_parallel``). Instead of building NCCL process groups from
+global ranks, we describe a ``jax.sharding.Mesh`` factorization; XLA emits the
+collectives over ICI/DCN from sharding annotations.
+
+Axis order follows the reference RankGenerator order ``tp-cp-ep-dp-pp``
+(parallel_state.py: RankGenerator) so that TP is innermost (fastest-varying,
+mapped to the tightest ICI neighborhood) and PP is outermost (can ride DCN
+across slices).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+# Canonical mesh axis names, outermost → innermost.
+PP_AXIS = "pp"
+DP_AXIS = "dp"
+EP_AXIS = "ep"
+CP_AXIS = "cp"
+TP_AXIS = "tp"
+
+MESH_AXES: Tuple[str, ...] = (PP_AXIS, DP_AXIS, EP_AXIS, CP_AXIS, TP_AXIS)
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    """Degrees for every parallel dimension.
+
+    Mirrors the argument semantics of the reference
+    (--tensor-model-parallel-size, --pipeline-model-parallel-size,
+    --context-parallel-size, --expert-model-parallel-size,
+    --num-layers-per-virtual-pipeline-stage, --sequence-parallel;
+    arguments.py distributed group :2045ff).
+    Data parallel degree is inferred from the device count.
+    """
+
+    tensor_parallel: int = 1
+    pipeline_parallel: int = 1
+    context_parallel: int = 1
+    expert_parallel: int = 1
+    # Virtual pipeline (interleaved 1F1B): number of model chunks per pp stage.
+    virtual_pipeline_parallel: int = 1
+    # Korthikanti-style sequence parallelism for LN/dropout regions: on TPU this
+    # is an activation-sharding choice (seq dim sharded over tp outside
+    # attention/MLP); XLA inserts the all-gather/reduce-scatter pairs.
+    sequence_parallel: bool = False
+    # Explicit data-parallel degree; None = infer from num_devices.
+    data_parallel: Optional[int] = None
+    # ZeRO-1/3 style sharding of optimizer state / params over dp
+    # (reference --use-distributed-optimizer / custom_fsdp).
+    distributed_optimizer: bool = True
+    fsdp: bool = False
+    # Number of pipeline microbatches per global step.
+    num_microbatches: int = 1
+    # MegaFBD analogue: run forward and backward on disjoint sub-meshes.
+    forward_backward_disaggregating: bool = False
+    # MegaDPP analogue: microbatch send-ordering policy ('dfc' depth-first /
+    # 'bfc' breadth-first; reference paper §5.2).
+    pipeline_order_policy: str = "bfc"
+
+    def __post_init__(self):
+        for name in ("tensor_parallel", "pipeline_parallel", "context_parallel",
+                     "expert_parallel", "virtual_pipeline_parallel"):
+            v = getattr(self, name)
+            if v < 1:
+                raise ValueError(f"{name} must be >= 1, got {v}")
+        if self.sequence_parallel and self.tensor_parallel == 1:
+            # Harmless no-op; keep parity with reference which warns+disables.
+            self.sequence_parallel = False
+
+    @property
+    def model_parallel_size(self) -> int:
+        return (self.tensor_parallel * self.pipeline_parallel *
+                self.context_parallel)
+
+    def infer_data_parallel(self, num_devices: int) -> int:
+        denom = (self.tensor_parallel * self.pipeline_parallel *
+                 self.context_parallel * self.expert_parallel)
+        if num_devices % denom != 0:
+            raise ValueError(
+                f"num_devices={num_devices} not divisible by "
+                f"tp*pp*cp*ep={denom}")
+        dp = num_devices // denom
+        if self.data_parallel is not None and self.data_parallel != dp:
+            raise ValueError(
+                f"explicit data_parallel={self.data_parallel} inconsistent with "
+                f"num_devices={num_devices} (inferred {dp})")
+        return dp
+
+    def mesh_shape(self, num_devices: int) -> Tuple[int, ...]:
+        dp = self.infer_data_parallel(num_devices)
+        return (self.pipeline_parallel, dp, self.expert_parallel,
+                self.context_parallel, self.tensor_parallel)

@@ -1,0 +1,66 @@
+"""Training/optimizer configuration.
+
+Mirrors the reference argument groups (/root/reference/megatron/training/
+arguments.py — _add_training_args, _add_learning_rate_args,
+_add_regularization_args, _add_checkpointing_args) and
+OptimizerConfig (/root/reference/megatron/core/optimizer/optimizer_config.py),
+reduced to the knobs that matter on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    optimizer: str = "adam"          # 'adam' | 'sgd'
+    lr: float = 3e-4
+    min_lr: float = 3e-5
+    lr_decay_style: str = "cosine"   # 'cosine' | 'linear' | 'constant'
+    lr_warmup_iters: int = 0
+    lr_decay_iters: Optional[int] = None  # default: train_iters
+    weight_decay: float = 0.01
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.95
+    adam_eps: float = 1e-8
+    sgd_momentum: float = 0.9
+    clip_grad: float = 1.0
+    # bf16 grad all-reduce (reference --accumulate-allreduce-grads-in-fp32
+    # inverse); we accumulate in fp32 by default.
+    grad_reduce_in_fp32: bool = True
+
+
+@dataclasses.dataclass
+class TrainingConfig:
+    micro_batch_size: int = 1
+    global_batch_size: int = 8
+    seq_length: int = 512
+    train_iters: int = 100
+    seed: int = 1234
+    log_interval: int = 10
+    eval_interval: Optional[int] = None
+    eval_iters: int = 10
+    save_interval: Optional[int] = None
+    save_dir: Optional[str] = None
+    load_dir: Optional[str] = None
+    exit_interval: Optional[int] = None
+    # NaN/spike guard (reference rerun_state_machine result validation).
+    check_for_nan_in_loss: bool = True
+    loss_spike_factor: float = 10.0
+    # MegaScan tracing (reference --trace / --trace-interval /
+    # --continuous-trace-iterations, arguments.py:2705ff).
+    trace: bool = False
+    trace_interval: int = 5
+    continuous_trace_iterations: int = 2
+    trace_dir: str = "trace"
+    trace_granularity: str = "full"
+
+    def num_microbatches(self, data_parallel: int) -> int:
+        denom = self.micro_batch_size * data_parallel
+        if self.global_batch_size % denom != 0:
+            raise ValueError(
+                f"global_batch_size={self.global_batch_size} not divisible by "
+                f"micro_batch_size*dp={denom}")
+        return self.global_batch_size // denom

@@ -1,0 +1,181 @@
+"""Transformer architecture configuration.
+
+TPU-native analogue of the reference's ``TransformerConfig`` dataclass
+(/root/reference/megatron/core/transformer/transformer_config.py:18) and
+``ModelParallelConfig`` (/root/reference/megatron/core/model_parallel_config.py).
+The reference couples these to CUDA-era concerns (TE, fp8 recipes, CUDA graphs);
+here the config describes the *math* of the model plus TPU-relevant choices
+(dtype policy, remat policy, kernel implementation selection).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+class AttnMaskType(enum.Enum):
+    causal = "causal"
+    padding = "padding"
+    bidirectional = "bidirectional"
+
+
+class ActivationKind(enum.Enum):
+    gelu = "gelu"
+    swiglu = "swiglu"
+    geglu = "geglu"
+    relu = "relu"
+    squared_relu = "squared_relu"
+
+
+class NormKind(enum.Enum):
+    layernorm = "LayerNorm"
+    rmsnorm = "RMSNorm"
+
+
+class PositionEmbeddingKind(enum.Enum):
+    rope = "rope"
+    learned_absolute = "learned_absolute"
+    yarn = "yarn"
+    none = "none"
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    """Architecture hyperparameters.
+
+    Field semantics follow the reference TransformerConfig
+    (transformer_config.py:18) — num_layers/hidden_size/num_attention_heads/
+    num_query_groups/ffn_hidden_size/kv_channels etc. — expressed TPU-first.
+    """
+
+    num_layers: int = 2
+    hidden_size: int = 128
+    num_attention_heads: int = 8
+    # GQA: number of KV heads (reference: num_query_groups).
+    num_query_groups: Optional[int] = None
+    ffn_hidden_size: Optional[int] = None
+    kv_channels: Optional[int] = None
+    vocab_size: int = 50304
+    max_position_embeddings: int = 2048
+
+    # Normalization / activation / position embedding.
+    normalization: NormKind = NormKind.layernorm
+    layernorm_epsilon: float = 1e-5
+    activation: ActivationKind = ActivationKind.gelu
+    position_embedding: PositionEmbeddingKind = PositionEmbeddingKind.rope
+    rotary_base: float = 10000.0
+    rotary_percent: float = 1.0
+    # YaRN context extension (position_embedding=yarn; reference
+    # yarn_rotary_pos_embedding.py): trained-context multiplier and the
+    # original pretraining context length.
+    rope_scaling_factor: float = 1.0
+    yarn_original_max_position: int = 4096
+    yarn_beta_fast: float = 32.0
+    yarn_beta_slow: float = 1.0
+    yarn_mscale_coeff: float = 0.1
+    add_qkv_bias: bool = False
+    add_bias_linear: bool = True
+    qk_layernorm: bool = False
+    attn_mask_type: AttnMaskType = AttnMaskType.causal
+    untie_embeddings_and_output_weights: bool = False
+
+    # Dropout (structural parity; usually 0 for LLM pretraining).
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
+
+    # Initialization.
+    init_method_std: float = 0.02
+
+    # Softmax / logits details (reference: apply_query_key_layer_scaling etc.).
+    attention_softmax_in_fp32: bool = True
+    apply_query_key_layer_scaling: bool = False
+
+    # MoE (reference: transformer_config.py moe_* fields; moe/ directory).
+    num_moe_experts: Optional[int] = None
+    moe_router_topk: int = 2
+    moe_ffn_hidden_size: Optional[int] = None
+    moe_aux_loss_coeff: float = 0.0
+    moe_z_loss_coeff: float = 0.0
+    moe_shared_expert_intermediate_size: Optional[int] = None
+    moe_capacity_factor: Optional[float] = None
+    # Layer frequency: 1 = every layer is MoE; k = every k-th layer.
+    moe_layer_freq: int = 1
+
+    # Multi-latent attention (DeepSeek-style MLA; reference multi_latent_attention.py:44).
+    multi_latent_attention: bool = False
+    q_lora_rank: Optional[int] = None
+    kv_lora_rank: int = 512
+    qk_head_dim: int = 128
+    qk_pos_emb_head_dim: int = 64
+    v_head_dim: int = 128
+
+    # dtype policy: params kept in fp32, compute in bf16 (TPU-native mixed precision).
+    params_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    # Rematerialization policy for the layer scan: 'none' | 'full' | 'selective'.
+    # 'selective' checkpoints only attention internals (reference
+    # --recompute-activations semantics, arguments.py recompute group).
+    remat_policy: str = "selective"
+
+    # Kernel implementation selection (spec_utils.py ModuleSpec analogue):
+    # 'reference' = pure jnp; 'pallas' = fused Pallas kernels where available.
+    attention_impl: str = "reference"
+
+    # Fused dot-product attention blockwise kernel sizes (Pallas).
+    flash_block_q: int = 512
+    flash_block_kv: int = 512
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            if self.activation in (ActivationKind.swiglu, ActivationKind.geglu):
+                self.ffn_hidden_size = int(4 * self.hidden_size * 2 / 3)
+            else:
+                self.ffn_hidden_size = 4 * self.hidden_size
+        if self.kv_channels is None:
+            self.kv_channels = self.hidden_size // self.num_attention_heads
+        if self.num_query_groups is None:
+            self.num_query_groups = self.num_attention_heads
+        if self.num_attention_heads % self.num_query_groups != 0:
+            raise ValueError(
+                f"num_attention_heads ({self.num_attention_heads}) must be divisible by "
+                f"num_query_groups ({self.num_query_groups})"
+            )
+        if self.num_moe_experts is not None and self.moe_ffn_hidden_size is None:
+            self.moe_ffn_hidden_size = self.ffn_hidden_size
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_moe_experts is not None
+
+    @property
+    def head_dim(self) -> int:
+        return self.kv_channels
+
+    def num_parameters(self) -> int:
+        """Approximate parameter count (embedding + blocks + final norm)."""
+        h = self.hidden_size
+        v = self.vocab_size
+        n_kv = self.num_query_groups
+        d = self.head_dim
+        per_layer = (
+            h * (self.num_attention_heads * d)  # Q
+            + 2 * h * (n_kv * d)  # K,V
+            + (self.num_attention_heads * d) * h  # out proj
+            + 2 * h  # ln
+        )
+        if self.activation in (ActivationKind.swiglu, ActivationKind.geglu):
+            per_layer += 3 * h * self.ffn_hidden_size
+        else:
+            per_layer += 2 * h * self.ffn_hidden_size
+        per_layer += 2 * h  # second ln
+        total = v * h + per_layer * self.num_layers + 2 * h
+        if self.position_embedding == PositionEmbeddingKind.learned_absolute:
+            total += self.max_position_embeddings * h
+        if self.untie_embeddings_and_output_weights:
+            total += v * h
+        return total

@@ -1,0 +1,50 @@
+"""Mock GPT dataset for tests/benchmarks without preprocessed data.
+
+Parity with /root/reference/megatron/core/datasets/gpt_dataset.py:753
+(MockGPTDataset / MockGPTLowLevelDataset: deterministic pseudo-random token
+sequences keyed by index). Batches carry the same fields the reference
+get_batch produces (pretrain_gpt.py:139): tokens, labels, loss_mask,
+position_ids (attention_mask is implicit causal).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator
+
+import numpy as np
+
+
+class MockGPTDataset:
+    def __init__(self, seq_length: int, vocab_size: int, seed: int = 0,
+                 size: int = 10**9):
+        self.seq_length = seq_length
+        self.vocab_size = vocab_size
+        self.seed = seed
+        self.size = size
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        rng = np.random.default_rng((self.seed, idx))
+        return rng.integers(0, self.vocab_size,
+                            size=self.seq_length + 1).astype(np.int32)
+
+
+def mock_batches(seq_length: int, vocab_size: int, batch_size: int,
+                 seed: int = 0, start_idx: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    """Infinite iterator of global batches (caller shards over dp)."""
+    ds = MockGPTDataset(seq_length, vocab_size, seed)
+    idx = start_idx
+    while True:
+        samples = np.stack([ds[idx + i] for i in range(batch_size)])
+        idx += batch_size
+        tokens = samples[:, :-1]
+        labels = samples[:, 1:]
+        yield {
+            "tokens": tokens,
+            "labels": labels,
+            "loss_mask": np.ones_like(tokens, dtype=np.float32),
+            "position_ids": np.tile(np.arange(seq_length, dtype=np.int32),
+                                    (batch_size, 1)),
+        }

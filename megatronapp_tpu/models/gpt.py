@@ -1,0 +1,115 @@
+"""GPT model (decoder-only LM).
+
+Parity with /root/reference/megatron/core/models/gpt/gpt_model.py:32
+(GPTModel: LanguageModelEmbedding → TransformerBlock → output layer with
+optionally tied word embeddings, vocab-parallel logits + CE). TPU-first:
+functional params pytree, scan-over-layers block, logical-axis shardings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import (
+    NormKind, PositionEmbeddingKind, TransformerConfig,
+)
+from megatronapp_tpu.ops import rotary
+from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
+from megatronapp_tpu.ops.normalization import apply_norm
+from megatronapp_tpu.transformer.block import block_forward, init_block_params
+from megatronapp_tpu.scope.hooks import scope_capture
+
+
+def init_gpt_params(rng, cfg: TransformerConfig):
+    """Returns (params, logical_axes) pytrees."""
+    k_emb, k_pos, k_block, k_out = jax.random.split(rng, 4)
+    std = cfg.init_method_std
+    p = {
+        "embedding": {
+            "word": jax.random.normal(
+                k_emb, (cfg.vocab_size, cfg.hidden_size), cfg.params_dtype) * std,
+        },
+        "final_ln_scale": jnp.ones((cfg.hidden_size,), cfg.params_dtype),
+    }
+    ax = {
+        "embedding": {"word": ("vocab", "embed")},
+        "final_ln_scale": ("embed",),
+    }
+    if cfg.position_embedding == PositionEmbeddingKind.learned_absolute:
+        p["embedding"]["pos"] = jax.random.normal(
+            k_pos, (cfg.max_position_embeddings, cfg.hidden_size),
+            cfg.params_dtype) * std
+        ax["embedding"]["pos"] = ("pos", "embed")
+    if cfg.normalization == NormKind.layernorm:
+        p["final_ln_bias"] = jnp.zeros((cfg.hidden_size,), cfg.params_dtype)
+        ax["final_ln_bias"] = ("embed",)
+    p["block"], ax["block"] = init_block_params(k_block, cfg)
+    if cfg.untie_embeddings_and_output_weights:
+        p["output"] = jax.random.normal(
+            k_out, (cfg.hidden_size, cfg.vocab_size), cfg.params_dtype) * std
+        ax["output"] = ("embed", "vocab")
+    return p, ax
+
+
+def gpt_embed(p, tokens: jnp.ndarray, cfg: TransformerConfig,
+              position_offset: int = 0) -> jnp.ndarray:
+    """tokens [B,S] → embeddings [B,S,H] (vocab axis tp-sharded: XLA handles
+    the sharded gather; reference VocabParallelEmbedding layers.py:172)."""
+    h = jnp.take(p["embedding"]["word"], tokens, axis=0)
+    if "pos" in p["embedding"]:
+        s = tokens.shape[1]
+        pos = jnp.arange(s) + position_offset
+        h = h + jnp.take(p["embedding"]["pos"], pos, axis=0)
+    return h.astype(cfg.compute_dtype)
+
+
+def gpt_rope_tables(cfg: TransformerConfig, seq_len: int,
+                    position_offset: int = 0):
+    if cfg.position_embedding == PositionEmbeddingKind.rope:
+        inv_freq = rotary.rope_frequencies(cfg.head_dim, cfg.rotary_base,
+                                           cfg.rotary_percent)
+    elif cfg.position_embedding == PositionEmbeddingKind.yarn:
+        inv_freq = rotary.yarn_frequencies(
+            cfg.head_dim, cfg.rotary_base,
+            scaling_factor=cfg.rope_scaling_factor,
+            original_max_position=cfg.yarn_original_max_position,
+            beta_fast=cfg.yarn_beta_fast, beta_slow=cfg.yarn_beta_slow,
+            rotary_percent=cfg.rotary_percent)
+    else:
+        return None, None
+    positions = jnp.arange(seq_len) + position_offset
+    cos, sin = rotary.rope_cos_sin(positions, inv_freq)
+    if cfg.position_embedding == PositionEmbeddingKind.yarn:
+        m = rotary.yarn_mscale(cfg.rope_scaling_factor, cfg.yarn_mscale_coeff)
+        cos, sin = cos * m, sin * m
+    return cos, sin
+
+
+def gpt_forward(p, tokens: jnp.ndarray, cfg: TransformerConfig,
+                attention_mask: Optional[jnp.ndarray] = None,
+                position_offset: int = 0):
+    """tokens [B,S] → (logits [B,S,V] fp32, moe_aux_loss)."""
+    b, s = tokens.shape
+    h = gpt_embed(p, tokens, cfg, position_offset)
+    cos, sin = gpt_rope_tables(cfg, s, position_offset)
+    h, aux = block_forward(p["block"], h, cfg, cos, sin, attention_mask)
+    h = apply_norm(cfg.normalization, h, p["final_ln_scale"],
+                   p.get("final_ln_bias"), cfg.layernorm_epsilon)
+    out_kernel = (p["output"] if "output" in p
+                  else p["embedding"]["word"].T)
+    logits = h.astype(cfg.compute_dtype) @ out_kernel.astype(cfg.compute_dtype)
+    logits = scope_capture("result", logits)
+    return logits.astype(jnp.float32), aux
+
+
+def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
+             loss_mask: Optional[jnp.ndarray], cfg: TransformerConfig):
+    """Training loss (CE + MoE aux). Mirrors pretrain_gpt.py loss_func
+    (/root/reference/pretrain_gpt.py:159)."""
+    logits, aux = gpt_forward(p, tokens, cfg)
+    loss, _ = cross_entropy_loss(logits, targets, loss_mask)
+    return loss + aux, {"lm_loss": loss, "moe_aux_loss": aux}

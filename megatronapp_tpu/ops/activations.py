@@ -1,0 +1,46 @@
+"""Activation functions + gated-MLP helpers.
+
+Parity with the reference fused bias-activation wrappers
+(/root/reference/megatron/core/fusions/fused_bias_gelu.py,
+fused_bias_swiglu.py, fused_bias_geglu.py). XLA fuses bias+activation into the
+producing matmul on TPU, so these are expressed directly in jnp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import ActivationKind
+
+
+def gelu(x):
+    # tanh approximation — matches the reference bias_gelu fusion
+    # (fused_bias_gelu.py uses the tanh form).
+    return jax.nn.gelu(x, approximate=True)
+
+
+def squared_relu(x):
+    return jnp.square(jax.nn.relu(x))
+
+
+def apply_activation(kind: ActivationKind, x, gate=None):
+    """Apply activation; for gated kinds `x` is the value and `gate` the gate
+    branch (reference fused_bias_swiglu.py: swiglu(y) = silu(y1) * y2)."""
+    if kind == ActivationKind.swiglu:
+        assert gate is not None
+        return jax.nn.silu(gate) * x
+    if kind == ActivationKind.geglu:
+        assert gate is not None
+        return gelu(gate) * x
+    if kind == ActivationKind.gelu:
+        return gelu(x)
+    if kind == ActivationKind.relu:
+        return jax.nn.relu(x)
+    if kind == ActivationKind.squared_relu:
+        return squared_relu(x)
+    raise ValueError(f"unknown activation {kind}")
+
+
+def is_gated(kind: ActivationKind) -> bool:
+    return kind in (ActivationKind.swiglu, ActivationKind.geglu)

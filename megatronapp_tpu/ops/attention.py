@@ -1,0 +1,73 @@
+"""Dot-product attention (reference jnp implementation).
+
+Parity with /root/reference/megatron/core/transformer/dot_product_attention.py
+(the 'local' CUDA-free impl): scaled QK^T → (scaled/masked) softmax in fp32 →
+context matmul, with GQA (num_query_groups < num_heads; attention.py:88) and
+causal masking. On TPU, XLA fuses the mask+softmax chain; the Pallas flash
+kernel (ops/pallas/flash_attention.py) is the memory-efficient production
+path selected via TransformerConfig.attention_impl.
+
+Shapes follow the TPU-friendly [batch, seq, heads, head_dim] layout
+(reference uses [s, b, h, d]; batch-major is better for TPU tiling).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import AttnMaskType
+
+
+def repeat_kv(k: jnp.ndarray, num_heads: int) -> jnp.ndarray:
+    """Broadcast KV heads to query heads for GQA ([B,S,Hkv,D] → [B,S,H,D])."""
+    n_kv = k.shape[2]
+    if n_kv == num_heads:
+        return k
+    reps = num_heads // n_kv
+    return jnp.repeat(k, reps, axis=2)
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # [B, Sq, H, D]
+    k: jnp.ndarray,  # [B, Skv, Hkv, D]
+    v: jnp.ndarray,  # [B, Skv, Hkv, D]
+    mask_type: AttnMaskType = AttnMaskType.causal,
+    attention_mask: Optional[jnp.ndarray] = None,  # [B, 1, Sq, Skv] True=keep
+    softmax_scale: Optional[float] = None,
+    softmax_in_fp32: bool = True,
+    q_offset: int = 0,
+) -> jnp.ndarray:
+    """Returns context [B, Sq, H, D].
+
+    q_offset: absolute position of q[0] relative to k[0] (used for decode
+    steps and for ring-attention block offsets).
+    """
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    if softmax_scale is None:
+        softmax_scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    k = repeat_kv(k, h)
+    v = repeat_kv(v, h)
+
+    # [B,H,Sq,Skv]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * softmax_scale
+
+    if mask_type == AttnMaskType.causal:
+        q_pos = jnp.arange(sq) + q_offset
+        kv_pos = jnp.arange(skv)
+        causal = q_pos[:, None] >= kv_pos[None, :]
+        scores = jnp.where(causal[None, None], scores, -1e30)
+    if attention_mask is not None:
+        scores = jnp.where(attention_mask, scores, -1e30)
+
+    if softmax_in_fp32:
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    else:
+        probs = jax.nn.softmax(scores, axis=-1)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

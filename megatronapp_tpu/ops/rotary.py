@@ -1,0 +1,103 @@
+"""Rotary position embeddings (RoPE) + YaRN scaling.
+
+Parity with the reference's rotary implementations
+(/root/reference/megatron/core/models/common/embeddings/rotary_pos_embedding.py
+and yarn_rotary_pos_embedding.py). The reference caches cos/sin on device per
+forward; here frequencies are computed inside the jit (cheap, fused by XLA) or
+passed in precomputed for inference decode steps.
+
+Uses the interleaved="false" (half-rotation / GPT-NeoX) layout which matches
+the reference default ``rotary_interleaved=False``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, base: float = 10000.0,
+                     rotary_percent: float = 1.0) -> jnp.ndarray:
+    """Inverse frequencies [rot_dim/2] in fp32."""
+    rot_dim = int(head_dim * rotary_percent)
+    rot_dim -= rot_dim % 2
+    exponent = jnp.arange(0, rot_dim, 2, dtype=jnp.float32) / rot_dim
+    return 1.0 / (base ** exponent)
+
+
+def yarn_frequencies(head_dim: int, base: float = 10000.0,
+                     scaling_factor: float = 1.0,
+                     original_max_position: int = 4096,
+                     beta_fast: float = 32.0, beta_slow: float = 1.0,
+                     rotary_percent: float = 1.0) -> jnp.ndarray:
+    """YaRN NTK-by-parts interpolation of RoPE frequencies.
+
+    Semantics of yarn_rotary_pos_embedding.py (find_correction_range +
+    linear_ramp_mask): low-frequency dims are interpolated by
+    1/scaling_factor, high-frequency dims keep extrapolation, with a linear
+    ramp between correction bounds.
+    """
+    rot_dim = int(head_dim * rotary_percent)
+    rot_dim -= rot_dim % 2
+    freq_extra = rope_frequencies(head_dim, base, rotary_percent)
+    freq_inter = freq_extra / scaling_factor
+
+    def correction_dim(num_rotations):
+        return (rot_dim * math.log(original_max_position /
+                                   (num_rotations * 2 * math.pi))) / \
+               (2 * math.log(base))
+
+    low = math.floor(correction_dim(beta_fast))
+    high = math.ceil(correction_dim(beta_slow))
+    low = max(low, 0)
+    high = min(high, rot_dim - 1)
+    ramp = (jnp.arange(rot_dim // 2, dtype=jnp.float32) - low) / max(high - low, 1)
+    ramp = jnp.clip(ramp, 0.0, 1.0)
+    # ramp==0 → extrapolation (high freq); ramp==1 → interpolation.
+    return freq_extra * (1 - ramp) + freq_inter * ramp
+
+
+def yarn_mscale(scaling_factor: float, mscale_coeff: float = 0.1) -> float:
+    if scaling_factor <= 1.0:
+        return 1.0
+    return 1.0 + mscale_coeff * math.log(scaling_factor)
+
+
+def rope_cos_sin(positions: jnp.ndarray, inv_freq: jnp.ndarray):
+    """cos/sin tables for given positions.
+
+    positions: [...seq] int32; returns cos,sin of shape [...seq, rot_dim/2].
+    """
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+               mscale: float = 1.0) -> jnp.ndarray:
+    """Apply half-rotation RoPE.
+
+    x: [batch, seq, heads, head_dim]; cos/sin: [seq, rot_dim/2] or
+    [batch, seq, rot_dim/2]. Rotates the first rot_dim features, passes the
+    rest through (rotary_percent < 1 parity).
+    """
+    half = cos.shape[-1]
+    rot_dim = 2 * half
+    x_rot, x_pass = x[..., :rot_dim], x[..., rot_dim:]
+    x1, x2 = x_rot[..., :half], x_rot[..., half:]
+    if cos.ndim == 2:  # [seq, half] → broadcast over batch and heads
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
+    else:  # [batch, seq, half]
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+    if mscale != 1.0:
+        c = c * mscale
+        s = s * mscale
+    out1 = x1.astype(jnp.float32) * c - x2.astype(jnp.float32) * s
+    out2 = x2.astype(jnp.float32) * c + x1.astype(jnp.float32) * s
+    out = jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+    if x_pass.shape[-1]:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out

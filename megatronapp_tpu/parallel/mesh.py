@@ -1,0 +1,104 @@
+"""Device mesh construction and accessors.
+
+TPU-native analogue of ``parallel_state.py`` group construction
+(/root/reference/megatron/core/parallel_state.py:1272 and accessors :18-124).
+Where the reference builds ~20 NCCL/Gloo process groups and stores them in
+module globals, here a single ``MeshContext`` owns a ``jax.sharding.Mesh`` with
+named axes (pp, dp, ep, cp, tp); "groups" are just axis names, and collectives
+are either emitted by XLA from shardings or written explicitly with
+``shard_map`` + ``psum``/``ppermute`` over an axis name.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from megatronapp_tpu.config.parallel_config import (
+    MESH_AXES, ParallelConfig, DP_AXIS, TP_AXIS, PP_AXIS, CP_AXIS, EP_AXIS,
+)
+
+
+@dataclasses.dataclass
+class MeshContext:
+    """Owns the device mesh and the parallel config that shaped it."""
+
+    mesh: Mesh
+    parallel: ParallelConfig
+
+    # --- degree accessors (parity with parallel_state get_*_world_size) ---
+    @property
+    def tp(self) -> int:
+        return self.mesh.shape[TP_AXIS]
+
+    @property
+    def pp(self) -> int:
+        return self.mesh.shape[PP_AXIS]
+
+    @property
+    def dp(self) -> int:
+        return self.mesh.shape[DP_AXIS]
+
+    @property
+    def cp(self) -> int:
+        return self.mesh.shape[CP_AXIS]
+
+    @property
+    def ep(self) -> int:
+        return self.mesh.shape[EP_AXIS]
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in MESH_AXES]))
+
+    # --- sharding helpers ---
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_spec(self, seq_sharded: bool = True) -> P:
+        """PartitionSpec for a [batch, seq, ...] activation/token array.
+
+        Batch is sharded over dp (and ep, which subdivides the data-parallel
+        world exactly as in the reference where EP ranks hold distinct data;
+        parallel_state.py:43-52). Sequence is sharded over cp (context
+        parallelism, §5.7 of SURVEY) when seq_sharded.
+        """
+        batch_axes = (DP_AXIS, EP_AXIS)
+        if seq_sharded and self.cp > 1:
+            return P(batch_axes, CP_AXIS)
+        return P(batch_axes)
+
+    @contextlib.contextmanager
+    def use(self):
+        with self.mesh:
+            yield self
+
+
+def build_mesh(parallel: ParallelConfig,
+               devices: Optional[Sequence[jax.Device]] = None) -> MeshContext:
+    """Build the mesh with axis order pp, dp, ep, cp, tp (outer→inner).
+
+    TP innermost keeps tensor-parallel collectives on nearest-neighbor ICI
+    links; PP outermost lets pipeline stages span slices over DCN — the
+    reference encodes the same locality preference via RankGenerator order
+    tp-cp-ep-dp-pp (parallel_state.py).
+    """
+    if devices is None:
+        devices = jax.devices()
+    shape = parallel.mesh_shape(len(devices))
+    dev_array = np.asarray(devices).reshape(shape)
+    mesh = Mesh(dev_array, MESH_AXES)
+    return MeshContext(mesh=mesh, parallel=parallel)
+
+
+def single_device_mesh() -> MeshContext:
+    """Trivial 1-device mesh (all axes size 1) for single-chip runs/tests."""
+    return build_mesh(ParallelConfig(), devices=jax.devices()[:1])

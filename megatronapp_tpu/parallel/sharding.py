@@ -1,0 +1,109 @@
+"""Logical-axis sharding rules.
+
+TPU-native replacement for the reference's explicit tensor-parallel layer
+classes (ColumnParallelLinear /root/reference/megatron/core/tensor_parallel/
+layers.py:675, RowParallelLinear :1019, VocabParallelEmbedding :172). Instead
+of hand-splitting weights and inserting collectives via autograd functions
+(mappings.py:27-353), every parameter carries a tuple of *logical* axis names;
+a rule table maps logical names to mesh axes and XLA inserts the matching
+all-gather / reduce-scatter / all-reduce.
+
+Column-parallel == output-feature axis mapped to 'tp';
+row-parallel == input-feature axis mapped to 'tp';
+vocab-parallel embedding == vocab axis mapped to 'tp'.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from megatronapp_tpu.config.parallel_config import (
+    DP_AXIS, EP_AXIS, CP_AXIS, TP_AXIS, PP_AXIS,
+)
+
+# Logical axis vocabulary used by model code.
+#   'vocab'        — vocabulary dim (tp-sharded: vocab-parallel embedding/CE)
+#   'embed'        — hidden/residual dim (replicated across tp; fsdp-shardable)
+#   'mlp'          — FFN intermediate dim (tp-sharded: column→row parallel pair)
+#   'heads'        — attention heads dim (tp-sharded)
+#   'kv_heads'     — GQA KV-heads dim (tp-sharded)
+#   'head_dim'     — per-head feature dim (unsharded)
+#   'qkv'          — fused QKV output dim (tp-sharded)
+#   'experts'      — MoE expert dim (ep-sharded)
+#   'layers'       — stacked-layer leading axis from scan (pp-sharded when
+#                    pipelining, else unsharded)
+#   'stage_layers' — layers within one pipeline stage (unsharded)
+#   'batch','seq'  — activation dims
+DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
+    ("vocab", TP_AXIS),
+    ("embed", None),
+    ("mlp", TP_AXIS),
+    ("heads", TP_AXIS),
+    ("kv_heads", TP_AXIS),
+    ("head_dim", None),
+    ("qkv", TP_AXIS),
+    ("experts", EP_AXIS),
+    # 'layers' is the scan-stacked leading axis. It is NOT pp-sharded here:
+    # the pipeline module owns pp placement explicitly (parallel/pipeline.py
+    # reshapes to [pp, layers/pp, ...] inside shard_map); in the non-pipelined
+    # path layers live whole on every pp group (pp=1).
+    ("layers", None),
+    ("stage_layers", None),
+    ("batch", (DP_AXIS, EP_AXIS)),
+    ("seq", CP_AXIS),
+    ("pos", None),
+)
+
+# FSDP variant: shard the residual/hidden dim of weights across dp as well
+# (reference custom_fsdp / --use-distributed-optimizer param sharding,
+# core/distributed/custom_fsdp/fully_sharded_data_parallel.py).
+FSDP_RULES: Tuple[Tuple[str, Any], ...] = tuple(
+    (name, (DP_AXIS,) if name == "embed" else axis)
+    for name, axis in DEFAULT_RULES
+)
+
+
+def rules_dict(rules=DEFAULT_RULES) -> Dict[str, Any]:
+    return dict(rules)
+
+
+def logical_to_spec(logical_axes: Tuple[Optional[str], ...],
+                    rules=DEFAULT_RULES) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec."""
+    table = dict(rules)
+    spec = []
+    used = set()
+    for name in logical_axes:
+        if name is None:
+            spec.append(None)
+            continue
+        axis = table.get(name)
+        # A mesh axis may appear only once in a PartitionSpec; later
+        # occurrences degrade to replication (matters for e.g. ('embed','mlp')
+        # under fsdp rules where two dims could both want dp).
+        key = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+        if axis is None or any(k in used for k in key):
+            spec.append(None)
+        else:
+            used.update(key)
+            spec.append(axis)
+    return P(*spec)
+
+
+def tree_logical_to_sharding(logical_tree, mesh: Mesh, rules=DEFAULT_RULES):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x),
+    )
+
+
+def shard_params(params, logical_tree, mesh: Mesh, rules=DEFAULT_RULES):
+    """Device-put a param pytree according to its logical axes."""
+    shardings = tree_logical_to_sharding(logical_tree, mesh, rules)
+    return jax.device_put(params, shardings)

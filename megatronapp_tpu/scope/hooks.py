@@ -1,0 +1,101 @@
+"""MegaScope capture hooks (identity unless enabled).
+
+Parity with the reference capture sites (tik_tensor calls at
+/root/reference/megatron/core/transformer/attention.py:979-981,
+dot_product_attention.py:168-170, mlp.py:116-118) and the TensorTracer flag
+system (/root/reference/megatron/core/tensor_tracer.py:66-74).
+
+Under jit, captures must be traced in: when enabled, `scope_capture` routes
+the (compressed) tensor to the host via ``jax.debug.callback`` (async, does
+not block the device). When disabled (default) it is the identity and has
+zero cost — XLA elides it entirely.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class FlagType(enum.IntEnum):
+    """Reference tensor_tracer.py:66-74 FlagType values (wire contract with
+    the frontend)."""
+    QKV_mat_mul = 0
+    RawAttentionScore = 1
+    ContextLayer = 2
+    MLP1 = 3
+    MLP2 = 4
+    Result = 5
+    MLP2_Plot = 6
+
+
+_SITE_TO_FLAG = {
+    "qkv_q": FlagType.QKV_mat_mul,
+    "qkv_k": FlagType.QKV_mat_mul,
+    "qkv_v": FlagType.QKV_mat_mul,
+    "attention_probs": FlagType.RawAttentionScore,
+    "context": FlagType.ContextLayer,
+    "mlp1": FlagType.MLP1,
+    "mlp2": FlagType.MLP2,
+    "result": FlagType.Result,
+}
+
+
+class _ScopeState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.sites: Dict[str, bool] = {}
+        self.sink: Optional[Callable] = None
+        self.compress_pixels: int = 0
+
+
+_state = _ScopeState()
+
+
+def configure(enabled: bool, sites: Optional[Dict[str, bool]] = None,
+              sink: Optional[Callable] = None, compress_pixels: int = 64):
+    """Enable/disable capture. `sink(site, layer_id, array)` is called on host.
+
+    NOTE: toggling changes trace-time behavior → triggers recompilation, the
+    documented cost of dynamic reconfiguration under jit (SURVEY §7 hard
+    parts). The WS server therefore batches config changes between steps.
+    """
+    _state.enabled = enabled
+    _state.sites = sites or {}
+    _state.sink = sink
+    _state.compress_pixels = compress_pixels
+
+
+def is_enabled(site: str) -> bool:
+    return _state.enabled and _state.sites.get(site, False) and _state.sink is not None
+
+
+def _compress(x: jnp.ndarray, pixels: int) -> jnp.ndarray:
+    """Bucket the feature dim to `pixels` means (tensor_tracer.py:76-122
+    Compressor with default method data.mean(dim=-1))."""
+    if pixels <= 0 or x.shape[-1] <= pixels:
+        return x.astype(jnp.float32)
+    feat = x.shape[-1]
+    chunk = feat // pixels
+    trimmed = x[..., : pixels * chunk].astype(jnp.float32)
+    return trimmed.reshape(*x.shape[:-1], pixels, chunk).mean(-1)
+
+
+def scope_capture(site: str, x: jnp.ndarray, layer_id=None) -> jnp.ndarray:
+    """Identity passthrough that optionally mirrors a compressed copy of x to
+    the host sink. Safe to call inside jit/scan."""
+    if not is_enabled(site):
+        return x
+    compressed = _compress(x, _state.compress_pixels)
+    sink = _state.sink
+
+    def _emit(arr, lid):
+        sink(site, None if lid is None else int(lid), arr)
+
+    lid = layer_id if layer_id is not None else -1
+    jax.debug.callback(_emit, compressed, lid)
+    return x

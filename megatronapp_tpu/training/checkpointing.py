@@ -1,0 +1,66 @@
+"""Checkpoint save/load (Orbax/tensorstore-backed, async, reshardable).
+
+Parity with /root/reference/megatron/training/checkpointing.py:315
+(save_checkpoint) / :1247 (load_checkpoint) and core/dist_checkpointing/
+(sharded state dicts, async save via strategies/async_utils.py, tensorstore
+strategy). On TPU, Orbax provides the same capability set natively: arrays
+are saved with their shardings, restore reshards to the *current* mesh (the
+reference's strategies/resharding.py TP/PP-change path), and AsyncCheckpointer
+overlaps writes with training (reference --async-save).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin wrapper over ocp.CheckpointManager.
+
+    save() is async by default (writes overlap next steps); wait() finalizes
+    — the analogue of maybe_finalize_async_save (training.py:884).
+    """
+
+    def __init__(self, directory: str, save_interval: Optional[int] = None,
+                 max_to_keep: int = 3, async_save: bool = True):
+        directory = os.path.abspath(directory)
+        os.makedirs(directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            save_interval_steps=save_interval or 1,
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=async_save,
+        )
+        self._mngr = ocp.CheckpointManager(directory, options=options)
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        return self._mngr.save(
+            step, args=ocp.args.StandardSave(state), force=force)
+
+    def restore(self, state_struct: Any, step: Optional[int] = None) -> Any:
+        """Restore into the shardings of `state_struct` (abstract arrays with
+        shardings → resharding on layout change comes free)."""
+        if step is None:
+            step = self._mngr.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(
+            lambda x: (ocp.utils.to_shape_dtype_struct(x)
+                       if hasattr(x, "dtype") else x),
+            state_struct)
+        return self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def wait(self):
+        self._mngr.wait_until_finished()
+
+    def close(self):
+        self._mngr.close()

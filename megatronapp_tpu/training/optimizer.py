@@ -1,0 +1,74 @@
+"""Optimizer construction (optax).
+
+Parity with /root/reference/megatron/core/optimizer/__init__.py:431
+(get_megatron_optimizer) + optimizer.py (Float16Optimizer etc.) +
+optimizer_param_scheduler.py (warmup + cosine/linear decay) + clip_grads.py.
+
+TPU-native notes: fp16 loss-scaling machinery is unnecessary (bf16 training
+is the norm on TPU — master params fp32, compute bf16, no dynamic scaler);
+ZeRO-1 state sharding is obtained by sharding optimizer-state pytrees with
+the same logical rules as params plus dp over the 'embed' axis (reference
+distrib_optimizer.py:80 semantics) — see training/train.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from megatronapp_tpu.config.training_config import OptimizerConfig
+
+
+def lr_schedule(cfg: OptimizerConfig, train_iters: int) -> optax.Schedule:
+    decay_iters = cfg.lr_decay_iters or train_iters
+    warmup = cfg.lr_warmup_iters
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = cfg.lr * step / jnp.maximum(warmup, 1)
+        frac = jnp.clip((step - warmup) / jnp.maximum(decay_iters - warmup, 1),
+                        0.0, 1.0)
+        if cfg.lr_decay_style == "cosine":
+            decay = cfg.min_lr + 0.5 * (cfg.lr - cfg.min_lr) * (
+                1.0 + jnp.cos(jnp.pi * frac))
+        elif cfg.lr_decay_style == "linear":
+            decay = cfg.lr + (cfg.min_lr - cfg.lr) * frac
+        else:
+            decay = jnp.asarray(cfg.lr)
+        return jnp.where(step < warmup, warm, decay)
+
+    return sched
+
+
+def _weight_decay_mask(params):
+    """No decay for 1-D params (biases, norm scales) — reference
+    get_param_groups (optimizer/__init__.py) no_weight_decay_cond default."""
+    return jax.tree.map(lambda p: p.ndim > 1, params)
+
+
+def get_optimizer(cfg: OptimizerConfig, train_iters: int,
+                  schedule: Optional[optax.Schedule] = None
+                  ) -> optax.GradientTransformation:
+    sched = schedule or lr_schedule(cfg, train_iters)
+    chain = []
+    if cfg.clip_grad:
+        chain.append(optax.clip_by_global_norm(cfg.clip_grad))
+    if cfg.optimizer == "adam":
+        chain.append(optax.scale_by_adam(
+            b1=cfg.adam_beta1, b2=cfg.adam_beta2, eps=cfg.adam_eps))
+        if cfg.weight_decay:
+            chain.append(optax.add_decayed_weights(
+                cfg.weight_decay, mask=_weight_decay_mask))
+    elif cfg.optimizer == "sgd":
+        chain.append(optax.trace(decay=cfg.sgd_momentum))
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer}")
+    chain.append(optax.scale_by_learning_rate(sched))
+    return optax.chain(*chain)
+
+
+def global_grad_norm(grads) -> jnp.ndarray:
+    return optax.global_norm(grads)

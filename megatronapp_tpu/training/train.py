@@ -1,0 +1,192 @@
+"""Training driver — the ``pretrain()`` analogue.
+
+Parity with /root/reference/megatron/training/training.py:894 (pretrain) /
+:668 (pretrain_body) / :1967 (train loop) / :1488 (training_log): mesh+state
+setup, microbatched train loop, throughput/loss logging, checkpoint
+save/resume, MegaScan tracing hooks, NaN-skip accounting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatronapp_tpu.config.parallel_config import ParallelConfig
+from megatronapp_tpu.config.training_config import (
+    OptimizerConfig, TrainingConfig,
+)
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.data.mock import mock_batches
+from megatronapp_tpu.models.gpt import gpt_loss, init_gpt_params
+from megatronapp_tpu.parallel.mesh import MeshContext, build_mesh
+from megatronapp_tpu.training.checkpointing import CheckpointManager
+from megatronapp_tpu.training.optimizer import get_optimizer
+from megatronapp_tpu.training.train_state import setup_train_state
+from megatronapp_tpu.training.train_step import make_train_step
+from megatronapp_tpu.trace.tracer import get_tracer
+from megatronapp_tpu.utils.flops import flops_per_token
+
+
+@dataclasses.dataclass
+class TrainResult:
+    state: Any
+    losses: list
+    tokens_per_sec: float
+    step_time_ms: float
+
+
+def reshape_global_batch(batch: Dict[str, np.ndarray], num_micro: int
+                         ) -> Dict[str, np.ndarray]:
+    """[global_batch, seq] → [num_micro, global_batch/num_micro, seq]."""
+    def r(x):
+        gb = x.shape[0]
+        return x.reshape(num_micro, gb // num_micro, *x.shape[1:])
+    return {k: r(v) for k, v in batch.items()}
+
+
+def gpt_microbatch_loss(cfg: TransformerConfig):
+    def loss_fn(params, micro):
+        loss, metrics = gpt_loss(params, micro["tokens"], micro["labels"],
+                                 micro["loss_mask"], cfg)
+        return loss, metrics
+    return loss_fn
+
+
+def pretrain_gpt(
+    model_cfg: TransformerConfig,
+    parallel_cfg: ParallelConfig,
+    train_cfg: TrainingConfig,
+    opt_cfg: OptimizerConfig,
+    batch_iter: Optional[Iterator[Dict[str, np.ndarray]]] = None,
+    ctx: Optional[MeshContext] = None,
+    log_fn: Callable[[str], None] = print,
+) -> TrainResult:
+    """End-to-end GPT pretraining loop. Returns final state + stats."""
+    if ctx is None:
+        ctx = build_mesh(parallel_cfg)
+    dp_total = ctx.dp * ctx.ep
+    num_micro = train_cfg.num_microbatches(dp_total)
+
+    optimizer = get_optimizer(opt_cfg, train_cfg.train_iters)
+    rng = jax.random.PRNGKey(train_cfg.seed)
+
+    def params_and_axes(rng):
+        return init_gpt_params(rng, model_cfg)
+
+    state, shardings, params_axes = setup_train_state(
+        rng, params_and_axes, optimizer, ctx)
+
+    # Checkpointing: restore from load_dir (or save_dir when resuming the
+    # same run), save only to save_dir — reference --load/--save semantics
+    # (training/checkpointing.py).
+    ckpt = None
+    start_step = 0
+    if train_cfg.save_dir:
+        ckpt = CheckpointManager(train_cfg.save_dir,
+                                 save_interval=train_cfg.save_interval)
+    restore_dir = train_cfg.load_dir or train_cfg.save_dir
+    if restore_dir:
+        if train_cfg.load_dir and train_cfg.load_dir != train_cfg.save_dir:
+            loader = CheckpointManager(train_cfg.load_dir)
+        else:
+            loader = ckpt
+        restored = loader.restore(state) if loader is not None else None
+        if restored is not None:
+            state = restored
+            start_step = int(jax.device_get(state["step"]))
+            log_fn(f"resumed from checkpoint at step {start_step}")
+        if loader is not None and loader is not ckpt:
+            loader.close()
+
+    if batch_iter is None:
+        # Fast-forward the data stream past already-consumed samples on
+        # resume (reference consumed_train_samples bookkeeping).
+        batch_iter = mock_batches(
+            train_cfg.seq_length, model_cfg.vocab_size,
+            train_cfg.global_batch_size, seed=train_cfg.seed,
+            start_idx=start_step * train_cfg.global_batch_size)
+
+    loss_fn = gpt_microbatch_loss(model_cfg)
+    step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
+                              train_cfg.train_iters,
+                              check_nan=train_cfg.check_for_nan_in_loss)
+
+    tracer = get_tracer()
+    if train_cfg.trace:
+        tracer.configure(
+            enabled=True, trace_dir=train_cfg.trace_dir,
+            interval=train_cfg.trace_interval,
+            continuous_iterations=train_cfg.continuous_trace_iterations,
+            mesh_ctx=ctx)
+
+    losses = []
+    window_tokens = 0
+    window_start = time.perf_counter()
+    step_time_ms = 0.0
+    tokens_per_sec = 0.0
+    tokens_per_step = train_cfg.global_batch_size * train_cfg.seq_length
+
+    with ctx.mesh:
+        for it in range(start_step, train_cfg.train_iters):
+            tracer.iteration_begin(it)
+            batch = reshape_global_batch(next(batch_iter), num_micro)
+            with tracer.scope("train-step"):
+                state, metrics = step_fn(state, batch)
+                # Block for accurate per-step timing only when tracing or
+                # logging this step; otherwise let steps pipeline.
+                should_log = ((it + 1) % train_cfg.log_interval == 0 or
+                              it + 1 == train_cfg.train_iters)
+                if tracer.active or should_log:
+                    metrics = jax.device_get(metrics)
+            tracer.iteration_end(it)
+            window_tokens += tokens_per_step
+
+            if should_log:
+                loss = float(metrics["loss"])
+                losses.append(loss)
+                now = time.perf_counter()
+                dt = now - window_start
+                steps_in_window = (it % train_cfg.log_interval) + 1 \
+                    if (it + 1) % train_cfg.log_interval else train_cfg.log_interval
+                tokens_per_sec = window_tokens / dt
+                step_time_ms = dt / max(steps_in_window, 1) * 1e3
+                tflops = (tokens_per_sec *
+                          flops_per_token(model_cfg, train_cfg.seq_length)
+                          / ctx.num_devices / 1e12)
+                log_fn(
+                    f"iter {it+1:6d}/{train_cfg.train_iters} | "
+                    f"loss {loss:.4f} | grad_norm "
+                    f"{float(metrics['grad_norm']):.3f} | "
+                    f"lr {float(metrics['lr']):.2e} | "
+                    f"skipped {int(metrics['skipped'])} | "
+                    f"{step_time_ms:.1f} ms/step | "
+                    f"{tokens_per_sec:,.0f} tok/s | "
+                    f"{tflops:.1f} TFLOP/s/dev")
+                window_tokens = 0
+                window_start = now
+
+            if ckpt is not None and train_cfg.save_interval and \
+                    (it + 1) % train_cfg.save_interval == 0:
+                ckpt.save(it + 1, jax.device_get(state))
+
+            if train_cfg.exit_interval and \
+                    (it + 1) % train_cfg.exit_interval == 0:
+                break
+
+    if ckpt is not None:
+        final_step = int(jax.device_get(state["step"]))
+        if train_cfg.save_interval and ckpt.latest_step != final_step:
+            ckpt.save(final_step, jax.device_get(state), force=True)
+        ckpt.wait()
+        ckpt.close()
+    if train_cfg.trace:
+        tracer.finalize()
+
+    return TrainResult(state=state, losses=losses,
+                       tokens_per_sec=tokens_per_sec,
+                       step_time_ms=step_time_ms)

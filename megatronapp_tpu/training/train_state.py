@@ -1,0 +1,90 @@
+"""Train state container + sharded initialization.
+
+The state pytree is {'step', 'params', 'opt_state'}; optimizer state leaves
+inherit the corresponding parameter's sharding. ZeRO-1 semantics of the
+reference DistributedOptimizer (/root/reference/megatron/core/optimizer/
+distrib_optimizer.py:80) fall out of the rules: with
+ParallelConfig.distributed_optimizer the 'embed' axis of params and adam
+moments is sharded over dp — "shard optimizer state over DP" with XLA doing
+the reduce-scatter/all-gather the reference implements by hand
+(distrib_optimizer.py grad reduce-scatter + param all-gather).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.parallel.mesh import MeshContext
+from megatronapp_tpu.parallel.sharding import (
+    DEFAULT_RULES, FSDP_RULES, tree_logical_to_sharding,
+)
+
+
+def _is_axes(x):
+    return (isinstance(x, tuple) and
+            all(a is None or isinstance(a, str) for a in x))
+
+
+def _param_like(leaf, params_axes) -> bool:
+    """True if `leaf` is a pytree with the same structure as params."""
+    try:
+        return (jax.tree.structure(leaf) ==
+                jax.tree.structure(params_axes, is_leaf=_is_axes))
+    except Exception:
+        return False
+
+
+def state_logical_axes(params_axes, opt_state_struct) -> Any:
+    """Logical-axes pytree matching {'step','params','opt_state'}: optimizer
+    substates shaped like params reuse the params axes; scalars get ()."""
+    opt_axes = jax.tree.map(
+        lambda node: params_axes if _param_like(node, params_axes) else (),
+        opt_state_struct,
+        is_leaf=lambda n: _param_like(n, params_axes) or not isinstance(
+            n, (tuple, list, dict)) or not jax.tree.leaves(n),
+    )
+    return {"step": (), "params": params_axes, "opt_state": opt_axes}
+
+
+def pick_rules(ctx: MeshContext):
+    return (FSDP_RULES if (ctx.parallel.fsdp or
+                           ctx.parallel.distributed_optimizer)
+            else DEFAULT_RULES)
+
+
+def setup_train_state(rng, params_and_axes_fn: Callable, optimizer,
+                      ctx: MeshContext, rules=None) -> Tuple[Any, Any, Any]:
+    """jit-init the full state directly into its shardings (params never
+    materialize unsharded — parity with the reference's per-rank init).
+
+    params_and_axes_fn(rng) -> (params, logical_axes). Returns
+    (state, state_shardings, params_axes).
+    """
+    rules = rules or pick_rules(ctx)
+    # Logical axes are config-static python data; capture them during an
+    # abstract trace (no device arrays are materialized).
+    captured = {}
+
+    def _shapes_only(rng):
+        params, axes = params_and_axes_fn(rng)
+        captured["axes"] = axes
+        return params
+
+    jax.eval_shape(_shapes_only, rng)
+    params_axes = captured["axes"]
+
+    def _init(rng):
+        params, _ = params_and_axes_fn(rng)
+        opt_state = optimizer.init(params)
+        return {"step": jnp.zeros((), jnp.int32), "params": params,
+                "opt_state": opt_state}
+
+    state_struct = jax.eval_shape(_init, rng)
+    axes = state_logical_axes(params_axes, state_struct["opt_state"])
+    shardings = tree_logical_to_sharding(axes, ctx.mesh, rules)
+    with ctx.mesh:
+        state = jax.jit(_init, out_shardings=shardings)(rng)
+    return state, shardings, params_axes

@@ -1,0 +1,125 @@
+"""Self-attention sublayer (GQA, RoPE, optional QK-layernorm).
+
+Parity with /root/reference/megatron/core/transformer/attention.py:88
+(Attention / SelfAttention :845). The reference splits weights across TP
+ranks explicitly via ColumnParallelLinear/RowParallelLinear; here the kernels
+carry logical axes ('heads'/'kv_heads' → tp) and XLA partitions the matmuls.
+
+Param leaf layout (per layer, unstacked):
+  q_kernel   [H, n_heads*D]        logical ('embed', 'qkv')
+  kv_kernel  [H, 2*n_kv*D]         logical ('embed', 'qkv')
+  q_bias     [n_heads*D]           logical ('qkv',)
+  kv_bias    [2*n_kv*D]            logical ('qkv',)
+  out_kernel [n_heads*D, H]        logical ('qkv', 'embed')
+  out_bias   [H]                   logical ('embed',)
+  (optional) q_ln_scale, k_ln_scale [D]
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.ops.attention import dot_product_attention
+from megatronapp_tpu.ops.normalization import rms_norm
+from megatronapp_tpu.ops import rotary
+from megatronapp_tpu.scope.hooks import scope_capture
+
+
+def init_attention_params(rng, cfg: TransformerConfig, out_std: float):
+    h = cfg.hidden_size
+    d = cfg.head_dim
+    nq, nkv = cfg.num_attention_heads, cfg.num_query_groups
+    keys = jax.random.split(rng, 3)
+    std = cfg.init_method_std
+    p = {
+        "q_kernel": jax.random.normal(keys[0], (h, nq * d), cfg.params_dtype) * std,
+        "kv_kernel": jax.random.normal(keys[1], (h, 2 * nkv * d), cfg.params_dtype) * std,
+        "out_kernel": jax.random.normal(keys[2], (nq * d, h), cfg.params_dtype) * out_std,
+    }
+    ax = {
+        "q_kernel": ("embed", "qkv"),
+        "kv_kernel": ("embed", "qkv"),
+        "out_kernel": ("qkv", "embed"),
+    }
+    if cfg.add_qkv_bias:
+        p["q_bias"] = jnp.zeros((nq * d,), cfg.params_dtype)
+        p["kv_bias"] = jnp.zeros((2 * nkv * d,), cfg.params_dtype)
+        ax["q_bias"] = ("qkv",)
+        ax["kv_bias"] = ("qkv",)
+    if cfg.add_bias_linear:
+        p["out_bias"] = jnp.zeros((h,), cfg.params_dtype)
+        ax["out_bias"] = ("embed",)
+    if cfg.qk_layernorm:
+        p["q_ln_scale"] = jnp.ones((d,), cfg.params_dtype)
+        p["k_ln_scale"] = jnp.ones((d,), cfg.params_dtype)
+        ax["q_ln_scale"] = ("head_dim",)
+        ax["k_ln_scale"] = ("head_dim",)
+    return p, ax
+
+
+def attention_forward(
+    p, x: jnp.ndarray, cfg: TransformerConfig,
+    rope_cos: Optional[jnp.ndarray] = None,
+    rope_sin: Optional[jnp.ndarray] = None,
+    attention_mask: Optional[jnp.ndarray] = None,
+    kv_cache=None, cache_index=None,
+    layer_id=None,
+) -> jnp.ndarray:
+    """x: [B, S, H] → [B, S, H]. Returns (out, new_kv_cache)."""
+    b, s, h = x.shape
+    d = cfg.head_dim
+    nq, nkv = cfg.num_attention_heads, cfg.num_query_groups
+    x = x.astype(cfg.compute_dtype)
+
+    q = x @ p["q_kernel"].astype(cfg.compute_dtype)
+    kv = x @ p["kv_kernel"].astype(cfg.compute_dtype)
+    if "q_bias" in p:
+        q = q + p["q_bias"].astype(cfg.compute_dtype)
+        kv = kv + p["kv_bias"].astype(cfg.compute_dtype)
+    q = q.reshape(b, s, nq, d)
+    k, v = jnp.split(kv.reshape(b, s, 2 * nkv, d), 2, axis=2)
+
+    # MegaScope QKV capture site (reference attention.py:979-981).
+    q = scope_capture("qkv_q", q, layer_id)
+    k = scope_capture("qkv_k", k, layer_id)
+    v = scope_capture("qkv_v", v, layer_id)
+
+    if cfg.qk_layernorm:
+        q = rms_norm(q, p["q_ln_scale"], cfg.layernorm_epsilon)
+        k = rms_norm(k, p["k_ln_scale"], cfg.layernorm_epsilon)
+
+    q_offset = 0
+    if rope_cos is not None:
+        q = rotary.apply_rope(q, rope_cos, rope_sin)
+        k = rotary.apply_rope(k, rope_cos, rope_sin)
+
+    new_cache = None
+    if kv_cache is not None:
+        # Decode path: append k,v at cache_index (static_context.py analogue).
+        ck, cv = kv_cache
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_index, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, cache_index, axis=1)
+        k, v = ck, cv
+        new_cache = (ck, cv)
+        q_offset = cache_index
+
+    # Note: the reference's apply_query_key_layer_scaling is numerically
+    # neutral (it divides QK by layer_number for fp16 range safety and
+    # multiplies it back inside the fused softmax). We always softmax in
+    # fp32, so no scaling is needed — the flag is accepted for config parity
+    # and intentionally has no effect on the math.
+    ctx = dot_product_attention(
+        q, k, v, mask_type=cfg.attn_mask_type,
+        attention_mask=attention_mask, softmax_scale=None,
+        softmax_in_fp32=cfg.attention_softmax_in_fp32,
+        q_offset=q_offset)
+    ctx = scope_capture("context", ctx, layer_id)
+
+    out = ctx.reshape(b, s, nq * d) @ p["out_kernel"].astype(cfg.compute_dtype)
+    if "out_bias" in p:
+        out = out + p["out_bias"].astype(cfg.compute_dtype)
+    return (out, new_cache) if kv_cache is not None else (out, None)

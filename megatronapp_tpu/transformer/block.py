@@ -1,0 +1,190 @@
+"""Transformer layer + block (scan-over-layers).
+
+Parity with /root/reference/megatron/core/transformer/transformer_layer.py:237
+(TransformerLayer) and transformer_block.py:220 (TransformerBlock). The
+reference builds a Python list of layer modules and loops; here per-layer
+params are *stacked* along a leading 'layers' axis and the block runs
+``jax.lax.scan`` over them — one compiled layer body regardless of depth
+(TPU-first: fast compiles, natural fit for pipeline chunking and remat).
+
+Pre-LN residual structure (reference: input_layernorm → attn → +residual →
+pre_mlp_layernorm → mlp → +residual).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import (
+    NormKind, TransformerConfig,
+)
+from megatronapp_tpu.ops.normalization import apply_norm
+from megatronapp_tpu.transformer.attention import (
+    attention_forward, init_attention_params,
+)
+from megatronapp_tpu.transformer.mlp import init_mlp_params, mlp_forward
+from megatronapp_tpu.transformer.moe import init_moe_params, moe_forward
+from megatronapp_tpu.scope.hooks import scope_capture
+
+
+def init_layer_params(rng, cfg: TransformerConfig, force_dense: bool = False):
+    """One layer's params + logical axes (unstacked)."""
+    # Scaled init for residual-out projections: std/sqrt(2*num_layers)
+    # (reference scaled_init_method_normal, training/utils).
+    out_std = cfg.init_method_std / jnp.sqrt(2.0 * cfg.num_layers)
+    k_attn, k_mlp = jax.random.split(rng)
+    attn_p, attn_ax = init_attention_params(k_attn, cfg, out_std)
+    p = {
+        "ln1_scale": jnp.ones((cfg.hidden_size,), cfg.params_dtype),
+        "ln2_scale": jnp.ones((cfg.hidden_size,), cfg.params_dtype),
+        "attention": attn_p,
+    }
+    ax = {
+        "ln1_scale": ("embed",),
+        "ln2_scale": ("embed",),
+        "attention": attn_ax,
+    }
+    if cfg.normalization == NormKind.layernorm:
+        p["ln1_bias"] = jnp.zeros((cfg.hidden_size,), cfg.params_dtype)
+        p["ln2_bias"] = jnp.zeros((cfg.hidden_size,), cfg.params_dtype)
+        ax["ln1_bias"] = ("embed",)
+        ax["ln2_bias"] = ("embed",)
+    if cfg.is_moe and not force_dense:
+        p["moe"], ax["moe"] = init_moe_params(k_mlp, cfg, out_std)
+    else:
+        p["mlp"], ax["mlp"] = init_mlp_params(k_mlp, cfg, out_std)
+    return p, ax
+
+
+def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
+                  rope_cos=None, rope_sin=None, attention_mask=None,
+                  layer_id=None, kv_cache=None, cache_index=None):
+    """One transformer layer. x: [B,S,H] → ((out, new_cache), aux_losses)."""
+    residual = x
+    h = apply_norm(cfg.normalization, x, p["ln1_scale"], p.get("ln1_bias"),
+                   cfg.layernorm_epsilon)
+    attn_out, new_cache = attention_forward(
+        p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
+        kv_cache=kv_cache, cache_index=cache_index, layer_id=layer_id)
+    x = residual + attn_out.astype(residual.dtype)
+
+    residual = x
+    h = apply_norm(cfg.normalization, x, p["ln2_scale"], p.get("ln2_bias"),
+                   cfg.layernorm_epsilon)
+    aux = None
+    if "moe" in p:
+        mlp_out, aux = moe_forward(p["moe"], h, cfg, layer_id=layer_id)
+    else:
+        mlp_out = mlp_forward(p["mlp"], h, cfg, layer_id=layer_id)
+    x = residual + mlp_out.astype(residual.dtype)
+    # MegaScope 'system' perturbation site between layers
+    # (transformer_block.py:542-544).
+    x = scope_capture("between_layers", x, layer_id)
+    return (x, new_cache), aux
+
+
+def _remat_wrap(fn, policy: str):
+    if policy == "full":
+        return jax.checkpoint(fn, policy=jax.checkpoint_policies.nothing_saveable)
+    if policy == "selective":
+        # Save matmul outputs, recompute the rest (attention softmax etc.) —
+        # semantics of the reference --recompute-activations selective mode.
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    return fn
+
+
+def _is_axes(x):
+    return isinstance(x, tuple) and all(
+        a is None or isinstance(a, str) for a in x)
+
+
+def _stack_layers(per_layer, extra_axis: str = "layers"):
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
+                           *[p for p, _ in per_layer])
+    ax = jax.tree.map(lambda axes: (extra_axis,) + axes, per_layer[0][1],
+                      is_leaf=_is_axes)
+    return stacked, ax
+
+
+def init_block_params(rng, cfg: TransformerConfig, num_layers: int = None):
+    """Stacked layer params for lax.scan.
+
+    Uniform case: every leaf gains a leading [L] 'layers' axis.
+    moe_layer_freq > 1 (reference transformer_config moe_layer_freq int
+    pattern — layer i is MoE iff i % freq == 0): layers are grouped into
+    L/freq scan units of {1 MoE layer + (freq-1) dense layers}, stacked as
+    {'moe': [G,...], 'dense': [G, freq-1, ...]} so the scan body stays
+    uniform (TPU-first: one compiled group body).
+    """
+    n = num_layers or cfg.num_layers
+    freq = cfg.moe_layer_freq if cfg.is_moe else 1
+    if freq == 1:
+        keys = jax.random.split(rng, n)
+        return _stack_layers([init_layer_params(k, cfg) for k in keys])
+
+    if n % freq != 0:
+        raise ValueError(f"num_layers={n} not divisible by "
+                         f"moe_layer_freq={freq}")
+    groups = n // freq
+    keys = jax.random.split(rng, n)
+    moe_layers, dense_groups = [], []
+    for g in range(groups):
+        moe_layers.append(init_layer_params(keys[g * freq], cfg))
+        dense = [init_layer_params(keys[g * freq + 1 + j], cfg,
+                                   force_dense=True)
+                 for j in range(freq - 1)]
+        dense_groups.append(_stack_layers(dense, extra_axis="stage_layers"))
+    moe_p, moe_ax = _stack_layers(moe_layers)
+    dense_p, dense_ax = _stack_layers(dense_groups, extra_axis="layers")
+    return ({"moe": moe_p, "dense": dense_p},
+            {"moe": moe_ax, "dense": dense_ax})
+
+
+def block_forward(stacked_p, x: jnp.ndarray, cfg: TransformerConfig,
+                  rope_cos=None, rope_sin=None, attention_mask=None,
+                  layer_offset: int = 0):
+    """Run all stacked layers via lax.scan. Returns (x, moe_aux_sum)."""
+    hetero = isinstance(stacked_p, dict) and "dense" in stacked_p
+
+    def run_layer(layer_p, h, lid):
+        (h2, _), aux = layer_forward(
+            layer_p, h, cfg, rope_cos, rope_sin, attention_mask,
+            layer_id=lid)
+        return h2, (aux if aux is not None
+                    else jnp.zeros((), jnp.float32))
+
+    if not hetero:
+        def body(carry, layer_p):
+            h, lid = carry
+            h2, aux = run_layer(layer_p, h, lid)
+            return (h2, lid + 1), aux
+
+        body = _remat_wrap(body, cfg.remat_policy)
+        (x, _), aux = jax.lax.scan(
+            body, (x, jnp.int32(layer_offset)), stacked_p)
+        return x, jnp.sum(aux)
+
+    freq = cfg.moe_layer_freq
+
+    def group_body(carry, group_p):
+        h, lid = carry
+        h, aux_moe = run_layer(group_p["moe"], h, lid)
+
+        def dense_body(inner, layer_p):
+            hh, l = inner
+            hh, a = run_layer(layer_p, hh, l)
+            return (hh, l + 1), a
+
+        (h, _), aux_dense = jax.lax.scan(
+            dense_body, (h, lid + 1), group_p["dense"])
+        return (h, lid + freq), aux_moe + jnp.sum(aux_dense)
+
+    group_body = _remat_wrap(group_body, cfg.remat_policy)
+    (x, _), aux = jax.lax.scan(
+        group_body, (x, jnp.int32(layer_offset)), stacked_p)
+    return x, jnp.sum(aux)

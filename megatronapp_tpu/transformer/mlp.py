@@ -1,0 +1,60 @@
+"""MLP sublayer (dense FFN, gated variants).
+
+Parity with /root/reference/megatron/core/transformer/mlp.py:32 (MLP with
+ColumnParallelLinear fc1 → activation → RowParallelLinear fc2). TP falls out
+of the 'mlp' logical axis; gated activations fuse gate+value into one fc1
+matmul exactly like the reference's ``gated_linear_unit`` path.
+
+Param leaf layout:
+  fc1_kernel [H, F] or [H, 2F] (gated)   logical ('embed','mlp')
+  fc1_bias   [F] / [2F]                  logical ('mlp',)
+  fc2_kernel [F, H]                      logical ('mlp','embed')
+  fc2_bias   [H]                         logical ('embed',)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.ops.activations import apply_activation, is_gated
+from megatronapp_tpu.scope.hooks import scope_capture
+
+
+def init_mlp_params(rng, cfg: TransformerConfig, out_std: float,
+                    ffn_hidden: int = None):
+    h = cfg.hidden_size
+    f = ffn_hidden or cfg.ffn_hidden_size
+    k1, k2 = jax.random.split(rng)
+    std = cfg.init_method_std
+    fc1_out = 2 * f if is_gated(cfg.activation) else f
+    p = {
+        "fc1_kernel": jax.random.normal(k1, (h, fc1_out), cfg.params_dtype) * std,
+        "fc2_kernel": jax.random.normal(k2, (f, h), cfg.params_dtype) * out_std,
+    }
+    ax = {"fc1_kernel": ("embed", "mlp"), "fc2_kernel": ("mlp", "embed")}
+    if cfg.add_bias_linear:
+        p["fc1_bias"] = jnp.zeros((fc1_out,), cfg.params_dtype)
+        p["fc2_bias"] = jnp.zeros((h,), cfg.params_dtype)
+        ax["fc1_bias"] = ("mlp",)
+        ax["fc2_bias"] = ("embed",)
+    return p, ax
+
+
+def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None):
+    x = x.astype(cfg.compute_dtype)
+    y = x @ p["fc1_kernel"].astype(cfg.compute_dtype)
+    if "fc1_bias" in p:
+        y = y + p["fc1_bias"].astype(cfg.compute_dtype)
+    y = scope_capture("mlp1", y, layer_id)
+    if is_gated(cfg.activation):
+        gate, val = jnp.split(y, 2, axis=-1)
+        y = apply_activation(cfg.activation, val, gate)
+    else:
+        y = apply_activation(cfg.activation, y)
+    out = y @ p["fc2_kernel"].astype(cfg.compute_dtype)
+    if "fc2_bias" in p:
+        out = out + p["fc2_bias"].astype(cfg.compute_dtype)
+    out = scope_capture("mlp2", out, layer_id)
+    return out

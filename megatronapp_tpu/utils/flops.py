@@ -1,0 +1,57 @@
+"""FLOPs accounting for throughput/MFU logging.
+
+Parity with /root/reference/megatron/training/training.py:142
+(num_floating_point_operations): counts dense matmul + attention + logit
+FLOPs per token for the standard transformer; used by training_log to report
+TFLOP/s/device and by bench.py for MFU.
+"""
+
+from __future__ import annotations
+
+from megatronapp_tpu.config.transformer_config import (
+    ActivationKind, TransformerConfig,
+)
+
+# Peak bf16 FLOP/s per chip for MFU math (TPU v5e ≈ 394 TFLOP/s bf16;
+# v5p ≈ 459; override with the actual platform at call sites if known).
+TPU_PEAK_FLOPS = {
+    "v5litepod": 394e12,
+    "v5 lite": 394e12,
+    "v5e": 394e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+    "cpu": 1e12,
+}
+
+
+def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
+    """Forward+backward FLOPs per token (3x forward matmul FLOPs)."""
+    h = cfg.hidden_size
+    d = cfg.head_dim
+    nq, nkv = cfg.num_attention_heads, cfg.num_query_groups
+    l = cfg.num_layers
+
+    # Attention projections: Q + KV + out.
+    proj = 2 * h * (nq * d) + 2 * h * (2 * nkv * d) + 2 * (nq * d) * h
+    # Attention scores + context: 2 * S * (nq*d) each (per token, seq_len kv).
+    attn = 2 * 2 * seq_len * nq * d
+    # MLP.
+    f = cfg.ffn_hidden_size
+    if cfg.is_moe:
+        f_active = cfg.moe_ffn_hidden_size * cfg.moe_router_topk
+        if cfg.moe_shared_expert_intermediate_size:
+            f_active += cfg.moe_shared_expert_intermediate_size
+        f = f_active
+    gated = cfg.activation in (ActivationKind.swiglu, ActivationKind.geglu)
+    mlp = (3 if gated else 2) * 2 * h * f
+    per_layer = proj + attn + mlp
+    logits = 2 * h * cfg.vocab_size
+    fwd = l * per_layer + logits
+    return 3.0 * fwd  # fwd + bwd (2x fwd)
+
+
+def mfu(tokens_per_sec_per_chip: float, cfg: TransformerConfig,
+        seq_len: int, peak_flops: float) -> float:
+    return tokens_per_sec_per_chip * flops_per_token(cfg, seq_len) / peak_flops

@@ -1,0 +1,31 @@
+"""Test configuration: 8 virtual CPU devices for multi-device mesh tests.
+
+Mirrors the reference test strategy (SURVEY §4): the reference launches 8
+real GPU ranks per node and reconfigures logical TP×PP×DP combos against
+them (tests/unit_tests/test_utilities.py:27-80 Utils); here a single host
+exposes 8 virtual CPU devices via --xla_force_host_platform_device_count and
+tests build meshes of any factorization over them.
+"""
+
+import os
+
+# Must be set before jax initializes its backends.
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# The axon TPU plugin (sitecustomize) force-sets jax_platforms='axon,cpu';
+# override back to cpu for the unit-test mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]

@@ -1,0 +1,118 @@
+"""GPT model unit tests (mirrors tests/unit_tests/models/test_gpt_model.py
+in the reference — forward shape, causality, config variants)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatronapp_tpu.config.transformer_config import (
+    ActivationKind, NormKind, PositionEmbeddingKind, TransformerConfig,
+)
+from megatronapp_tpu.models.gpt import gpt_forward, gpt_loss, init_gpt_params
+
+
+def small_cfg(**kw):
+    defaults = dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+                    vocab_size=128, max_position_embeddings=64,
+                    remat_policy="none")
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
+class TestGPTModel:
+    def test_forward_shape_and_dtype(self):
+        cfg = small_cfg()
+        p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits, aux = gpt_forward(p, tokens, cfg)
+        assert logits.shape == (2, 16, 128)
+        assert logits.dtype == jnp.float32
+
+    def test_causality(self):
+        cfg = small_cfg()
+        p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        t1 = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 128)
+        t2 = t1.at[0, -1].set((t1[0, -1] + 1) % 128)
+        l1, _ = gpt_forward(p, t1, cfg)
+        l2, _ = gpt_forward(p, t2, cfg)
+        np.testing.assert_allclose(np.asarray(l1[:, :-1]),
+                                   np.asarray(l2[:, :-1]), atol=1e-4)
+
+    @pytest.mark.parametrize("variant", ["llama", "gpt2", "moe", "gqa"])
+    def test_variants_run(self, variant):
+        kw = {}
+        if variant == "llama":
+            kw = dict(activation=ActivationKind.swiglu,
+                      normalization=NormKind.rmsnorm,
+                      add_bias_linear=False,
+                      untie_embeddings_and_output_weights=True)
+        elif variant == "gpt2":
+            kw = dict(position_embedding=PositionEmbeddingKind.learned_absolute,
+                      add_qkv_bias=True)
+        elif variant == "moe":
+            kw = dict(num_moe_experts=4, moe_aux_loss_coeff=0.01,
+                      moe_z_loss_coeff=1e-3)
+        elif variant == "gqa":
+            kw = dict(num_query_groups=2, qk_layernorm=True)
+        cfg = small_cfg(**kw)
+        p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        loss, metrics = gpt_loss(p, tokens, tokens, None, cfg)
+        assert bool(jnp.isfinite(loss))
+
+    def test_moe_layer_freq(self):
+        """moe_layer_freq=2 interleaves MoE and dense layers (layer i is MoE
+        iff i % freq == 0) via the group-scan path."""
+        cfg = small_cfg(num_layers=4, num_moe_experts=4, moe_layer_freq=2,
+                        moe_aux_loss_coeff=0.01)
+        p, ax = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        blk = p["block"]
+        assert set(blk.keys()) == {"moe", "dense"}
+        # 2 groups of (1 moe + 1 dense).
+        assert blk["moe"]["moe"]["fc1_kernel"].shape[0] == 2
+        assert blk["dense"]["mlp"]["fc1_kernel"].shape[:2] == (2, 1)
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        loss, metrics = gpt_loss(p, tokens, tokens, None, cfg)
+        assert bool(jnp.isfinite(loss))
+        assert float(metrics["moe_aux_loss"]) > 0
+        g = jax.grad(lambda p: gpt_loss(p, tokens, tokens, None, cfg)[0])(p)
+        assert bool(jnp.any(g["block"]["dense"]["mlp"]["fc1_kernel"] != 0))
+
+    def test_yarn_differs_from_rope(self):
+        cfg_r = small_cfg()
+        cfg_y = small_cfg(position_embedding=PositionEmbeddingKind.yarn,
+                          rope_scaling_factor=8.0,
+                          yarn_original_max_position=16)
+        p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg_r)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 32), 0, 128)
+        lr, _ = gpt_forward(p, tokens, cfg_r)
+        ly, _ = gpt_forward(p, tokens, cfg_y)
+        assert not np.allclose(np.asarray(lr), np.asarray(ly), atol=1e-3)
+
+    def test_remat_matches_no_remat(self):
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 128)
+        losses = {}
+        for policy in ("none", "full", "selective"):
+            cfg = small_cfg(remat_policy=policy)
+            p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+            loss, _ = gpt_loss(p, tokens, tokens, None, cfg)
+            g = jax.grad(lambda p: gpt_loss(p, tokens, tokens, None, cfg)[0])(p)
+            losses[policy] = (float(loss),
+                              float(jnp.sum(jnp.abs(g["block"]["ln1_scale"]))))
+        for policy in ("full", "selective"):
+            np.testing.assert_allclose(losses[policy], losses["none"],
+                                       rtol=1e-5)
+
+    def test_logical_axes_cover_params(self):
+        cfg = small_cfg()
+        p, ax = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        is_axes = lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x)
+        assert (jax.tree.structure(p) ==
+                jax.tree.structure(ax, is_leaf=is_axes))
+        # Every leaf's axes tuple rank matches the param rank.
+        flat_p = jax.tree.leaves(p)
+        flat_ax = jax.tree.leaves(ax, is_leaf=is_axes)
+        for leaf, axes in zip(flat_p, flat_ax):
+            assert leaf.ndim == len(axes), (leaf.shape, axes)
