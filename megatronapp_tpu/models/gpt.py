@@ -24,8 +24,13 @@ from megatronapp_tpu.transformer.block import block_forward, init_block_params
 from megatronapp_tpu.scope.hooks import scope_capture
 
 
-def init_gpt_params(rng, cfg: TransformerConfig):
-    """Returns (params, logical_axes) pytrees."""
+def init_gpt_params(rng, cfg: TransformerConfig, pp: int = 1, vpp: int = 1):
+    """Returns (params, logical_axes) pytrees.
+
+    pp > 1: block params are stored in the pipeline layout
+    [pp, vpp, L/(pp*vpp), ...] (sharded over the pp mesh axis) with the
+    interleaved chunk→stage assignment — see parallel/pipeline.py.
+    """
     k_emb, k_pos, k_block, k_out = jax.random.split(rng, 4)
     std = cfg.init_method_std
     p = {
@@ -48,6 +53,23 @@ def init_gpt_params(rng, cfg: TransformerConfig):
         p["final_ln_bias"] = jnp.zeros((cfg.hidden_size,), cfg.params_dtype)
         ax["final_ln_bias"] = ("embed",)
     p["block"], ax["block"] = init_block_params(k_block, cfg)
+    if pp > 1:
+        from megatronapp_tpu.parallel.pipeline import (
+            reshape_params_for_pipeline,
+        )
+        if cfg.is_moe and cfg.moe_layer_freq > 1:
+            raise NotImplementedError(
+                "pipeline parallelism with moe_layer_freq > 1 group-scan "
+                "layout is not supported yet")
+        if cfg.num_layers % (pp * vpp) != 0:
+            raise ValueError(
+                f"num_layers={cfg.num_layers} not divisible by "
+                f"pp*vpp={pp * vpp}")
+        p["block"] = reshape_params_for_pipeline(p["block"], pp, vpp)
+        from megatronapp_tpu.parallel.sharding import is_logical_axes
+        ax["block"] = jax.tree.map(
+            lambda axes: ("pp_stage", "vpp_chunk", "stage_layers") + axes[1:],
+            ax["block"], is_leaf=is_logical_axes)
     if cfg.untie_embeddings_and_output_weights:
         p["output"] = jax.random.normal(
             k_out, (cfg.hidden_size, cfg.vocab_size), cfg.params_dtype) * std
@@ -56,7 +78,7 @@ def init_gpt_params(rng, cfg: TransformerConfig):
 
 
 def gpt_embed(p, tokens: jnp.ndarray, cfg: TransformerConfig,
-              position_offset: int = 0) -> jnp.ndarray:
+              position_offset: int = 0, dtype=None) -> jnp.ndarray:
     """tokens [B,S] → embeddings [B,S,H] (vocab axis tp-sharded: XLA handles
     the sharded gather; reference VocabParallelEmbedding layers.py:172)."""
     h = jnp.take(p["embedding"]["word"], tokens, axis=0)
@@ -64,7 +86,7 @@ def gpt_embed(p, tokens: jnp.ndarray, cfg: TransformerConfig,
         s = tokens.shape[1]
         pos = jnp.arange(s) + position_offset
         h = h + jnp.take(p["embedding"]["pos"], pos, axis=0)
-    return h.astype(cfg.compute_dtype)
+    return h.astype(dtype or cfg.compute_dtype)
 
 
 def gpt_rope_tables(cfg: TransformerConfig, seq_len: int,
@@ -97,13 +119,7 @@ def gpt_forward(p, tokens: jnp.ndarray, cfg: TransformerConfig,
     h = gpt_embed(p, tokens, cfg, position_offset)
     cos, sin = gpt_rope_tables(cfg, s, position_offset)
     h, aux = block_forward(p["block"], h, cfg, cos, sin, attention_mask)
-    h = apply_norm(cfg.normalization, h, p["final_ln_scale"],
-                   p.get("final_ln_bias"), cfg.layernorm_epsilon)
-    out_kernel = (p["output"] if "output" in p
-                  else p["embedding"]["word"].T)
-    logits = h.astype(cfg.compute_dtype) @ out_kernel.astype(cfg.compute_dtype)
-    logits = scope_capture("result", logits)
-    return logits.astype(jnp.float32), aux
+    return gpt_head(p, h, cfg), aux
 
 
 def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
@@ -112,4 +128,49 @@ def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
     (/root/reference/pretrain_gpt.py:159)."""
     logits, aux = gpt_forward(p, tokens, cfg)
     loss, _ = cross_entropy_loss(logits, targets, loss_mask)
+    return loss + aux, {"lm_loss": loss, "moe_aux_loss": aux}
+
+
+def gpt_head(p, h: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
+    """Final norm + vocab projection. h [..., S, H] → logits fp32."""
+    h = apply_norm(cfg.normalization, h, p["final_ln_scale"],
+                   p.get("final_ln_bias"), cfg.layernorm_epsilon)
+    out_kernel = (p["output"] if "output" in p
+                  else p["embedding"]["word"].T)
+    logits = h.astype(cfg.compute_dtype) @ out_kernel.astype(cfg.compute_dtype)
+    logits = scope_capture("result", logits)
+    return logits.astype(jnp.float32)
+
+
+def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
+                      cfg: TransformerConfig, ctx, vpp: int = 1):
+    """Pipelined training loss over microbatched inputs [M, mb, S].
+
+    Embedding and LM head run outside the pipeline body (compiler-sharded
+    over dp/tp); the layer stack runs inside spmd_pipeline over the pp axis.
+    The reference runs its schedules imperatively per rank
+    (schedules.py:1918 1F1B); here the schedule is one jitted scan.
+    """
+    from megatronapp_tpu.parallel.pipeline import spmd_pipeline
+
+    m, mb, s = tokens_mb.shape
+    # fp32 across the shard_map boundary (spmd_pipeline casts to the compute
+    # dtype at microbatch injection — see pipeline.py body notes).
+    h = gpt_embed(p, tokens_mb.reshape(m * mb, s), cfg, dtype=jnp.float32)
+    h = h.reshape(m, mb, s, -1)
+    cos, sin = gpt_rope_tables(cfg, s)
+
+    def stage_fn(chunk_params, x, layer_offset):
+        return block_forward(chunk_params, x, cfg, cos, sin, None,
+                             layer_offset=layer_offset)
+
+    out_mb, aux = spmd_pipeline(
+        stage_fn, p["block"], h, ctx, num_microbatches=m, vpp=vpp,
+        compute_dtype=cfg.compute_dtype)
+    # Aux losses are summed over the M microbatches inside the pipeline;
+    # normalize to per-microbatch scale to match the non-pipelined path.
+    aux = aux / m
+
+    logits = gpt_head(p, out_mb, cfg)
+    loss, _ = cross_entropy_loss(logits, targets_mb, loss_mask_mb)
     return loss + aux, {"lm_loss": loss, "moe_aux_loss": aux}

@@ -51,6 +51,10 @@ DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
     # reshapes to [pp, layers/pp, ...] inside shard_map); in the non-pipelined
     # path layers live whole on every pp group (pp=1).
     ("layers", None),
+    # Pipeline param layout [pp, vpp, Lc, ...] (parallel/pipeline.py
+    # reshape_params_for_pipeline): stage axis sharded over pp.
+    ("pp_stage", PP_AXIS),
+    ("vpp_chunk", None),
     ("stage_layers", None),
     ("batch", (DP_AXIS, EP_AXIS)),
     ("seq", CP_AXIS),
@@ -68,6 +72,14 @@ FSDP_RULES: Tuple[Tuple[str, Any], ...] = tuple(
 
 def rules_dict(rules=DEFAULT_RULES) -> Dict[str, Any]:
     return dict(rules)
+
+
+def is_logical_axes(x) -> bool:
+    """Leaf predicate for logical-axes pytrees: a tuple of axis names/None.
+    The single canonical copy — jax.tree.map over axes trees must use this
+    as is_leaf everywhere or the tuples get flattened into strings."""
+    return isinstance(x, tuple) and all(
+        a is None or isinstance(a, str) for a in x)
 
 
 def logical_to_spec(logical_axes: Tuple[Optional[str], ...],

@@ -22,7 +22,9 @@ from megatronapp_tpu.config.training_config import (
 )
 from megatronapp_tpu.config.transformer_config import TransformerConfig
 from megatronapp_tpu.data.mock import mock_batches
-from megatronapp_tpu.models.gpt import gpt_loss, init_gpt_params
+from megatronapp_tpu.models.gpt import (
+    gpt_loss, gpt_pipeline_loss, init_gpt_params,
+)
 from megatronapp_tpu.parallel.mesh import MeshContext, build_mesh
 from megatronapp_tpu.training.checkpointing import CheckpointManager
 from megatronapp_tpu.training.optimizer import get_optimizer
@@ -74,9 +76,10 @@ def pretrain_gpt(
 
     optimizer = get_optimizer(opt_cfg, train_cfg.train_iters)
     rng = jax.random.PRNGKey(train_cfg.seed)
+    vpp = parallel_cfg.virtual_pipeline_parallel
 
     def params_and_axes(rng):
-        return init_gpt_params(rng, model_cfg)
+        return init_gpt_params(rng, model_cfg, pp=ctx.pp, vpp=vpp)
 
     state, shardings, params_axes = setup_train_state(
         rng, params_and_axes, optimizer, ctx)
@@ -111,10 +114,17 @@ def pretrain_gpt(
             train_cfg.global_batch_size, seed=train_cfg.seed,
             start_idx=start_step * train_cfg.global_batch_size)
 
-    loss_fn = gpt_microbatch_loss(model_cfg)
+    if ctx.pp > 1:
+        def loss_fn(params, batch_mb):
+            return gpt_pipeline_loss(
+                params, batch_mb["tokens"], batch_mb["labels"],
+                batch_mb["loss_mask"], model_cfg, ctx, vpp=vpp)
+    else:
+        loss_fn = gpt_microbatch_loss(model_cfg)
     step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
                               train_cfg.train_iters,
-                              check_nan=train_cfg.check_for_nan_in_loss)
+                              check_nan=train_cfg.check_for_nan_in_loss,
+                              pipeline=ctx.pp > 1)
 
     tracer = get_tracer()
     if train_cfg.trace:

@@ -23,9 +23,7 @@ from megatronapp_tpu.parallel.sharding import (
 )
 
 
-def _is_axes(x):
-    return (isinstance(x, tuple) and
-            all(a is None or isinstance(a, str) for a in x))
+from megatronapp_tpu.parallel.sharding import is_logical_axes as _is_axes
 
 
 def _param_like(leaf, params_axes) -> bool:

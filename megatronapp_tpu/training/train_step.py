@@ -39,11 +39,16 @@ def make_train_step(
     state_shardings,
     train_iters: int,
     check_nan: bool = True,
+    pipeline: bool = False,
 ):
     """loss_fn(params, microbatch_dict) -> (loss, metrics_dict).
 
     Returns jitted step(state, batch) -> (state, metrics); batch arrays are
-    [num_micro, global_batch, seq].
+    [num_micro, global_batch, seq]. In pipeline mode, loss_fn consumes the
+    whole microbatched batch at once (the pipeline schedules microbatches
+    internally — parallel/pipeline.py); otherwise a lax.scan accumulates
+    grads microbatch by microbatch (reference
+    forward_backward_no_pipelining, schedules.py:618).
     """
     sched = lr_schedule(opt_cfg, train_iters)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -52,27 +57,32 @@ def make_train_step(
         params = state["params"]
         num_micro = batch["tokens"].shape[0]
 
-        def accum(carry, micro):
-            g_acc, loss_acc, aux_acc = carry
-            (loss, metrics), g = grad_fn(params, micro)
-            g_acc = jax.tree.map(
-                lambda a, b: a + b.astype(a.dtype), g_acc, g)
-            return (g_acc, loss_acc + loss,
-                    jax.tree.map(lambda a, b: a + b, aux_acc, metrics)), None
+        if pipeline:
+            (loss, aux), grads = grad_fn(params, batch)
+        else:
+            def accum(carry, micro):
+                g_acc, loss_acc, aux_acc = carry
+                (loss, metrics), g = grad_fn(params, micro)
+                g_acc = jax.tree.map(
+                    lambda a, b: a + b.astype(a.dtype), g_acc, g)
+                return (g_acc, loss_acc + loss,
+                        jax.tree.map(lambda a, b: a + b, aux_acc,
+                                     metrics)), None
 
-        zeros = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        metrics_struct = jax.eval_shape(
-            lambda: loss_fn(params, jax.tree.map(lambda x: x[0], batch))[1])
-        aux_zeros = jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), metrics_struct)
-        (g_sum, loss_sum, aux_sum), _ = jax.lax.scan(
-            accum, (zeros, jnp.zeros((), jnp.float32), aux_zeros), batch)
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            metrics_struct = jax.eval_shape(
+                lambda: loss_fn(params,
+                                jax.tree.map(lambda x: x[0], batch))[1])
+            aux_zeros = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), metrics_struct)
+            (g_sum, loss_sum, aux_sum), _ = jax.lax.scan(
+                accum, (zeros, jnp.zeros((), jnp.float32), aux_zeros), batch)
 
-        inv = 1.0 / num_micro
-        grads = jax.tree.map(lambda g: g * inv, g_sum)
-        loss = loss_sum * inv
-        aux = jax.tree.map(lambda a: a * inv, aux_sum)
+            inv = 1.0 / num_micro
+            grads = jax.tree.map(lambda g: g * inv, g_sum)
+            loss = loss_sum * inv
+            aux = jax.tree.map(lambda a: a * inv, aux_sum)
 
         grad_norm = global_grad_norm(grads)
         finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)

@@ -98,9 +98,7 @@ def _remat_wrap(fn, policy: str):
     return fn
 
 
-def _is_axes(x):
-    return isinstance(x, tuple) and all(
-        a is None or isinstance(a, str) for a in x)
+from megatronapp_tpu.parallel.sharding import is_logical_axes as _is_axes
 
 
 def _stack_layers(per_layer, extra_axis: str = "layers"):
