@@ -122,6 +122,11 @@ class TransformerConfig:
     # --recompute-activations semantics, arguments.py recompute group).
     remat_policy: str = "selective"
 
+    # Context-parallel attention mode (reference cp_comm_type,
+    # transformer_config.py:458-462): 'p2p' ring / 'a2a' Ulysses /
+    # 'allgather'.
+    cp_comm_type: str = "p2p"
+
     # Kernel implementation selection (spec_utils.py ModuleSpec analogue):
     # 'reference' = pure jnp; 'pallas' = fused Pallas kernels where available.
     attention_impl: str = "reference"

@@ -113,20 +113,22 @@ def gpt_rope_tables(cfg: TransformerConfig, seq_len: int,
 
 def gpt_forward(p, tokens: jnp.ndarray, cfg: TransformerConfig,
                 attention_mask: Optional[jnp.ndarray] = None,
-                position_offset: int = 0):
+                position_offset: int = 0, ctx=None):
     """tokens [B,S] → (logits [B,S,V] fp32, moe_aux_loss)."""
     b, s = tokens.shape
     h = gpt_embed(p, tokens, cfg, position_offset)
     cos, sin = gpt_rope_tables(cfg, s, position_offset)
-    h, aux = block_forward(p["block"], h, cfg, cos, sin, attention_mask)
+    h, aux = block_forward(p["block"], h, cfg, cos, sin, attention_mask,
+                           ctx=ctx)
     return gpt_head(p, h, cfg), aux
 
 
 def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
-             loss_mask: Optional[jnp.ndarray], cfg: TransformerConfig):
+             loss_mask: Optional[jnp.ndarray], cfg: TransformerConfig,
+             ctx=None):
     """Training loss (CE + MoE aux). Mirrors pretrain_gpt.py loss_func
     (/root/reference/pretrain_gpt.py:159)."""
-    logits, aux = gpt_forward(p, tokens, cfg)
+    logits, aux = gpt_forward(p, tokens, cfg, ctx=ctx)
     loss, _ = cross_entropy_loss(logits, targets, loss_mask)
     return loss + aux, {"lm_loss": loss, "moe_aux_loss": aux}
 
@@ -161,8 +163,20 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
     cos, sin = gpt_rope_tables(cfg, s)
 
     def stage_fn(chunk_params, x, layer_offset):
-        return block_forward(chunk_params, x, cfg, cos, sin, None,
-                             layer_offset=layer_offset)
+        cos_l, sin_l = cos, sin
+        from megatronapp_tpu.config.parallel_config import CP_AXIS
+        from megatronapp_tpu.parallel.collectives import current_manual_axes
+        if CP_AXIS in current_manual_axes() and cos is not None:
+            # Inside the pipeline body the cp axis is manual: x carries the
+            # local S/cp sequence block — slice the rope tables to match.
+            # (In the pp==1 fallback stage_fn runs outside any manual
+            # region and x carries the full sequence — no slicing.)
+            s_loc = x.shape[1]
+            start = jax.lax.axis_index(CP_AXIS) * s_loc
+            cos_l = jax.lax.dynamic_slice_in_dim(cos, start, s_loc)
+            sin_l = jax.lax.dynamic_slice_in_dim(sin, start, s_loc)
+        return block_forward(chunk_params, x, cfg, cos_l, sin_l, None,
+                             layer_offset=layer_offset, ctx=ctx)
 
     out_mb, aux = spmd_pipeline(
         stage_fn, p["block"], h, ctx, num_microbatches=m, vpp=vpp,

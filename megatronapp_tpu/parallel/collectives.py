@@ -1,0 +1,74 @@
+"""Helpers for manual-collective (shard_map) code.
+
+The reference wraps torch.distributed in
+virtual_tensor_parallel_communication.py; here the collectives themselves are
+jax.lax primitives — this module only holds small shared utilities for code
+running inside shard_map manual regions.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def current_manual_axes() -> Tuple[str, ...]:
+    """Mesh axes that are Manual in the ambient context (nested shard_maps
+    accumulate them)."""
+    m = jax.sharding.get_abstract_mesh()
+    if m is None or not m.shape:
+        return ()
+    Manual = jax.sharding.AxisType.Manual
+    return tuple(name for name, t in zip(m.axis_names, m.axis_types)
+                 if t == Manual)
+
+
+def _axes_tuple(axis) -> Tuple[str, ...]:
+    if axis is None:
+        return current_manual_axes()
+    if isinstance(axis, str):
+        return (axis,)
+    return tuple(axis)
+
+
+def varying_zeros(shape, dtype, axis: Union[str, Sequence[str], None] = None):
+    """Zeros with 'varying' VMA over the given axes (default: every manual
+    axis in scope) WITHOUT lax.pcast.
+
+    pcast's transpose is a psum, and the current XLA build crashes on bf16
+    manual all-reduces ("Invalid binary instruction opcode copy" — reducer
+    regions containing converts). axis_index is varying and
+    non-differentiable, so adding 0*axis_index yields a varying value with no
+    collective in the backward pass.
+    """
+    z = jnp.zeros((), jnp.int32)
+    for a in _axes_tuple(axis):
+        z = z + jax.lax.axis_index(a) * 0
+    return jnp.zeros(shape, dtype) + z.astype(dtype)
+
+
+def varying_full(shape, fill, dtype,
+                 axis: Union[str, Sequence[str], None] = None):
+    z = jnp.zeros((), jnp.int32)
+    for a in _axes_tuple(axis):
+        z = z + jax.lax.axis_index(a) * 0
+    return jnp.full(shape, fill, dtype) + z.astype(dtype)
+
+
+def _anchor(like: jnp.ndarray) -> jnp.ndarray:
+    """Scalar zero inheriting `like`'s varying-manual-axes type, with no
+    backward edge (stop_gradient) and no axis_index — safe inside nested
+    shard_maps where parent-bound axis names cannot be referenced."""
+    flat = jax.lax.stop_gradient(like).ravel()
+    return (flat[0] * 0).astype(jnp.float32)
+
+
+def zeros_like_vma(shape, dtype, like: jnp.ndarray):
+    """Zeros of (shape, dtype) whose varying-manual-axes match `like`."""
+    return jnp.zeros(shape, dtype) + _anchor(like).astype(dtype)
+
+
+def full_like_vma(shape, fill, dtype, like: jnp.ndarray):
+    return jnp.full(shape, fill, dtype) + _anchor(like).astype(dtype)

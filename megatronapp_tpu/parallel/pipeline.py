@@ -37,21 +37,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from megatronapp_tpu.config.parallel_config import PP_AXIS
+from megatronapp_tpu.config.parallel_config import CP_AXIS, PP_AXIS
 from megatronapp_tpu.parallel.mesh import MeshContext
 
 
-def _varying_zeros(shape, dtype, axis):
-    """Zeros with 'varying' VMA over `axis` WITHOUT lax.pcast.
-
-    pcast's transpose is a psum, and this XLA build crashes on bf16 manual
-    all-reduces ("Invalid binary instruction opcode copy" — reducer regions
-    with converts). axis_index is varying and non-differentiable, so adding
-    0*axis_index makes the value varying with no collective in the backward
-    pass.
-    """
-    z = jax.lax.axis_index(axis) * 0
-    return jnp.zeros(shape, dtype) + z.astype(dtype)
+from megatronapp_tpu.parallel.collectives import zeros_like_vma
 
 
 def reshape_params_for_pipeline(stacked_params, pp: int, vpp: int = 1):
@@ -113,22 +103,38 @@ def spmd_pipeline(
     mesh = ctx.mesh
     total_steps = M * vpp + pp - 1
     cycle = pp * vpp
+    # Context parallelism composes by WIDENING this manual region (nested
+    # shard_maps are unreliable in this JAX build): with cp > 1 the body is
+    # manual over both pp and cp, sequence enters pre-sharded [.., S/cp, ..],
+    # and attention calls the ring/a2a impls directly (context_attention
+    # detects the ambient manual cp).
+    cp = ctx.cp
+    manual_axes = {PP_AXIS} | ({CP_AXIS} if cp > 1 else set())
 
     def body(params_local, h_mb_in):
-        # params_local: [1, vpp, Lc, ...]; h_mb_in: full [M, mb, S, H].
+        # params_local: [1, vpp, Lc, ...]; h_mb_in: [M, mb, S(/cp), H].
         # h_mb_in MUST be fp32 at this boundary: its transpose-psum (and the
         # pcast below) must not be a bf16 manual all-reduce (XLA:CPU bug —
-        # see _varying_zeros). Casting to the compute dtype happens per
-        # injection, after the pcast.
+        # see collectives.varying_zeros). Casting to the compute dtype
+        # happens per injection, after the pcast.
         h_mb_in = jax.lax.pcast(h_mb_in, (PP_AXIS,), to="varying")
         stage = jax.lax.axis_index(PP_AXIS)
         params_s = jax.tree.map(lambda x: x[0], params_local)
+        if cp > 1:
+            # Make params cp-varying up front: otherwise every bf16 use of a
+            # cp-invariant param inside the stage transposes to a bf16
+            # psum_invariant over cp (the XLA:CPU crash). Params are fp32
+            # here, so this pcast's transpose is a single fp32 psum per
+            # param — which is also exactly the cp grad reduction.
+            params_s = jax.tree.map(
+                lambda p: jax.lax.pcast(p, (CP_AXIS,), to="varying"),
+                params_s)
         layers_per_chunk = jax.tree.leaves(params_s)[0].shape[1]
         mb_shape = h_mb_in.shape[1:]
 
-        state = _varying_zeros(mb_shape, compute_dtype, PP_AXIS)
-        outputs = _varying_zeros(h_mb_in.shape, compute_dtype, PP_AXIS)
-        aux = _varying_zeros((), jnp.float32, PP_AXIS)
+        state = zeros_like_vma(mb_shape, compute_dtype, h_mb_in)
+        outputs = zeros_like_vma(h_mb_in.shape, compute_dtype, h_mb_in)
+        aux = zeros_like_vma((), jnp.float32, h_mb_in)
 
         def step(carry, t):
             state, outputs, aux = carry
@@ -168,14 +174,22 @@ def spmd_pipeline(
 
         (state, outputs, aux), _ = jax.lax.scan(
             step, (state, outputs, aux), jnp.arange(total_steps))
-        # Sum aux losses across stages; outputs live on the last stage.
-        aux = jax.lax.psum(aux, PP_AXIS)
+        # Sum aux losses across stages (and average over cp shards, whose
+        # aux terms are per-local-token means); outputs live on the last
+        # stage.
+        if cp > 1:
+            aux = jax.lax.psum(aux, (PP_AXIS, CP_AXIS)) / cp
+        else:
+            aux = jax.lax.psum(aux, PP_AXIS)
         return outputs[None], aux[None]
 
+    h_spec = P(None, None, CP_AXIS) if cp > 1 else P(None)
+    out_spec = (P(PP_AXIS, None, None, CP_AXIS) if cp > 1
+                else P(PP_AXIS))
     sm = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(PP_AXIS), P(None)),
-        out_specs=(P(PP_AXIS), P(PP_AXIS)),
-        axis_names={PP_AXIS})
+        in_specs=(P(PP_AXIS), h_spec),
+        out_specs=(out_spec, P(PP_AXIS)),
+        axis_names=manual_axes)
     outputs_all, aux_all = sm(pipe_params, h_mb)
     return outputs_all[-1], aux_all[0]

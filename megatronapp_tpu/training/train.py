@@ -51,10 +51,10 @@ def reshape_global_batch(batch: Dict[str, np.ndarray], num_micro: int
     return {k: r(v) for k, v in batch.items()}
 
 
-def gpt_microbatch_loss(cfg: TransformerConfig):
+def gpt_microbatch_loss(cfg: TransformerConfig, ctx=None):
     def loss_fn(params, micro):
         loss, metrics = gpt_loss(params, micro["tokens"], micro["labels"],
-                                 micro["loss_mask"], cfg)
+                                 micro["loss_mask"], cfg, ctx=ctx)
         return loss, metrics
     return loss_fn
 
@@ -120,7 +120,7 @@ def pretrain_gpt(
                 params, batch_mb["tokens"], batch_mb["labels"],
                 batch_mb["loss_mask"], model_cfg, ctx, vpp=vpp)
     else:
-        loss_fn = gpt_microbatch_loss(model_cfg)
+        loss_fn = gpt_microbatch_loss(model_cfg, ctx=ctx)
     step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
                               train_cfg.train_iters,
                               check_nan=train_cfg.check_for_nan_in_loss,

@@ -67,7 +67,7 @@ def attention_forward(
     rope_sin: Optional[jnp.ndarray] = None,
     attention_mask: Optional[jnp.ndarray] = None,
     kv_cache=None, cache_index=None,
-    layer_id=None,
+    layer_id=None, ctx=None,
 ) -> jnp.ndarray:
     """x: [B, S, H] → [B, S, H]. Returns (out, new_kv_cache)."""
     b, s, h = x.shape
@@ -112,14 +112,27 @@ def attention_forward(
     # multiplies it back inside the fused softmax). We always softmax in
     # fp32, so no scaling is needed — the flag is accepted for config parity
     # and intentionally has no effect on the math.
-    ctx = dot_product_attention(
-        q, k, v, mask_type=cfg.attn_mask_type,
-        attention_mask=attention_mask, softmax_scale=None,
-        softmax_in_fp32=cfg.attention_softmax_in_fp32,
-        q_offset=q_offset)
-    ctx = scope_capture("context", ctx, layer_id)
+    if ctx is not None and ctx.cp > 1 and kv_cache is None:
+        # Context-parallel attention over the cp axis (seq sharded).
+        from megatronapp_tpu.ops.context_parallel import context_attention
+        from megatronapp_tpu.config.transformer_config import AttnMaskType
+        if attention_mask is not None:
+            raise NotImplementedError(
+                "explicit attention_mask is not supported under context "
+                "parallelism yet (only causal/bidirectional); run with "
+                "context_parallel=1 or drop the mask")
+        attn_out = context_attention(
+            q, k, v, ctx.mesh, cfg.cp_comm_type,
+            causal=cfg.attn_mask_type == AttnMaskType.causal)
+    else:
+        attn_out = dot_product_attention(
+            q, k, v, mask_type=cfg.attn_mask_type,
+            attention_mask=attention_mask, softmax_scale=None,
+            softmax_in_fp32=cfg.attention_softmax_in_fp32,
+            q_offset=q_offset)
+    attn_out = scope_capture("context", attn_out, layer_id)
 
-    out = ctx.reshape(b, s, nq * d) @ p["out_kernel"].astype(cfg.compute_dtype)
+    out = attn_out.reshape(b, s, nq * d) @ p["out_kernel"].astype(cfg.compute_dtype)
     if "out_bias" in p:
         out = out + p["out_bias"].astype(cfg.compute_dtype)
     return (out, new_cache) if kv_cache is not None else (out, None)

@@ -62,14 +62,15 @@ def init_layer_params(rng, cfg: TransformerConfig, force_dense: bool = False):
 
 def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                   rope_cos=None, rope_sin=None, attention_mask=None,
-                  layer_id=None, kv_cache=None, cache_index=None):
+                  layer_id=None, kv_cache=None, cache_index=None, ctx=None):
     """One transformer layer. x: [B,S,H] → ((out, new_cache), aux_losses)."""
     residual = x
     h = apply_norm(cfg.normalization, x, p["ln1_scale"], p.get("ln1_bias"),
                    cfg.layernorm_epsilon)
     attn_out, new_cache = attention_forward(
         p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
-        kv_cache=kv_cache, cache_index=cache_index, layer_id=layer_id)
+        kv_cache=kv_cache, cache_index=cache_index, layer_id=layer_id,
+        ctx=ctx)
     x = residual + attn_out.astype(residual.dtype)
 
     residual = x
@@ -145,14 +146,14 @@ def init_block_params(rng, cfg: TransformerConfig, num_layers: int = None):
 
 def block_forward(stacked_p, x: jnp.ndarray, cfg: TransformerConfig,
                   rope_cos=None, rope_sin=None, attention_mask=None,
-                  layer_offset: int = 0):
+                  layer_offset: int = 0, ctx=None):
     """Run all stacked layers via lax.scan. Returns (x, moe_aux_sum)."""
     hetero = isinstance(stacked_p, dict) and "dense" in stacked_p
 
     def run_layer(layer_p, h, lid):
         (h2, _), aux = layer_forward(
             layer_p, h, cfg, rope_cos, rope_sin, attention_mask,
-            layer_id=lid)
+            layer_id=lid, ctx=ctx)
         return h2, (aux if aux is not None
                     else jnp.zeros((), jnp.float32))
 
