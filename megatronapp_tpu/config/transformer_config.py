@@ -128,8 +128,9 @@ class TransformerConfig:
     cp_comm_type: str = "p2p"
 
     # Kernel implementation selection (spec_utils.py ModuleSpec analogue):
-    # 'reference' = pure jnp; 'pallas' = fused Pallas kernels where available.
-    attention_impl: str = "reference"
+    # 'reference' = pure jnp; 'pallas' = fused Pallas flash attention;
+    # 'auto' = pallas on TPU, reference elsewhere.
+    attention_impl: str = "auto"
 
     # Fused dot-product attention blockwise kernel sizes (Pallas).
     flash_block_q: int = 512
@@ -152,6 +153,10 @@ class TransformerConfig:
             )
         if self.num_moe_experts is not None and self.moe_ffn_hidden_size is None:
             self.moe_ffn_hidden_size = self.ffn_hidden_size
+        if self.cp_comm_type not in ("p2p", "a2a", "allgather"):
+            raise ValueError(
+                f"cp_comm_type must be one of 'p2p' (ring), 'a2a' (Ulysses) "
+                f"or 'allgather', got {self.cp_comm_type!r}")
 
     @property
     def is_moe(self) -> bool:

@@ -72,11 +72,7 @@ def ring_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
     l = zeros_like_vma((b, h, sq), jnp.float32, q)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
-    def body(carry, step):
-        o, m, l, k_blk, v_blk = carry
-        # After `step` rotations my shard holds the block originally from
-        # rank (my - step) mod cp.
-        src = (my - step) % cp
+    def block_update(o, m, l, k_blk, v_blk, src):
         s = _block_scores(q, repeat_kv(k_blk, h), softmax_scale)  # [B,H,Sq,Skv]
         if causal:
             # Block-level: src > my → entirely masked; src == my → causal
@@ -101,12 +97,24 @@ def ring_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
                         repeat_kv(v_blk, h),
                         preferred_element_type=jnp.float32)
         o = o * corr[..., None] + pv
+        return o, m_new, l
+
+    # Local block first, then cp-1 rotate-then-compute steps — the final
+    # rotation (returning blocks home) would be wasted ICI traffic.
+    o, m, l = block_update(o, m, l, k, v, my)
+
+    def body(carry, step):
+        o, m, l, k_blk, v_blk = carry
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (o, m_new, l, k_blk, v_blk), None
+        # After `step` rotations my shard holds the block originally from
+        # rank (my - step) mod cp.
+        src = (my - step) % cp
+        o, m, l = block_update(o, m, l, k_blk, v_blk, src)
+        return (o, m, l, k_blk, v_blk), None
 
     (o, m, l, _, _), _ = jax.lax.scan(body, (o, m, l, k, v),
-                                      jnp.arange(cp))
+                                      jnp.arange(1, cp))
     out = o / jnp.maximum(l, 1e-20)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Sq,H,D]
 
@@ -180,6 +188,10 @@ def context_attention(q, k, v, mesh, cp_comm_type: str = "p2p",
     q,k,v: GLOBAL [B, S, H, D] arrays with S sharded over cp. Returns global
     [B, S, H, D] with the same sharding.
     """
+    if cp_comm_type not in _CP_IMPLS:
+        raise ValueError(
+            f"cp_comm_type must be one of {sorted(_CP_IMPLS)}, got "
+            f"{cp_comm_type!r}")
     impl = _CP_IMPLS[cp_comm_type]
     fn = functools.partial(impl, causal=causal, softmax_scale=softmax_scale)
 

@@ -1,0 +1,402 @@
+"""Pallas TPU flash attention (forward + custom-VJP backward).
+
+The reference gets fused attention from TransformerEngine/Apex CUDA kernels
+(SURVEY §2.7 native-code inventory: "Pallas flash attention" is the TPU
+replacement obligation). This kernel:
+
+- blockwise online-softmax forward, O(S) memory (no [Sq,Skv] materialized),
+  fp32 accumulators, bf16 matmul inputs on the MXU;
+- causal masking with whole-block skip for fully-masked tiles;
+- GQA: KV heads indexed as h // group via BlockSpec index maps, no repeat;
+- custom VJP with two backward kernels (dq; dk/dv), log-sum-exp residuals —
+  the FlashAttention-2 recipe;
+- runs in interpret mode on CPU (tests) and compiled on TPU.
+
+Layout: [B, H, S, D] per-head-contiguous (callers reshape from [B,S,H,D]).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _cdiv(a, b):
+    return (a + b - 1) // b
+
+
+
+def _mask_rows(x, start, limit):
+    """Zero rows >= limit. Padding may be NaN (interpret mode pads with NaN),
+    so this must be a select, not a multiply (NaN*0 == NaN)."""
+    idx = start + jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0)
+    return jnp.where(idx < limit, x, jnp.zeros_like(x))
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc, m_scr, l_scr, *, scale, causal, block_q, block_kv,
+                num_kv, seq_q, seq_kv):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q_start = iq * block_q
+    k_start = ik * block_kv
+
+    def compute():
+        q = _mask_rows(q_ref[0, 0].astype(jnp.float32) * scale,
+                       q_start, seq_q)                # [bq, D]
+        k = _mask_rows(k_ref[0, 0], k_start, seq_kv)  # [bkv, D]
+        s = jax.lax.dot_general(
+            q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bkv]
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        valid = (rows < seq_q) & (cols < seq_kv)
+        if causal:
+            valid = valid & (rows >= cols)
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+        corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
+        l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=1)
+        v = _mask_rows(v_ref[0, 0], k_start, seq_kv)  # [bkv, D]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc[:] = acc[:] * corr[:, None] + pv
+        m_scr[:, 0] = m_new
+
+    if causal:
+        # Skip tiles entirely above the diagonal.
+        @pl.when(q_start + block_q - 1 >= k_start)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ik == num_kv - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        o_ref[0, 0] = (acc[:] / jnp.maximum(l, 1e-20)[:, None]).astype(
+            o_ref.dtype)
+        m = m_scr[:, 0]
+        lse = jnp.where(
+            l > 0, jnp.maximum(m, _NEG_INF / 2) + jnp.log(
+                jnp.maximum(l, 1e-20)), _NEG_INF)
+        lse_ref[0, 0] = lse[:, None]
+
+
+def _flash_forward(q, k, v, scale, causal, block_q, block_kv):
+    b, h, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    group = h // hkv
+    block_q = min(block_q, sq)
+    block_kv = min(block_kv, skv)
+    nq = _cdiv(sq, block_q)
+    nk = _cdiv(skv, block_kv)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, num_kv=nk, seq_q=sq, seq_kv=skv)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, block_q, block_kv, num_kv,
+                   seq_q, seq_kv):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = iq * block_q
+    k_start = ik * block_kv
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = _mask_rows(k_ref[0, 0], k_start, seq_kv)
+        v = _mask_rows(v_ref[0, 0], k_start, seq_kv)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, 0]
+        delta = delta_ref[0, 0][:, 0]
+
+        s = jax.lax.dot_general(q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        valid = (rows < seq_q) & (cols < seq_kv)
+        if causal:
+            valid = valid & (rows >= cols)
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(do.astype(v.dtype), v,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = jnp.where(valid, p * (dp - delta[:, None]), 0.0)
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        @pl.when(q_start + block_q - 1 >= k_start)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ik == num_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_kv, num_q, seq_q, seq_kv):
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = iq * block_q
+    k_start = ik * block_kv
+
+    def compute():
+        q = _mask_rows(q_ref[0, 0].astype(jnp.float32) * scale,
+                       q_start, seq_q)
+        k = _mask_rows(k_ref[0, 0], k_start, seq_kv)
+        v = _mask_rows(v_ref[0, 0], k_start, seq_kv)
+        do = _mask_rows(do_ref[0, 0].astype(jnp.float32), q_start, seq_q)
+        lse = lse_ref[0, 0][:, 0]
+        delta = delta_ref[0, 0][:, 0]
+
+        s = jax.lax.dot_general(q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        valid = (rows < seq_q) & (cols < seq_kv)
+        if causal:
+            valid = valid & (rows >= cols)
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)  # [bq, bkv]
+        # dv += p^T @ do
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do.astype(v.dtype), v,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = jnp.where(valid, p * (dp - delta[:, None]), 0.0)  # [bq, bkv]
+        # dk += ds^T @ q * scale (q already has scale folded in → use raw q)
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(q_start + block_q - 1 >= k_start)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(iq == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(res, g, scale, causal, block_q, block_kv):
+    q, k, v, out, lse = res
+    b, h, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    group = h // hkv
+    block_q = min(block_q, sq)
+    block_kv = min(block_kv, skv)
+    nq = _cdiv(sq, block_q)
+    nk = _cdiv(skv, block_kv)
+
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # [B,H,Sq]
+    lse4 = lse[..., None]
+    delta4 = delta[..., None]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv, num_kv=nk,
+                          seq_q=sq, seq_kv=skv),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, iq, ik, g_=group: (b_, h_ // g_, ik, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, iq, ik, g_=group: (b_, h_ // g_, ik, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, g, lse4, delta4)
+
+    # dk/dv computed at q-head granularity [B, H, Skv, D]; grouped heads are
+    # reduced outside (GQA) — simple and correct; a fused variant can
+    # accumulate in-kernel later.
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv, num_q=nq,
+                          seq_q=sq, seq_kv=skv),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, ik, iq, g_=group: (b_, h_ // g_, ik, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, ik, iq, g_=group: (b_, h_ // g_, ik, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, skv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, g, lse4, delta4)
+
+    if group > 1:
+        dk = dk_full.reshape(b, hkv, group, skv, d).sum(axis=2)
+        dv = dv_full.reshape(b, hkv, group, skv, d).sum(axis=2)
+    else:
+        dk, dv = dk_full, dv_full
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_bhsd(q, k, v, scale, causal, block_q, block_kv):
+    out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_kv)
+    return out
+
+
+def _fwd_rule(q, k, v, scale, causal, block_q, block_kv):
+    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_kv)
+    return out, (q, k, v, out, lse)
+
+
+def _bwd_rule(scale, causal, block_q, block_kv, res, g):
+    return _flash_backward(res, g, scale, causal, block_q, block_kv)
+
+
+_flash_attention_bhsd.defvjp(_fwd_rule, _bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    softmax_scale: Optional[float] = None,
+                    block_q: int = 512, block_kv: int = 512):
+    """Flash attention on [B, S, H, D] tensors (GQA-aware).
+
+    Returns [B, Sq, H, D]. Drop-in for ops.attention.dot_product_attention's
+    causal/bidirectional paths.
+    """
+    b, sq, h, d = q.shape
+    if softmax_scale is None:
+        softmax_scale = 1.0 / (d ** 0.5)
+    qt = jnp.swapaxes(q, 1, 2)   # [B,H,S,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash_attention_bhsd(qt, kt, vt, float(softmax_scale), causal,
+                                block_q, block_kv)
+    return jnp.swapaxes(out, 1, 2)

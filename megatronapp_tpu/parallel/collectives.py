@@ -8,7 +8,7 @@ running inside shard_map manual regions.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple, Union
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,42 +25,15 @@ def current_manual_axes() -> Tuple[str, ...]:
                  if t == Manual)
 
 
-def _axes_tuple(axis) -> Tuple[str, ...]:
-    if axis is None:
-        return current_manual_axes()
-    if isinstance(axis, str):
-        return (axis,)
-    return tuple(axis)
-
-
-def varying_zeros(shape, dtype, axis: Union[str, Sequence[str], None] = None):
-    """Zeros with 'varying' VMA over the given axes (default: every manual
-    axis in scope) WITHOUT lax.pcast.
-
-    pcast's transpose is a psum, and the current XLA build crashes on bf16
-    manual all-reduces ("Invalid binary instruction opcode copy" — reducer
-    regions containing converts). axis_index is varying and
-    non-differentiable, so adding 0*axis_index yields a varying value with no
-    collective in the backward pass.
-    """
-    z = jnp.zeros((), jnp.int32)
-    for a in _axes_tuple(axis):
-        z = z + jax.lax.axis_index(a) * 0
-    return jnp.zeros(shape, dtype) + z.astype(dtype)
-
-
-def varying_full(shape, fill, dtype,
-                 axis: Union[str, Sequence[str], None] = None):
-    z = jnp.zeros((), jnp.int32)
-    for a in _axes_tuple(axis):
-        z = z + jax.lax.axis_index(a) * 0
-    return jnp.full(shape, fill, dtype) + z.astype(dtype)
-
-
 def _anchor(like: jnp.ndarray) -> jnp.ndarray:
     """Scalar zero inheriting `like`'s varying-manual-axes type, with no
     backward edge (stop_gradient) and no axis_index — safe inside nested
-    shard_maps where parent-bound axis names cannot be referenced."""
+    shard_maps where parent-bound axis names cannot be referenced.
+
+    Why not lax.pcast for making carries varying: pcast's transpose is a
+    psum, and the current XLA build crashes on bf16 manual all-reduces
+    ("Invalid binary instruction opcode copy" — reducer regions containing
+    converts). This anchor adds no collective in either direction."""
     flat = jax.lax.stop_gradient(like).ravel()
     return (flat[0] * 0).astype(jnp.float32)
 

@@ -115,7 +115,7 @@ def spmd_pipeline(
         # params_local: [1, vpp, Lc, ...]; h_mb_in: [M, mb, S(/cp), H].
         # h_mb_in MUST be fp32 at this boundary: its transpose-psum (and the
         # pcast below) must not be a bf16 manual all-reduce (XLA:CPU bug —
-        # see collectives.varying_zeros). Casting to the compute dtype
+        # see collectives.zeros_like_vma). Casting to the compute dtype
         # happens per injection, after the pcast.
         h_mb_in = jax.lax.pcast(h_mb_in, (PP_AXIS,), to="varying")
         stage = jax.lax.axis_index(PP_AXIS)

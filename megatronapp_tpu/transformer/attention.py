@@ -22,7 +22,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.config.transformer_config import (
+    AttnMaskType, TransformerConfig,
+)
 from megatronapp_tpu.ops.attention import dot_product_attention
 from megatronapp_tpu.ops.normalization import rms_norm
 from megatronapp_tpu.ops import rotary
@@ -115,7 +117,6 @@ def attention_forward(
     if ctx is not None and ctx.cp > 1 and kv_cache is None:
         # Context-parallel attention over the cp axis (seq sharded).
         from megatronapp_tpu.ops.context_parallel import context_attention
-        from megatronapp_tpu.config.transformer_config import AttnMaskType
         if attention_mask is not None:
             raise NotImplementedError(
                 "explicit attention_mask is not supported under context "
@@ -125,11 +126,62 @@ def attention_forward(
             q, k, v, ctx.mesh, cfg.cp_comm_type,
             causal=cfg.attn_mask_type == AttnMaskType.causal)
     else:
-        attn_out = dot_product_attention(
-            q, k, v, mask_type=cfg.attn_mask_type,
-            attention_mask=attention_mask, softmax_scale=None,
-            softmax_in_fp32=cfg.attention_softmax_in_fp32,
-            q_offset=q_offset)
+        from megatronapp_tpu.parallel.collectives import current_manual_axes
+
+        impl = cfg.attention_impl
+        if impl == "auto":
+            impl = "pallas" if jax.default_backend() == "tpu" else "reference"
+        # GSPMD cannot partition a pallas_call (it would replicate full
+        # attention on every device), so the kernel must be placed
+        # explicitly: on a multi-device mesh we shard_map it manually over
+        # (dp, ep, tp) — attention is embarrassingly parallel over
+        # batch/heads. Inside an existing manual region (the pp/cp pipeline
+        # body) nesting shard_maps is unsupported in this JAX build, so fall
+        # back to the reference impl there.
+        in_manual = bool(current_manual_axes())
+        use_flash = (
+            impl == "pallas" and attention_mask is None
+            and kv_cache is None and not in_manual
+            and cfg.attn_mask_type in (AttnMaskType.causal,
+                                       AttnMaskType.bidirectional))
+        multi_device = ctx is not None and ctx.num_devices > 1
+        if use_flash and multi_device:
+            dp_ep = ctx.dp * ctx.ep
+            use_flash = (b % dp_ep == 0 and nq % ctx.tp == 0
+                         and nkv % ctx.tp == 0)
+        if use_flash:
+            from megatronapp_tpu.ops.pallas.flash_attention import (
+                flash_attention,
+            )
+            causal = cfg.attn_mask_type == AttnMaskType.causal
+            if multi_device:
+                from jax.sharding import PartitionSpec as P
+                from megatronapp_tpu.config.parallel_config import (
+                    DP_AXIS, EP_AXIS, TP_AXIS,
+                )
+                spec = P((DP_AXIS, EP_AXIS), None, TP_AXIS, None)
+                flash = jax.shard_map(
+                    lambda q_, k_, v_: flash_attention(
+                        q_, k_, v_, causal=causal,
+                        block_q=cfg.flash_block_q,
+                        block_kv=cfg.flash_block_kv),
+                    mesh=ctx.mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec,
+                    axis_names={DP_AXIS, EP_AXIS, TP_AXIS},
+                    # pallas out_shapes carry no vma info; the kernel is
+                    # purely local (no collectives), so skip vma checking.
+                    check_vma=False)
+                attn_out = flash(q, k, v)
+            else:
+                attn_out = flash_attention(
+                    q, k, v, causal=causal,
+                    block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv)
+        else:
+            attn_out = dot_product_attention(
+                q, k, v, mask_type=cfg.attn_mask_type,
+                attention_mask=attention_mask, softmax_scale=None,
+                softmax_in_fp32=cfg.attention_softmax_in_fp32,
+                q_offset=q_offset)
     attn_out = scope_capture("context", attn_out, layer_id)
 
     out = attn_out.reshape(b, s, nq * d) @ p["out_kernel"].astype(cfg.compute_dtype)
