@@ -153,10 +153,12 @@ class TransformerConfig:
             )
         if self.num_moe_experts is not None and self.moe_ffn_hidden_size is None:
             self.moe_ffn_hidden_size = self.ffn_hidden_size
-        if self.cp_comm_type not in ("p2p", "a2a", "allgather"):
+        from megatronapp_tpu.ops.context_parallel import CP_COMM_TYPES
+        if self.cp_comm_type not in CP_COMM_TYPES:
             raise ValueError(
-                f"cp_comm_type must be one of 'p2p' (ring), 'a2a' (Ulysses) "
-                f"or 'allgather', got {self.cp_comm_type!r}")
+                f"cp_comm_type must be one of {sorted(CP_COMM_TYPES)} "
+                f"('p2p' = ring, 'a2a' = Ulysses), got "
+                f"{self.cp_comm_type!r}")
 
     @property
     def is_moe(self) -> bool:

@@ -178,6 +178,9 @@ _CP_IMPLS = {
     "a2a": ulysses_attention,
     "allgather": allgather_attention,
 }
+# Authoritative set of valid cp_comm_type values (TransformerConfig
+# validation derives from this).
+CP_COMM_TYPES = frozenset(_CP_IMPLS)
 
 
 def context_attention(q, k, v, mesh, cp_comm_type: str = "p2p",

@@ -1,0 +1,127 @@
+"""Slow-chip detection heuristic.
+
+Behavioral parity with /root/reference/scripts/aggregate.py:399 (try_detect)
+and :366 (detect_in_data_parallelism_group):
+
+Stage 1 — across data-parallel peers, compare the k-th occurrence of each
+schedule event per iteration:
+  * a 'loss' or 'allreduce' event *finishing early* (< 0.9 x the mean of the
+    other ranks) marks the rank suspect — a slow rank reaches the sync op
+    last and therefore waits *less* inside it;
+  * a 'backward' event *taking long* (> 1.1 x the mean of the others) marks
+    the rank suspect.
+A rank suspected more than `stage1_threshold` (5) times is escalated.
+
+Stage 2 — for an escalated rank, compare each of its collective events
+('all-reduce'/'reduce-scatter'/'all-gather' — the TP '_reduce' analogues)
+against the related_sync_op peers; if it is the earliest-finishing member in
+> 40% of them, report it as abnormal.
+
+On TPU, 'rank' granularity is the trace producer (one process per host; the
+reference has one process per GPU). The math is identical.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Set
+
+SYNC_EARLY_EVENTS = ("loss", "allreduce", "grad-sync", "optimizer")
+SLOW_EVENTS = ("backward", "forward-backward")
+COLLECTIVE_PREFIXES = ("all-reduce", "reduce-scatter", "all-gather",
+                       "collective-permute", "all-to-all")
+
+EARLY_FACTOR = 0.9
+SLOW_FACTOR = 1.1
+STAGE1_THRESHOLD = 5
+STAGE2_FRACTION = 0.4
+
+
+def _end(e):
+    return e["ts"] + e.get("dur", 0.0)
+
+
+def detect_stage1(events: List[dict]) -> Dict[int, int]:
+    """Suspect counts per pid (reference try_detect stage 1)."""
+    # Bucket by (iteration, name, occurrence index) across pids.
+    buckets: Dict[tuple, Dict[int, List[dict]]] = defaultdict(
+        lambda: defaultdict(list))
+    for e in events:
+        if e["ph"] != "X":
+            continue
+        if e["name"] in SYNC_EARLY_EVENTS or e["name"] in SLOW_EVENTS:
+            key = (e["args"].get("iteration", -1), e["name"])
+            buckets[key][e["pid"]].append(e)
+
+    suspects: Dict[int, int] = defaultdict(int)
+    for (it, name), per_pid in buckets.items():
+        if len(per_pid) < 2:
+            continue
+        depth = min(len(v) for v in per_pid.values())
+        for i in range(depth):
+            if name in SYNC_EARLY_EVENTS:
+                # Use wait time inside the op ≈ duration: a slow rank
+                # arrives late and waits less.
+                durs = {pid: v[i].get("dur", 0.0)
+                        for pid, v in per_pid.items()}
+                for pid, d in durs.items():
+                    others = [durs[q] for q in durs if q != pid]
+                    avg = sum(others) / len(others)
+                    if avg > 0 and d < EARLY_FACTOR * avg:
+                        suspects[pid] += 1
+            else:  # slow events: longer duration ⇒ suspect
+                durs = {pid: v[i].get("dur", 0.0)
+                        for pid, v in per_pid.items()}
+                for pid, d in durs.items():
+                    others = [durs[q] for q in durs if q != pid]
+                    avg = sum(others) / len(others)
+                    if avg > 0 and d > SLOW_FACTOR * avg:
+                        suspects[pid] += 1
+    return dict(suspects)
+
+
+def detect_stage2(events: List[dict], related: Dict[int, Set[int]],
+                  pid: int) -> bool:
+    """Within collectives, is `pid` the earliest finisher in >40% of its
+    related-op sets (reference detect_in_data_parallelism_group)?"""
+    by_id = {e["args"]["id"]: e for e in events
+             if "id" in e.get("args", {})}
+    total = 0
+    slow_cnt = 0
+    seen = set()
+    for eid, ids in related.items():
+        if eid in seen or len(ids) < 2:
+            continue
+        seen.update(ids)
+        evs = [by_id[i] for i in ids if i in by_id]
+        if not any(e["pid"] == pid for e in evs):
+            continue
+        if not any(e["name"].startswith(p) for p in COLLECTIVE_PREFIXES
+                   for e in evs[:1]):
+            continue
+        total += 1
+        mine = [e for e in evs if e["pid"] == pid]
+        others = [e for e in evs if e["pid"] != pid]
+        if mine and others and all(
+                _end(mine[0]) < _end(o) for o in others):
+            slow_cnt += 1
+    return total > 0 and slow_cnt > STAGE2_FRACTION * total
+
+
+def try_detect(events: List[dict], related: Dict[int, Set[int]],
+               stage1_threshold: int = STAGE1_THRESHOLD) -> List[int]:
+    """Full two-stage detection; returns abnormal pids (reference
+    try_detect → abnormal.txt)."""
+    counts = detect_stage1(events)
+    escalated = [pid for pid, c in counts.items() if c > stage1_threshold]
+    abnormal = []
+    for pid in escalated:
+        # Stage 2 only filters when collective events with groups exist;
+        # otherwise stage-1 escalation stands (the reference requires
+        # _reduce events, which exist in its traces by construction).
+        has_collectives = any(
+            e["name"].startswith(p) for e in events
+            for p in COLLECTIVE_PREFIXES)
+        if not has_collectives or detect_stage2(events, related, pid):
+            abnormal.append(pid)
+    return abnormal

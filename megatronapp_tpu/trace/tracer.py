@@ -30,6 +30,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 # Granularity sets (reference trace.py:75-132): 'full' records everything,
@@ -50,6 +51,33 @@ GRANULARITY_EVENTS = {
 
 def _now_ns() -> int:
     return time.perf_counter_ns()
+
+
+_CALLBACKS_SUPPORTED: Optional[bool] = None
+
+
+def callbacks_supported() -> bool:
+    """Whether the backend supports host callbacks (io_callback).
+
+    Standard PJRT TPU/CPU backends do; the tunneled 'axon' dev backend does
+    not (UNIMPLEMENTED: host send/recv callbacks). Without callbacks the
+    tracer degrades to host-side scopes (train-step/iteration spans) — the
+    schedule-phase spans need callbacks.
+    """
+    global _CALLBACKS_SUPPORTED
+    if _CALLBACKS_SUPPORTED is None:
+        from jax.experimental import io_callback
+
+        def probe(x):
+            tok = io_callback(lambda _: np.zeros((), np.int32),
+                              jax.ShapeDtypeStruct((), np.int32), x)
+            return x + tok
+        try:
+            jax.device_get(jax.jit(probe)(np.int32(0)))
+            _CALLBACKS_SUPPORTED = True
+        except Exception:
+            _CALLBACKS_SUPPORTED = False
+    return _CALLBACKS_SUPPORTED
 
 
 class Tracer:
@@ -149,6 +177,12 @@ class Tracer:
                     rec["args"].update(attrs)
                     break
 
+    # -- in-graph phase spans ----------------------------------------------
+    def phase_event(self, name: str, ph: str):
+        """Host-side record emission used by in-graph callbacks."""
+        if self.enabled and self.active:
+            self._emit(name, ph, _now_ns() - self._iter_t0, {})
+
     # -- in-graph markers ---------------------------------------------------
     def marker(self, name: str, x, **attrs):
         """In-graph event marker: identity on x, records host time when the
@@ -241,3 +275,99 @@ _TRACER = Tracer()
 
 def get_tracer() -> Tracer:
     return _TRACER
+
+
+# ---------------------------------------------------------------------------
+# In-graph schedule-phase spans (SURVEY §2.4: the schedule-phase events —
+# forward/backward/loss/optimizer — whose emit sites the reference lost in
+# its rebase and the detector depends on). A span is a custom-VJP identity:
+# its forward emits the forward-phase record, and because cotangents traverse
+# the graph in reverse, the SAME pair of spans around a forward region
+# automatically emits a correctly-oriented 'backward' span during the
+# backward pass — the TPU-native analogue of wrapping both fwd and bwd
+# schedule phases with CUDA events.
+# ---------------------------------------------------------------------------
+
+def _phase_cb(name: str, ph: str):
+    def cb(_):
+        _TRACER.phase_event(name, ph)
+        return np.zeros((), np.int32)
+    return cb
+
+
+def _emit_in_graph(x_anchor, name: str, ph: str):
+    from jax.experimental import io_callback
+    from jax.sharding import SingleDeviceSharding
+    # Under SPMD partitioning a side-effecting callback may not be
+    # replicated — pin it to one device (this process records one timeline,
+    # like the reference's one-tracer-per-rank). ordered=True is not
+    # SPMD-compatible (its ordering token stays replicated → partitioner
+    # RET_CHECK); execution order is enforced by the data dependency on
+    # x_anchor instead.
+    token = io_callback(_phase_cb(name, ph),
+                        jax.ShapeDtypeStruct((), np.int32),
+                        x_anchor, ordered=False,
+                        sharding=SingleDeviceSharding(jax.local_devices()[0]))
+    return token
+
+
+def _anchor_scalar(tree):
+    leaf = jax.tree.leaves(tree)[0]
+    return (jax.lax.stop_gradient(leaf).ravel()[0] * 0).astype(np.float32)
+
+
+def _tie(tree, token):
+    leaves = jax.tree.leaves(tree)
+    first = leaves[0]
+    leaves[0] = first + token.astype(first.dtype) * 0
+    return jax.tree.unflatten(jax.tree.structure(tree), leaves)
+
+
+def _make_span(fwd_ph: str, bwd_ph: str):
+    def span(tree, fwd_name: str, bwd_name: Optional[str] = None):
+        def _primal(t):
+            # The primal body must ALSO emit: JAX uses the primal (not the
+            # fwd rule) when the span is not on a differentiation path
+            # (e.g. spans around the optimizer update).
+            tok = _emit_in_graph(_anchor_scalar(t), fwd_name, fwd_ph)
+            return _tie(t, tok)
+
+        @jax.custom_vjp
+        def f(t):
+            return _primal(t)
+
+        def fwd(t):
+            return _primal(t), None
+
+        def bwd(_, g):
+            if bwd_name is not None:
+                # Cotangent leaves can be float0 (int inputs); anchor on a
+                # constant — ordering comes from surrounding data deps.
+                tok = _emit_in_graph(jnp.zeros((), jnp.float32),
+                                     bwd_name, bwd_ph)
+                floats = [l for l in jax.tree.leaves(g)
+                          if hasattr(l, "dtype") and
+                          jnp.issubdtype(l.dtype, jnp.floating)]
+                if floats:
+                    g = _tie_first_float(g, tok)
+            return (g,)
+
+        f.defvjp(fwd, bwd)
+        return f(tree)
+
+    return span
+
+
+def _tie_first_float(tree, token):
+    leaves = jax.tree.leaves(tree)
+    for i, l in enumerate(leaves):
+        if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating):
+            leaves[i] = l + token.astype(l.dtype) * 0
+            break
+    return jax.tree.unflatten(jax.tree.structure(tree), leaves)
+
+
+# Open fwd_name in the forward pass; close bwd_name in the backward pass.
+phase_span_begin = _make_span("B", "E")
+# Close fwd_name in the forward pass; open bwd_name in the backward pass.
+phase_span_end = _make_span("E", "B")

@@ -127,12 +127,27 @@ def pretrain_gpt(
                               pipeline=ctx.pp > 1)
 
     tracer = get_tracer()
+    traced_step_fn = step_fn
     if train_cfg.trace:
         tracer.configure(
             enabled=True, trace_dir=train_cfg.trace_dir,
             interval=train_cfg.trace_interval,
             continuous_iterations=train_cfg.continuous_trace_iterations,
-            mesh_ctx=ctx)
+            granularity=train_cfg.trace_granularity, mesh_ctx=ctx)
+        # Separate compiled step with in-graph phase markers — selected only
+        # on traced iterations so untraced steps carry zero overhead (the
+        # reference's per-window tracing achieves this by skipping event
+        # creation; under jit the instrumentation must be traced in).
+        from megatronapp_tpu.trace.tracer import callbacks_supported
+        if callbacks_supported():
+            traced_step_fn = make_train_step(
+                loss_fn, optimizer, opt_cfg, ctx, shardings,
+                train_cfg.train_iters,
+                check_nan=train_cfg.check_for_nan_in_loss,
+                pipeline=ctx.pp > 1, trace_phases=True)
+        else:
+            log_fn("trace: backend lacks host callbacks; schedule-phase "
+                   "spans disabled (host-side scopes only)")
 
     losses = []
     window_tokens = 0
@@ -146,14 +161,21 @@ def pretrain_gpt(
             tracer.iteration_begin(it)
             batch = reshape_global_batch(next(batch_iter), num_micro)
             with tracer.scope("train-step"):
-                state, metrics = step_fn(state, batch)
+                active_fn = traced_step_fn if tracer.active else step_fn
+                state, metrics = active_fn(state, batch)
                 # Block for accurate per-step timing only when tracing or
                 # logging this step; otherwise let steps pipeline.
                 should_log = ((it + 1) % train_cfg.log_interval == 0 or
                               it + 1 == train_cfg.train_iters)
                 if tracer.active or should_log:
                     metrics = jax.device_get(metrics)
-            tracer.iteration_end(it)
+            was_traced = tracer.active
+            # Fence on the updated params so in-flight phase callbacks
+            # (e.g. the optimizer span) land inside this iteration window.
+            tracer.iteration_end(
+                it, fence=state["params"] if was_traced else None)
+            if was_traced:
+                tracer.save()
             window_tokens += tokens_per_step
 
             if should_log:

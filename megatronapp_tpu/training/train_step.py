@@ -40,6 +40,7 @@ def make_train_step(
     train_iters: int,
     check_nan: bool = True,
     pipeline: bool = False,
+    trace_phases: bool = False,
 ):
     """loss_fn(params, microbatch_dict) -> (loss, metrics_dict).
 
@@ -51,6 +52,27 @@ def make_train_step(
     forward_backward_no_pipelining, schedules.py:618).
     """
     sched = lr_schedule(opt_cfg, train_iters)
+    if trace_phases:
+        # MegaScan schedule-phase spans (trace/tracer.py): 'forward' spans
+        # the loss computation; its custom-VJP mirrors emit the 'backward'
+        # span during the gradient pass; 'loss' marks the loss value.
+        from megatronapp_tpu.trace.tracer import (
+            phase_span_begin, phase_span_end,
+        )
+        inner_loss = loss_fn
+
+        def loss_fn(params, micro):  # noqa: F811 — traced wrapper
+            # Spans must sit on the params→loss differentiation path so the
+            # custom-VJP backward mirrors fire: B 'forward' on params entry
+            # (its bwd emits E 'backward' when the last param cotangent
+            # leaves), E 'forward' + B 'backward' mirror on the loss.
+            params = phase_span_begin(params, "forward", "backward")
+            loss, metrics = inner_loss(params, micro)
+            loss = phase_span_end(loss, "forward", "backward")
+            loss = phase_span_begin(loss, "loss")
+            loss = phase_span_end(loss, "loss")
+            return loss, metrics
+
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def step(state, batch):
@@ -84,7 +106,15 @@ def make_train_step(
             loss = loss_sum * inv
             aux = jax.tree.map(lambda a: a * inv, aux_sum)
 
+        if trace_phases:
+            from megatronapp_tpu.trace.tracer import (
+                phase_span_begin, phase_span_end,
+            )
+            grads = phase_span_begin(grads, "allreduce")
         grad_norm = global_grad_norm(grads)
+        if trace_phases:
+            grad_norm = phase_span_end(grad_norm, "allreduce")
+            grads = phase_span_begin(grads, "optimizer")
         finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
 
         def do_update(_):
@@ -105,6 +135,8 @@ def make_train_step(
             new_params, new_opt = do_update(None)
             skipped = jnp.zeros((), jnp.int32)
 
+        if trace_phases:
+            new_params = phase_span_end(new_params, "optimizer")
         new_state = {
             "step": state["step"] + 1,
             "params": new_params,

@@ -47,6 +47,25 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=3e-6)
 
+    def test_uneven_blocks_grads(self):
+        """Backward kernels' padded-row masking: s not a block multiple."""
+        q, k, v = make_qkv(s=80, h=2, hkv=2, d=16)
+
+        def loss_f(args):
+            return jnp.sum(flash_attention(*args, causal=True, block_q=32,
+                                           block_kv=32) ** 2)
+
+        def loss_r(args):
+            from megatronapp_tpu.ops.attention import dot_product_attention
+            return jnp.sum(dot_product_attention(*args) ** 2)
+
+        gf = jax.grad(loss_f)((q, k, v))
+        gr = jax.grad(loss_r)((q, k, v))
+        for a, b in zip(gf, gr):
+            assert bool(jnp.all(jnp.isfinite(a)))
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+
     def test_grads_match(self):
         q, k, v = make_qkv(s=64, h=2, hkv=2, d=16)
 
