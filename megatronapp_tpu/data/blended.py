@@ -1,0 +1,40 @@
+"""Weighted blend of multiple datasets.
+
+Parity with /root/reference/megatron/core/datasets/blended_dataset.py:25
+(BlendedDataset): samples are drawn from constituent datasets in proportion
+to weights using the deficit-tracking index built by the C++ helper
+(build_blending_indices), deterministic and stable across runs.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from megatronapp_tpu.data.helpers import build_blending_indices
+
+
+class BlendedDataset:
+    def __init__(self, datasets: Sequence, weights: Sequence[float],
+                 num_samples: int):
+        if len(datasets) != len(weights):
+            raise ValueError("datasets and weights length mismatch")
+        self.datasets = list(datasets)
+        self.num_samples = num_samples
+        self.dataset_index, self.dataset_sample_index = \
+            build_blending_indices(np.asarray(weights, dtype=np.float64),
+                                   num_samples)
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, idx: int):
+        d = self.dataset_index[idx]
+        s = self.dataset_sample_index[idx]
+        ds = self.datasets[d]
+        return ds[int(s) % len(ds)]
+
+    @property
+    def seq_length(self):
+        return self.datasets[0].seq_length

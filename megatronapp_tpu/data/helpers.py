@@ -1,0 +1,140 @@
+"""ctypes bindings for the C++ dataset index builders (+ numpy fallback).
+
+Replaces the reference's pybind11 `helpers_cpp` module
+(/root/reference/megatron/core/datasets/helpers.cpp) — built on demand with
+g++ into libdata_helpers.so next to the source; a pure-numpy fallback keeps
+everything working where no compiler exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libdata_helpers.so")
+_LIB = None
+_LOCK = threading.Lock()
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    global _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        src = os.path.join(_NATIVE_DIR, "helpers.cpp")
+        have_src = os.path.exists(src)
+        stale = (have_src and os.path.exists(_SO_PATH) and
+                 os.path.getmtime(_SO_PATH) < os.path.getmtime(src))
+        if (not os.path.exists(_SO_PATH) or stale) and have_src:
+            # Build to a temp path and rename atomically: concurrent
+            # processes must never dlopen a half-written .so.
+            tmp = _SO_PATH + f".tmp.{os.getpid()}"
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src],
+                    check=True, capture_output=True)
+                os.replace(tmp, _SO_PATH)
+            except Exception:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return None
+        if not os.path.exists(_SO_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        lib.build_sample_idx.restype = ctypes.c_int64
+        lib.build_sample_idx.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.build_blending_indices.restype = None
+        lib.build_blending_indices.argtypes = [
+            ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int32, ctypes.c_int64,
+        ]
+        _LIB = lib
+        return _LIB
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def build_sample_idx(sizes: np.ndarray, doc_idx: np.ndarray,
+                     seq_length: int, num_samples: int) -> np.ndarray:
+    """[num_samples+1, 2] (doc_pos, offset) pairs; sample i spans tokens
+    from sample_idx[i] to sample_idx[i+1] (+1 label token overlap)."""
+    sizes = np.ascontiguousarray(sizes, dtype=np.int32)
+    doc_idx = np.ascontiguousarray(doc_idx, dtype=np.int64)
+    lib = _load_native()
+    if lib is not None:
+        out = np.zeros((num_samples + 1, 2), dtype=np.int64)
+        rc = lib.build_sample_idx(
+            _ptr(sizes, ctypes.c_int32), _ptr(doc_idx, ctypes.c_int64),
+            len(doc_idx), seq_length, num_samples,
+            _ptr(out, ctypes.c_int64))
+        if rc != 0:
+            raise ValueError(
+                "document stream exhausted before num_samples; add epochs")
+        return out
+    return _build_sample_idx_np(sizes, doc_idx, seq_length, num_samples)
+
+
+def _build_sample_idx_np(sizes, doc_idx, seq_length, num_samples):
+    out = np.zeros((num_samples + 1, 2), dtype=np.int64)
+    doc_pos, doc_offset = 0, 0
+    for i in range(1, num_samples + 1):
+        remaining = seq_length
+        while remaining > 0:
+            if doc_pos >= len(doc_idx):
+                raise ValueError(
+                    "document stream exhausted before num_samples; "
+                    "add epochs")
+            doc_len = sizes[doc_idx[doc_pos]] - doc_offset
+            if doc_len > remaining:
+                doc_offset += remaining
+                remaining = 0
+            else:
+                remaining -= doc_len
+                doc_offset = 0
+                doc_pos += 1
+        out[i] = (doc_pos, doc_offset)
+    return out
+
+
+def build_blending_indices(weights: np.ndarray, size: int
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+    """(dataset_index[size] int16, dataset_sample_index[size] int64)."""
+    weights = np.ascontiguousarray(weights, dtype=np.float64)
+    weights = weights / weights.sum()
+    lib = _load_native()
+    ds_idx = np.zeros(size, dtype=np.int16)
+    ds_sample = np.zeros(size, dtype=np.int64)
+    if lib is not None:
+        lib.build_blending_indices(
+            _ptr(ds_idx, ctypes.c_int16), _ptr(ds_sample, ctypes.c_int64),
+            _ptr(weights, ctypes.c_double), len(weights), size)
+        return ds_idx, ds_sample
+    consumed = np.zeros(len(weights), dtype=np.int64)
+    for i in range(size):
+        err = weights * (i + 1) - consumed
+        best = int(np.argmax(err))
+        ds_idx[i] = best
+        ds_sample[i] = consumed[best]
+        consumed[best] += 1
+    return ds_idx, ds_sample
+
+
+def native_available() -> bool:
+    return _load_native() is not None

@@ -33,18 +33,11 @@ class MockGPTDataset:
 
 def mock_batches(seq_length: int, vocab_size: int, batch_size: int,
                  seed: int = 0, start_idx: int = 0) -> Iterator[Dict[str, np.ndarray]]:
-    """Infinite iterator of global batches (caller shards over dp)."""
+    """Infinite iterator of global batches (caller shards over dp).
+
+    Delegates batch assembly to gpt_batches so the get_batch field contract
+    lives in one place."""
+    from megatronapp_tpu.data.gpt_dataset import gpt_batches
     ds = MockGPTDataset(seq_length, vocab_size, seed)
-    idx = start_idx
-    while True:
-        samples = np.stack([ds[idx + i] for i in range(batch_size)])
-        idx += batch_size
-        tokens = samples[:, :-1]
-        labels = samples[:, 1:]
-        yield {
-            "tokens": tokens,
-            "labels": labels,
-            "loss_mask": np.ones_like(tokens, dtype=np.float32),
-            "position_ids": np.tile(np.arange(seq_length, dtype=np.int32),
-                                    (batch_size, 1)),
-        }
+    ds.seq_length = seq_length
+    return gpt_batches(ds, batch_size, start_idx=start_idx)

@@ -1,0 +1,89 @@
+// Dataset index-building kernels (C++), ctypes ABI.
+//
+// TPU-native replacement for /root/reference/megatron/core/datasets/
+// helpers.cpp (846 LoC, pybind11): same algorithms (sample-index and
+// blending-index construction are backend-agnostic), fresh implementation
+// with a plain C ABI so Python binds via ctypes (no pybind11 in this image).
+//
+// Build: g++ -O3 -shared -fPIC -o libdata_helpers.so helpers.cpp
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Build the GPT sample index: for `num_samples` samples of `seq_length`+1
+// tokens drawn from the document stream (documents concatenated in doc_idx
+// order), record for each sample the (document-stream position, offset
+// within that document) where it starts. Mirrors the semantics of the
+// reference build_sample_idx (helpers.cpp:838-845 export).
+//
+// sizes:        token count per sequence in the underlying dataset
+// doc_idx:      epoch-expanded, shuffled document order (len = num_docs_total)
+// sample_idx:   out, shape [num_samples + 1, 2] int64 (doc_pos, offset)
+// Returns 0 on success, -1 if the document stream is exhausted early.
+int64_t build_sample_idx(const int32_t* sizes,
+                         const int64_t* doc_idx,
+                         int64_t doc_idx_len,
+                         int64_t seq_length,
+                         int64_t num_samples,
+                         int64_t* sample_idx /* [(n+1)*2] */) {
+    int64_t doc_pos = 0;     // position in doc_idx
+    int64_t doc_offset = 0;  // token offset within current document
+    sample_idx[0] = doc_pos;
+    sample_idx[1] = doc_offset;
+    for (int64_t i = 1; i <= num_samples; ++i) {
+        int64_t remaining = seq_length;  // +1 handled by overlap convention:
+        // each sample takes seq_length tokens and the next sample starts
+        // seq_length later (the trailing label token overlaps the next
+        // sample's first token, reference GPTDataset convention).
+        while (remaining > 0) {
+            if (doc_pos >= doc_idx_len) return -1;
+            int64_t doc_len = sizes[doc_idx[doc_pos]] - doc_offset;
+            if (doc_len > remaining) {
+                doc_offset += remaining;
+                remaining = 0;
+            } else {
+                remaining -= doc_len;
+                doc_offset = 0;
+                ++doc_pos;
+            }
+        }
+        sample_idx[i * 2] = doc_pos;
+        sample_idx[i * 2 + 1] = doc_offset;
+    }
+    return 0;
+}
+
+// Weighted blending: distribute `size` samples over `num_datasets` datasets
+// proportionally to weights, tracking the running deficit (reference
+// build_blending_indices): at each step pick the dataset with the largest
+// (weight * i - consumed) error.
+void build_blending_indices(int16_t* dataset_index,  // out [size]
+                            int64_t* dataset_sample_index,  // out [size]
+                            const double* weights,
+                            int32_t num_datasets,
+                            int64_t size) {
+    int64_t* consumed = new int64_t[num_datasets];
+    std::memset(consumed, 0, sizeof(int64_t) * num_datasets);
+    for (int64_t i = 0; i < size; ++i) {
+        double sample_count = static_cast<double>(i + 1);
+        int32_t best = 0;
+        double best_err = weights[0] * sample_count -
+                          static_cast<double>(consumed[0]);
+        for (int32_t d = 1; d < num_datasets; ++d) {
+            double err = weights[d] * sample_count -
+                         static_cast<double>(consumed[d]);
+            if (err > best_err) {
+                best_err = err;
+                best = d;
+            }
+        }
+        dataset_index[i] = static_cast<int16_t>(best);
+        dataset_sample_index[i] = consumed[best];
+        ++consumed[best];
+    }
+    delete[] consumed;
+}
+
+}  // extern "C"

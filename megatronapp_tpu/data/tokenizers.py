@@ -1,0 +1,81 @@
+"""Tokenizer wrappers.
+
+Parity with /root/reference/megatron/training/tokenizer/tokenizer.py
+(build_tokenizer: GPT2BPETokenizer, HuggingFaceTokenizer, NullTokenizer,
+with vocab padding to a multiple for TP divisibility).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class NullTokenizer:
+    """Integer-string passthrough (reference NullTokenizer) — for synthetic
+    and pre-tokenized data."""
+
+    def __init__(self, vocab_size: int):
+        self._vocab_size = vocab_size
+        self.eod = vocab_size - 1
+
+    @property
+    def vocab_size(self) -> int:
+        return self._vocab_size
+
+    def tokenize(self, text: str) -> List[int]:
+        return [int(t) for t in text.split()]
+
+    def detokenize(self, ids: List[int]) -> str:
+        return " ".join(str(i) for i in ids)
+
+
+class HuggingFaceTokenizer:
+    """Any HF tokenizer by name/path (reference HuggingFaceTokenizer)."""
+
+    def __init__(self, name_or_path: str):
+        from transformers import AutoTokenizer
+        self._tok = AutoTokenizer.from_pretrained(name_or_path)
+        self.eod = self._tok.eos_token_id
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tok)
+
+    def tokenize(self, text: str) -> List[int]:
+        return self._tok.encode(text)
+
+    def detokenize(self, ids: List[int]) -> str:
+        return self._tok.decode(ids)
+
+
+class GPT2BPETokenizer(HuggingFaceTokenizer):
+    """GPT-2 byte-level BPE (reference GPT2BPETokenizer; vocab/merges come
+    from the HF hub or a local path)."""
+
+    def __init__(self, name_or_path: str = "gpt2"):
+        super().__init__(name_or_path)
+
+
+def pad_vocab_size(orig_vocab_size: int, multiple: int = 128,
+                   tp: int = 1) -> int:
+    """Pad vocab to a multiple divisible by TP (reference
+    _vocab_size_with_padding)."""
+    after = orig_vocab_size
+    unit = multiple * tp
+    while after % unit != 0:
+        after += 1
+    return after
+
+
+def build_tokenizer(tokenizer_type: str, name_or_path: Optional[str] = None,
+                    vocab_size: Optional[int] = None):
+    """Factory (reference build_tokenizer)."""
+    if tokenizer_type == "NullTokenizer":
+        assert vocab_size is not None
+        return NullTokenizer(vocab_size)
+    if tokenizer_type == "GPT2BPETokenizer":
+        return GPT2BPETokenizer(name_or_path or "gpt2")
+    if tokenizer_type == "HuggingFaceTokenizer":
+        assert name_or_path
+        return HuggingFaceTokenizer(name_or_path)
+    raise ValueError(f"unknown tokenizer_type {tokenizer_type}")

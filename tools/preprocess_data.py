@@ -1,0 +1,65 @@
+"""Preprocess a JSONL corpus into the .bin/.idx indexed format.
+
+Parity with /root/reference/tools/preprocess_data.py (jsonl → tokenized
+IndexedDataset with EOD appended per document).
+
+Usage:
+  python tools/preprocess_data.py --input corpus.jsonl \
+      --output-prefix data/my_corpus --tokenizer-type GPT2BPETokenizer \
+      [--json-key text] [--append-eod]
+"""
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
+
+import numpy as np
+
+from megatronapp_tpu.data.indexed_dataset import (
+    IndexedDatasetWriter, best_dtype,
+)
+from megatronapp_tpu.data.tokenizers import build_tokenizer
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", required=True, help="jsonl file")
+    ap.add_argument("--output-prefix", required=True)
+    ap.add_argument("--json-key", default="text")
+    ap.add_argument("--tokenizer-type", default="GPT2BPETokenizer")
+    ap.add_argument("--tokenizer-name-or-path", default=None)
+    ap.add_argument("--vocab-size", type=int, default=None,
+                    help="for NullTokenizer")
+    ap.add_argument("--append-eod", action="store_true")
+    ap.add_argument("--log-interval", type=int, default=10000)
+    args = ap.parse_args()
+
+    tok = build_tokenizer(args.tokenizer_type, args.tokenizer_name_or_path,
+                          args.vocab_size)
+    dtype = best_dtype(tok.vocab_size)
+    n_docs = n_tokens = 0
+    with IndexedDatasetWriter(args.output_prefix, dtype) as writer, \
+            open(args.input) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            ids = tok.tokenize(doc[args.json_key])
+            if args.append_eod and tok.eod is not None:
+                ids = list(ids) + [tok.eod]
+            if not ids:
+                continue
+            writer.add_document(np.asarray(ids))
+            n_docs += 1
+            n_tokens += len(ids)
+            if n_docs % args.log_interval == 0:
+                print(f"processed {n_docs} docs, {n_tokens} tokens")
+    print(f"done: {n_docs} documents, {n_tokens} tokens → "
+          f"{args.output_prefix}.bin/.idx")
+
+
+if __name__ == "__main__":
+    main()
