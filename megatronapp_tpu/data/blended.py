@@ -25,6 +25,15 @@ class BlendedDataset:
         self.dataset_index, self.dataset_sample_index = \
             build_blending_indices(np.asarray(weights, dtype=np.float64),
                                    num_samples)
+        # Validate each constituent can supply its weighted share
+        # (reference BlendedDataset size check).
+        counts = np.bincount(self.dataset_index, minlength=len(datasets))
+        for d, need in enumerate(counts):
+            if need > len(self.datasets[d]):
+                raise ValueError(
+                    f"dataset {d} supplies {need} samples under these "
+                    f"weights but only has {len(self.datasets[d])}; reduce "
+                    f"num_samples or its weight")
 
     def __len__(self) -> int:
         return self.num_samples
@@ -32,8 +41,7 @@ class BlendedDataset:
     def __getitem__(self, idx: int):
         d = self.dataset_index[idx]
         s = self.dataset_sample_index[idx]
-        ds = self.datasets[d]
-        return ds[int(s) % len(ds)]
+        return self.datasets[d][int(s)]
 
     @property
     def seq_length(self):

@@ -19,14 +19,17 @@ import numpy as np
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libdata_helpers.so")
 _LIB = None
+_LOAD_FAILED = False
 _LOCK = threading.Lock()
 
 
 def _load_native() -> Optional[ctypes.CDLL]:
-    global _LIB
+    global _LIB, _LOAD_FAILED
     with _LOCK:
         if _LIB is not None:
             return _LIB
+        if _LOAD_FAILED:
+            return None
         src = os.path.join(_NATIVE_DIR, "helpers.cpp")
         have_src = os.path.exists(src)
         stale = (have_src and os.path.exists(_SO_PATH) and
@@ -45,12 +48,15 @@ def _load_native() -> Optional[ctypes.CDLL]:
                     os.unlink(tmp)
                 except OSError:
                     pass
+                _LOAD_FAILED = True
                 return None
         if not os.path.exists(_SO_PATH):
+            _LOAD_FAILED = True
             return None
         try:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError:
+            _LOAD_FAILED = True
             return None
         lib.build_sample_idx.restype = ctypes.c_int64
         lib.build_sample_idx.argtypes = [

@@ -87,7 +87,16 @@ class IndexedDatasetWriter:
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            # Don't leave a valid-looking .idx behind a mid-stream failure.
+            self._bin.close()
+            for suffix in (".bin", ".idx"):
+                try:
+                    os.unlink(self.path_prefix + suffix)
+                except OSError:
+                    pass
+            return False
         self.finalize()
 
 
@@ -138,10 +147,18 @@ class IndexedDataset:
     def get(self, idx: int, offset: int = 0,
             length: Optional[int] = None) -> np.ndarray:
         """Partial sequence read (reference IndexedDataset.get)."""
+        seq_len = int(self.sequence_lengths[idx])
+        if not 0 <= offset <= seq_len:
+            raise IndexError(
+                f"offset {offset} out of range for sequence {idx} "
+                f"(length {seq_len})")
         ptr = self.sequence_pointers[idx] + offset * self._itemsize
-        max_len = self.sequence_lengths[idx] - offset
+        max_len = seq_len - offset
         length = max_len if length is None else min(length, max_len)
-        return np.frombuffer(self._bin, dtype=self.dtype, count=int(length),
+        # np.frombuffer treats ANY negative count as "read to the end" —
+        # never let one through.
+        length = max(int(length), 0)
+        return np.frombuffer(self._bin, dtype=self.dtype, count=length,
                              offset=int(ptr))
 
     @property

@@ -39,5 +39,4 @@ def mock_batches(seq_length: int, vocab_size: int, batch_size: int,
     lives in one place."""
     from megatronapp_tpu.data.gpt_dataset import gpt_batches
     ds = MockGPTDataset(seq_length, vocab_size, seed)
-    ds.seq_length = seq_length
     return gpt_batches(ds, batch_size, start_idx=start_idx)

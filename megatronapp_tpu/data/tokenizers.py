@@ -60,11 +60,8 @@ def pad_vocab_size(orig_vocab_size: int, multiple: int = 128,
                    tp: int = 1) -> int:
     """Pad vocab to a multiple divisible by TP (reference
     _vocab_size_with_padding)."""
-    after = orig_vocab_size
     unit = multiple * tp
-    while after % unit != 0:
-        after += 1
-    return after
+    return ((orig_vocab_size + unit - 1) // unit) * unit
 
 
 def build_tokenizer(tokenizer_type: str, name_or_path: Optional[str] = None,

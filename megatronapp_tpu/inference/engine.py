@@ -1,0 +1,235 @@
+"""Static inference engine: KV-cached autoregressive generation.
+
+Parity with /root/reference/megatron/core/inference/engines/static_engine.py
+(StaticInferenceEngine), text_generation_controllers/text_generation_
+controller.py (prefill + decode loop, sampling) and
+megatron/inference/text_generation/{generation.py,sampling}: greedy,
+temperature, top-k, top-p sampling; static preallocated KV cache
+(contexts/static_context.py analogue).
+
+TPU-first: prefill is one jit over the prompt; decode is one jitted step
+(donated cache) driven by lax.while-free host loop — token-by-token outputs
+stream to a callback (the MegaScope per-token streaming contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.models.gpt import (
+    gpt_embed, gpt_head, gpt_rope_tables,
+)
+from megatronapp_tpu.transformer.block import layer_forward
+from megatronapp_tpu.scope.hooks import scope_capture
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Reference common_inference_params/SamplingParams."""
+    temperature: float = 1.0
+    top_k: int = 0          # 0 = disabled
+    top_p: float = 0.0      # 0 = disabled
+    greedy: bool = False
+    seed: int = 0
+
+
+def sample_logits(logits: jnp.ndarray, rng, params: SamplingParams):
+    """logits [B,V] → token ids [B] (generation.py sampling parity)."""
+    if params.greedy:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(params.temperature, 1e-6)
+    if params.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -params.top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if params.top_p > 0.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative prob >= top_p.
+        cutoff_idx = jnp.sum(cum < params.top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
+                                     axis=-1)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
+    """[L, B, S_max, Hkv, D] K and V (static_context.py analogue)."""
+    shape = (cfg.num_layers, batch, max_len, cfg.num_query_groups,
+             cfg.head_dim)
+    return (jnp.zeros(shape, cfg.compute_dtype),
+            jnp.zeros(shape, cfg.compute_dtype))
+
+
+def _forward_with_cache(p, tokens, cache, cache_index,
+                        cfg: TransformerConfig):
+    """tokens [B,S_step] starting at position cache_index →
+    (logits [B,S_step,V], cache). Layer loop unrolled (stacked params are
+    indexed per layer; caches updated in place via dynamic_update_slice)."""
+    b, s = tokens.shape
+    h = gpt_embed(p, tokens, cfg, position_offset=cache_index)
+    max_len = cache[0].shape[2]
+    inv_cos, inv_sin = gpt_rope_tables(cfg, max_len)
+    # Slice rope tables for the current positions.
+    if inv_cos is not None:
+        cos = jax.lax.dynamic_slice_in_dim(inv_cos, cache_index, s)
+        sin = jax.lax.dynamic_slice_in_dim(inv_sin, cache_index, s)
+    else:
+        cos = sin = None
+
+    ck, cv = cache
+
+    def body(carry, inputs):
+        hh = carry
+        layer_p, k_l, v_l, lid = inputs
+        (hh, new_cache), _ = layer_forward(
+            layer_p, hh, cfg, cos, sin, None, layer_id=lid,
+            kv_cache=(k_l, v_l), cache_index=cache_index)
+        return hh, new_cache
+
+    h, new_caches = jax.lax.scan(
+        body, h,
+        (p["block"], ck, cv, jnp.arange(cfg.num_layers)))
+    logits = gpt_head(p, h, cfg)
+    return logits, new_caches
+
+
+class StaticInferenceEngine:
+    """generate() over a fixed-shape batch with a preallocated cache."""
+
+    def __init__(self, params, cfg: TransformerConfig,
+                 tokenizer=None, max_seq_len: Optional[int] = None):
+        self.params = params
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.max_seq_len = max_seq_len or cfg.max_position_embeddings
+
+        self._prefill = jax.jit(
+            functools.partial(_forward_with_cache, cfg=cfg),
+            static_argnames=(), donate_argnums=(2,))
+        self._decode = jax.jit(
+            functools.partial(_forward_with_cache, cfg=cfg),
+            donate_argnums=(2,))
+
+    def generate(self, prompt_tokens: np.ndarray, max_new_tokens: int,
+                 sampling: Optional[SamplingParams] = None,
+                 eod_id: Optional[int] = None,
+                 token_callback: Optional[Callable] = None) -> np.ndarray:
+        """prompt_tokens [B, S_prompt] int32 → [B, S_prompt+max_new]."""
+        sampling = sampling or SamplingParams()
+        prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
+        b, s_prompt = prompt_tokens.shape
+        total = s_prompt + max_new_tokens
+        if total > self.max_seq_len:
+            raise ValueError(f"prompt+new ({total}) exceeds max_seq_len "
+                             f"({self.max_seq_len})")
+        cache = init_kv_cache(self.cfg, b, self.max_seq_len)
+        rng = jax.random.PRNGKey(sampling.seed)
+
+        logits, cache = self._prefill(self.params, prompt_tokens, cache, 0)
+        # MegaScope per-token logits hook (tik_result parity).
+        logits_last = logits[:, -1]
+        out = [prompt_tokens]
+        finished = np.zeros((b,), bool)
+        pos = s_prompt
+        for step in range(max_new_tokens):
+            rng, krng = jax.random.split(rng)
+            next_tok = sample_logits(logits_last, krng, sampling)
+            next_tok = next_tok.astype(jnp.int32)
+            tok_host = np.asarray(jax.device_get(next_tok))
+            if token_callback is not None:
+                token_callback(step, tok_host,
+                               np.asarray(jax.device_get(logits_last)))
+            if eod_id is not None:
+                finished |= tok_host == eod_id
+            out.append(next_tok[:, None])
+            if eod_id is not None and finished.all():
+                break
+            if step == max_new_tokens - 1:
+                break
+            logits, cache = self._decode(self.params, next_tok[:, None],
+                                         cache, pos)
+            logits_last = logits[:, -1]
+            pos += 1
+        return np.asarray(jax.device_get(jnp.concatenate(out, axis=1)))
+
+    def generate_text(self, prompts, max_new_tokens: int,
+                      sampling: Optional[SamplingParams] = None,
+                      token_callback: Optional[Callable] = None):
+        """String-level API (api.py generate_and_post_process parity).
+
+        Prompts of different lengths run as separate batches (no padding
+        leaks into causal attention); equal-length prompts could be batched
+        by the caller via generate()."""
+        assert self.tokenizer is not None, "tokenizer required"
+        eod = getattr(self.tokenizer, "eod", None)
+        texts = []
+        for prompt in prompts:
+            ids = np.asarray([self.tokenizer.tokenize(prompt)], np.int32)
+            out = self.generate(ids, max_new_tokens, sampling, eod_id=eod,
+                                token_callback=token_callback)
+            new_ids = out[0, ids.shape[1]:].tolist()
+            if eod is not None and eod in new_ids:
+                new_ids = new_ids[: new_ids.index(eod)]
+            texts.append(self.tokenizer.detokenize(new_ids))
+        return texts
+
+
+def beam_search(engine: StaticInferenceEngine, prompt_tokens: np.ndarray,
+                max_new_tokens: int, beam_width: int = 4,
+                length_penalty: float = 1.0,
+                eod_id: Optional[int] = None) -> np.ndarray:
+    """Beam search decode (reference generation.py beam_search parity) for a
+    single prompt [1, S]."""
+    cfg = engine.cfg
+    prompt = jnp.asarray(prompt_tokens, jnp.int32)
+    assert prompt.shape[0] == 1, "beam search takes a single prompt"
+    s_prompt = prompt.shape[1]
+
+    # Expand prompt to beam_width rows; run one shared prefill.
+    beams = jnp.tile(prompt, (beam_width, 1))
+    cache = init_kv_cache(cfg, beam_width, engine.max_seq_len)
+    logits, cache = engine._prefill(engine.params, beams, cache, 0)
+    logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+
+    # First step: take top beam_width continuations of the single prompt.
+    top_logp, top_idx = jax.lax.top_k(logp[0], beam_width)
+    scores = np.asarray(top_logp, np.float64)
+    beams = np.concatenate([np.asarray(beams),
+                            np.asarray(top_idx)[:, None]], axis=1)
+    finished = np.zeros((beam_width,), bool)
+    pos = s_prompt
+
+    for _ in range(max_new_tokens - 1):
+        if eod_id is not None and finished.all():
+            break
+        tok = jnp.asarray(beams[:, -1:], jnp.int32)
+        logits, cache = engine._decode(engine.params, tok, cache, pos)
+        pos += 1
+        logp = np.asarray(jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32), axis=-1))
+        vocab = logp.shape[-1]
+        cand = scores[:, None] + np.where(finished[:, None], -1e9, logp)
+        if eod_id is not None:
+            # Finished beams keep their score on a dummy continuation.
+            cand[finished, 0] = scores[finished]
+        flat = cand.ravel()
+        best = np.argsort(flat)[::-1][:beam_width]
+        parents, toks = best // vocab, best % vocab
+        scores = flat[best]
+        beams = np.concatenate([beams[parents], toks[:, None]], axis=1)
+        finished = finished[parents] | (
+            (toks == eod_id) if eod_id is not None else False)
+        # Reorder the cache rows to follow the surviving beams.
+        cache = jax.tree.map(lambda c: c[:, parents], cache)
+
+    lengths = (beams.shape[1] - s_prompt) * np.ones(beam_width)
+    final = scores / (lengths ** length_penalty)
+    return beams[int(np.argmax(final))][None]

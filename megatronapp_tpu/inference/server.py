@@ -1,0 +1,120 @@
+"""Text-generation server: REST /api + WebSocket per-token streaming.
+
+Parity with /root/reference/megatron/inference/text_generation_server.py
+(MegatronServer Flask PUT /api :487, InferenceWSServer/InferenceGenerate
+:29-298 — the MegaScope inference-mode streaming contract) and
+tools/run_text_generation_server.py. aiohttp replaces Flask+ws (both in one
+event loop; generation runs in a worker thread so the loop stays live).
+
+REST:  PUT /api  {"prompts": [...], "tokens_to_generate": N,
+                  "temperature": f, "top_k": i, "top_p": f, "greedy": b}
+       → {"text": [...], "segments": [...]}
+WS:    /ws — client sends the same JSON; server streams
+       {"type": "token", "step": i, "token": id, "text": str} per token
+       then {"type": "done", "text": full}.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from megatronapp_tpu.inference.engine import (
+    SamplingParams, StaticInferenceEngine,
+)
+
+
+def _sampling_from_request(req: dict) -> SamplingParams:
+    return SamplingParams(
+        temperature=float(req.get("temperature", 1.0)),
+        top_k=int(req.get("top_k", 0)),
+        top_p=float(req.get("top_p", 0.0)),
+        greedy=bool(req.get("greedy", False)),
+        seed=int(req.get("random_seed", 0)),
+    )
+
+
+class TextGenerationServer:
+    def __init__(self, engine: StaticInferenceEngine, host="0.0.0.0",
+                 port=5000):
+        self.engine = engine
+        self.host = host
+        self.port = port
+
+    # ------------------------------------------------------------------
+    async def handle_api(self, request):
+        from aiohttp import web
+        try:
+            req = await request.json()
+            prompts = req["prompts"]
+            n = int(req.get("tokens_to_generate", 64))
+            sampling = _sampling_from_request(req)
+            loop = asyncio.get_running_loop()
+            texts = await loop.run_in_executor(
+                None, lambda: self.engine.generate_text(prompts, n,
+                                                        sampling))
+            return web.json_response({
+                "text": [p + t for p, t in zip(prompts, texts)],
+                "segments": texts,
+            })
+        except Exception as e:  # parity: reference returns 400 with message
+            return web.json_response({"message": str(e)}, status=400)
+
+    async def handle_ws(self, request):
+        from aiohttp import web
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        loop = asyncio.get_running_loop()
+        async for msg in ws:
+            if msg.type != 1:  # TEXT
+                continue
+            req = json.loads(msg.data)
+            prompts = req.get("prompts") or [req.get("prompt", "")]
+            n = int(req.get("tokens_to_generate", 64))
+            sampling = _sampling_from_request(req)
+            queue: asyncio.Queue = asyncio.Queue()
+
+            def cb(step, tokens, logits):
+                text = self.engine.tokenizer.detokenize(
+                    [int(tokens[0])]) if self.engine.tokenizer else ""
+                loop.call_soon_threadsafe(queue.put_nowait, {
+                    "type": "token", "step": int(step),
+                    "token": int(tokens[0]), "text": text,
+                })
+
+            fut = loop.run_in_executor(
+                None, lambda: self.engine.generate_text(
+                    prompts[:1], n, sampling, token_callback=cb))
+            done = False
+            while not done:
+                get = asyncio.create_task(queue.get())
+                await asyncio.wait({get, fut},
+                                   return_when=asyncio.FIRST_COMPLETED)
+                while not queue.empty() or get.done():
+                    payload = (get.result() if get.done()
+                               else queue.get_nowait())
+                    await ws.send_json(payload)
+                    if queue.empty():
+                        break
+                    get = asyncio.create_task(queue.get())
+                if fut.done() and queue.empty():
+                    if not get.done():
+                        get.cancel()
+                    texts = fut.result()
+                    await ws.send_json({"type": "done", "text": texts[0]})
+                    done = True
+        return ws
+
+    # ------------------------------------------------------------------
+    def build_app(self):
+        from aiohttp import web
+        app = web.Application()
+        app.router.add_put("/api", self.handle_api)
+        app.router.add_post("/api", self.handle_api)
+        app.router.add_get("/ws", self.handle_ws)
+        return app
+
+    def run(self):
+        from aiohttp import web
+        web.run_app(self.build_app(), host=self.host, port=self.port)

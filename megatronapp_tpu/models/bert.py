@@ -1,0 +1,171 @@
+"""BERT model (bidirectional encoder, MLM + NSP heads).
+
+Parity with /root/reference/megatron/core/models/bert/bert_model.py
+(BertModel: embeddings incl. tokentype, bidirectional TransformerBlock with
+padding mask, BertLMHead dense+gelu+LN → tied-embedding logits, optional
+binary NSP head) and pretrain_bert.py's loss (masked-LM CE + NSP CE).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import (
+    AttnMaskType, NormKind, PositionEmbeddingKind, TransformerConfig,
+)
+from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
+from megatronapp_tpu.ops.normalization import apply_norm
+from megatronapp_tpu.ops.activations import gelu
+from megatronapp_tpu.transformer.block import block_forward, init_block_params
+
+
+def bert_config(**kw) -> TransformerConfig:
+    """BERT-flavored TransformerConfig defaults (learned positions,
+    bidirectional+padding attention)."""
+    defaults = dict(
+        position_embedding=PositionEmbeddingKind.learned_absolute,
+        attn_mask_type=AttnMaskType.padding,
+        add_qkv_bias=True,
+    )
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
+def init_bert_params(rng, cfg: TransformerConfig, num_tokentypes: int = 2,
+                     add_binary_head: bool = True):
+    k_emb, k_pos, k_tt, k_block, k_lm, k_bin = jax.random.split(rng, 6)
+    std = cfg.init_method_std
+    h = cfg.hidden_size
+    p = {
+        "embedding": {
+            "word": jax.random.normal(
+                k_emb, (cfg.vocab_size, h), cfg.params_dtype) * std,
+            "pos": jax.random.normal(
+                k_pos, (cfg.max_position_embeddings, h),
+                cfg.params_dtype) * std,
+            "tokentype": jax.random.normal(
+                k_tt, (num_tokentypes, h), cfg.params_dtype) * std,
+        },
+        "emb_ln_scale": jnp.ones((h,), cfg.params_dtype),
+        "emb_ln_bias": jnp.zeros((h,), cfg.params_dtype),
+        # BertLMHead: dense + LN then tied-embedding projection.
+        "lm_head": {
+            "dense": jax.random.normal(k_lm, (h, h), cfg.params_dtype) * std,
+            "dense_bias": jnp.zeros((h,), cfg.params_dtype),
+            "ln_scale": jnp.ones((h,), cfg.params_dtype),
+            "ln_bias": jnp.zeros((h,), cfg.params_dtype),
+            "output_bias": jnp.zeros((cfg.vocab_size,), cfg.params_dtype),
+        },
+    }
+    ax = {
+        "embedding": {"word": ("vocab", "embed"), "pos": ("pos", "embed"),
+                      "tokentype": (None, "embed")},
+        "emb_ln_scale": ("embed",),
+        "emb_ln_bias": ("embed",),
+        "lm_head": {
+            "dense": ("embed", "embed"), "dense_bias": ("embed",),
+            "ln_scale": ("embed",), "ln_bias": ("embed",),
+            "output_bias": ("vocab",),
+        },
+    }
+    p["block"], ax["block"] = init_block_params(k_block, cfg)
+    if add_binary_head:
+        p["binary_head"] = {
+            "pooler": jax.random.normal(k_bin, (h, h),
+                                        cfg.params_dtype) * std,
+            "pooler_bias": jnp.zeros((h,), cfg.params_dtype),
+            "dense": jax.random.normal(k_bin, (h, 2),
+                                       cfg.params_dtype) * std,
+            "dense_bias": jnp.zeros((2,), cfg.params_dtype),
+        }
+        ax["binary_head"] = {
+            "pooler": ("embed", "embed"), "pooler_bias": ("embed",),
+            "dense": ("embed", None), "dense_bias": (None,),
+        }
+    return p, ax
+
+
+def bert_forward(p, tokens, cfg: TransformerConfig,
+                 padding_mask: Optional[jnp.ndarray] = None,
+                 tokentype_ids: Optional[jnp.ndarray] = None, ctx=None):
+    """tokens [B,S] (+ padding_mask [B,S] 1=real) →
+    (lm_logits [B,S,V], binary_logits [B,2] | None)."""
+    b, s = tokens.shape
+    emb = p["embedding"]
+    h = jnp.take(emb["word"], tokens, axis=0)
+    h = h + jnp.take(emb["pos"], jnp.arange(s), axis=0)
+    if tokentype_ids is not None:
+        h = h + jnp.take(emb["tokentype"], tokentype_ids, axis=0)
+    else:
+        h = h + emb["tokentype"][0]
+    h = apply_norm(NormKind.layernorm, h, p["emb_ln_scale"],
+                   p["emb_ln_bias"], cfg.layernorm_epsilon)
+    h = h.astype(cfg.compute_dtype)
+
+    attn_mask = None
+    if padding_mask is not None:
+        # [B,1,1,S] True=attend; bidirectional otherwise.
+        attn_mask = padding_mask[:, None, None, :].astype(bool)
+    h, _ = block_forward(p["block"], h, cfg, None, None, attn_mask, ctx=ctx)
+
+    # LM head (bert_lm_head: dense+gelu+LN then tied projection).
+    lm = p["lm_head"]
+    y = gelu(h.astype(jnp.float32) @ lm["dense"].astype(jnp.float32)
+             + lm["dense_bias"].astype(jnp.float32))
+    y = apply_norm(NormKind.layernorm, y, lm["ln_scale"], lm["ln_bias"],
+                   cfg.layernorm_epsilon)
+    logits = (y.astype(cfg.compute_dtype)
+              @ emb["word"].T.astype(cfg.compute_dtype)).astype(jnp.float32)
+    logits = logits + lm["output_bias"].astype(jnp.float32)
+
+    binary_logits = None
+    if "binary_head" in p:
+        bh = p["binary_head"]
+        pooled = jnp.tanh(h[:, 0].astype(jnp.float32)
+                          @ bh["pooler"].astype(jnp.float32)
+                          + bh["pooler_bias"].astype(jnp.float32))
+        binary_logits = (pooled @ bh["dense"].astype(jnp.float32)
+                         + bh["dense_bias"].astype(jnp.float32))
+    return logits, binary_logits
+
+
+def bert_loss(p, batch, cfg: TransformerConfig, ctx=None):
+    """Masked-LM CE (over loss_mask positions) + NSP CE
+    (pretrain_bert.py loss_func parity)."""
+    logits, binary_logits = bert_forward(
+        p, batch["tokens"], cfg, padding_mask=batch.get("padding_mask"),
+        tokentype_ids=batch.get("tokentype_ids"), ctx=ctx)
+    lm_loss, _ = cross_entropy_loss(logits, batch["labels"],
+                                    batch["loss_mask"])
+    total = lm_loss
+    metrics = {"lm_loss": lm_loss}
+    if binary_logits is not None and "is_random" in batch:
+        nsp, _ = cross_entropy_loss(binary_logits[:, None, :],
+                                    batch["is_random"][:, None])
+        total = total + nsp
+        metrics["sop_loss"] = nsp
+    else:
+        metrics["sop_loss"] = jnp.zeros((), jnp.float32)
+    return total, metrics
+
+
+def mock_bert_batch(rng, batch_size, seq_length, vocab_size,
+                    mask_prob=0.15, mask_id=4):
+    """Synthetic masked-LM batch (reference MockBertDataset semantics)."""
+    import numpy as np
+    r = np.random.default_rng(rng)
+    tokens = r.integers(5, vocab_size, size=(batch_size, seq_length))
+    labels = tokens.copy()
+    mask = r.random((batch_size, seq_length)) < mask_prob
+    tokens = np.where(mask, mask_id, tokens)
+    return {
+        "tokens": tokens.astype(np.int32),
+        "labels": labels.astype(np.int32),
+        "loss_mask": mask.astype(np.float32),
+        "padding_mask": np.ones((batch_size, seq_length), np.float32),
+        "tokentype_ids": np.zeros((batch_size, seq_length), np.int32),
+        "is_random": r.integers(0, 2, size=(batch_size,)).astype(np.int32),
+    }

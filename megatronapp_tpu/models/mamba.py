@@ -1,0 +1,222 @@
+"""Mamba (selective state-space) model.
+
+Parity with /root/reference/megatron/core/ssm/ (MambaMixer/MambaBlock,
+1.6k LoC; hybrid mamba/attention layer allocation in mamba_hybrid_layer_
+allocation.py). The reference leans on Triton kernels for the selective
+scan; TPU-first this is a ``lax.associative_scan`` — the first-order
+recurrence h_t = a_t h_{t-1} + b_t is associative, so XLA lowers it to a
+log-depth parallel scan that maps well onto the VPU, no custom kernel
+needed.
+
+Mixer structure (Mamba-1): in_proj → (x, z); causal depthwise conv1d;
+silu; data-dependent Δ, B, C; selective scan over diagonal A; gate by
+silu(z); out_proj.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import (
+    NormKind, TransformerConfig,
+)
+from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
+from megatronapp_tpu.ops.normalization import apply_norm, rms_norm
+from megatronapp_tpu.parallel.sharding import is_logical_axes
+from megatronapp_tpu.transformer.block import (
+    _remat_wrap, init_layer_params, layer_forward,
+)
+
+
+@dataclasses.dataclass
+class MambaConfig:
+    """SSM hyperparameters (reference MambaMixer defaults)."""
+    state_dim: int = 16        # N
+    conv_kernel: int = 4
+    expand: int = 2            # E = expand * hidden
+    dt_rank: Optional[int] = None  # defaults to ceil(hidden/16)
+    # 'M' = mamba layer, '*' = attention layer (reference hybrid allocation
+    # string, e.g. 'MMM*MMM*' — ssm/mamba_hybrid_layer_allocation.py).
+    hybrid_pattern: Optional[str] = None
+
+
+def init_mamba_mixer_params(rng, cfg: TransformerConfig, mcfg: MambaConfig):
+    h = cfg.hidden_size
+    e = mcfg.expand * h
+    n = mcfg.state_dim
+    dt_rank = mcfg.dt_rank or max(h // 16, 1)
+    keys = jax.random.split(rng, 6)
+    std = cfg.init_method_std
+    p = {
+        "in_kernel": jax.random.normal(keys[0], (h, 2 * e),
+                                       cfg.params_dtype) * std,
+        "conv_kernel": jax.random.normal(
+            keys[1], (mcfg.conv_kernel, e), cfg.params_dtype) * std,
+        "conv_bias": jnp.zeros((e,), cfg.params_dtype),
+        # x → (Δ_rank, B, C)
+        "x_proj": jax.random.normal(keys[2], (e, dt_rank + 2 * n),
+                                    cfg.params_dtype) * std,
+        "dt_proj": jax.random.normal(keys[3], (dt_rank, e),
+                                     cfg.params_dtype) * std,
+        # softplus(dt_bias) initialized in [1e-3, 1e-1] (reference dt init).
+        "dt_bias": jnp.log(jnp.expm1(jnp.exp(jax.random.uniform(
+            keys[4], (e,), jnp.float32,
+            jnp.log(1e-3), jnp.log(1e-1))))).astype(cfg.params_dtype),
+        # A negative-real diagonal, initialized -[1..N] per channel.
+        "A_log": jnp.log(jnp.tile(jnp.arange(1, n + 1, dtype=jnp.float32),
+                                  (e, 1))).astype(cfg.params_dtype),
+        "D": jnp.ones((e,), cfg.params_dtype),
+        "out_kernel": jax.random.normal(
+            keys[5], (e, h), cfg.params_dtype) * (
+                std / jnp.sqrt(2.0 * cfg.num_layers)),
+    }
+    ax = {
+        "in_kernel": ("embed", "mlp"), "conv_kernel": (None, "mlp"),
+        "conv_bias": ("mlp",), "x_proj": ("mlp", None),
+        "dt_proj": (None, "mlp"), "dt_bias": ("mlp",),
+        "A_log": ("mlp", None), "D": ("mlp",),
+        "out_kernel": ("mlp", "embed"),
+    }
+    return p, ax
+
+
+def _selective_scan(u, dt, A, B, C, D):
+    """u,dt [B,S,E]; A [E,N]; B,C [B,S,N]; D [E] → y [B,S,E].
+
+    h_t = exp(dt_t A) h_{t-1} + dt_t B_t u_t ;  y_t = C_t · h_t + D u_t.
+    Runs as a parallel associative scan over the sequence axis.
+    """
+    # Discretize: a [B,S,E,N], b [B,S,E,N].
+    a = jnp.exp(dt[..., None] * A[None, None])            # [B,S,E,N]
+    b = dt[..., None] * B[:, :, None, :] * u[..., None]   # [B,S,E,N]
+
+    def combine(left, right):
+        a_l, b_l = left
+        a_r, b_r = right
+        return a_l * a_r, a_r * b_l + b_r
+
+    _, h = jax.lax.associative_scan(combine, (a, b), axis=1)
+    y = jnp.einsum("bsen,bsn->bse", h, C)
+    return y + u * D[None, None]
+
+
+def mamba_mixer_forward(p, x, cfg: TransformerConfig, mcfg: MambaConfig):
+    """x [B,S,H] → [B,S,H]."""
+    b, s, h = x.shape
+    e = mcfg.expand * h
+    n = mcfg.state_dim
+    dt_rank = mcfg.dt_rank or max(h // 16, 1)
+    dt_f32 = jnp.float32
+    xz = x.astype(cfg.compute_dtype) @ p["in_kernel"].astype(
+        cfg.compute_dtype)
+    u, z = jnp.split(xz, 2, axis=-1)
+
+    # Causal depthwise conv along seq.
+    k = mcfg.conv_kernel
+    u_pad = jnp.pad(u, ((0, 0), (k - 1, 0), (0, 0)))
+    windows = jnp.stack([u_pad[:, i:i + s] for i in range(k)], axis=0)
+    u = jnp.einsum("kbse,ke->bse", windows,
+                   p["conv_kernel"].astype(u.dtype))
+    u = u + p["conv_bias"].astype(u.dtype)
+    u = jax.nn.silu(u)
+
+    proj = u @ p["x_proj"].astype(u.dtype)  # [B,S,dt_rank+2N]
+    dt_r, B_, C_ = jnp.split(proj, [dt_rank, dt_rank + n], axis=-1)
+    dt = jax.nn.softplus(
+        dt_r.astype(dt_f32) @ p["dt_proj"].astype(dt_f32)
+        + p["dt_bias"].astype(dt_f32))
+    A = -jnp.exp(p["A_log"].astype(dt_f32))
+    y = _selective_scan(u.astype(dt_f32), dt, A, B_.astype(dt_f32),
+                        C_.astype(dt_f32), p["D"].astype(dt_f32))
+    y = y.astype(cfg.compute_dtype) * jax.nn.silu(z)
+    return y @ p["out_kernel"].astype(cfg.compute_dtype)
+
+
+def init_mamba_params(rng, cfg: TransformerConfig, mcfg: MambaConfig):
+    """Stacked mamba layers (+ optional interleaved attention via
+    hybrid_pattern) + embedding + head."""
+    pattern = mcfg.hybrid_pattern or "M" * cfg.num_layers
+    if len(pattern) != cfg.num_layers:
+        raise ValueError("hybrid_pattern length must equal num_layers")
+    k_emb, k_layers, k_out = jax.random.split(rng, 3)
+    std = cfg.init_method_std
+    h = cfg.hidden_size
+    p = {
+        "embedding": {"word": jax.random.normal(
+            k_emb, (cfg.vocab_size, h), cfg.params_dtype) * std},
+        "final_ln_scale": jnp.ones((h,), cfg.params_dtype),
+    }
+    ax = {
+        "embedding": {"word": ("vocab", "embed")},
+        "final_ln_scale": ("embed",),
+    }
+    keys = jax.random.split(k_layers, cfg.num_layers)
+    layers_p, layers_ax = [], None
+    for i, kind in enumerate(pattern):
+        if kind == "M":
+            mp, max_ = init_mamba_mixer_params(keys[i], cfg, mcfg)
+            lp = {"ln_scale": jnp.ones((h,), cfg.params_dtype),
+                  "mixer": mp}
+            lax_ = {"ln_scale": ("embed",), "mixer": max_}
+        elif kind == "*":
+            lp, lax_ = init_layer_params(keys[i], cfg)
+        else:
+            raise ValueError(f"unknown layer kind {kind!r}")
+        layers_p.append((kind, lp, lax_))
+    # Hybrid stacks are heterogeneous → store as a list (unrolled loop);
+    # a pure-M stack is stacked for lax.scan.
+    if set(pattern) == {"M"}:
+        p["layers"] = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                   *[lp for _, lp, _ in layers_p])
+        ax["layers"] = jax.tree.map(lambda axes: ("layers",) + axes,
+                                    layers_p[0][2], is_leaf=is_logical_axes)
+    else:
+        p["layers"] = [lp for _, lp, _ in layers_p]
+        ax["layers"] = [lax_ for _, _, lax_ in layers_p]
+    return p, ax
+
+
+def mamba_forward(p, tokens, cfg: TransformerConfig, mcfg: MambaConfig,
+                  ctx=None):
+    pattern = mcfg.hybrid_pattern or "M" * cfg.num_layers
+    h = jnp.take(p["embedding"]["word"], tokens, axis=0).astype(
+        cfg.compute_dtype)
+
+    if set(pattern) == {"M"}:
+        def body(carry, layer_p):
+            x = carry
+            y = rms_norm(x, layer_p["ln_scale"], cfg.layernorm_epsilon)
+            x = x + mamba_mixer_forward(layer_p["mixer"], y, cfg,
+                                        mcfg).astype(x.dtype)
+            return x, None
+
+        body = _remat_wrap(body, cfg.remat_policy)
+        h, _ = jax.lax.scan(body, h, p["layers"])
+    else:
+        from megatronapp_tpu.models.gpt import gpt_rope_tables
+        cos, sin = gpt_rope_tables(cfg, tokens.shape[1])
+        for kind, layer_p in zip(pattern, p["layers"]):
+            if kind == "M":
+                y = rms_norm(h, layer_p["ln_scale"], cfg.layernorm_epsilon)
+                h = h + mamba_mixer_forward(layer_p["mixer"], y, cfg,
+                                            mcfg).astype(h.dtype)
+            else:
+                (h, _), _ = layer_forward(layer_p, h, cfg, cos, sin,
+                                          ctx=ctx)
+
+    h = rms_norm(h, p["final_ln_scale"], cfg.layernorm_epsilon)
+    dt = cfg.compute_dtype
+    logits = h.astype(dt) @ p["embedding"]["word"].T.astype(dt)
+    return logits.astype(jnp.float32)
+
+
+def mamba_loss(p, tokens, targets, loss_mask, cfg: TransformerConfig,
+               mcfg: MambaConfig, ctx=None):
+    """pretrain_mamba.py loss parity."""
+    logits = mamba_forward(p, tokens, cfg, mcfg, ctx=ctx)
+    loss, _ = cross_entropy_loss(logits, targets, loss_mask)
+    return loss, {"lm_loss": loss}

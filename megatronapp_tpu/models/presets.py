@@ -1,0 +1,90 @@
+"""Named model-family presets.
+
+Parity targets from the reference example configs (examples/gpt3,
+examples/mixtral/train_mixtral_8x7b_distributed.sh:51,85, run_single_gpt.sh,
+BASELINE.md parity list).
+"""
+
+from __future__ import annotations
+
+from megatronapp_tpu.config.transformer_config import (
+    ActivationKind, NormKind, PositionEmbeddingKind, TransformerConfig,
+)
+
+
+def gpt2_125m(**kw) -> TransformerConfig:
+    d = dict(num_layers=12, hidden_size=768, num_attention_heads=12,
+             vocab_size=50304, max_position_embeddings=1024,
+             position_embedding=PositionEmbeddingKind.learned_absolute,
+             add_qkv_bias=True)
+    d.update(kw)
+    return TransformerConfig(**d)
+
+
+def gpt3_2p7b(**kw) -> TransformerConfig:
+    """BASELINE.md north-star model (GPT-3 2.7B)."""
+    d = dict(num_layers=32, hidden_size=2560, num_attention_heads=32,
+             vocab_size=50304, max_position_embeddings=2048)
+    d.update(kw)
+    return TransformerConfig(**d)
+
+
+def gpt_16l_2048h(**kw) -> TransformerConfig:
+    """Reference DPP/FBD test model (test_train_gpt_single_dpp.sh:30-66:
+    16L / h2048 / 32 heads / seq 2048)."""
+    d = dict(num_layers=16, hidden_size=2048, num_attention_heads=32,
+             vocab_size=50304, max_position_embeddings=2048)
+    d.update(kw)
+    return TransformerConfig(**d)
+
+
+def llama3_8b(**kw) -> TransformerConfig:
+    d = dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
+             num_query_groups=8, ffn_hidden_size=14336, vocab_size=128256,
+             max_position_embeddings=8192, rotary_base=500000.0,
+             activation=ActivationKind.swiglu,
+             normalization=NormKind.rmsnorm, add_bias_linear=False,
+             untie_embeddings_and_output_weights=True)
+    d.update(kw)
+    return TransformerConfig(**d)
+
+
+def mixtral_8x7b(**kw) -> TransformerConfig:
+    """examples/mixtral parity: 8 experts, top-2, GQA-8."""
+    d = dict(num_layers=32, hidden_size=4096, num_attention_heads=32,
+             num_query_groups=8, ffn_hidden_size=14336, vocab_size=32000,
+             max_position_embeddings=32768, rotary_base=1e6,
+             activation=ActivationKind.swiglu,
+             normalization=NormKind.rmsnorm, add_bias_linear=False,
+             untie_embeddings_and_output_weights=True,
+             num_moe_experts=8, moe_router_topk=2,
+             moe_ffn_hidden_size=14336, moe_aux_loss_coeff=0.02)
+    d.update(kw)
+    return TransformerConfig(**d)
+
+
+def bert_base(**kw) -> TransformerConfig:
+    from megatronapp_tpu.models.bert import bert_config
+    d = dict(num_layers=12, hidden_size=768, num_attention_heads=12,
+             vocab_size=30592, max_position_embeddings=512)
+    d.update(kw)
+    return bert_config(**d)
+
+
+def t5_base(**kw) -> TransformerConfig:
+    from megatronapp_tpu.models.t5 import t5_config
+    d = dict(num_layers=12, hidden_size=768, num_attention_heads=12,
+             vocab_size=32128, max_position_embeddings=512)
+    d.update(kw)
+    return t5_config(**d)
+
+
+PRESETS = {
+    "gpt2-125m": gpt2_125m,
+    "gpt3-2.7b": gpt3_2p7b,
+    "gpt-16l-2048h": gpt_16l_2048h,
+    "llama3-8b": llama3_8b,
+    "mixtral-8x7b": mixtral_8x7b,
+    "bert-base": bert_base,
+    "t5-base": t5_base,
+}

@@ -1,0 +1,209 @@
+"""T5 encoder-decoder model.
+
+Parity with /root/reference/megatron/core/models/T5/t5_model.py (T5Model:
+shared token embedding, bidirectional encoder block, decoder block with
+causal self-attention + cross-attention over encoder output, tied LM head)
+and pretrain_t5.py's span-corruption loss plumbing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import (
+    AttnMaskType, NormKind, PositionEmbeddingKind, TransformerConfig,
+)
+from megatronapp_tpu.ops.attention import dot_product_attention
+from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
+from megatronapp_tpu.ops.normalization import apply_norm
+from megatronapp_tpu.transformer.attention import (
+    attention_forward, init_attention_params,
+)
+from megatronapp_tpu.transformer.block import (
+    block_forward, init_block_params, init_layer_params, _remat_wrap,
+)
+from megatronapp_tpu.transformer.mlp import init_mlp_params, mlp_forward
+from megatronapp_tpu.parallel.sharding import is_logical_axes
+
+
+def t5_config(**kw) -> TransformerConfig:
+    defaults = dict(position_embedding=PositionEmbeddingKind.learned_absolute,
+                    add_qkv_bias=False, add_bias_linear=False,
+                    normalization=NormKind.rmsnorm)
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
+def _init_cross_attention_params(rng, cfg: TransformerConfig, out_std):
+    h, d = cfg.hidden_size, cfg.head_dim
+    nq, nkv = cfg.num_attention_heads, cfg.num_query_groups
+    k1, k2, k3 = jax.random.split(rng, 3)
+    std = cfg.init_method_std
+    p = {
+        "q_kernel": jax.random.normal(k1, (h, nq * d), cfg.params_dtype) * std,
+        "kv_kernel": jax.random.normal(k2, (h, 2 * nkv * d),
+                                       cfg.params_dtype) * std,
+        "out_kernel": jax.random.normal(k3, (nq * d, h),
+                                        cfg.params_dtype) * out_std,
+    }
+    ax = {"q_kernel": ("embed", "qkv"), "kv_kernel": ("embed", "qkv"),
+          "out_kernel": ("qkv", "embed")}
+    return p, ax
+
+
+def _cross_attention_forward(p, x, enc_out, cfg: TransformerConfig,
+                             enc_mask: Optional[jnp.ndarray] = None):
+    """x [B,Sd,H] attends over enc_out [B,Se,H]."""
+    b, sd, _ = x.shape
+    se = enc_out.shape[1]
+    d = cfg.head_dim
+    nq, nkv = cfg.num_attention_heads, cfg.num_query_groups
+    dt = cfg.compute_dtype
+    q = (x.astype(dt) @ p["q_kernel"].astype(dt)).reshape(b, sd, nq, d)
+    kv = (enc_out.astype(dt) @ p["kv_kernel"].astype(dt))
+    k, v = jnp.split(kv.reshape(b, se, 2 * nkv, d), 2, axis=2)
+    mask = None
+    if enc_mask is not None:
+        mask = enc_mask[:, None, None, :].astype(bool)
+    ctx_ = dot_product_attention(q, k, v,
+                                 mask_type=AttnMaskType.bidirectional,
+                                 attention_mask=mask)
+    return ctx_.reshape(b, sd, nq * d) @ p["out_kernel"].astype(dt)
+
+
+def init_t5_decoder_layer_params(rng, cfg: TransformerConfig):
+    out_std = cfg.init_method_std / jnp.sqrt(2.0 * cfg.num_layers)
+    k_self, k_cross, k_mlp = jax.random.split(rng, 3)
+    self_p, self_ax = init_attention_params(k_self, cfg, out_std)
+    cross_p, cross_ax = _init_cross_attention_params(k_cross, cfg, out_std)
+    mlp_p, mlp_ax = init_mlp_params(k_mlp, cfg, out_std)
+    h = cfg.hidden_size
+    p = {"ln1_scale": jnp.ones((h,), cfg.params_dtype),
+         "ln_cross_scale": jnp.ones((h,), cfg.params_dtype),
+         "ln2_scale": jnp.ones((h,), cfg.params_dtype),
+         "self_attention": self_p, "cross_attention": cross_p, "mlp": mlp_p}
+    ax = {"ln1_scale": ("embed",), "ln_cross_scale": ("embed",),
+          "ln2_scale": ("embed",),
+          "self_attention": self_ax, "cross_attention": cross_ax,
+          "mlp": mlp_ax}
+    if cfg.normalization == NormKind.layernorm:
+        for name in ("ln1", "ln_cross", "ln2"):
+            p[f"{name}_bias"] = jnp.zeros((h,), cfg.params_dtype)
+            ax[f"{name}_bias"] = ("embed",)
+    return p, ax
+
+
+def t5_decoder_layer_forward(p, x, enc_out, cfg: TransformerConfig,
+                             enc_mask=None, ctx=None):
+    residual = x
+    h = apply_norm(cfg.normalization, x, p["ln1_scale"], p.get("ln1_bias"),
+                   cfg.layernorm_epsilon)
+    # Causal self-attention over the decoder stream.
+    attn_out, _ = attention_forward(p["self_attention"], h, cfg,
+                                    None, None, None, ctx=ctx)
+    x = residual + attn_out.astype(residual.dtype)
+
+    residual = x
+    h = apply_norm(cfg.normalization, x, p["ln_cross_scale"],
+                   p.get("ln_cross_bias"), cfg.layernorm_epsilon)
+    cross_out = _cross_attention_forward(p["cross_attention"], h, enc_out,
+                                         cfg, enc_mask)
+    x = residual + cross_out.astype(residual.dtype)
+
+    residual = x
+    h = apply_norm(cfg.normalization, x, p["ln2_scale"], p.get("ln2_bias"),
+                   cfg.layernorm_epsilon)
+    x = residual + mlp_forward(p["mlp"], h, cfg).astype(residual.dtype)
+    return x
+
+
+def init_t5_params(rng, enc_cfg: TransformerConfig,
+                   dec_cfg: Optional[TransformerConfig] = None):
+    """Shared embedding + encoder block + stacked decoder layers + final
+    norms. dec_cfg defaults to enc_cfg (with causal self-attention)."""
+    dec_cfg = dec_cfg or dataclasses.replace(
+        enc_cfg, attn_mask_type=AttnMaskType.causal)
+    k_emb, k_pos, k_enc, k_dec = jax.random.split(rng, 4)
+    std = enc_cfg.init_method_std
+    h = enc_cfg.hidden_size
+    p = {
+        "embedding": {
+            "word": jax.random.normal(
+                k_emb, (enc_cfg.vocab_size, h), enc_cfg.params_dtype) * std,
+            "pos": jax.random.normal(
+                k_pos, (enc_cfg.max_position_embeddings, h),
+                enc_cfg.params_dtype) * std,
+        },
+        "enc_final_ln_scale": jnp.ones((h,), enc_cfg.params_dtype),
+        "dec_final_ln_scale": jnp.ones((h,), enc_cfg.params_dtype),
+    }
+    ax = {
+        "embedding": {"word": ("vocab", "embed"), "pos": ("pos", "embed")},
+        "enc_final_ln_scale": ("embed",),
+        "dec_final_ln_scale": ("embed",),
+    }
+    p["encoder"], ax["encoder"] = init_block_params(k_enc, enc_cfg)
+    keys = jax.random.split(k_dec, dec_cfg.num_layers)
+    per_layer = [init_t5_decoder_layer_params(k, dec_cfg) for k in keys]
+    p["decoder"] = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                *[q for q, _ in per_layer])
+    ax["decoder"] = jax.tree.map(lambda axes: ("layers",) + axes,
+                                 per_layer[0][1], is_leaf=is_logical_axes)
+    return p, ax
+
+
+def _embed(p, tokens, cfg):
+    s = tokens.shape[1]
+    h = jnp.take(p["embedding"]["word"], tokens, axis=0)
+    h = h + jnp.take(p["embedding"]["pos"], jnp.arange(s), axis=0)
+    return h.astype(cfg.compute_dtype)
+
+
+def t5_forward(p, enc_tokens, dec_tokens, enc_cfg: TransformerConfig,
+               dec_cfg: Optional[TransformerConfig] = None,
+               enc_mask: Optional[jnp.ndarray] = None, ctx=None):
+    """→ lm_logits [B, Sd, V] fp32."""
+    dec_cfg = dec_cfg or dataclasses.replace(
+        enc_cfg, attn_mask_type=AttnMaskType.causal)
+
+    # Encoder (bidirectional; padding mask optional).
+    h_enc = _embed(p, enc_tokens, enc_cfg)
+    enc_run_cfg = dataclasses.replace(
+        enc_cfg, attn_mask_type=AttnMaskType.bidirectional)
+    attn_mask = (enc_mask[:, None, None, :].astype(bool)
+                 if enc_mask is not None else None)
+    enc_out, _ = block_forward(p["encoder"], h_enc, enc_run_cfg, None, None,
+                               attn_mask, ctx=ctx)
+    enc_out = apply_norm(enc_cfg.normalization, enc_out,
+                         p["enc_final_ln_scale"], None,
+                         enc_cfg.layernorm_epsilon)
+
+    # Decoder scan over stacked layers.
+    h_dec = _embed(p, dec_tokens, dec_cfg)
+
+    def body(carry, layer_p):
+        hh = t5_decoder_layer_forward(layer_p, carry, enc_out, dec_cfg,
+                                      enc_mask, ctx=ctx)
+        return hh, None
+
+    body = _remat_wrap(body, dec_cfg.remat_policy)
+    h_dec, _ = jax.lax.scan(body, h_dec, p["decoder"])
+    h_dec = apply_norm(dec_cfg.normalization, h_dec,
+                       p["dec_final_ln_scale"], None,
+                       dec_cfg.layernorm_epsilon)
+    dt = dec_cfg.compute_dtype
+    logits = h_dec.astype(dt) @ p["embedding"]["word"].T.astype(dt)
+    return logits.astype(jnp.float32)
+
+
+def t5_loss(p, batch, enc_cfg: TransformerConfig, ctx=None):
+    """pretrain_t5.py loss parity: CE over decoder targets with loss mask."""
+    logits = t5_forward(p, batch["text_enc"], batch["text_dec"], enc_cfg,
+                        enc_mask=batch.get("enc_mask"), ctx=ctx)
+    loss, _ = cross_entropy_loss(logits, batch["labels"],
+                                 batch.get("loss_mask"))
+    return loss, {"lm_loss": loss}

@@ -167,10 +167,13 @@ class TestBlended:
     def test_blended_dataset(self, small_corpus):
         prefix, _ = small_corpus
         indexed = IndexedDataset(prefix)
-        a = GPTDataset(indexed, seq_length=16, num_samples=30, seed=1)
+        a = GPTDataset(indexed, seq_length=16, num_samples=40, seed=1)
         b = GPTDataset(indexed, seq_length=16, num_samples=30, seed=2)
         blend = BlendedDataset([a, b], [0.7, 0.3], 50)
         assert len(blend) == 50
         assert blend[0].shape == (17,)
         counts = np.bincount(blend.dataset_index, minlength=2)
         np.testing.assert_allclose(counts / 50, [0.7, 0.3], atol=0.03)
+        # Undersized constituent is rejected up front.
+        with pytest.raises(ValueError):
+            BlendedDataset([b, a], [0.9, 0.1], 50)

@@ -1,0 +1,151 @@
+"""Inference engine + server tests (reference tests/unit_tests/inference/)."""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.data.tokenizers import NullTokenizer
+from megatronapp_tpu.inference.engine import (
+    SamplingParams, StaticInferenceEngine, beam_search, sample_logits,
+)
+from megatronapp_tpu.models.gpt import gpt_forward, init_gpt_params
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = TransformerConfig(num_layers=2, hidden_size=64,
+                            num_attention_heads=4, vocab_size=128,
+                            max_position_embeddings=64, remat_policy="none")
+    p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    return StaticInferenceEngine(p, cfg, tokenizer=NullTokenizer(128))
+
+
+class TestSampling:
+    def test_greedy(self):
+        logits = jnp.array([[0.0, 5.0, 1.0]])
+        tok = sample_logits(logits, jax.random.PRNGKey(0),
+                            SamplingParams(greedy=True))
+        assert int(tok[0]) == 1
+
+    def test_top_k_restricts(self):
+        logits = jnp.array([[10.0, 9.0, -10.0, -10.0]])
+        for seed in range(20):
+            tok = sample_logits(logits, jax.random.PRNGKey(seed),
+                                SamplingParams(top_k=2, temperature=5.0))
+            assert int(tok[0]) in (0, 1)
+
+    def test_top_p_restricts(self):
+        logits = jnp.array([[10.0, 1.0, 0.0, -1.0]])
+        for seed in range(20):
+            tok = sample_logits(logits, jax.random.PRNGKey(seed),
+                                SamplingParams(top_p=0.5))
+            assert int(tok[0]) == 0
+
+
+class TestEngine:
+    def test_cache_decode_matches_full_forward(self, engine):
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(1), (2, 8), 0, 128))
+        out = engine.generate(prompt, 6, SamplingParams(greedy=True))
+        toks = prompt.copy()
+        for _ in range(6):
+            logits, _ = gpt_forward(engine.params, jnp.asarray(toks),
+                                    engine.cfg)
+            nxt = np.asarray(jnp.argmax(logits[:, -1], -1))[:, None]
+            toks = np.concatenate([toks, nxt], 1)
+        np.testing.assert_array_equal(out, toks)
+
+    def test_eod_stops(self, engine):
+        prompt = np.zeros((1, 4), np.int32)
+        out = engine.generate(prompt, 10, SamplingParams(greedy=True),
+                              eod_id=-999)  # never fires
+        assert out.shape[1] == 14
+
+    def test_generate_text(self, engine):
+        texts = engine.generate_text(["1 2 3"], 4,
+                                     SamplingParams(greedy=True))
+        assert len(texts) == 1
+        assert all(tok.isdigit() for tok in texts[0].split())
+
+    def test_beam_width_one_equals_greedy(self, engine):
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(3), (1, 6), 0, 128))
+        greedy = engine.generate(prompt, 5, SamplingParams(greedy=True))
+        beam = beam_search(engine, prompt, 5, beam_width=1)
+        np.testing.assert_array_equal(greedy, beam)
+
+    def test_beam_score_at_least_greedy(self, engine):
+        """Beam-4's sequence log-prob >= greedy's (beam explores more)."""
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(4), (1, 6), 0, 128))
+
+        def seq_logprob(tokens):
+            logits, _ = gpt_forward(engine.params, jnp.asarray(tokens),
+                                    engine.cfg)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            s = 0.0
+            for t in range(prompt.shape[1] - 1, tokens.shape[1] - 1):
+                s += float(logp[0, t, tokens[0, t + 1]])
+            return s
+
+        greedy = engine.generate(prompt, 5, SamplingParams(greedy=True))
+        beam = beam_search(engine, prompt, 5, beam_width=4)
+        assert seq_logprob(beam) >= seq_logprob(greedy) - 1e-4
+
+
+class TestServer:
+    def test_rest_api(self, engine):
+        from aiohttp.test_utils import TestClient, TestServer as ATestServer
+        from megatronapp_tpu.inference.server import TextGenerationServer
+
+        srv = TextGenerationServer(engine)
+
+        async def run():
+            client = TestClient(ATestServer(srv.build_app()))
+            await client.start_server()
+            resp = await client.put("/api", json={
+                "prompts": ["1 2 3"], "tokens_to_generate": 4,
+                "greedy": True})
+            assert resp.status == 200
+            data = await resp.json()
+            assert len(data["text"]) == 1
+            assert data["text"][0].startswith("1 2 3")
+            # malformed request → 400
+            resp = await client.put("/api", json={"nope": 1})
+            assert resp.status == 400
+            await client.close()
+
+        asyncio.get_event_loop().run_until_complete(run())
+
+    def test_ws_streaming(self, engine):
+        from aiohttp.test_utils import TestClient, TestServer as ATestServer
+        from megatronapp_tpu.inference.server import TextGenerationServer
+
+        srv = TextGenerationServer(engine)
+
+        async def run():
+            client = TestClient(ATestServer(srv.build_app()))
+            await client.start_server()
+            ws = await client.ws_connect("/ws")
+            await ws.send_json({"prompt": "1 2 3",
+                                "tokens_to_generate": 3, "greedy": True})
+            tokens = []
+            done = None
+            while True:
+                msg = await ws.receive_json(timeout=60)
+                if msg["type"] == "token":
+                    tokens.append(msg["token"])
+                elif msg["type"] == "done":
+                    done = msg
+                    break
+            assert len(tokens) == 3
+            assert done["text"]
+            await ws.close()
+            await client.close()
+
+        asyncio.get_event_loop().run_until_complete(run())

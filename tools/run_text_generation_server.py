@@ -1,0 +1,57 @@
+"""Launch the text-generation server on a checkpoint.
+
+Parity with /root/reference/tools/run_text_generation_server.py (engine
+assembly :120-150, --enable-ws-server :158 — WS is always mounted at /ws
+here).
+
+Usage:
+  python tools/run_text_generation_server.py --load-dir CKPT \
+      --preset gpt2-125m --tokenizer-type GPT2BPETokenizer --port 5000
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
+
+
+def main():
+    import jax
+
+    from megatronapp_tpu.data.tokenizers import build_tokenizer
+    from megatronapp_tpu.inference.engine import StaticInferenceEngine
+    from megatronapp_tpu.inference.server import TextGenerationServer
+    from megatronapp_tpu.models.gpt import init_gpt_params
+    from megatronapp_tpu.models.presets import PRESETS
+    from megatronapp_tpu.training.checkpointing import CheckpointManager
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--load-dir", default=None)
+    ap.add_argument("--preset", default="gpt2-125m",
+                    choices=sorted(PRESETS))
+    ap.add_argument("--tokenizer-type", default="NullTokenizer")
+    ap.add_argument("--tokenizer-name-or-path", default=None)
+    ap.add_argument("--port", type=int, default=5000)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--max-seq-len", type=int, default=None)
+    args = ap.parse_args()
+
+    cfg = PRESETS[args.preset]()
+    params, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    if args.load_dir:
+        mngr = CheckpointManager(args.load_dir)
+        state = mngr.restore({"step": 0, "params": params, "opt_state": {}})
+        if state is not None:
+            params = state["params"]
+            print(f"loaded checkpoint step {state['step']}")
+        mngr.close()
+    tok = build_tokenizer(args.tokenizer_type, args.tokenizer_name_or_path,
+                          vocab_size=cfg.vocab_size)
+    engine = StaticInferenceEngine(params, cfg, tokenizer=tok,
+                                   max_seq_len=args.max_seq_len)
+    print(f"serving on {args.host}:{args.port} (PUT /api, WS /ws)")
+    TextGenerationServer(engine, args.host, args.port).run()
+
+
+if __name__ == "__main__":
+    main()
