@@ -1,0 +1,117 @@
+"""MegaScope perturbation injection.
+
+Parity with /root/reference/megatron/core/tensor_disturbance.py:27-75
+(Disturbance with NOISE_REGISTRY: 'noise1' additive Gaussian, 'noise2'
+multiplicative uniform) applied at three sites:
+  weight       — linear-layer weights (reference tensor_parallel/layers.py
+                 :944-951),
+  calculation  — MLP activations (mlp.py),
+  system       — hidden states between layers (transformer_block.py:542-544).
+
+Under jit the noise must be traced in (SURVEY §7 hard parts): the config is
+read at trace time, so toggling a site or changing its kind recompiles the
+step — scale/seed changes ride through as array inputs via the global
+disturbance state refreshed per step by the WS server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+SITES = ("weight", "calculation", "system")
+
+
+def noise1(x, rng, scale):
+    """Additive Gaussian (reference NOISE_REGISTRY['noise1'])."""
+    return x + (scale * jax.random.normal(rng, x.shape)).astype(x.dtype)
+
+
+def noise2(x, rng, scale):
+    """Multiplicative uniform in [1-scale, 1+scale] (reference 'noise2')."""
+    factor = 1.0 + scale * (2.0 * jax.random.uniform(rng, x.shape) - 1.0)
+    return x * factor.astype(x.dtype)
+
+
+NOISE_REGISTRY = {"noise1": noise1, "noise2": noise2}
+
+
+@dataclasses.dataclass
+class SiteConfig:
+    kind: str = "noise1"
+    scale: float = 0.0
+    # Restrict to specific layers; None = all layers.
+    layers: Optional[tuple] = None
+
+
+class Disturbance:
+    """Global (per-process) perturbation state, read at trace time."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sites: Dict[str, SiteConfig] = {}
+        self.seed = 0
+        # Bumped every configure() call: step builders key their jit cache
+        # on this so stale compilations are not reused.
+        self.version = 0
+
+    def configure(self, config: Dict[str, dict], seed: int = 0):
+        """config: {site: {kind, scale, layers}} (WS wire format)."""
+        with self._lock:
+            self.sites = {}
+            for site, c in config.items():
+                if site not in SITES:
+                    raise ValueError(
+                        f"unknown disturbance site {site!r}; valid: {SITES}")
+                kind = c.get("kind", "noise1")
+                if kind not in NOISE_REGISTRY:
+                    raise ValueError(
+                        f"unknown noise kind {kind!r}; valid: "
+                        f"{sorted(NOISE_REGISTRY)}")
+                layers = c.get("layers")
+                self.sites[site] = SiteConfig(
+                    kind=kind, scale=float(c.get("scale", 0.0)),
+                    layers=tuple(layers) if layers is not None else None)
+            self.seed = seed
+            self.version += 1
+
+    def clear(self):
+        with self._lock:
+            self.sites = {}
+            self.version += 1
+
+    def active(self, site: str) -> bool:
+        c = self.sites.get(site)
+        return c is not None and c.scale != 0.0
+
+    def apply(self, site: str, x: jnp.ndarray, layer_id=None) -> jnp.ndarray:
+        """Traced-in application; identity when the site is inactive at
+        trace time."""
+        c = self.sites.get(site)
+        if c is None or c.scale == 0.0:
+            return x
+        import zlib
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed),
+            zlib.crc32(site.encode()) % (2 ** 31))
+        if layer_id is not None:
+            rng = jax.random.fold_in(rng, jnp.asarray(layer_id, jnp.uint32))
+            if c.layers is not None:
+                # Per-layer gating with a traced layer_id: apply noise, then
+                # select (both branches traced; scan-compatible).
+                noisy = NOISE_REGISTRY[c.kind](x, rng, c.scale)
+                in_set = jnp.isin(jnp.asarray(layer_id),
+                                  jnp.asarray(c.layers))
+                return jnp.where(in_set, noisy, x)
+        return NOISE_REGISTRY[c.kind](x, rng, c.scale)
+
+
+_DISTURBANCE = Disturbance()
+
+
+def get_disturbance() -> Disturbance:
+    return _DISTURBANCE

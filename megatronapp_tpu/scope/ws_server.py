@@ -1,0 +1,179 @@
+"""MegaScope training-mode WebSocket server.
+
+Parity with /root/reference/megatron/training/training_wsserver.py:39-146 +
+the training-loop integration (training.py:1975-2024): the frontend sends
+``run_training_step`` with visualization / disturbance / compressor configs;
+training executes one step with those configs applied and streams captured
+tensor payloads back, then a step summary.
+
+Wire contract (reference :46-52): per capture the server sends
+  {"update_type": <FlagType value>, "layer_id": int, "site": str,
+   "result": [[...]]}
+then {"type": "step_done", "iteration": i, "loss": f}.
+
+Config changes that alter which sites/disturbances are traced in trigger a
+recompile of the step (documented hard part, SURVEY §7) — the session keys
+its jit cache on the scope/disturbance config versions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from megatronapp_tpu.scope.disturbance import get_disturbance
+from megatronapp_tpu.scope.hooks import _SITE_TO_FLAG
+from megatronapp_tpu.scope.tensor_tracer import get_tensor_tracer
+
+
+class TrainingScopeSession:
+    """Owns train state + a rebuildable step function; one step per
+    run_step() call with the requested scope configs applied."""
+
+    def __init__(self, model_cfg, parallel_cfg, train_cfg, opt_cfg,
+                 batch_iter=None, ctx=None):
+        from megatronapp_tpu.data.mock import mock_batches
+        from megatronapp_tpu.models.gpt import init_gpt_params
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.training.optimizer import get_optimizer
+        from megatronapp_tpu.training.train_state import setup_train_state
+
+        self.model_cfg = model_cfg
+        self.train_cfg = train_cfg
+        self.opt_cfg = opt_cfg
+        self.ctx = ctx or build_mesh(parallel_cfg)
+        self.optimizer = get_optimizer(opt_cfg, train_cfg.train_iters)
+        rng = jax.random.PRNGKey(train_cfg.seed)
+        self.state, self.shardings, _ = setup_train_state(
+            rng, lambda k: init_gpt_params(k, model_cfg), self.optimizer,
+            self.ctx)
+        self.batch_iter = batch_iter or mock_batches(
+            train_cfg.seq_length, model_cfg.vocab_size,
+            train_cfg.global_batch_size, seed=train_cfg.seed)
+        self.iteration = 0
+        self._step_cache = {}
+        self._lock = threading.Lock()
+
+    def _build_step(self):
+        from megatronapp_tpu.training.train import gpt_microbatch_loss
+        from megatronapp_tpu.training.train_step import make_train_step
+        dist = get_disturbance()
+        # Key on canonical config CONTENT (not a monotonic version counter,
+        # which would force a recompile every step and leak executables).
+        dist_key = tuple(sorted(
+            (site, c.kind, c.scale, c.layers)
+            for site, c in dist.sites.items()))
+        key = (dist_key,
+               get_tensor_tracer().enabled,
+               tuple(sorted((lid, tuple(sorted(f.value for f in flags)))
+                            for lid, flags in
+                            get_tensor_tracer().flags.items())))
+        if key not in self._step_cache:
+            loss_fn = gpt_microbatch_loss(self.model_cfg, ctx=self.ctx)
+            self._step_cache[key] = make_train_step(
+                loss_fn, self.optimizer, self.opt_cfg, self.ctx,
+                self.shardings, self.train_cfg.train_iters)
+        return self._step_cache[key]
+
+    def run_step(self, visualization: Optional[Dict] = None,
+                 disturbance: Optional[Dict] = None,
+                 compressor: Optional[Dict] = None) -> List[dict]:
+        """Apply configs, run one training step, return streamed payloads
+        (captures + step summary)."""
+        with self._lock:
+            payloads: List[dict] = []
+            tt = get_tensor_tracer()
+
+            def report(site, layer_id, arr):
+                flag = _SITE_TO_FLAG.get(site)
+                payloads.append({
+                    "update_type": int(flag) if flag is not None else -1,
+                    "site": site,
+                    "layer_id": int(layer_id) if layer_id is not None else -1,
+                    "result": np.asarray(arr, np.float64).tolist(),
+                })
+
+            comp = compressor or {}
+            if visualization:
+                tt.set_flags_from_config(visualization)
+                tt.activate(report, pixels=int(comp.get("pixels", 16)),
+                            method=comp.get("method", "mean"))
+            else:
+                tt.deactivate()
+            if disturbance is not None:
+                get_disturbance().configure(disturbance,
+                                            seed=self.iteration)
+            else:
+                get_disturbance().clear()
+
+            from megatronapp_tpu.training.train import reshape_global_batch
+            num_micro = self.train_cfg.num_microbatches(
+                self.ctx.dp * self.ctx.ep)
+            batch = reshape_global_batch(next(self.batch_iter), num_micro)
+            step_fn = self._build_step()
+            with self.ctx.mesh:
+                self.state, metrics = step_fn(self.state, batch)
+                metrics = jax.device_get(metrics)
+            # Flush async debug callbacks before deactivating, or late
+            # captures are dropped / race the payload list.
+            jax.effects_barrier()
+            tt.deactivate()
+            tt.clear_records()
+            self.iteration += 1
+            payloads.append({
+                "type": "step_done",
+                "iteration": self.iteration,
+                "loss": float(metrics["loss"]),
+                "grad_norm": float(metrics["grad_norm"]),
+            })
+            return payloads
+
+
+class TrainingScopeServer:
+    """WS endpoint /ws driving a TrainingScopeSession (rank-0 semantics)."""
+
+    def __init__(self, session: TrainingScopeSession, host="0.0.0.0",
+                 port=5656):
+        self.session = session
+        self.host = host
+        self.port = port
+
+    async def handle_ws(self, request):
+        from aiohttp import web
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        loop = asyncio.get_running_loop()
+        async for msg in ws:
+            if msg.type != 1:
+                continue
+            req = json.loads(msg.data)
+            if req.get("type") != "run_training_step":
+                await ws.send_json({"type": "error",
+                                    "message": "unknown message type"})
+                continue
+            try:
+                payloads = await loop.run_in_executor(
+                    None, lambda: self.session.run_step(
+                        req.get("visualization"),
+                        req.get("disturbance"),
+                        req.get("compressor")))
+                for p in payloads:
+                    await ws.send_json(p)
+            except Exception as e:
+                await ws.send_json({"type": "error", "message": str(e)})
+        return ws
+
+    def build_app(self):
+        from aiohttp import web
+        app = web.Application()
+        app.router.add_get("/ws", self.handle_ws)
+        return app
+
+    def run(self):
+        from aiohttp import web
+        web.run_app(self.build_app(), host=self.host, port=self.port)

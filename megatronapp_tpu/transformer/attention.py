@@ -77,8 +77,15 @@ def attention_forward(
     nq, nkv = cfg.num_attention_heads, cfg.num_query_groups
     x = x.astype(cfg.compute_dtype)
 
-    q = x @ p["q_kernel"].astype(cfg.compute_dtype)
-    kv = x @ p["kv_kernel"].astype(cfg.compute_dtype)
+    # MegaScope 'weight' perturbation site (reference
+    # tensor_parallel/layers.py:944-951 applies it to every parallel
+    # linear's weights).
+    from megatronapp_tpu.scope.disturbance import get_disturbance
+    _dist = get_disturbance()
+    q_kernel = _dist.apply("weight", p["q_kernel"], layer_id)
+    kv_kernel = _dist.apply("weight", p["kv_kernel"], layer_id)
+    q = x @ q_kernel.astype(cfg.compute_dtype)
+    kv = x @ kv_kernel.astype(cfg.compute_dtype)
     if "q_bias" in p:
         q = q + p["q_bias"].astype(cfg.compute_dtype)
         kv = kv + p["kv_bias"].astype(cfg.compute_dtype)
@@ -184,7 +191,8 @@ def attention_forward(
                 q_offset=q_offset)
     attn_out = scope_capture("context", attn_out, layer_id)
 
-    out = attn_out.reshape(b, s, nq * d) @ p["out_kernel"].astype(cfg.compute_dtype)
+    out_kernel = _dist.apply("weight", p["out_kernel"], layer_id)
+    out = attn_out.reshape(b, s, nq * d) @ out_kernel.astype(cfg.compute_dtype)
     if "out_bias" in p:
         out = out + p["out_bias"].astype(cfg.compute_dtype)
     return (out, new_cache) if kv_cache is not None else (out, None)

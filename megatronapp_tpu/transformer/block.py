@@ -82,8 +82,10 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     else:
         mlp_out = mlp_forward(p["mlp"], h, cfg, layer_id=layer_id)
     x = residual + mlp_out.astype(residual.dtype)
-    # MegaScope 'system' perturbation site between layers
+    # MegaScope 'system' perturbation + capture site between layers
     # (transformer_block.py:542-544).
+    from megatronapp_tpu.scope.disturbance import get_disturbance
+    x = get_disturbance().apply("system", x, layer_id)
     x = scope_capture("between_layers", x, layer_id)
     return (x, new_cache), aux
 

@@ -43,17 +43,24 @@ def init_mlp_params(rng, cfg: TransformerConfig, out_std: float,
 
 
 def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None):
+    from megatronapp_tpu.scope.disturbance import get_disturbance
+    _dist = get_disturbance()
     x = x.astype(cfg.compute_dtype)
-    y = x @ p["fc1_kernel"].astype(cfg.compute_dtype)
+    fc1_kernel = _dist.apply("weight", p["fc1_kernel"], layer_id)
+    y = x @ fc1_kernel.astype(cfg.compute_dtype)
     if "fc1_bias" in p:
         y = y + p["fc1_bias"].astype(cfg.compute_dtype)
     y = scope_capture("mlp1", y, layer_id)
+    # MegaScope 'calculation' perturbation site (reference mlp.py).
+    from megatronapp_tpu.scope.disturbance import get_disturbance
+    y = get_disturbance().apply("calculation", y, layer_id)
     if is_gated(cfg.activation):
         gate, val = jnp.split(y, 2, axis=-1)
         y = apply_activation(cfg.activation, val, gate)
     else:
         y = apply_activation(cfg.activation, y)
-    out = y @ p["fc2_kernel"].astype(cfg.compute_dtype)
+    fc2_kernel = _dist.apply("weight", p["fc2_kernel"], layer_id)
+    out = y @ fc2_kernel.astype(cfg.compute_dtype)
     if "fc2_bias" in p:
         out = out + p["fc2_bias"].astype(cfg.compute_dtype)
     out = scope_capture("mlp2", out, layer_id)

@@ -120,7 +120,7 @@ class TestServer:
             assert resp.status == 400
             await client.close()
 
-        asyncio.get_event_loop().run_until_complete(run())
+        asyncio.run(run())
 
     def test_ws_streaming(self, engine):
         from aiohttp.test_utils import TestClient, TestServer as ATestServer
@@ -148,4 +148,4 @@ class TestServer:
             await ws.close()
             await client.close()
 
-        asyncio.get_event_loop().run_until_complete(run())
+        asyncio.run(run())

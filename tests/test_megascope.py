@@ -1,0 +1,179 @@
+"""MegaScope tests: tensor tracer, disturbance, training WS server.
+
+Mirrors the reference script-driven MegaScope validation (SURVEY §4) as
+pytest."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatronapp_tpu.config.parallel_config import ParallelConfig
+from megatronapp_tpu.config.training_config import (
+    OptimizerConfig, TrainingConfig,
+)
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.models.gpt import gpt_loss, init_gpt_params
+from megatronapp_tpu.scope.disturbance import get_disturbance
+from megatronapp_tpu.scope.hooks import FlagType
+from megatronapp_tpu.scope.tensor_tracer import Compressor, get_tensor_tracer
+
+
+def tiny_cfg(**kw):
+    d = dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+             vocab_size=128, max_position_embeddings=64,
+             remat_policy="none")
+    d.update(kw)
+    return TransformerConfig(**d)
+
+
+@pytest.fixture(autouse=True)
+def clean_scope_state():
+    yield
+    get_tensor_tracer().deactivate()
+    get_tensor_tracer().clear_records()
+    get_disturbance().clear()
+
+
+class TestCompressor:
+    def test_bucketed_mean(self):
+        c = Compressor(pixels=4, method="mean")
+        x = np.arange(16, dtype=np.float32)[None]
+        out = c(x)
+        np.testing.assert_allclose(out[0], [1.5, 5.5, 9.5, 13.5])
+
+    def test_small_input_passthrough(self):
+        c = Compressor(pixels=64)
+        x = np.ones((2, 8), np.float32)
+        np.testing.assert_array_equal(c(x), x)
+
+    def test_invalid_method(self):
+        with pytest.raises(ValueError):
+            Compressor(method="eval_me")
+
+
+class TestTensorTracerCapture:
+    def test_capture_flows_through_forward(self):
+        cfg = tiny_cfg()
+        p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        tt = get_tensor_tracer()
+        captured = []
+        tt.set_flags_from_config({"QKV_mat_mul": [0], "MLP1": [0, 1]})
+        tt.activate(lambda site, lid, arr: captured.append((site, lid)),
+                    pixels=8)
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        gpt_loss(p, tokens, tokens, None, cfg)
+        jax.effects_barrier()
+        tt.deactivate()
+        sites = {s for s, _ in captured}
+        assert "mlp1" in sites
+        assert {"qkv_q", "qkv_k", "qkv_v"} & sites
+
+    def test_pca(self):
+        tt = get_tensor_tracer()
+        tt.mlp2_records = [np.random.default_rng(0).normal(
+            size=(20, 16)).astype(np.float32)]
+        out = tt.pca_mlp2()
+        assert out.shape == (20, 2)
+
+    def test_report_result_top_candidates(self):
+        tt = get_tensor_tracer()
+        logits = np.zeros(50)
+        logits[7] = 10.0
+        from megatronapp_tpu.data.tokenizers import NullTokenizer
+        res = tt.report_result(logits, 7, NullTokenizer(50))
+        assert res["token"] == 7
+        assert res["candidates"][0]["token"] == 7
+        assert res["candidates"][0]["prob"] > 0.9
+        assert len(res["candidates"]) == 20
+
+
+class TestDisturbance:
+    def test_noise_changes_loss(self):
+        cfg = tiny_cfg()
+        p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 128)
+        base, _ = gpt_loss(p, tokens, tokens, None, cfg)
+        get_disturbance().configure(
+            {"system": {"kind": "noise1", "scale": 1.0}})
+        noisy, _ = gpt_loss(p, tokens, tokens, None, cfg)
+        get_disturbance().clear()
+        clean, _ = gpt_loss(p, tokens, tokens, None, cfg)
+        assert abs(float(noisy) - float(base)) > 1e-3
+        assert abs(float(clean) - float(base)) < 1e-6
+
+    def test_layer_gating(self):
+        cfg = tiny_cfg()
+        p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 128)
+        base, _ = gpt_loss(p, tokens, tokens, None, cfg)
+        # Noise restricted to a layer id that doesn't exist → no effect.
+        get_disturbance().configure(
+            {"system": {"kind": "noise2", "scale": 0.5, "layers": [99]}})
+        out, _ = gpt_loss(p, tokens, tokens, None, cfg)
+        assert abs(float(out) - float(base)) < 1e-6
+
+    def test_invalid_configs_rejected(self):
+        with pytest.raises(ValueError):
+            get_disturbance().configure({"bogus_site": {"scale": 1.0}})
+        with pytest.raises(ValueError):
+            get_disturbance().configure(
+                {"system": {"kind": "bogus", "scale": 1.0}})
+
+
+class TestTrainingScopeServer:
+    def test_ws_run_training_step(self, devices8):
+        from aiohttp.test_utils import TestClient, TestServer as ATestServer
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.scope.ws_server import (
+            TrainingScopeServer, TrainingScopeSession,
+        )
+
+        model = tiny_cfg()
+        par = ParallelConfig()
+        ctx = build_mesh(par, devices=devices8[:1])
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=2,
+                               seq_length=16, train_iters=10, log_interval=10)
+        session = TrainingScopeSession(model, par, train,
+                                       OptimizerConfig(lr=1e-3), ctx=ctx)
+        srv = TrainingScopeServer(session)
+
+        async def run():
+            client = TestClient(ATestServer(srv.build_app()))
+            await client.start_server()
+            ws = await client.ws_connect("/ws")
+            # Step 1: with visualization + disturbance.
+            await ws.send_json({
+                "type": "run_training_step",
+                "visualization": {"MLP1": [0, 1], "QKV_mat_mul": [0]},
+                "disturbance": {"system": {"kind": "noise1",
+                                           "scale": 0.01}},
+                "compressor": {"pixels": 4, "method": "mean"},
+            })
+            captures, done = [], None
+            while done is None:
+                msg = await ws.receive_json(timeout=120)
+                if msg.get("type") == "step_done":
+                    done = msg
+                elif msg.get("type") == "error":
+                    raise AssertionError(msg)
+                else:
+                    captures.append(msg)
+            assert done["iteration"] == 1
+            assert np.isfinite(done["loss"])
+            sites = {c["site"] for c in captures}
+            assert "mlp1" in sites
+            mlp1 = next(c for c in captures if c["site"] == "mlp1")
+            assert np.asarray(mlp1["result"]).shape[-1] == 4  # pixels
+            assert mlp1["update_type"] == int(FlagType.MLP1)
+            # Step 2: plain step, no captures.
+            await ws.send_json({"type": "run_training_step"})
+            msg = await ws.receive_json(timeout=120)
+            assert msg.get("type") == "step_done"
+            assert msg["iteration"] == 2
+            await ws.close()
+            await client.close()
+
+        asyncio.run(run())
