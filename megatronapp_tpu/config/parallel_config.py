@@ -60,9 +60,10 @@ class ParallelConfig:
     num_microbatches: int = 1
     # MegaFBD analogue: run forward and backward on disjoint sub-meshes.
     forward_backward_disaggregating: bool = False
-    # MegaDPP analogue: microbatch send-ordering policy ('dfc' depth-first /
-    # 'bfc' breadth-first; reference paper §5.2).
-    pipeline_order_policy: str = "bfc"
+    # MegaDPP analogue: chunk/microbatch traversal policy for the pipeline
+    # schedule ('dfc' depth-first-chunk = interleaved, 'bfc'
+    # breadth-first-chunk = sequential chunk passes; reference paper §5.2).
+    pipeline_order_policy: str = "dfc"
 
     def __post_init__(self):
         for name in ("tensor_parallel", "pipeline_parallel", "context_parallel",
@@ -73,6 +74,10 @@ class ParallelConfig:
         if self.sequence_parallel and self.tensor_parallel == 1:
             # Harmless no-op; keep parity with reference which warns+disables.
             self.sequence_parallel = False
+        if self.pipeline_order_policy not in ("dfc", "bfc"):
+            raise ValueError(
+                f"pipeline_order_policy must be 'dfc' or 'bfc', got "
+                f"{self.pipeline_order_policy!r}")
 
     @property
     def model_parallel_size(self) -> int:

@@ -145,7 +145,8 @@ def gpt_head(p, h: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
 
 
 def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
-                      cfg: TransformerConfig, ctx, vpp: int = 1):
+                      cfg: TransformerConfig, ctx, vpp: int = 1,
+                      order_policy: str = "dfc"):
     """Pipelined training loss over microbatched inputs [M, mb, S].
 
     Embedding and LM head run outside the pipeline body (compiler-sharded
@@ -180,7 +181,7 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
 
     out_mb, aux = spmd_pipeline(
         stage_fn, p["block"], h, ctx, num_microbatches=m, vpp=vpp,
-        compute_dtype=cfg.compute_dtype)
+        compute_dtype=cfg.compute_dtype, order_policy=order_policy)
     # Aux losses are summed over the M microbatches inside the pipeline;
     # normalize to per-microbatch scale to match the non-pipelined path.
     aux = aux / m

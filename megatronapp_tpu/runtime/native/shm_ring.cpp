@@ -1,0 +1,161 @@
+// POSIX shared-memory ring buffer for host-side staging, ctypes ABI.
+//
+// TPU-native analogue of the reference MegaDPP shm transport
+// (/root/reference/megatron/shm_tensor_new_rdma/shm_tensor_new_rdma.cpp:
+// /dev/shm segments + semaphores per neighbor pair, background send/recv
+// threads; pre-alloc variant shm_tensor_new_rdma_pre_alloc.cpp). On TPU the
+// device-to-device activation traffic itself rides ICI via XLA collectives
+// (SURVEY §2.7), so the host staging role that remains is inter-PROCESS
+// tensor hand-off on one host: data loaders feeding trainer processes,
+// checkpoint shards staged for async upload, trace buffers. This is that
+// staging ring: a single-producer single-consumer lock-free byte ring in
+// /dev/shm with atomic head/tail, plus a standalone bandwidth benchmark
+// entry (profiling/shm_benchmark.cpp parity via tools/shm_benchmark.py).
+//
+// Build: g++ -O3 -shared -fPIC -o libshm_ring.so shm_ring.cpp -lrt
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct RingHeader {
+    std::atomic<uint64_t> head;  // next write offset (producer)
+    std::atomic<uint64_t> tail;  // next read offset (consumer)
+    uint64_t capacity;           // data bytes
+    uint64_t magic;
+};
+
+constexpr uint64_t kMagic = 0x4d544152494e4721ull;  // "MTARING!"
+
+struct Ring {
+    RingHeader* hdr;
+    uint8_t* data;
+    size_t map_size;
+    int fd;
+};
+
+Ring* map_ring(const char* name, uint64_t capacity, bool create) {
+    int flags = create ? (O_CREAT | O_RDWR) : O_RDWR;
+    int fd = shm_open(name, flags, 0600);
+    if (fd < 0) return nullptr;
+    size_t map_size = sizeof(RingHeader) + capacity;
+    if (create && ftruncate(fd, map_size) != 0) {
+        close(fd);
+        return nullptr;
+    }
+    if (!create) {
+        struct stat st;
+        if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(RingHeader)) {
+            close(fd);
+            return nullptr;
+        }
+        map_size = st.st_size;
+    }
+    void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                     fd, 0);
+    if (mem == MAP_FAILED) {
+        close(fd);
+        return nullptr;
+    }
+    Ring* ring = new Ring;
+    ring->hdr = reinterpret_cast<RingHeader*>(mem);
+    ring->data = reinterpret_cast<uint8_t*>(mem) + sizeof(RingHeader);
+    ring->map_size = map_size;
+    ring->fd = fd;
+    if (create) {
+        ring->hdr->head.store(0, std::memory_order_relaxed);
+        ring->hdr->tail.store(0, std::memory_order_relaxed);
+        ring->hdr->capacity = capacity;
+        ring->hdr->magic = kMagic;
+    } else if (ring->hdr->magic != kMagic) {
+        munmap(mem, map_size);
+        close(fd);
+        delete ring;
+        return nullptr;
+    }
+    return ring;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shm_ring_create(const char* name, uint64_t capacity) {
+    return map_ring(name, capacity, true);
+}
+
+void* shm_ring_open(const char* name) {
+    return map_ring(name, 0, false);
+}
+
+// Returns bytes written (len or 0 if insufficient space). Message framing:
+// u64 length prefix, payload, both possibly wrapping the ring.
+uint64_t shm_ring_push(void* handle, const uint8_t* buf, uint64_t len) {
+    if (len == 0) return 0;  // zero-length frames are indistinguishable
+                             // from "ring empty" on the pop side
+    Ring* r = static_cast<Ring*>(handle);
+    uint64_t cap = r->hdr->capacity;
+    uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+    uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+    uint64_t used = head - tail;
+    uint64_t need = len + 8;
+    if (used + need > cap) return 0;
+
+    uint64_t pos = head % cap;
+    uint8_t hdr8[8];
+    std::memcpy(hdr8, &len, 8);
+    for (int i = 0; i < 8; ++i) r->data[(pos + i) % cap] = hdr8[i];
+    uint64_t dpos = (pos + 8) % cap;
+    uint64_t first = cap - dpos < len ? cap - dpos : len;
+    std::memcpy(r->data + dpos, buf, first);
+    if (first < len) std::memcpy(r->data, buf + first, len - first);
+    r->hdr->head.store(head + need, std::memory_order_release);
+    return len;
+}
+
+// Returns the message length (and copies up to buf_len bytes into buf), or
+// 0 if the ring is empty, or UINT64_MAX if buf_len is too small (message is
+// left in place).
+uint64_t shm_ring_pop(void* handle, uint8_t* buf, uint64_t buf_len) {
+    Ring* r = static_cast<Ring*>(handle);
+    uint64_t cap = r->hdr->capacity;
+    uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+    uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+    if (head == tail) return 0;
+
+    uint64_t pos = tail % cap;
+    uint8_t hdr8[8];
+    for (int i = 0; i < 8; ++i) hdr8[i] = r->data[(pos + i) % cap];
+    uint64_t len;
+    std::memcpy(&len, hdr8, 8);
+    if (len > buf_len) return UINT64_MAX;
+    uint64_t dpos = (pos + 8) % cap;
+    uint64_t first = cap - dpos < len ? cap - dpos : len;
+    std::memcpy(buf, r->data + dpos, first);
+    if (first < len) std::memcpy(buf + first, r->data, len - first);
+    r->hdr->tail.store(tail + len + 8, std::memory_order_release);
+    return len;
+}
+
+uint64_t shm_ring_used(void* handle) {
+    Ring* r = static_cast<Ring*>(handle);
+    return r->hdr->head.load(std::memory_order_acquire) -
+           r->hdr->tail.load(std::memory_order_acquire);
+}
+
+void shm_ring_close(void* handle) {
+    Ring* r = static_cast<Ring*>(handle);
+    munmap(r->hdr, r->map_size);
+    close(r->fd);
+    delete r;
+}
+
+void shm_ring_unlink(const char* name) { shm_unlink(name); }
+
+}  // extern "C"

@@ -1,0 +1,170 @@
+"""ctypes binding for the C++ shared-memory staging ring.
+
+Host-side inter-process tensor hand-off (see runtime/native/shm_ring.cpp for
+the MegaDPP-transport lineage). Single-producer single-consumer; numpy
+arrays are framed with a tiny header carrying dtype/shape.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libshm_ring.so")
+_LIB = None
+_LOAD_FAILED = False
+_LOCK = threading.Lock()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _LOAD_FAILED
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _LOAD_FAILED:
+            return None
+        src = os.path.join(_NATIVE_DIR, "shm_ring.cpp")
+        if not os.path.exists(_SO_PATH) or (
+                os.path.exists(src) and
+                os.path.getmtime(_SO_PATH) < os.path.getmtime(src)):
+            if not os.path.exists(src):
+                _LOAD_FAILED = True
+                return None
+            tmp = _SO_PATH + f".tmp.{os.getpid()}"
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src,
+                     "-lrt"],
+                    check=True, capture_output=True)
+                os.replace(tmp, _SO_PATH)
+            except Exception:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                _LOAD_FAILED = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            _LOAD_FAILED = True
+            return None
+        lib.shm_ring_create.restype = ctypes.c_void_p
+        lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.shm_ring_open.restype = ctypes.c_void_p
+        lib.shm_ring_open.argtypes = [ctypes.c_char_p]
+        lib.shm_ring_push.restype = ctypes.c_uint64
+        lib.shm_ring_push.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_uint8),
+                                      ctypes.c_uint64]
+        lib.shm_ring_pop.restype = ctypes.c_uint64
+        lib.shm_ring_pop.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_uint8),
+                                     ctypes.c_uint64]
+        lib.shm_ring_used.restype = ctypes.c_uint64
+        lib.shm_ring_used.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_close.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_unlink.argtypes = [ctypes.c_char_p]
+        _LIB = lib
+        return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+_UINT64_MAX = 2 ** 64 - 1
+
+
+class ShmRing:
+    """SPSC byte/tensor ring in /dev/shm."""
+
+    def __init__(self, name: str, capacity: int = 1 << 24,
+                 create: bool = True):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libshm_ring.so unavailable (g++ missing?)")
+        self._lib = lib
+        self.name = name.encode()
+        if create:
+            self._h = lib.shm_ring_create(self.name, capacity)
+        else:
+            self._h = lib.shm_ring_open(self.name)
+        if not self._h:
+            raise OSError(f"failed to map shm ring {name!r}")
+
+    _U8P = ctypes.POINTER(ctypes.c_uint8)
+
+    def _np_ptr(self, arr: np.ndarray):
+        return arr.ctypes.data_as(self._U8P)
+
+    # -- raw bytes ---------------------------------------------------------
+    def push_bytes(self, data) -> bool:
+        arr = np.frombuffer(data, dtype=np.uint8)
+        return self._lib.shm_ring_push(self._h, self._np_ptr(arr),
+                                       len(arr)) == len(arr)
+
+    def pop_bytes(self, max_len: int = 1 << 22) -> Optional[bytes]:
+        arr = self._pop_np(max_len)
+        return None if arr is None else arr.tobytes()
+
+    def _pop_np(self, max_len: int) -> Optional[np.ndarray]:
+        # Reuse one receive buffer across calls (allocated/grown lazily).
+        buf = getattr(self, "_rx", None)
+        if buf is None or len(buf) < max_len:
+            buf = self._rx = np.empty(max_len, np.uint8)
+        n = self._lib.shm_ring_pop(self._h, self._np_ptr(buf), max_len)
+        if n == 0:
+            return None
+        if n == _UINT64_MAX:
+            raise ValueError("message larger than max_len")
+        return buf[:n]
+
+    # -- numpy tensors -----------------------------------------------------
+    def push_array(self, arr: np.ndarray) -> bool:
+        arr = np.ascontiguousarray(arr)
+        meta = json.dumps({"dtype": arr.dtype.str,
+                           "shape": arr.shape}).encode()
+        flat = arr.view(np.uint8).ravel()
+        frame = np.empty(4 + len(meta) + flat.nbytes, np.uint8)
+        frame[:4] = np.frombuffer(
+            len(meta).to_bytes(4, "little"), np.uint8)
+        frame[4: 4 + len(meta)] = np.frombuffer(meta, np.uint8)
+        frame[4 + len(meta):] = flat
+        return self._lib.shm_ring_push(self._h, self._np_ptr(frame),
+                                       len(frame)) == len(frame)
+
+    def pop_array(self, max_len: int = 1 << 26) -> Optional[np.ndarray]:
+        frame = self._pop_np(max_len)
+        if frame is None:
+            return None
+        mlen = int.from_bytes(frame[:4].tobytes(), "little")
+        meta = json.loads(frame[4: 4 + mlen].tobytes())
+        payload = frame[4 + mlen:]
+        return payload.view(np.dtype(meta["dtype"])).reshape(
+            meta["shape"]).copy()
+
+    @property
+    def used_bytes(self) -> int:
+        return int(self._lib.shm_ring_used(self._h))
+
+    def close(self):
+        if self._h:
+            self._lib.shm_ring_close(self._h)
+            self._h = None
+
+    def unlink(self):
+        self._lib.shm_ring_unlink(self.name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

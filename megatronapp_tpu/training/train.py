@@ -69,6 +69,9 @@ def pretrain_gpt(
     log_fn: Callable[[str], None] = print,
 ) -> TrainResult:
     """End-to-end GPT pretraining loop. Returns final state + stats."""
+    if parallel_cfg.forward_backward_disaggregating:
+        return _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg,
+                                 opt_cfg, batch_iter, log_fn)
     if ctx is None:
         ctx = build_mesh(parallel_cfg)
     dp_total = ctx.dp * ctx.ep
@@ -118,7 +121,8 @@ def pretrain_gpt(
         def loss_fn(params, batch_mb):
             return gpt_pipeline_loss(
                 params, batch_mb["tokens"], batch_mb["labels"],
-                batch_mb["loss_mask"], model_cfg, ctx, vpp=vpp)
+                batch_mb["loss_mask"], model_cfg, ctx, vpp=vpp,
+                order_policy=parallel_cfg.pipeline_order_policy)
     else:
         loss_fn = gpt_microbatch_loss(model_cfg, ctx=ctx)
     step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
@@ -222,3 +226,65 @@ def pretrain_gpt(
     return TrainResult(state=state, losses=losses,
                        tokens_per_sec=tokens_per_sec,
                        step_time_ms=step_time_ms)
+
+
+def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
+                      batch_iter=None, log_fn=print) -> TrainResult:
+    """MegaFBD training path: forward and backward on disjoint sub-meshes
+    (parallel/fbd.py). DP is halved on each mesh; the forward mesh runs the
+    grad-free forward while the backward mesh computes the update for the
+    same batch, and dispatches overlap (losses stay on device between log
+    intervals)."""
+    from megatronapp_tpu.parallel.fbd import FBDExecutor, split_fbd_meshes
+
+    if parallel_cfg.pipeline_parallel > 1 or \
+            parallel_cfg.context_parallel > 1:
+        raise NotImplementedError(
+            "forward/backward disaggregation currently composes with "
+            "tp/dp only (pp/cp sub-mesh support pending)")
+    for field, val in (("save_dir", train_cfg.save_dir),
+                       ("load_dir", train_cfg.load_dir),
+                       ("trace", train_cfg.trace)):
+        if val:
+            raise NotImplementedError(
+                f"TrainingConfig.{field} is not supported under "
+                f"forward_backward_disaggregating yet")
+
+    fwd_ctx, bwd_ctx = split_fbd_meshes(parallel_cfg)
+    log_fn(f"FBD: forward mesh {dict(fwd_ctx.mesh.shape)} | backward mesh "
+           f"{dict(bwd_ctx.mesh.shape)}")
+    num_micro = train_cfg.num_microbatches(bwd_ctx.dp * bwd_ctx.ep)
+
+    if batch_iter is None:
+        batch_iter = mock_batches(train_cfg.seq_length, model_cfg.vocab_size,
+                                  train_cfg.global_batch_size,
+                                  seed=train_cfg.seed)
+
+    optimizer = get_optimizer(opt_cfg, train_cfg.train_iters)
+    rng = jax.random.PRNGKey(train_cfg.seed)
+    with bwd_ctx.mesh:
+        state, shardings, _ = setup_train_state(
+            rng, lambda k: init_gpt_params(k, model_cfg), optimizer,
+            bwd_ctx)
+    loss_fn = gpt_microbatch_loss(model_cfg)
+    executor = FBDExecutor(loss_fn, optimizer, fwd_ctx, bwd_ctx, state,
+                           shardings)
+
+    losses = []
+    t0 = time.perf_counter()
+    for it in range(train_cfg.train_iters):
+        batch = reshape_global_batch(next(batch_iter), num_micro)
+        out = executor.step(batch)
+        if (it + 1) % train_cfg.log_interval == 0 or \
+                it + 1 == train_cfg.train_iters:
+            loss = float(jax.device_get(out["loss"]))
+            fwd_loss = float(jax.device_get(out["fwd_loss"]))
+            losses.append(loss)
+            log_fn(f"iter {it+1:6d}/{train_cfg.train_iters} | "
+                   f"loss {loss:.4f} | fwd-mesh loss {fwd_loss:.4f}")
+    dt = time.perf_counter() - t0
+    tokens = train_cfg.train_iters * train_cfg.global_batch_size * \
+        train_cfg.seq_length
+    return TrainResult(state=executor.state, losses=losses,
+                       tokens_per_sec=tokens / dt,
+                       step_time_ms=dt / train_cfg.train_iters * 1e3)

@@ -1,0 +1,182 @@
+"""MegaFBD (forward/backward disaggregation) + MegaDPP (schedule order
+policy, shm staging ring) tests."""
+
+import multiprocessing as mp
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatronapp_tpu.config.parallel_config import ParallelConfig
+from megatronapp_tpu.config.training_config import (
+    OptimizerConfig, TrainingConfig,
+)
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.parallel.fbd import split_fbd_meshes
+from megatronapp_tpu.parallel.mesh import build_mesh
+from megatronapp_tpu.training.train import pretrain_gpt
+
+
+def tiny(**kw):
+    d = dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+             vocab_size=128, max_position_embeddings=64)
+    d.update(kw)
+    return TransformerConfig(**d)
+
+
+class TestFBD:
+    def test_mesh_split_accounting(self, devices8):
+        """DP halves across the two meshes (reference rank accounting,
+        README.md:193-198)."""
+        par = ParallelConfig(tensor_parallel=2,
+                             forward_backward_disaggregating=True)
+        fwd, bwd = split_fbd_meshes(par, devices=devices8[:8])
+        assert fwd.dp == bwd.dp == 2  # 8 devs / tp2 → dp4 → halved
+        assert fwd.tp == bwd.tp == 2
+        assert set(fwd.mesh.devices.flat).isdisjoint(
+            set(bwd.mesh.devices.flat))
+
+    def test_odd_dp_rejected(self, devices8):
+        par = ParallelConfig(tensor_parallel=4,
+                             forward_backward_disaggregating=True)
+        with pytest.raises(ValueError):
+            split_fbd_meshes(par, devices=devices8[:4])  # dp=1, odd
+
+    def test_fbd_training_matches_normal(self, devices8):
+        """FBD run must track a plain run: same model/data → same loss
+        trajectory (update math identical, only placement differs)."""
+        from tests.test_training import learnable_batches
+
+        model = tiny(compute_dtype=jnp.float32)
+        # 8 devices → bwd mesh dp=4; gbs=16 / (mbs2 × dp4) = 2 microbatches.
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=16,
+                               seq_length=32, train_iters=8, log_interval=2)
+        opt = OptimizerConfig(lr=1e-3, lr_decay_iters=8, clip_grad=0.0)
+
+        par_fbd = ParallelConfig(forward_backward_disaggregating=True)
+        res_fbd = pretrain_gpt(model, par_fbd, train, opt,
+                               batch_iter=learnable_batches(32, 128, 16))
+
+        par_plain = ParallelConfig()
+        ctx = build_mesh(par_plain, devices=devices8[:1])
+        train_plain = TrainingConfig(micro_batch_size=8,
+                                     global_batch_size=16, seq_length=32,
+                                     train_iters=8, log_interval=2)
+        res_plain = pretrain_gpt(model, par_plain, train_plain, opt, ctx=ctx,
+                                 batch_iter=learnable_batches(32, 128, 16))
+        np.testing.assert_allclose(res_fbd.losses, res_plain.losses,
+                                   atol=1e-3)
+        assert res_fbd.losses[-1] < res_fbd.losses[0]
+
+
+class TestDPPOrderPolicy:
+    @pytest.mark.parametrize("policy", ["dfc", "bfc"])
+    def test_policies_match_dense(self, devices8, policy):
+        from megatronapp_tpu.models.gpt import (
+            gpt_loss, gpt_pipeline_loss, init_gpt_params,
+        )
+
+        cfg = tiny(num_layers=8, remat_policy="none")
+        pp, vpp, M, mb, s = 2, 2, 4, 1, 16
+        par = ParallelConfig(pipeline_parallel=pp,
+                             virtual_pipeline_parallel=vpp,
+                             pipeline_order_policy=policy)
+        ctx = build_mesh(par, devices=devices8[:pp])
+        rng = jax.random.PRNGKey(0)
+        p_flat, _ = init_gpt_params(rng, cfg)
+        p_pipe, _ = init_gpt_params(rng, cfg, pp=pp, vpp=vpp)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (M, mb, s), 0, 128)
+        labels = jnp.roll(tokens, -1, axis=-1)
+        ref = float(jnp.mean(jnp.stack([
+            gpt_loss(p_flat, tokens[i], labels[i], None, cfg)[0]
+            for i in range(M)])))
+        with ctx.mesh:
+            loss, _ = jax.jit(lambda p, t, l: gpt_pipeline_loss(
+                p, t, l, None, cfg, ctx, vpp=vpp,
+                order_policy=policy))(p_pipe, tokens, labels)
+        assert abs(float(loss) - ref) < 5e-4, (policy, float(loss), ref)
+
+    def test_bfc_training_runs(self, devices8):
+        from tests.test_training import learnable_batches
+
+        model = tiny(num_layers=4)
+        par = ParallelConfig(pipeline_parallel=2,
+                             virtual_pipeline_parallel=2,
+                             pipeline_order_policy="bfc")
+        ctx = build_mesh(par, devices=devices8[:2])
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                               seq_length=32, train_iters=6, log_interval=3)
+        res = pretrain_gpt(model, par, train, OptimizerConfig(lr=1e-3),
+                           ctx=ctx, batch_iter=learnable_batches(32, 128, 8))
+        assert res.losses[-1] < res.losses[0]
+
+
+def _producer_proc(name, arrs):
+    from megatronapp_tpu.runtime.shm_ring import ShmRing
+    ring = ShmRing(name, create=False)
+    for a in arrs:
+        while not ring.push_array(a):
+            time.sleep(0.001)
+    ring.close()
+
+
+class TestShmRing:
+    def test_native_builds(self):
+        from megatronapp_tpu.runtime.shm_ring import native_available
+        assert native_available()
+
+    def test_round_trip_same_process(self):
+        from megatronapp_tpu.runtime.shm_ring import ShmRing
+        name = f"/mta_test_{time.time_ns() & 0xffffff}"
+        with ShmRing(name, capacity=1 << 20) as ring:
+            a = np.arange(1000, dtype=np.float32).reshape(10, 100)
+            assert ring.push_array(a)
+            b = np.random.default_rng(0).integers(
+                0, 255, size=37, dtype=np.uint8)
+            assert ring.push_array(b)
+            out_a = ring.pop_array()
+            out_b = ring.pop_array()
+            np.testing.assert_array_equal(out_a, a)
+            np.testing.assert_array_equal(out_b, b)
+            assert ring.pop_array() is None
+            ring.unlink()
+
+    def test_backpressure(self):
+        from megatronapp_tpu.runtime.shm_ring import ShmRing
+        name = f"/mta_test_{time.time_ns() & 0xffffff}"
+        with ShmRing(name, capacity=1 << 12) as ring:
+            big = np.zeros(1 << 13, np.uint8)
+            assert not ring.push_array(big)  # larger than capacity
+            small = np.zeros(1 << 10, np.uint8)
+            pushed = 0
+            while ring.push_array(small):
+                pushed += 1
+                assert pushed < 10, "ring never filled"
+            assert pushed >= 1
+            ring.pop_array()
+            assert ring.push_array(small)  # space reclaimed
+            ring.unlink()
+
+    def test_cross_process_transfer(self):
+        from megatronapp_tpu.runtime.shm_ring import ShmRing
+        name = f"/mta_test_{time.time_ns() & 0xffffff}"
+        rng = np.random.default_rng(0)
+        arrs = [rng.normal(size=(64, 64)).astype(np.float32)
+                for _ in range(8)]
+        ring = ShmRing(name, capacity=1 << 20)
+        proc = mp.Process(target=_producer_proc, args=(name, arrs))
+        proc.start()
+        got = []
+        deadline = time.time() + 30
+        while len(got) < len(arrs) and time.time() < deadline:
+            out = ring.pop_array()
+            if out is not None:
+                got.append(out)
+        proc.join(timeout=10)
+        ring.close()
+        ring.unlink()
+        assert len(got) == len(arrs)
+        for a, b in zip(arrs, got):
+            np.testing.assert_array_equal(a, b)

@@ -1,0 +1,61 @@
+"""Shared-memory staging ring bandwidth benchmark.
+
+Parity with /root/reference/profiling/shm_benchmark.cpp (+ its
+shm_benchmark_test.py driver): producer and consumer processes stream
+tensors through the ring and report GB/s.
+"""
+
+import argparse
+import multiprocessing as mp
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
+
+import numpy as np
+
+
+def _producer(name, n_msgs, msg_bytes):
+    from megatronapp_tpu.runtime.shm_ring import ShmRing
+    ring = ShmRing(name, create=False)
+    payload = np.random.default_rng(0).integers(
+        0, 255, size=msg_bytes, dtype=np.uint8)
+    sent = 0
+    while sent < n_msgs:
+        if ring.push_array(payload):
+            sent += 1
+    ring.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--msg-mb", type=float, default=4.0)
+    ap.add_argument("--num-messages", type=int, default=64)
+    ap.add_argument("--capacity-mb", type=int, default=64)
+    args = ap.parse_args()
+
+    from megatronapp_tpu.runtime.shm_ring import ShmRing
+
+    name = f"/mta_bench_{time.time_ns() & 0xffffff}"
+    msg_bytes = int(args.msg_mb * 1e6)
+    ring = ShmRing(name, capacity=int(args.capacity_mb * 1e6), create=True)
+    proc = mp.Process(target=_producer,
+                      args=(name, args.num_messages, msg_bytes))
+    t0 = time.perf_counter()
+    proc.start()
+    received = 0
+    while received < args.num_messages:
+        arr = ring.pop_array(max_len=msg_bytes + 4096)
+        if arr is not None:
+            received += 1
+    dt = time.perf_counter() - t0
+    proc.join()
+    ring.close()
+    ring.unlink()
+    total_gb = args.num_messages * msg_bytes / 1e9
+    print(f"{args.num_messages} x {args.msg_mb:.1f} MB in {dt:.3f}s "
+          f"→ {total_gb / dt:.2f} GB/s")
+
+
+if __name__ == "__main__":
+    main()
