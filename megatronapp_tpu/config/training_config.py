@@ -49,6 +49,12 @@ class TrainingConfig:
     # NaN/spike guard (reference rerun_state_machine result validation).
     check_for_nan_in_loss: bool = True
     loss_spike_factor: float = 10.0
+    # Rerun state machine (reference --rerun-mode / --error-injection-rate,
+    # arguments.py:1795-1812): 'disabled' | 'validate_results'.
+    rerun_mode: str = "validate_results"
+    error_injection_rate: float = 0.0
+    # Host-side straggler detector (reference --log-straggler).
+    log_straggler: bool = False
     # MegaScan tracing (reference --trace / --trace-interval /
     # --continuous-trace-iterations, arguments.py:2705ff).
     trace: bool = False

@@ -1,0 +1,107 @@
+"""Rerun state machine: result validation + step replay fault classification.
+
+Parity with /root/reference/megatron/core/rerun_state_machine.py (1307 LoC):
+- validates training results per step (NaN/Inf loss, loss spikes vs a
+  running statistic — the reference's result validation);
+- on a validation failure, REPLAYS the exact same step (same batch, same
+  state) and compares: a different result on identical inputs ⇒ transient
+  hardware fault (the chip mis-executed); an identical bad result ⇒
+  deterministic cause (data/numerics/model) — the reference's
+  rerun-to-classify logic;
+- supports error injection for testing (reference RerunErrorInjector :1147,
+  --error-injection-rate);
+- its state (step counters, EMA) is checkpointable (state_dict parity).
+
+The JAX replay is simpler than the reference's RNG/data capture: train steps
+are pure functions of (state, batch), so replay = call again with the saved
+inputs — determinism is the default on TPU/XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+from typing import Any, Callable, Dict, Optional
+
+
+class RerunDiagnostic(enum.Enum):
+    """Classification of a validation failure (reference diagnostics)."""
+    OK = "ok"
+    TRANSIENT_FAULT = "transient_hardware_fault"
+    PERSISTENT = "persistent_error"
+
+
+@dataclasses.dataclass
+class RerunStateMachine:
+    """Wraps step execution with validation + replay classification."""
+
+    # 'disabled' | 'validate_results' | 'report_stats' (reference
+    # --rerun-mode, arguments.py:1795-1812).
+    mode: str = "validate_results"
+    loss_spike_factor: float = 10.0
+    ema_decay: float = 0.95
+    error_injection_rate: float = 0.0
+    _ema_loss: Optional[float] = None
+    _step: int = 0
+    _injected: int = 0
+    reports: list = dataclasses.field(default_factory=list)
+
+    def validate(self, loss: float):
+        """Returns (ok, effective_loss). effective_loss differs from the
+        input only under error injection (the injected NaN must reach the
+        caller's classification path, not just this check)."""
+        self._step += 1
+        if self.error_injection_rate > 0 and \
+                self._step * self.error_injection_rate >= self._injected + 1:
+            self._injected += 1
+            loss = float("nan")  # injected fault for pipeline testing
+        if self.mode == "disabled":
+            return True, loss
+        if not math.isfinite(loss):
+            return False, loss
+        if self._ema_loss is not None and \
+                loss > self.loss_spike_factor * self._ema_loss:
+            return False, loss
+        self._ema_loss = (loss if self._ema_loss is None else
+                          self.ema_decay * self._ema_loss +
+                          (1 - self.ema_decay) * loss)
+        return True, loss
+
+    def classify_failure(self, step_fn: Callable, state, batch,
+                         bad_loss: float,
+                         atol: float = 0.0) -> RerunDiagnostic:
+        """Replay the failing step on identical inputs and compare
+        (reference should_run_forward_backward rerun logic)."""
+        import jax
+        _, metrics = step_fn(state, batch)
+        replay_loss = float(jax.device_get(metrics["loss"]))
+        both_nan = (not math.isfinite(bad_loss)
+                    and not math.isfinite(replay_loss))
+        if both_nan or abs(replay_loss - bad_loss) <= atol:
+            diag = RerunDiagnostic.PERSISTENT
+        else:
+            diag = RerunDiagnostic.TRANSIENT_FAULT
+        self.reports.append({
+            "step": self._step, "first_loss": bad_loss,
+            "replay_loss": replay_loss, "diagnostic": diag.value,
+        })
+        return diag
+
+    # -- checkpointable state (reference state_dict into common ckpt) ------
+    def state_dict(self) -> Dict[str, Any]:
+        return {"mode": self.mode, "ema_loss": self._ema_loss,
+                "step": self._step, "injected": self._injected}
+
+    def load_state_dict(self, sd: Dict[str, Any]):
+        self.mode = sd.get("mode", self.mode)
+        self._ema_loss = sd.get("ema_loss")
+        self._step = sd.get("step", 0)
+        self._injected = sd.get("injected", 0)
+
+
+_RERUN = RerunStateMachine()
+
+
+def get_rerun_state_machine() -> RerunStateMachine:
+    return _RERUN

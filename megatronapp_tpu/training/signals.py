@@ -1,0 +1,35 @@
+"""Signal-based graceful exit.
+
+Parity with /root/reference/megatron/training/dist_signal_handler.py
+(--exit-signal-handler): install a SIGTERM/SIGINT handler that flips a flag;
+the train loop checks it each iteration, checkpoints, and exits cleanly.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Iterable
+
+
+class DistSignalHandler:
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,)):
+        self._signals = tuple(signals)
+        self._received = threading.Event()
+        self._prev = {}
+
+    def __enter__(self):
+        for sig in self._signals:
+            self._prev[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def __exit__(self, *exc):
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        return False
+
+    def _handle(self, signum, frame):
+        self._received.set()
+
+    def signals_received(self) -> bool:
+        return self._received.is_set()

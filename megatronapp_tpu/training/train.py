@@ -67,6 +67,7 @@ def pretrain_gpt(
     batch_iter: Optional[Iterator[Dict[str, np.ndarray]]] = None,
     ctx: Optional[MeshContext] = None,
     log_fn: Callable[[str], None] = print,
+    batch_iter_factory: Optional[Callable] = None,
 ) -> TrainResult:
     """End-to-end GPT pretraining loop. Returns final state + stats."""
     if parallel_cfg.forward_backward_disaggregating:
@@ -111,11 +112,16 @@ def pretrain_gpt(
 
     if batch_iter is None:
         # Fast-forward the data stream past already-consumed samples on
-        # resume (reference consumed_train_samples bookkeeping).
-        batch_iter = mock_batches(
-            train_cfg.seq_length, model_cfg.vocab_size,
-            train_cfg.global_batch_size, seed=train_cfg.seed,
-            start_idx=start_step * train_cfg.global_batch_size)
+        # resume (reference consumed_train_samples bookkeeping) — via the
+        # caller's factory for real datasets, the mock stream otherwise.
+        consumed = start_step * train_cfg.global_batch_size
+        if batch_iter_factory is not None:
+            batch_iter = batch_iter_factory(consumed)
+        else:
+            batch_iter = mock_batches(
+                train_cfg.seq_length, model_cfg.vocab_size,
+                train_cfg.global_batch_size, seed=train_cfg.seed,
+                start_idx=consumed)
 
     if ctx.pp > 1:
         def loss_fn(params, batch_mb):
@@ -129,6 +135,13 @@ def pretrain_gpt(
                               train_cfg.train_iters,
                               check_nan=train_cfg.check_for_nan_in_loss,
                               pipeline=ctx.pp > 1)
+    # Non-donating variant for rerun replay (compiles only if a failure is
+    # ever classified; the donating step would delete the live state's
+    # buffers on replay).
+    replay_step_fn = make_train_step(
+        loss_fn, optimizer, opt_cfg, ctx, shardings, train_cfg.train_iters,
+        check_nan=train_cfg.check_for_nan_in_loss, pipeline=ctx.pp > 1,
+        donate=False)
 
     tracer = get_tracer()
     traced_step_fn = step_fn
@@ -153,6 +166,19 @@ def pretrain_gpt(
             log_fn("trace: backend lacks host callbacks; schedule-phase "
                    "spans disabled (host-side scopes only)")
 
+    from megatronapp_tpu.training.rerun_state_machine import (
+        get_rerun_state_machine,
+    )
+    from megatronapp_tpu.utils.straggler import get_straggler_detector
+
+    rerun = get_rerun_state_machine()
+    rerun.mode = train_cfg.rerun_mode
+    rerun.loss_spike_factor = train_cfg.loss_spike_factor
+    rerun.error_injection_rate = train_cfg.error_injection_rate
+    straggler = get_straggler_detector()
+    if train_cfg.log_straggler:
+        straggler.enable()
+
     losses = []
     window_tokens = 0
     window_start = time.perf_counter()
@@ -160,10 +186,12 @@ def pretrain_gpt(
     tokens_per_sec = 0.0
     tokens_per_step = train_cfg.global_batch_size * train_cfg.seq_length
 
+    last_sync_iter = start_step
     with ctx.mesh:
         for it in range(start_step, train_cfg.train_iters):
             tracer.iteration_begin(it)
             batch = reshape_global_batch(next(batch_iter), num_micro)
+            straggler.start()
             with tracer.scope("train-step"):
                 active_fn = traced_step_fn if tracer.active else step_fn
                 state, metrics = active_fn(state, batch)
@@ -173,6 +201,40 @@ def pretrain_gpt(
                               it + 1 == train_cfg.train_iters)
                 if tracer.active or should_log:
                     metrics = jax.device_get(metrics)
+                    # Straggler sampling: normalize the sync-to-sync window
+                    # by the number of pipelined steps it covers, so traced
+                    # (1-step) and logged (log_interval-step) samples share
+                    # a baseline.
+                    steps_in_span = max(it + 1 - last_sync_iter, 1)
+                    outlier = straggler.stop(steps=steps_in_span)
+                    last_sync_iter = it + 1
+                    if outlier is not None:
+                        log_fn(f"straggler: step {it+1} averaged "
+                               f"{outlier.elapsed_s*1e3:.0f} ms/step "
+                               f"(>{straggler.z_threshold} sigma)")
+                    # Result validation runs at sync points; the in-graph
+                    # NaN guard (lax.cond skip) protects params on EVERY
+                    # step regardless — only the host-side classification
+                    # is sampled (vs the reference's per-step check).
+                    loss_val = float(metrics["loss"])
+                    ok, eff_loss = rerun.validate(loss_val)
+                    if not ok:
+                        # The step's lax.cond already skipped the param
+                        # update on non-finite losses, so `state` still
+                        # holds the pre-update params — replaying the same
+                        # (state, batch) via the NON-donating step
+                        # classifies transient vs persistent (reference
+                        # rerun-to-classify; spikes with finite loss did
+                        # update, so those are report-only).
+                        import math as _math
+                        if not _math.isfinite(eff_loss):
+                            diag = rerun.classify_failure(
+                                replay_step_fn, state, batch, eff_loss)
+                            log_fn(f"rerun: invalid loss {eff_loss} at step "
+                                   f"{it+1} — {diag.value}")
+                        else:
+                            log_fn(f"rerun: loss spike {eff_loss:.4f} at "
+                                   f"step {it+1} (report-only)")
             was_traced = tracer.active
             # Fence on the updated params so in-flight phase callbacks
             # (e.g. the optimizer span) land inside this iteration window.

@@ -24,11 +24,20 @@ from megatronapp_tpu.training.optimizer import global_grad_norm, lr_schedule
 
 
 def batch_shardings(ctx: MeshContext) -> Any:
-    """Shardings for a batch dict of [num_micro, global_batch, seq] arrays."""
+    """Sharding for batch dicts of [num_micro, global_batch, ...] arrays.
+
+    Returned as a pytree PREFIX (one sharding for the whole dict) so batches
+    with model-specific extra fields (BERT's tokentype_ids/is_random, T5's
+    enc/dec pairs) shard uniformly over the batch axis. With cp > 1 the
+    sequence axis must also shard, which requires rank-3 leaves — the GPT
+    field set.
+    """
     spec = ctx.batch_spec()
-    micro_spec = P(None, *spec)
-    sh = NamedSharding(ctx.mesh, micro_spec)
-    return {"tokens": sh, "labels": sh, "loss_mask": sh, "position_ids": sh}
+    if ctx.cp > 1:
+        sh = NamedSharding(ctx.mesh, P(None, *spec))
+        return {"tokens": sh, "labels": sh, "loss_mask": sh,
+                "position_ids": sh}
+    return NamedSharding(ctx.mesh, P(None, *spec))
 
 
 def make_train_step(
@@ -41,6 +50,7 @@ def make_train_step(
     check_nan: bool = True,
     pipeline: bool = False,
     trace_phases: bool = False,
+    donate: bool = True,
 ):
     """loss_fn(params, microbatch_dict) -> (loss, metrics_dict).
 
@@ -156,7 +166,7 @@ def make_train_step(
         step,
         in_shardings=(state_shardings, b_sh),
         out_shardings=(state_shardings, None),
-        donate_argnums=(0,),
+        donate_argnums=(0,) if donate else (),
     )
 
 

@@ -1,0 +1,79 @@
+"""Host-side straggler detector.
+
+Parity with /root/reference/megatron/core/utils.py:1030 (StragglerDetector,
+docs core/README_STRAGGLER.md): collects per-step timings and flags outlier
+steps/processes. The reference reads GPU power/temp/clocks via pynvml; on
+TPU those counters aren't host-visible, so this detector works purely from
+step-time statistics (MegaScan's trace-based detector — trace/detect.py —
+is the op-granularity complement, exactly as in the reference).
+
+Toggleable at runtime (reference: curl port on/off) via enable()/disable().
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Deque, List, Optional
+
+
+@dataclasses.dataclass
+class StepRecord:
+    step: int
+    elapsed_s: float
+
+
+class StragglerDetector:
+    def __init__(self, window: int = 64, z_threshold: float = 3.0,
+                 min_samples: int = 8):
+        self.window: Deque[StepRecord] = deque(maxlen=window)
+        self.z_threshold = z_threshold
+        self.min_samples = min_samples
+        self.enabled = False
+        self.flagged: List[StepRecord] = []
+        self._t0: Optional[float] = None
+        self._step = 0
+
+    def enable(self):
+        self.enabled = True
+
+    def disable(self):
+        self.enabled = False
+
+    def start(self):
+        # Keep a running span open: start() fires every iteration but the
+        # sample closes only at the next sync point (stop()).
+        if self.enabled and self._t0 is None:
+            self._t0 = time.perf_counter()
+
+    def stop(self, steps: int = 1) -> Optional[StepRecord]:
+        """Record a sample normalized to per-step time (a sync span may
+        cover several pipelined steps); returns the record if it is an
+        outlier."""
+        if not self.enabled or self._t0 is None:
+            return None
+        elapsed = (time.perf_counter() - self._t0) / max(steps, 1)
+        self._t0 = None
+        self._step += 1
+        rec = StepRecord(self._step, elapsed)
+        outlier = None
+        if len(self.window) >= self.min_samples:
+            times = [r.elapsed_s for r in self.window]
+            mean = sum(times) / len(times)
+            var = sum((t - mean) ** 2 for t in times) / len(times)
+            std = var ** 0.5
+            if std > 0 and (elapsed - mean) / std > self.z_threshold:
+                self.flagged.append(rec)
+                outlier = rec
+        # Outliers are excluded from the baseline window.
+        if outlier is None:
+            self.window.append(rec)
+        return outlier
+
+
+_DETECTOR = StragglerDetector()
+
+
+def get_straggler_detector() -> StragglerDetector:
+    return _DETECTOR

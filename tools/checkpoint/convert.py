@@ -1,0 +1,181 @@
+"""Checkpoint converter: HuggingFace ↔ megatronapp-tpu parameter pytrees.
+
+Parity with /root/reference/tools/checkpoint/convert.py (+ loader/saver
+plugins for llama/mistral/HF models): maps HF transformer weights into our
+functional param layout (models/gpt.py) and saves an Orbax checkpoint that
+pretrain_gpt --load / the inference server can consume.
+
+Usage:
+  python tools/checkpoint/convert.py --model-type gpt2 \
+      --hf-path /path/to/hf_model --save-dir /ckpts/gpt2
+  python tools/checkpoint/convert.py --model-type llama \
+      --hf-path meta-llama/... --save-dir /ckpts/llama
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
+
+import numpy as np
+
+
+def convert_gpt2_state_dict(sd, cfg):
+    """HF GPT-2 state dict → our GPT param pytree.
+
+    HF GPT-2 uses Conv1D ([in, out] kernels — no transpose needed) with a
+    fused c_attn [H, 3H]."""
+    import jax.numpy as jnp
+
+    h = cfg.hidden_size
+
+    def t(name):
+        return np.asarray(sd[name], np.float32)
+
+    layers = {}
+    per_layer = []
+    for i in range(cfg.num_layers):
+        pre = f"h.{i}."
+        c_attn_w = t(pre + "attn.c_attn.weight")   # [H, 3H]
+        c_attn_b = t(pre + "attn.c_attn.bias")
+        per_layer.append({
+            "ln1_scale": t(pre + "ln_1.weight"),
+            "ln1_bias": t(pre + "ln_1.bias"),
+            "ln2_scale": t(pre + "ln_2.weight"),
+            "ln2_bias": t(pre + "ln_2.bias"),
+            "attention": {
+                "q_kernel": c_attn_w[:, :h],
+                "kv_kernel": c_attn_w[:, h:],
+                "q_bias": c_attn_b[:h],
+                "kv_bias": c_attn_b[h:],
+                "out_kernel": t(pre + "attn.c_proj.weight"),
+                "out_bias": t(pre + "attn.c_proj.bias"),
+            },
+            "mlp": {
+                "fc1_kernel": t(pre + "mlp.c_fc.weight"),
+                "fc1_bias": t(pre + "mlp.c_fc.bias"),
+                "fc2_kernel": t(pre + "mlp.c_proj.weight"),
+                "fc2_bias": t(pre + "mlp.c_proj.bias"),
+            },
+        })
+    import jax
+    layers = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
+
+    wte = t("wte.weight")
+    vocab_pad = cfg.vocab_size - wte.shape[0]
+    if vocab_pad > 0:  # pad vocab rows to the configured (TP-friendly) size
+        wte = np.concatenate([wte, np.zeros((vocab_pad, h), np.float32)])
+    return {
+        "embedding": {
+            "word": jnp.asarray(wte),
+            "pos": jnp.asarray(t("wpe.weight")),
+        },
+        "block": layers,
+        "final_ln_scale": jnp.asarray(t("ln_f.weight")),
+        "final_ln_bias": jnp.asarray(t("ln_f.bias")),
+    }
+
+
+def convert_llama_state_dict(sd, cfg):
+    """HF Llama state dict → our GPT param pytree (swiglu/rmsnorm/GQA).
+
+    HF Linear kernels are [out, in] → transpose; gate/up fuse into our
+    fc1 [H, 2F] with the GATE half first (transformer/mlp.py split order)."""
+    import jax
+    import jax.numpy as jnp
+
+    def t(name):
+        return np.asarray(sd[name], np.float32)
+
+    def lin(name):
+        return t(name).T  # [out,in] → [in,out]
+
+    per_layer = []
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        k_w = lin(pre + "self_attn.k_proj.weight")
+        v_w = lin(pre + "self_attn.v_proj.weight")
+        gate = lin(pre + "mlp.gate_proj.weight")
+        up = lin(pre + "mlp.up_proj.weight")
+        per_layer.append({
+            "ln1_scale": t(pre + "input_layernorm.weight"),
+            "ln2_scale": t(pre + "post_attention_layernorm.weight"),
+            "attention": {
+                "q_kernel": lin(pre + "self_attn.q_proj.weight"),
+                "kv_kernel": np.concatenate([k_w, v_w], axis=1),
+                "out_kernel": lin(pre + "self_attn.o_proj.weight"),
+            },
+            "mlp": {
+                "fc1_kernel": np.concatenate([gate, up], axis=1),
+                "fc2_kernel": lin(pre + "mlp.down_proj.weight"),
+            },
+        })
+    layers = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
+    p = {
+        "embedding": {"word": jnp.asarray(t("model.embed_tokens.weight"))},
+        "block": layers,
+        "final_ln_scale": jnp.asarray(t("model.norm.weight")),
+    }
+    if "lm_head.weight" in sd:
+        p["output"] = jnp.asarray(lin("lm_head.weight"))
+    return p
+
+
+CONVERTERS = {"gpt2": convert_gpt2_state_dict,
+              "llama": convert_llama_state_dict}
+
+
+def load_hf_state_dict(path):
+    """Load an HF checkpoint directory (safetensors or torch .bin)."""
+    import os
+    entries = {}
+    names = [f for f in os.listdir(path)
+             if f.endswith((".safetensors", ".bin"))]
+    if not names:
+        raise FileNotFoundError(f"no weight files in {path}")
+    for f in sorted(names):
+        full = os.path.join(path, f)
+        if f.endswith(".safetensors"):
+            from safetensors.numpy import load_file
+            entries.update(load_file(full))
+        else:
+            import torch
+            sd = torch.load(full, map_location="cpu", weights_only=True)
+            entries.update({k: v.numpy() for k, v in sd.items()})
+    # Strip common prefixes.
+    return {k.removeprefix("transformer."): v for k, v in entries.items()}
+
+
+def main():
+    import jax
+
+    from megatronapp_tpu.training.checkpointing import CheckpointManager
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-type", required=True, choices=sorted(CONVERTERS))
+    ap.add_argument("--hf-path", required=True)
+    ap.add_argument("--save-dir", required=True)
+    ap.add_argument("--preset", default=None)
+    args = ap.parse_args()
+
+    from megatronapp_tpu.models.presets import PRESETS
+    if args.preset:
+        cfg = PRESETS[args.preset]()
+    elif args.model_type == "gpt2":
+        cfg = PRESETS["gpt2-125m"]()
+    else:
+        cfg = PRESETS["llama3-8b"]()
+
+    sd = load_hf_state_dict(args.hf_path)
+    params = CONVERTERS[args.model_type](sd, cfg)
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    mngr = CheckpointManager(args.save_dir, async_save=False)
+    mngr.save(0, {"step": 0, "params": params, "opt_state": {}},
+              force=True)
+    mngr.wait()
+    mngr.close()
+    print(f"converted {n/1e6:.1f}M params → {args.save_dir}")
+
+
+if __name__ == "__main__":
+    main()
