@@ -1,0 +1,7 @@
+#!/bin/bash
+# BERT-base MLM+NSP (reference run_single_bert.sh analogue).
+python pretrain_bert.py \
+    --num-layers 12 --hidden-size 768 --num-attention-heads 12 \
+    --vocab-size 30592 --seq-length 512 --max-position-embeddings 512 \
+    --micro-batch-size 4 --global-batch-size 32 \
+    --train-iters 1000 --lr 1e-4 --lr-warmup-iters 100 "$@"

@@ -1,0 +1,9 @@
+#!/bin/bash
+# MegaDPP breadth-first-chunk schedule (reference --use-dpp).
+python pretrain_gpt.py \
+    --num-layers 16 --hidden-size 2048 --num-attention-heads 32 \
+    --seq-length 2048 --max-position-embeddings 2048 \
+    --micro-batch-size 2 --global-batch-size 16 \
+    --tensor-model-parallel-size 2 --pipeline-model-parallel-size 2 \
+    --num-layers-per-virtual-pipeline-stage 4 --use-dpp \
+    --train-iters 100 --lr 1e-4 "$@"

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Reference test_scripts/test_train_gpt_single_{trace,dpp}.sh analogue:
+# GPT 16L / h2048 / 32 heads / seq 2048, TP=2 PP=2 VPP=2, mbs=2 gbs=16,
+# MegaScan tracing on (DockerUsage.md:86-99 flag set).
+python pretrain_gpt.py \
+    --num-layers 16 --hidden-size 2048 --num-attention-heads 32 \
+    --seq-length 2048 --max-position-embeddings 2048 \
+    --micro-batch-size 2 --global-batch-size 16 \
+    --tensor-model-parallel-size 2 --pipeline-model-parallel-size 2 \
+    --num-layers-per-virtual-pipeline-stage 4 \
+    --train-iters 100 --lr 1e-4 --lr-warmup-iters 10 \
+    --trace --trace-interval 5 --continuous-trace-iterations 2 \
+    "$@"

@@ -1,0 +1,9 @@
+#!/bin/bash
+# MegaFBD forward/backward disaggregation
+# (reference test_train_gpt_distributed_fbd.sh analogue; DP must be even).
+python pretrain_gpt.py \
+    --num-layers 16 --hidden-size 2048 --num-attention-heads 32 \
+    --seq-length 2048 --max-position-embeddings 2048 \
+    --micro-batch-size 2 --global-batch-size 16 \
+    --forward-backward-disaggregating \
+    --train-iters 100 --lr 1e-4 "$@"

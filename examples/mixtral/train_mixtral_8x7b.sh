@@ -1,0 +1,9 @@
+#!/bin/bash
+# Mixtral-8x7B EP training (reference
+# examples/mixtral/train_mixtral_8x7b_distributed.sh:51,85 — 8 experts,
+# EP=8, top-2 routing).
+python pretrain_gpt.py --preset mixtral-8x7b \
+    --seq-length 4096 --micro-batch-size 1 --global-batch-size 256 \
+    --tensor-model-parallel-size 4 --expert-model-parallel-size 8 \
+    --sequence-parallel \
+    --train-iters 500 --lr 1e-4 --lr-warmup-iters 50 "$@"

@@ -131,6 +131,18 @@ def pretrain_gpt(
                 order_policy=parallel_cfg.pipeline_order_policy)
     else:
         loss_fn = gpt_microbatch_loss(model_cfg, ctx=ctx)
+    eval_step_fn = None
+    eval_iter = None
+    if train_cfg.eval_interval and ctx.pp == 1:
+        # Held-out evaluation (reference evaluate_and_print_results,
+        # training.py eval loop): a distinct data stream (different seed)
+        # scored with the forward-only step.
+        from megatronapp_tpu.training.train_step import make_eval_step
+        eval_step_fn = make_eval_step(loss_fn, ctx, shardings)
+        eval_iter = mock_batches(
+            train_cfg.seq_length, model_cfg.vocab_size,
+            train_cfg.global_batch_size, seed=train_cfg.seed + 1)
+
     step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
                               train_cfg.train_iters,
                               check_nan=train_cfg.check_for_nan_in_loss,
@@ -267,6 +279,17 @@ def pretrain_gpt(
                     f"{tflops:.1f} TFLOP/s/dev")
                 window_tokens = 0
                 window_start = now
+
+            if eval_step_fn is not None and \
+                    (it + 1) % train_cfg.eval_interval == 0:
+                totals = []
+                for _ in range(train_cfg.eval_iters):
+                    ebatch = reshape_global_batch(next(eval_iter), num_micro)
+                    totals.append(eval_step_fn(state, ebatch))
+                eval_loss = float(jax.device_get(
+                    jnp.mean(jnp.stack(totals))))
+                log_fn(f"eval @ iter {it+1}: loss {eval_loss:.4f} over "
+                       f"{train_cfg.eval_iters} batches")
 
             if ckpt is not None and train_cfg.save_interval and \
                     (it + 1) % train_cfg.save_interval == 0:
