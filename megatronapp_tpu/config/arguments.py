@@ -127,6 +127,11 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     g.add_argument("--tokenizer-type", default="NullTokenizer")
     g.add_argument("--tokenizer-name-or-path", default=None)
 
+    g = ap.add_argument_group("logging")  # _add_logging_args parity
+    g.add_argument("--tensorboard-dir", default=None)
+    g.add_argument("--metrics-jsonl", default=None,
+                   help="append per-log-step scalars to this JSONL file")
+
     g = ap.add_argument_group("fault-tolerance")  # _add_rerun args parity
     g.add_argument("--rerun-mode", default="validate_results",
                    choices=["disabled", "validate_results"])
@@ -240,6 +245,8 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
         raise ValueError("--seq-length exceeds --max-position-embeddings")
 
     training = TrainingConfig(
+        metrics_jsonl=args.metrics_jsonl,
+        tensorboard_dir=args.tensorboard_dir,
         rerun_mode=args.rerun_mode,
         error_injection_rate=args.error_injection_rate,
         log_straggler=args.log_straggler,

@@ -55,6 +55,9 @@ class TrainingConfig:
     error_injection_rate: float = 0.0
     # Host-side straggler detector (reference --log-straggler).
     log_straggler: bool = False
+    # Metrics sinks (reference --tensorboard-dir / wandb analogues).
+    metrics_jsonl: Optional[str] = None
+    tensorboard_dir: Optional[str] = None
     # MegaScan tracing (reference --trace / --trace-interval /
     # --continuous-trace-iterations, arguments.py:2705ff).
     trace: bool = False

@@ -1,0 +1,82 @@
+"""Metrics sinks: JSONL, CSV, TensorBoard.
+
+Parity with the reference's logging fan-out (training_log, training.py:1488
+→ tensorboard writers in global_vars.py, wandb_utils.py, one_logger_utils.py
+and the throughput progress log :1757): one `MetricsLogger` dispatches each
+step's scalars to every configured sink.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional
+
+
+class JsonlSink:
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+
+    def log(self, step: int, metrics: Dict[str, float]):
+        # Strict JSON: NaN/Inf are not valid tokens; stringify them so the
+        # exact lines that matter for fault diagnosis stay parseable.
+        clean = {k: (v if not (isinstance(v, float)
+                               and not math.isfinite(v)) else str(v))
+                 for k, v in metrics.items()}
+        self._f.write(json.dumps(
+            {"step": step, "ts": time.time(), **clean}) + "\n")
+
+    def close(self):
+        self._f.close()
+
+
+class TensorBoardSink:
+    """Optional (reference --tensorboard-dir)."""
+
+    def __init__(self, log_dir: str, warn=None):
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self._w = SummaryWriter(log_dir=log_dir)
+        except Exception as e:
+            if warn is not None:
+                warn(f"tensorboard sink disabled: {type(e).__name__}: {e}")
+            self._w = None
+
+    def log(self, step: int, metrics: Dict[str, float]):
+        if self._w is None:
+            return
+        for k, v in metrics.items():
+            try:
+                self._w.add_scalar(k, float(v), step)
+            except (TypeError, ValueError):
+                pass
+
+    def close(self):
+        if self._w is not None:
+            self._w.close()
+
+
+class MetricsLogger:
+    def __init__(self):
+        self._sinks: List = []
+
+    def add_jsonl(self, path: str):
+        self._sinks.append(JsonlSink(path))
+        return self
+
+    def add_tensorboard(self, log_dir: str, warn=None):
+        self._sinks.append(TensorBoardSink(log_dir, warn=warn))
+        return self
+
+    def log(self, step: int, metrics: Dict[str, float]):
+        clean = {k: (float(v) if hasattr(v, "__float__") else v)
+                 for k, v in metrics.items()}
+        for s in self._sinks:
+            s.log(step, clean)
+
+    def close(self):
+        for s in self._sinks:
+            s.close()

@@ -183,6 +183,15 @@ def pretrain_gpt(
     )
     from megatronapp_tpu.utils.straggler import get_straggler_detector
 
+    from megatronapp_tpu.training.metrics import MetricsLogger
+    metrics_logger = MetricsLogger()
+    if jax.process_index() == 0:  # rank-0 writer (reference tb gating)
+        if train_cfg.metrics_jsonl:
+            metrics_logger.add_jsonl(train_cfg.metrics_jsonl)
+        if train_cfg.tensorboard_dir:
+            metrics_logger.add_tensorboard(train_cfg.tensorboard_dir,
+                                           warn=log_fn)
+
     rerun = get_rerun_state_machine()
     rerun.mode = train_cfg.rerun_mode
     rerun.loss_spike_factor = train_cfg.loss_spike_factor
@@ -277,6 +286,12 @@ def pretrain_gpt(
                     f"{step_time_ms:.1f} ms/step | "
                     f"{tokens_per_sec:,.0f} tok/s | "
                     f"{tflops:.1f} TFLOP/s/dev")
+                metrics_logger.log(it + 1, {
+                    **metrics,
+                    "tokens_per_sec": tokens_per_sec,
+                    "step_time_ms": step_time_ms,
+                    "tflops_per_device": tflops,
+                })
                 window_tokens = 0
                 window_start = now
 
@@ -307,6 +322,7 @@ def pretrain_gpt(
         ckpt.close()
     if train_cfg.trace:
         tracer.finalize()
+    metrics_logger.close()
 
     return TrainResult(state=state, losses=losses,
                        tokens_per_sec=tokens_per_sec,
@@ -329,7 +345,9 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
             "tp/dp only (pp/cp sub-mesh support pending)")
     for field, val in (("save_dir", train_cfg.save_dir),
                        ("load_dir", train_cfg.load_dir),
-                       ("trace", train_cfg.trace)):
+                       ("trace", train_cfg.trace),
+                       ("metrics_jsonl", train_cfg.metrics_jsonl),
+                       ("tensorboard_dir", train_cfg.tensorboard_dir)):
         if val:
             raise NotImplementedError(
                 f"TrainingConfig.{field} is not supported under "
