@@ -55,6 +55,14 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
                    help="named model preset (models/presets.py); flags "
                         "override preset fields they explicitly set")
 
+    g = ap.add_argument_group("mla")  # MLATransformerConfig parity
+    g.add_argument("--multi-latent-attention", action="store_true")
+    g.add_argument("--q-lora-rank", type=int, default=None)
+    g.add_argument("--kv-lora-rank", type=int, default=512)
+    g.add_argument("--qk-head-dim", type=int, default=128)
+    g.add_argument("--qk-pos-emb-head-dim", type=int, default=64)
+    g.add_argument("--v-head-dim", type=int, default=128)
+
     g = ap.add_argument_group("moe")  # _add_moe_args parity
     g.add_argument("--num-experts", type=int, default=None)
     g.add_argument("--moe-router-topk", type=int, default=2)
@@ -210,6 +218,12 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
             moe_layer_freq=args.moe_layer_freq,
             moe_shared_expert_intermediate_size=(
                 args.moe_shared_expert_intermediate_size),
+            multi_latent_attention=args.multi_latent_attention,
+            q_lora_rank=args.q_lora_rank,
+            kv_lora_rank=args.kv_lora_rank,
+            qk_head_dim=args.qk_head_dim,
+            qk_pos_emb_head_dim=args.qk_pos_emb_head_dim,
+            v_head_dim=args.v_head_dim,
             cp_comm_type=args.cp_comm_type,
             remat_policy=args.recompute_granularity,
             compute_dtype=jnp.float32 if args.fp32 else jnp.bfloat16,

@@ -91,12 +91,15 @@ def gpt_embed(p, tokens: jnp.ndarray, cfg: TransformerConfig,
 
 def gpt_rope_tables(cfg: TransformerConfig, seq_len: int,
                     position_offset: int = 0):
+    # MLA applies rope only on the decoupled position heads.
+    rope_dim = (cfg.qk_pos_emb_head_dim if cfg.multi_latent_attention
+                else cfg.head_dim)
     if cfg.position_embedding == PositionEmbeddingKind.rope:
-        inv_freq = rotary.rope_frequencies(cfg.head_dim, cfg.rotary_base,
+        inv_freq = rotary.rope_frequencies(rope_dim, cfg.rotary_base,
                                            cfg.rotary_percent)
     elif cfg.position_embedding == PositionEmbeddingKind.yarn:
         inv_freq = rotary.yarn_frequencies(
-            cfg.head_dim, cfg.rotary_base,
+            rope_dim, cfg.rotary_base,
             scaling_factor=cfg.rope_scaling_factor,
             original_max_position=cfg.yarn_original_max_position,
             beta_fast=cfg.yarn_beta_fast, beta_slow=cfg.yarn_beta_slow,

@@ -37,7 +37,11 @@ def init_layer_params(rng, cfg: TransformerConfig, force_dense: bool = False):
     # (reference scaled_init_method_normal, training/utils).
     out_std = cfg.init_method_std / jnp.sqrt(2.0 * cfg.num_layers)
     k_attn, k_mlp = jax.random.split(rng)
-    attn_p, attn_ax = init_attention_params(k_attn, cfg, out_std)
+    if cfg.multi_latent_attention:
+        from megatronapp_tpu.transformer.mla import init_mla_params
+        attn_p, attn_ax = init_mla_params(k_attn, cfg, out_std)
+    else:
+        attn_p, attn_ax = init_attention_params(k_attn, cfg, out_std)
     p = {
         "ln1_scale": jnp.ones((cfg.hidden_size,), cfg.params_dtype),
         "ln2_scale": jnp.ones((cfg.hidden_size,), cfg.params_dtype),
@@ -67,10 +71,20 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     residual = x
     h = apply_norm(cfg.normalization, x, p["ln1_scale"], p.get("ln1_bias"),
                    cfg.layernorm_epsilon)
-    attn_out, new_cache = attention_forward(
-        p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
-        kv_cache=kv_cache, cache_index=cache_index, layer_id=layer_id,
-        ctx=ctx)
+    if cfg.multi_latent_attention:
+        from megatronapp_tpu.transformer.mla import mla_forward
+        if kv_cache is not None:
+            raise NotImplementedError(
+                "MLA decode with a KV cache is not implemented yet (the "
+                "cache should hold the compressed latent + shared rope key)")
+        attn_out = mla_forward(p["attention"], h, cfg, rope_cos, rope_sin,
+                               attention_mask, layer_id=layer_id, ctx=ctx)
+        new_cache = None
+    else:
+        attn_out, new_cache = attention_forward(
+            p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
+            kv_cache=kv_cache, cache_index=cache_index, layer_id=layer_id,
+            ctx=ctx)
     x = residual + attn_out.astype(residual.dtype)
 
     residual = x

@@ -1,0 +1,136 @@
+"""Multi-latent attention (MLA, DeepSeek-style).
+
+Parity with /root/reference/megatron/core/transformer/
+multi_latent_attention.py:44 (MLASelfAttention) and MLATransformerConfig
+(transformer_config.py:1072): queries (optionally) and keys/values project
+through low-rank latents; position information flows only through small
+decoupled rope heads (qk_pos_emb_head_dim) — the KV cache compresses to the
+latent + shared rope key.
+
+Shapes (per layer):
+  q path:   x[H] → (q_lora_rank → ln →)? nq*(dqk + dpe)
+  kv path:  x[H] → kv_lora_rank + dpe   (latent ‖ shared k_pe)
+            latent → ln → nq*(dqk + dv) (k_nope ‖ v)
+  attn:     q = [q_nope ‖ rope(q_pe)], k = [k_nope ‖ rope(k_pe)] with the
+            shared k_pe broadcast across heads; softmax scale
+            1/sqrt(dqk + dpe); out: nq*dv → H.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.ops import rotary
+from megatronapp_tpu.ops.attention import dot_product_attention
+from megatronapp_tpu.ops.normalization import rms_norm
+
+
+def init_mla_params(rng, cfg: TransformerConfig, out_std: float):
+    h = cfg.hidden_size
+    nq = cfg.num_attention_heads
+    dqk, dpe, dv = cfg.qk_head_dim, cfg.qk_pos_emb_head_dim, cfg.v_head_dim
+    klat = cfg.kv_lora_rank
+    keys = jax.random.split(rng, 6)
+    std = cfg.init_method_std
+    p = {}
+    ax = {}
+    if cfg.q_lora_rank:
+        p["q_down"] = jax.random.normal(
+            keys[0], (h, cfg.q_lora_rank), cfg.params_dtype) * std
+        p["q_ln_scale"] = jnp.ones((cfg.q_lora_rank,), cfg.params_dtype)
+        p["q_up"] = jax.random.normal(
+            keys[1], (cfg.q_lora_rank, nq * (dqk + dpe)),
+            cfg.params_dtype) * std
+        ax["q_down"] = ("embed", None)
+        ax["q_ln_scale"] = (None,)
+        ax["q_up"] = (None, "qkv")
+    else:
+        p["q_proj"] = jax.random.normal(
+            keys[0], (h, nq * (dqk + dpe)), cfg.params_dtype) * std
+        ax["q_proj"] = ("embed", "qkv")
+    # Compressed KV latent + shared rope key (one dpe-wide head).
+    p["kv_down"] = jax.random.normal(
+        keys[2], (h, klat + dpe), cfg.params_dtype) * std
+    p["kv_ln_scale"] = jnp.ones((klat,), cfg.params_dtype)
+    p["kv_up"] = jax.random.normal(
+        keys[3], (klat, nq * (dqk + dv)), cfg.params_dtype) * std
+    p["out_kernel"] = jax.random.normal(
+        keys[4], (nq * dv, h), cfg.params_dtype) * out_std
+    ax.update({
+        "kv_down": ("embed", None), "kv_ln_scale": (None,),
+        "kv_up": (None, "qkv"), "out_kernel": ("qkv", "embed"),
+    })
+    return p, ax
+
+
+def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
+                rope_cos=None, rope_sin=None,
+                attention_mask: Optional[jnp.ndarray] = None,
+                layer_id=None, ctx=None):
+    if ctx is not None and ctx.cp > 1:
+        raise NotImplementedError(
+            "MLA under context parallelism is not implemented yet (needs "
+            "the ring/a2a path for the concatenated nope+rope heads)")
+    from megatronapp_tpu.scope.disturbance import get_disturbance
+    from megatronapp_tpu.scope.hooks import scope_capture
+    _dist = get_disturbance()
+
+    b, s, h = x.shape
+    nq = cfg.num_attention_heads
+    dqk, dpe, dv = cfg.qk_head_dim, cfg.qk_pos_emb_head_dim, cfg.v_head_dim
+    klat = cfg.kv_lora_rank
+    dt = cfg.compute_dtype
+    x = x.astype(dt)
+
+    if "q_proj" in p:
+        q = x @ _dist.apply("weight", p["q_proj"], layer_id).astype(dt)
+    else:
+        q_lat = x @ p["q_down"].astype(dt)
+        q_lat = rms_norm(q_lat, p["q_ln_scale"], cfg.layernorm_epsilon)
+        q = q_lat @ p["q_up"].astype(dt)
+    q = q.reshape(b, s, nq, dqk + dpe)
+    q_nope, q_pe = q[..., :dqk], q[..., dqk:]
+
+    kv = x @ _dist.apply("weight", p["kv_down"],
+                         layer_id).astype(dt)  # [B,S,klat+dpe]
+    latent, k_pe = kv[..., :klat], kv[..., klat:]
+    latent = rms_norm(latent, p["kv_ln_scale"], cfg.layernorm_epsilon)
+    kv_up = (latent @ p["kv_up"].astype(dt)).reshape(b, s, nq, dqk + dv)
+    k_nope, v = kv_up[..., :dqk], kv_up[..., dqk:]
+
+    if rope_cos is not None:
+        q_pe = rotary.apply_rope(q_pe, rope_cos, rope_sin)
+        k_pe = rotary.apply_rope(k_pe[:, :, None, :], rope_cos,
+                                 rope_sin)[:, :, 0]
+    k_pe = jnp.broadcast_to(k_pe[:, :, None, :], (b, s, nq, dpe))
+
+    # YaRN: the rope tables already carry mscale (models/gpt.py), which
+    # gives the pe logits the reference's mscale² factor; the nope logits
+    # need the same factor explicitly (reference multi_latent_attention.py
+    # :83-84 applies mscale²/sqrt(d) to ALL logits).
+    from megatronapp_tpu.config.transformer_config import (
+        PositionEmbeddingKind,
+    )
+    if cfg.position_embedding == PositionEmbeddingKind.yarn:
+        m = rotary.yarn_mscale(cfg.rope_scaling_factor,
+                               cfg.yarn_mscale_coeff)
+        q_nope = q_nope * m
+        k_nope = k_nope * m
+
+    q_full = jnp.concatenate([q_nope, q_pe], axis=-1)
+    k_full = jnp.concatenate([k_nope, k_pe], axis=-1)
+    q_full = scope_capture("qkv_q", q_full, layer_id)
+    k_full = scope_capture("qkv_k", k_full, layer_id)
+    v = scope_capture("qkv_v", v, layer_id)
+    scale = 1.0 / jnp.sqrt(jnp.float32(dqk + dpe))
+    out = dot_product_attention(
+        q_full, k_full, v, mask_type=cfg.attn_mask_type,
+        attention_mask=attention_mask, softmax_scale=scale,
+        softmax_in_fp32=cfg.attention_softmax_in_fp32)
+    out = scope_capture("context", out, layer_id)
+    return out.reshape(b, s, nq * dv) @ _dist.apply(
+        "weight", p["out_kernel"], layer_id).astype(dt)
