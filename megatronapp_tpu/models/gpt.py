@@ -114,13 +114,62 @@ def gpt_rope_tables(cfg: TransformerConfig, seq_len: int,
     return cos, sin
 
 
+def packed_attention_mask(segment_ids: jnp.ndarray) -> jnp.ndarray:
+    """Block-diagonal mask for packed sequences: token i may attend j only
+    within the same segment (causality comes from the standard causal mask
+    on top). Parity with the reference packed/THD formats
+    (core/packed_seq_params.py + --reset-attention-mask /
+    --reset-position-ids semantics; positions reset per segment in
+    packed_position_ids). Note: an explicit mask routes attention through
+    the reference impl (O(S²) scores), not the flash kernel — a
+    segment-aware flash variant is future work.
+
+    segment_ids [B,S] → bool mask [B,1,S,S] (True = may attend)."""
+    same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+    return same
+
+
+def packed_position_ids(segment_ids: jnp.ndarray) -> jnp.ndarray:
+    """Per-segment position ids: positions restart at 0 at each segment
+    boundary (reference --reset-position-ids). [B,S] → [B,S] int32."""
+    b, s = segment_ids.shape
+    idx = jnp.arange(s)[None, :]
+    is_start = jnp.concatenate(
+        [jnp.ones((b, 1), bool),
+         segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1)
+    seg_start = jax.lax.cummax(jnp.where(is_start, idx, 0), axis=1)
+    return (idx - seg_start).astype(jnp.int32)
+
+
 def gpt_forward(p, tokens: jnp.ndarray, cfg: TransformerConfig,
                 attention_mask: Optional[jnp.ndarray] = None,
-                position_offset: int = 0, ctx=None):
-    """tokens [B,S] → (logits [B,S,V] fp32, moe_aux_loss)."""
+                position_offset: int = 0, ctx=None,
+                segment_ids: Optional[jnp.ndarray] = None):
+    """tokens [B,S] → (logits [B,S,V] fp32, moe_aux_loss).
+
+    segment_ids: optional [B,S] packing map — attention is restricted to
+    within-segment (packed sequences)."""
     b, s = tokens.shape
     h = gpt_embed(p, tokens, cfg, position_offset)
     cos, sin = gpt_rope_tables(cfg, s, position_offset)
+    if segment_ids is not None:
+        if ctx is not None and ctx.cp > 1:
+            raise NotImplementedError(
+                "packed sequences (segment_ids) are not supported under "
+                "context parallelism yet")
+        seg_mask = packed_attention_mask(segment_ids)
+        attention_mask = (seg_mask if attention_mask is None
+                          else attention_mask & seg_mask)
+        if cos is not None:
+            # Positions restart per segment (reference
+            # --reset-position-ids): per-token rope tables [B,S,half].
+            rel_pos = packed_position_ids(segment_ids) + position_offset
+            from megatronapp_tpu.ops import rotary as _rot
+            rope_dim = (cfg.qk_pos_emb_head_dim
+                        if cfg.multi_latent_attention else cfg.head_dim)
+            inv_freq = _rot.rope_frequencies(rope_dim, cfg.rotary_base,
+                                             cfg.rotary_percent)
+            cos, sin = _rot.rope_cos_sin(rel_pos, inv_freq)
     h, aux = block_forward(p["block"], h, cfg, cos, sin, attention_mask,
                            ctx=ctx)
     return gpt_head(p, h, cfg), aux
@@ -128,10 +177,11 @@ def gpt_forward(p, tokens: jnp.ndarray, cfg: TransformerConfig,
 
 def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
              loss_mask: Optional[jnp.ndarray], cfg: TransformerConfig,
-             ctx=None):
+             ctx=None, segment_ids: Optional[jnp.ndarray] = None):
     """Training loss (CE + MoE aux). Mirrors pretrain_gpt.py loss_func
     (/root/reference/pretrain_gpt.py:159)."""
-    logits, aux = gpt_forward(p, tokens, cfg, ctx=ctx)
+    logits, aux = gpt_forward(p, tokens, cfg, ctx=ctx,
+                              segment_ids=segment_ids)
     loss, _ = cross_entropy_loss(logits, targets, loss_mask)
     return loss + aux, {"lm_loss": loss, "moe_aux_loss": aux}
 

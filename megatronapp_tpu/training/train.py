@@ -54,7 +54,8 @@ def reshape_global_batch(batch: Dict[str, np.ndarray], num_micro: int
 def gpt_microbatch_loss(cfg: TransformerConfig, ctx=None):
     def loss_fn(params, micro):
         loss, metrics = gpt_loss(params, micro["tokens"], micro["labels"],
-                                 micro["loss_mask"], cfg, ctx=ctx)
+                                 micro["loss_mask"], cfg, ctx=ctx,
+                                 segment_ids=micro.get("segment_ids"))
         return loss, metrics
     return loss_fn
 
@@ -125,6 +126,11 @@ def pretrain_gpt(
 
     if ctx.pp > 1:
         def loss_fn(params, batch_mb):
+            if "segment_ids" in batch_mb:
+                raise NotImplementedError(
+                    "packed sequences (segment_ids) are not supported in "
+                    "the pipelined path yet; run with "
+                    "pipeline_parallel=1")
             return gpt_pipeline_loss(
                 params, batch_mb["tokens"], batch_mb["labels"],
                 batch_mb["loss_mask"], model_cfg, ctx, vpp=vpp,
