@@ -4,17 +4,96 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Metric of record (BASELINE.md): tokens/sec/chip; vs_baseline is MFU relative
 to the 40% MFU north-star target (reference publishes no absolute numbers —
 BASELINE.json published: {}).
+
+Robustness (round-1 postmortem): the tunneled axon TPU backend can hang
+indefinitely (even tiny matmuls never return), which round 1 turned into a
+whole-round rc=1 with no perf artifact.  The benchmark therefore runs in a
+watchdog structure:
+
+  parent (no jax import)  --spawns-->  probe child (tiny matmul, hard timeout)
+                          --spawns-->  bench child (the real measurement)
+
+Each child is retried with backoff on timeout/crash; if everything fails the
+parent still exits 0 with a diagnostic JSON line so the driver records
+*something* actionable instead of a traceback.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+PROBE_TIMEOUT_S = 90
+BENCH_TIMEOUT_S = 420
+ATTEMPTS = 3
+BACKOFF_S = (20, 60)
 
 
-def main():
+def _run_child(mode: str, timeout_s: int):
+    """Run this file in a subprocess; return parsed JSON from its last
+    stdout line, or an error dict."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), mode],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"{mode} timed out after {timeout_s}s "
+                                      "(tunnel hang)"}
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if proc.returncode != 0 or not lines:
+        tail = (proc.stderr or "").strip().splitlines()[-8:]
+        return {"ok": False,
+                "error": f"{mode} rc={proc.returncode}: " + " | ".join(tail)}
+    try:
+        return json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return {"ok": False, "error": f"{mode} emitted non-JSON: {lines[-1][:200]}"}
+
+
+def parent_main():
+    history = []
+    for attempt in range(ATTEMPTS):
+        if attempt:
+            time.sleep(BACKOFF_S[min(attempt - 1, len(BACKOFF_S) - 1)])
+        probe = _run_child("--probe", PROBE_TIMEOUT_S)
+        if not probe.get("ok"):
+            history.append(f"attempt {attempt+1} probe: {probe.get('error')}")
+            continue
+        res = _run_child("--bench", BENCH_TIMEOUT_S)
+        if res.get("metric"):
+            res.setdefault("extra", {})["probe_s"] = probe.get("elapsed")
+            print(json.dumps(res))
+            return
+        history.append(f"attempt {attempt+1} bench: {res.get('error')}")
+    # All attempts failed: emit a diagnostic record in the standard schema.
+    print(json.dumps({
+        "metric": "gpt2_125m_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "extra": {"error": "benchmark could not run", "history": history},
+    }))
+
+
+def probe_main():
+    """Tiny device op to verify the backend is alive."""
+    t0 = time.time()
+    import jax
+    import jax.numpy as jnp
+    d = jax.devices()[0]
+    x = jnp.ones((128, 128))
+    s = float(jax.device_get(jnp.dot(x, x)).sum())
+    assert s == 128.0 * 128 * 128
+    print(json.dumps({"ok": True, "device": str(d),
+                      "elapsed": round(time.time() - t0, 1)}))
+
+
+def bench_main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from megatronapp_tpu.config.parallel_config import ParallelConfig
     from megatronapp_tpu.config.training_config import OptimizerConfig
     from megatronapp_tpu.config.transformer_config import TransformerConfig
@@ -95,4 +174,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--probe" in sys.argv:
+        probe_main()
+    elif "--bench" in sys.argv:
+        bench_main()
+    else:
+        parent_main()

@@ -60,6 +60,10 @@ class TransformerConfig:
     ffn_hidden_size: Optional[int] = None
     kv_channels: Optional[int] = None
     vocab_size: int = 50304
+    # Tokenizer's true vocab when vocab_size is padded to a TP-friendly
+    # multiple (reference --make-vocab-size-divisible-by): inference masks
+    # logits for padded ids so sampling cannot emit out-of-vocab tokens.
+    true_vocab_size: Optional[int] = None
     max_position_embeddings: int = 2048
 
     # Normalization / activation / position embedding.
@@ -126,6 +130,10 @@ class TransformerConfig:
     # transformer_config.py:458-462): 'p2p' ring / 'a2a' Ulysses /
     # 'allgather'.
     cp_comm_type: str = "p2p"
+    # Causal 'p2p' ring uses the load-balanced zigzag layout (rank i holds
+    # chunks i and 2cp-1-i — the reference's TE ring behavior). Disable to
+    # force the contiguous-layout ring (debug/oracle comparisons).
+    cp_zigzag: bool = True
 
     # Kernel implementation selection (spec_utils.py ModuleSpec analogue):
     # 'reference' = pure jnp; 'pallas' = fused Pallas flash attention;

@@ -40,6 +40,21 @@ class SamplingParams:
     seed: int = 0
 
 
+def mask_padded_vocab(logits: jnp.ndarray, cfg: TransformerConfig
+                      ) -> jnp.ndarray:
+    """Mask logits for vocab rows beyond the tokenizer's true vocab to -inf.
+
+    Converted checkpoints pad the embedding to a TP-friendly vocab size with
+    zero rows; with tied embeddings those ids get logit exactly 0 — often
+    above the mean of real logits — and would otherwise be sampleable
+    (advisor finding r1)."""
+    true_v = cfg.true_vocab_size
+    if true_v is None or true_v >= logits.shape[-1]:
+        return logits
+    ids = jnp.arange(logits.shape[-1])
+    return jnp.where(ids < true_v, logits, -1e30)
+
+
 def sample_logits(logits: jnp.ndarray, rng, params: SamplingParams):
     """logits [B,V] → token ids [B] (generation.py sampling parity)."""
     if params.greedy:
@@ -135,7 +150,7 @@ class StaticInferenceEngine:
 
         logits, cache = self._prefill(self.params, prompt_tokens, cache, 0)
         # MegaScope per-token logits hook (tik_result parity).
-        logits_last = logits[:, -1]
+        logits_last = mask_padded_vocab(logits[:, -1], self.cfg)
         out = [prompt_tokens]
         finished = np.zeros((b,), bool)
         pos = s_prompt
@@ -156,7 +171,7 @@ class StaticInferenceEngine:
                 break
             logits, cache = self._decode(self.params, next_tok[:, None],
                                          cache, pos)
-            logits_last = logits[:, -1]
+            logits_last = mask_padded_vocab(logits[:, -1], self.cfg)
             pos += 1
         return np.asarray(jax.device_get(jnp.concatenate(out, axis=1)))
 
@@ -197,7 +212,8 @@ def beam_search(engine: StaticInferenceEngine, prompt_tokens: np.ndarray,
     beams = jnp.tile(prompt, (beam_width, 1))
     cache = init_kv_cache(cfg, beam_width, engine.max_seq_len)
     logits, cache = engine._prefill(engine.params, beams, cache, 0)
-    logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+    logp = jax.nn.log_softmax(
+        mask_padded_vocab(logits[:, -1], cfg).astype(jnp.float32), axis=-1)
 
     # First step: take top beam_width continuations of the single prompt.
     top_logp, top_idx = jax.lax.top_k(logp[0], beam_width)
@@ -214,7 +230,8 @@ def beam_search(engine: StaticInferenceEngine, prompt_tokens: np.ndarray,
         logits, cache = engine._decode(engine.params, tok, cache, pos)
         pos += 1
         logp = np.asarray(jax.nn.log_softmax(
-            logits[:, -1].astype(jnp.float32), axis=-1))
+            mask_padded_vocab(logits[:, -1], cfg).astype(jnp.float32),
+            axis=-1))
         vocab = logp.shape[-1]
         cand = scores[:, None] + np.where(finished[:, None], -1e9, logp)
         if eod_id is not None:

@@ -78,38 +78,59 @@ def init_gpt_params(rng, cfg: TransformerConfig, pp: int = 1, vpp: int = 1):
 
 
 def gpt_embed(p, tokens: jnp.ndarray, cfg: TransformerConfig,
-              position_offset: int = 0, dtype=None) -> jnp.ndarray:
+              position_offset: int = 0, dtype=None,
+              position_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """tokens [B,S] → embeddings [B,S,H] (vocab axis tp-sharded: XLA handles
-    the sharded gather; reference VocabParallelEmbedding layers.py:172)."""
+    the sharded gather; reference VocabParallelEmbedding layers.py:172).
+
+    position_ids: optional explicit positions ([B,S] or [1,S]) — packed
+    sequences reset positions per segment for learned-absolute embeddings
+    too (reference resets the position_ids fed to the embedding)."""
     h = jnp.take(p["embedding"]["word"], tokens, axis=0)
     if "pos" in p["embedding"]:
-        s = tokens.shape[1]
-        pos = jnp.arange(s) + position_offset
+        if position_ids is None:
+            position_ids = jnp.arange(tokens.shape[1])[None, :]
+        pos = position_ids + position_offset
         h = h + jnp.take(p["embedding"]["pos"], pos, axis=0)
     return h.astype(dtype or cfg.compute_dtype)
 
 
-def gpt_rope_tables(cfg: TransformerConfig, seq_len: int,
-                    position_offset: int = 0):
+def rope_params(cfg: TransformerConfig):
+    """(inv_freq, mscale) for the configured rope variant, or (None, 1.0).
+
+    Single source of truth for the variant selection so the per-token
+    packed-sequence tables inherit YaRN's NTK-by-parts interpolation and
+    mscale exactly like the standard tables."""
     # MLA applies rope only on the decoupled position heads.
     rope_dim = (cfg.qk_pos_emb_head_dim if cfg.multi_latent_attention
                 else cfg.head_dim)
     if cfg.position_embedding == PositionEmbeddingKind.rope:
-        inv_freq = rotary.rope_frequencies(rope_dim, cfg.rotary_base,
-                                           cfg.rotary_percent)
-    elif cfg.position_embedding == PositionEmbeddingKind.yarn:
+        return rotary.rope_frequencies(rope_dim, cfg.rotary_base,
+                                       cfg.rotary_percent), 1.0
+    if cfg.position_embedding == PositionEmbeddingKind.yarn:
         inv_freq = rotary.yarn_frequencies(
             rope_dim, cfg.rotary_base,
             scaling_factor=cfg.rope_scaling_factor,
             original_max_position=cfg.yarn_original_max_position,
             beta_fast=cfg.yarn_beta_fast, beta_slow=cfg.yarn_beta_slow,
             rotary_percent=cfg.rotary_percent)
-    else:
-        return None, None
-    positions = jnp.arange(seq_len) + position_offset
-    cos, sin = rotary.rope_cos_sin(positions, inv_freq)
-    if cfg.position_embedding == PositionEmbeddingKind.yarn:
         m = rotary.yarn_mscale(cfg.rope_scaling_factor, cfg.yarn_mscale_coeff)
+        return inv_freq, m
+    return None, 1.0
+
+
+def gpt_rope_tables(cfg: TransformerConfig, seq_len: int,
+                    position_offset: int = 0,
+                    positions: Optional[jnp.ndarray] = None):
+    """Rope cos/sin tables for arange positions, or explicit per-token
+    `positions` (packed sequences)."""
+    inv_freq, m = rope_params(cfg)
+    if inv_freq is None:
+        return None, None
+    if positions is None:
+        positions = jnp.arange(seq_len)
+    cos, sin = rotary.rope_cos_sin(positions + position_offset, inv_freq)
+    if m != 1.0:
         cos, sin = cos * m, sin * m
     return cos, sin
 
@@ -144,35 +165,53 @@ def packed_position_ids(segment_ids: jnp.ndarray) -> jnp.ndarray:
 def gpt_forward(p, tokens: jnp.ndarray, cfg: TransformerConfig,
                 attention_mask: Optional[jnp.ndarray] = None,
                 position_offset: int = 0, ctx=None,
-                segment_ids: Optional[jnp.ndarray] = None):
+                segment_ids: Optional[jnp.ndarray] = None,
+                zigzag_keep: bool = False):
     """tokens [B,S] → (logits [B,S,V] fp32, moe_aux_loss).
 
     segment_ids: optional [B,S] packing map — attention is restricted to
-    within-segment (packed sequences)."""
+    within-segment (packed sequences).
+
+    Under causal ring context parallelism the sequence is transparently
+    permuted into the load-balanced zigzag layout (ops/context_parallel.py
+    zigzag_indices) and logits are unpermuted on return; `zigzag_keep=True`
+    skips the unpermute (gpt_loss permutes the targets instead — cheaper
+    than moving [B,S,V] logits across cp shards)."""
+    from megatronapp_tpu.ops.context_parallel import (
+        zigzag_active, zigzag_indices, zigzag_inverse_indices,
+    )
+
     b, s = tokens.shape
-    h = gpt_embed(p, tokens, cfg, position_offset)
-    cos, sin = gpt_rope_tables(cfg, s, position_offset)
+    packed_pos = None
     if segment_ids is not None:
         if ctx is not None and ctx.cp > 1:
             raise NotImplementedError(
                 "packed sequences (segment_ids) are not supported under "
                 "context parallelism yet")
+        # Positions restart per segment (reference --reset-position-ids) —
+        # for BOTH the learned-absolute embedding and rope tables.
+        packed_pos = packed_position_ids(segment_ids)
+    positions = packed_pos
+    zz = (zigzag_active(cfg, ctx) and segment_ids is None
+          and attention_mask is None)
+    if zz:
+        idx = jnp.asarray(zigzag_indices(s, ctx.cp))
+        tokens = jnp.take(tokens, idx, axis=1)
+        positions = idx[None, :]
+    h = gpt_embed(p, tokens, cfg, position_offset, position_ids=positions)
+    cos, sin = gpt_rope_tables(cfg, s, position_offset,
+                               positions=(positions[0] if zz else positions))
+    if segment_ids is not None:
         seg_mask = packed_attention_mask(segment_ids)
         attention_mask = (seg_mask if attention_mask is None
                           else attention_mask & seg_mask)
-        if cos is not None:
-            # Positions restart per segment (reference
-            # --reset-position-ids): per-token rope tables [B,S,half].
-            rel_pos = packed_position_ids(segment_ids) + position_offset
-            from megatronapp_tpu.ops import rotary as _rot
-            rope_dim = (cfg.qk_pos_emb_head_dim
-                        if cfg.multi_latent_attention else cfg.head_dim)
-            inv_freq = _rot.rope_frequencies(rope_dim, cfg.rotary_base,
-                                             cfg.rotary_percent)
-            cos, sin = _rot.rope_cos_sin(rel_pos, inv_freq)
     h, aux = block_forward(p["block"], h, cfg, cos, sin, attention_mask,
-                           ctx=ctx)
-    return gpt_head(p, h, cfg), aux
+                           ctx=ctx, zigzag=zz)
+    logits = gpt_head(p, h, cfg)
+    if zz and not zigzag_keep:
+        logits = jnp.take(logits, jnp.asarray(zigzag_inverse_indices(
+            s, ctx.cp)), axis=1)
+    return logits, aux
 
 
 def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
@@ -180,8 +219,18 @@ def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
              ctx=None, segment_ids: Optional[jnp.ndarray] = None):
     """Training loss (CE + MoE aux). Mirrors pretrain_gpt.py loss_func
     (/root/reference/pretrain_gpt.py:159)."""
+    from megatronapp_tpu.ops.context_parallel import (
+        zigzag_active, zigzag_indices,
+    )
     logits, aux = gpt_forward(p, tokens, cfg, ctx=ctx,
-                              segment_ids=segment_ids)
+                              segment_ids=segment_ids, zigzag_keep=True)
+    if zigzag_active(cfg, ctx) and segment_ids is None:
+        # Logits are in zigzag order — permute targets/mask to match (the
+        # masked-mean CE is permutation-invariant).
+        idx = jnp.asarray(zigzag_indices(tokens.shape[1], ctx.cp))
+        targets = jnp.take(targets, idx, axis=1)
+        if loss_mask is not None:
+            loss_mask = jnp.take(loss_mask, idx, axis=1)
     loss, _ = cross_entropy_loss(logits, targets, loss_mask)
     return loss + aux, {"lm_loss": loss, "moe_aux_loss": aux}
 
@@ -209,12 +258,30 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
     """
     from megatronapp_tpu.parallel.pipeline import spmd_pipeline
 
+    from megatronapp_tpu.ops.context_parallel import (
+        zigzag_active, zigzag_indices,
+    )
+
     m, mb, s = tokens_mb.shape
+    positions = None
+    if zigzag_active(cfg, ctx):
+        # Zigzag cp layout (see gpt_forward): permute the sequence so each
+        # cp rank's contiguous block holds chunks (i, 2cp-1-i); rope tables
+        # follow the permuted positions, and the in-pipeline cp-rank slicing
+        # of cos/sin then picks each rank's zigzag positions. Targets are
+        # permuted identically below, so the loss is unchanged.
+        idx = jnp.asarray(zigzag_indices(s, ctx.cp))
+        tokens_mb = jnp.take(tokens_mb, idx, axis=2)
+        targets_mb = jnp.take(targets_mb, idx, axis=2)
+        loss_mask_mb = jnp.take(loss_mask_mb, idx, axis=2)
+        positions = idx
     # fp32 across the shard_map boundary (spmd_pipeline casts to the compute
     # dtype at microbatch injection — see pipeline.py body notes).
-    h = gpt_embed(p, tokens_mb.reshape(m * mb, s), cfg, dtype=jnp.float32)
+    h = gpt_embed(p, tokens_mb.reshape(m * mb, s), cfg, dtype=jnp.float32,
+                  position_ids=None if positions is None
+                  else positions[None, :])
     h = h.reshape(m, mb, s, -1)
-    cos, sin = gpt_rope_tables(cfg, s)
+    cos, sin = gpt_rope_tables(cfg, s, positions=positions)
 
     def stage_fn(chunk_params, x, layer_offset):
         cos_l, sin_l = cos, sin
@@ -230,7 +297,8 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
             cos_l = jax.lax.dynamic_slice_in_dim(cos, start, s_loc)
             sin_l = jax.lax.dynamic_slice_in_dim(sin, start, s_loc)
         return block_forward(chunk_params, x, cfg, cos_l, sin_l, None,
-                             layer_offset=layer_offset, ctx=ctx)
+                             layer_offset=layer_offset, ctx=ctx,
+                             zigzag=positions is not None)
 
     out_mb, aux = spmd_pipeline(
         stage_fn, p["block"], h, ctx, num_microbatches=m, vpp=vpp,

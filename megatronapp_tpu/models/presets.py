@@ -14,7 +14,8 @@ from megatronapp_tpu.config.transformer_config import (
 
 def gpt2_125m(**kw) -> TransformerConfig:
     d = dict(num_layers=12, hidden_size=768, num_attention_heads=12,
-             vocab_size=50304, max_position_embeddings=1024,
+             vocab_size=50304, true_vocab_size=50257,
+             max_position_embeddings=1024,
              position_embedding=PositionEmbeddingKind.learned_absolute,
              add_qkv_bias=True)
     d.update(kw)

@@ -18,11 +18,17 @@ ppermute — each hop rides a single ICI neighbor link. Causal masking skips
 future blocks entirely (their contribution is zero), matching the reference
 ring's P2P schedule.
 
-TODO(perf): causal ring currently uses contiguous sequence sharding, so rank
-i does i+1 unmasked blocks while the scan runs cp lock-step rounds — the last
-rank sets wall-clock (~2x balanced cost). The reference balances this with
-the zigzag chunk assignment (rank i holds chunks i and 2cp-1-i); adopt that
-layout here in a perf pass.
+Causal ring comes in two layouts:
+- contiguous (`ring_attention`): rank i holds sequence chunk i. Every
+  lock-step round computes the full local score block (masked-out blocks
+  still burn MXU time), so per-rank cost is the full S²/cp — no causal
+  savings.
+- zigzag (`zigzag_ring_attention`): rank i holds chunks (i, 2cp-1-i) of a
+  2cp-way split (the reference's TE ring layout). Each non-diagonal round
+  computes exactly half the score block — the visible half is known from
+  (rank, src) alone — so per-rank cost is ~S²/(2cp), balanced across ranks.
+  Callers permute the sequence into zigzag order first (`zigzag_indices`);
+  models do this transparently (models/gpt.py).
 """
 
 from __future__ import annotations
@@ -119,6 +125,140 @@ def ring_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Sq,H,D]
 
 
+def zigzag_indices(seq_len: int, cp: int):
+    """Permutation taking a contiguous sequence to zigzag layout.
+
+    The sequence splits into 2cp chunks; rank i's contiguous S/cp shard of
+    the PERMUTED sequence holds original chunks (i, 2cp-1-i) — the
+    reference's causal-balanced ring layout (TE cp_comm_type='p2p').
+    Returns an int32 index array `idx` with permuted[j] = original[idx[j]];
+    `idx` doubles as the per-token original positions of the permuted
+    sequence (for rope tables).
+    """
+    import numpy as np
+    if seq_len % (2 * cp):
+        raise ValueError(
+            f"zigzag context parallelism needs seq_len divisible by "
+            f"2*cp={2*cp} (got {seq_len})")
+    c = seq_len // (2 * cp)
+    order = []
+    for i in range(cp):
+        order += [i, 2 * cp - 1 - i]
+    return np.concatenate(
+        [np.arange(ch * c, (ch + 1) * c, dtype=np.int32) for ch in order])
+
+
+def zigzag_inverse_indices(seq_len: int, cp: int):
+    """Inverse permutation: unpermuted[i] = permuted[inv[i]]."""
+    import numpy as np
+    idx = zigzag_indices(seq_len, cp)
+    inv = np.empty_like(idx)
+    inv[idx] = np.arange(seq_len, dtype=np.int32)
+    return inv
+
+
+def zigzag_ring_attention(q, k, v, axis_name: str = CP_AXIS,
+                          causal: bool = True,
+                          softmax_scale: Optional[float] = None):
+    """Causal-balanced ring attention over zigzag-laid-out sequences.
+
+    q,k,v: local [B, S/cp, H, D] where the local block is [chunk_my ;
+    chunk_{2cp-1-my}] of a 2cp-way split. For each rotated KV block from
+    rank `src`, the visible region is known statically from (my, src):
+
+      src == my : diagonal round — full block with position mask.
+      src <  my : only kv chunk `src` (first half) is visible; all q rows
+                  attend it fully (both q chunks sit later in time).
+      src >  my : only q chunk `2cp-1-my` (second half) attends; it sees
+                  both kv chunks fully.
+
+    The two off-diagonal cases each compute a half-size score block of
+    EQUAL flop count, selected with lax.cond — every rank does the same
+    work every round (~S²/(2cp) total vs the contiguous ring's S²/cp).
+    Reference: TE ring P2P zigzag (transformer_config.py:458-462 cp_comm_
+    type='p2p'); layout produced by get_batch_on_this_cp_rank-style
+    permutation (training/utils.py).
+    """
+    if not causal:
+        # Bidirectional attention has no imbalance; plain ring is optimal.
+        return ring_attention(q, k, v, axis_name, causal=False,
+                              softmax_scale=softmax_scale)
+    cp = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    c = sq // 2  # one global chunk
+    if softmax_scale is None:
+        softmax_scale = 1.0 / (d ** 0.5)
+
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def positions(rank):
+        # Global positions of a rank's local rows [chunk_rank; mirror].
+        r = jnp.arange(c)
+        return jnp.concatenate([rank * c + r, (2 * cp - 1 - rank) * c + r])
+
+    def softmax_update(o, m, l, s, v_rep, rows):
+        """Online-softmax update of rows [rows] with scores s
+        [B,H,nrows,Skv] and values v_rep [B,Skv,H,D]."""
+        o_r = jax.lax.dynamic_slice_in_dim(o, rows[0], rows[1], axis=2)
+        m_r = jax.lax.dynamic_slice_in_dim(m, rows[0], rows[1], axis=2)
+        l_r = jax.lax.dynamic_slice_in_dim(l, rows[0], rows[1], axis=2)
+        m_new = jnp.maximum(m_r, jnp.max(s, axis=-1))
+        m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+        p = jnp.exp(s - m_safe[..., None])
+        corr = jnp.exp(jnp.minimum(m_r - m_new, 0.0))
+        corr = jnp.where(m_r <= _NEG_INF / 2, 0.0, corr)
+        l_r = l_r * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v_rep.dtype), v_rep,
+                        preferred_element_type=jnp.float32)
+        o_r = o_r * corr[..., None] + pv
+        return (jax.lax.dynamic_update_slice_in_dim(o, o_r, rows[0], axis=2),
+                jax.lax.dynamic_update_slice_in_dim(m, m_new, rows[0], axis=2),
+                jax.lax.dynamic_update_slice_in_dim(l, l_r, rows[0], axis=2))
+
+    # Diagonal round (src == my): full local block with the zigzag position
+    # mask (half the scores are masked; only paid once).
+    q_pos = positions(my)
+    s0 = _block_scores(q, repeat_kv(k, h), softmax_scale)
+    mask0 = q_pos[:, None] >= q_pos[None, :]
+    s0 = jnp.where(mask0[None, None], s0, _NEG_INF)
+    p0 = jnp.exp(s0 - jnp.maximum(jnp.max(s0, -1), _NEG_INF / 2)[..., None])
+    p0 = jnp.where(mask0[None, None], p0, 0.0)
+    m = jnp.max(s0, -1)
+    l = jnp.sum(p0, -1)
+    o = jnp.einsum("bhqk,bkhd->bhqd", p0.astype(v.dtype), repeat_kv(v, h),
+                   preferred_element_type=jnp.float32)
+
+    def body(carry, step):
+        o, m, l, k_blk, v_blk = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src = (my - step) % cp
+
+        def lower(o, m, l):
+            # src < my: kv chunk `src` (first half) fully visible to all q.
+            k_lo = repeat_kv(k_blk[:, :c], h)
+            v_lo = repeat_kv(v_blk[:, :c], h)
+            s = _block_scores(q, k_lo, softmax_scale)  # [B,H,2c,c]
+            return softmax_update(o, m, l, s, v_lo, (0, sq))
+
+        def upper(o, m, l):
+            # src > my: q chunk `2cp-1-my` (second half) sees both kv
+            # chunks fully.
+            k_all = repeat_kv(k_blk, h)
+            v_all = repeat_kv(v_blk, h)
+            s = _block_scores(q[:, c:], k_all, softmax_scale)  # [B,H,c,2c]
+            return softmax_update(o, m, l, s, v_all, (c, c))
+
+        o, m, l = jax.lax.cond(src < my, lower, upper, o, m, l)
+        return (o, m, l, k_blk, v_blk), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(body, (o, m, l, k, v),
+                                      jnp.arange(1, cp))
+    out = o / jnp.maximum(l, 1e-20)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
 def ulysses_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
                       softmax_scale: Optional[float] = None):
     """Ulysses-style all-to-all head-parallel attention (inside shard_map).
@@ -175,12 +315,27 @@ def allgather_attention(q, k, v, axis_name: str = CP_AXIS,
 
 _CP_IMPLS = {
     "p2p": ring_attention,
+    "p2p_zigzag": zigzag_ring_attention,
     "a2a": ulysses_attention,
     "allgather": allgather_attention,
 }
-# Authoritative set of valid cp_comm_type values (TransformerConfig
-# validation derives from this).
-CP_COMM_TYPES = frozenset(_CP_IMPLS)
+# Authoritative set of valid cp_comm_type CONFIG values (reference names;
+# 'p2p' auto-upgrades to the zigzag impl for causal attention when
+# TransformerConfig.cp_zigzag — the internal 'p2p_zigzag' key is not a
+# user-facing config value).
+CP_COMM_TYPES = frozenset({"p2p", "a2a", "allgather"})
+
+
+def zigzag_active(cfg, ctx) -> bool:
+    """True when the config+mesh allow the zigzag ring. Models that permute
+    their sequences use this to decide; the kernel dispatch
+    (transformer/attention.py) additionally requires the caller-provided
+    `zigzag` layout flag, so models that DON'T permute (t5, mamba hybrid)
+    safely keep the contiguous ring."""
+    from megatronapp_tpu.config.transformer_config import AttnMaskType
+    return (ctx is not None and ctx.cp > 1 and cfg.cp_comm_type == "p2p"
+            and cfg.cp_zigzag
+            and cfg.attn_mask_type == AttnMaskType.causal)
 
 
 def context_attention(q, k, v, mesh, cp_comm_type: str = "p2p",

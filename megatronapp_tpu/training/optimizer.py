@@ -43,10 +43,27 @@ def lr_schedule(cfg: OptimizerConfig, train_iters: int) -> optax.Schedule:
     return sched
 
 
+# Leaf-name suffixes/names exempt from weight decay: biases, norm scales,
+# and Mamba's per-channel state params.  Matching by NAME, not ndim: block
+# params are stacked with leading layers/stage axes (init_block_params), so
+# semantically-1-D leaves (ln scales, biases) can have ndim > 1.
+_NO_DECAY_SUFFIXES = ("_bias", "_scale")
+_NO_DECAY_NAMES = frozenset({"A_log", "D"})
+
+
 def _weight_decay_mask(params):
-    """No decay for 1-D params (biases, norm scales) — reference
-    get_param_groups (optimizer/__init__.py) no_weight_decay_cond default."""
-    return jax.tree.map(lambda p: p.ndim > 1, params)
+    """No decay for biases and norm params — reference get_param_groups
+    (optimizer/__init__.py) no_weight_decay_cond default."""
+    import jax.tree_util as jtu
+
+    def decay(path, p):
+        name = next((k.key for k in reversed(path)
+                     if isinstance(k, jtu.DictKey)), "")
+        if name.endswith(_NO_DECAY_SUFFIXES) or name in _NO_DECAY_NAMES:
+            return False
+        return p.ndim > 1
+
+    return jtu.tree_map_with_path(decay, params)
 
 
 def get_optimizer(cfg: OptimizerConfig, train_iters: int,

@@ -69,9 +69,13 @@ def attention_forward(
     rope_sin: Optional[jnp.ndarray] = None,
     attention_mask: Optional[jnp.ndarray] = None,
     kv_cache=None, cache_index=None,
-    layer_id=None, ctx=None,
+    layer_id=None, ctx=None, zigzag: bool = False,
 ) -> jnp.ndarray:
-    """x: [B, S, H] → [B, S, H]. Returns (out, new_kv_cache)."""
+    """x: [B, S, H] → [B, S, H]. Returns (out, new_kv_cache).
+
+    zigzag: the CALLER laid the sequence out in zigzag cp order (model-side
+    permutation, models/gpt.py) — required before the zigzag ring kernel may
+    be dispatched; models that don't permute keep the contiguous ring."""
     b, s, h = x.shape
     d = cfg.head_dim
     nq, nkv = cfg.num_attention_heads, cfg.num_query_groups
@@ -123,14 +127,18 @@ def attention_forward(
     # and intentionally has no effect on the math.
     if ctx is not None and ctx.cp > 1 and kv_cache is None:
         # Context-parallel attention over the cp axis (seq sharded).
-        from megatronapp_tpu.ops.context_parallel import context_attention
+        from megatronapp_tpu.ops.context_parallel import (
+            context_attention, zigzag_active,
+        )
         if attention_mask is not None:
             raise NotImplementedError(
                 "explicit attention_mask is not supported under context "
                 "parallelism yet (only causal/bidirectional); run with "
                 "context_parallel=1 or drop the mask")
+        comm = ("p2p_zigzag" if zigzag and zigzag_active(cfg, ctx)
+                else cfg.cp_comm_type)
         attn_out = context_attention(
-            q, k, v, ctx.mesh, cfg.cp_comm_type,
+            q, k, v, ctx.mesh, comm,
             causal=cfg.attn_mask_type == AttnMaskType.causal)
     else:
         from megatronapp_tpu.parallel.collectives import current_manual_axes

@@ -66,7 +66,8 @@ def init_layer_params(rng, cfg: TransformerConfig, force_dense: bool = False):
 
 def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                   rope_cos=None, rope_sin=None, attention_mask=None,
-                  layer_id=None, kv_cache=None, cache_index=None, ctx=None):
+                  layer_id=None, kv_cache=None, cache_index=None, ctx=None,
+                  zigzag: bool = False):
     """One transformer layer. x: [B,S,H] → ((out, new_cache), aux_losses)."""
     residual = x
     h = apply_norm(cfg.normalization, x, p["ln1_scale"], p.get("ln1_bias"),
@@ -84,7 +85,7 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
         attn_out, new_cache = attention_forward(
             p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
             kv_cache=kv_cache, cache_index=cache_index, layer_id=layer_id,
-            ctx=ctx)
+            ctx=ctx, zigzag=zigzag)
     x = residual + attn_out.astype(residual.dtype)
 
     residual = x
@@ -162,14 +163,14 @@ def init_block_params(rng, cfg: TransformerConfig, num_layers: int = None):
 
 def block_forward(stacked_p, x: jnp.ndarray, cfg: TransformerConfig,
                   rope_cos=None, rope_sin=None, attention_mask=None,
-                  layer_offset: int = 0, ctx=None):
+                  layer_offset: int = 0, ctx=None, zigzag: bool = False):
     """Run all stacked layers via lax.scan. Returns (x, moe_aux_sum)."""
     hetero = isinstance(stacked_p, dict) and "dense" in stacked_p
 
     def run_layer(layer_p, h, lid):
         (h2, _), aux = layer_forward(
             layer_p, h, cfg, rope_cos, rope_sin, attention_mask,
-            layer_id=lid, ctx=ctx)
+            layer_id=lid, ctx=ctx, zigzag=zigzag)
         return h2, (aux if aux is not None
                     else jnp.zeros((), jnp.float32))
 

@@ -70,9 +70,11 @@ def _router(p, x_flat: jnp.ndarray, cfg: TransformerConfig):
     aux = jnp.zeros((), jnp.float32)
     if cfg.moe_aux_loss_coeff:
         # Switch/GShard load-balancing loss (moe_utils.py switch_load_balancing
-        # _loss_func): E * sum(fraction_tokens_per_expert * mean_prob).
+        # _loss_func): sum(probs_pe * tokens_pe) * E * coeff / (T^2 * topk) —
+        # the 1/topk keeps the loss scale invariant in k (reference
+        # normalization; advisor finding r1).
         onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)  # [T,K,E]
-        frac = jnp.mean(jnp.sum(onehot, axis=1), axis=0)  # tokens per expert
+        frac = jnp.mean(jnp.sum(onehot, axis=1), axis=0) / cfg.moe_router_topk
         mean_prob = jnp.mean(probs, axis=0)
         aux = aux + cfg.moe_aux_loss_coeff * e * jnp.sum(frac * mean_prob)
     if cfg.moe_z_loss_coeff:
