@@ -1,0 +1,133 @@
+"""BERT pretraining dataset: masked-LM + next-sentence prediction samples.
+
+Parity with /root/reference/megatron/core/datasets/bert_dataset.py
+(BERTMaskedWordPieceDataset.__getitem__: sentence-span sample → A/B split
+with 50% random swap (NSP), center-out truncation to the target length,
+[CLS] A [SEP] B [SEP] assembly with tokentype assignments, masked-LM
+prediction, padding) — fresh implementation over our sentence-split
+IndexedDataset.
+
+Batch fields match models/bert.py bert_loss:
+  tokens, labels, loss_mask, padding_mask, tokentype_ids, is_random.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from megatronapp_tpu.data.indexed_dataset import IndexedDataset
+from megatronapp_tpu.data.masked_dataset import (
+    MaskingConfig, build_sentence_sample_mapping,
+    create_masked_lm_predictions, masked_batches,
+)
+
+
+@dataclasses.dataclass
+class BertTokenIds:
+    """Special token ids the dataset needs (reference reads them off the
+    BertWordPieceTokenizer: cls/sep/mask/pad)."""
+    cls: int
+    sep: int
+    mask: int
+    pad: int
+
+
+class BertDataset:
+    """Masked-LM + NSP samples from a sentence-split .bin/.idx corpus."""
+
+    def __init__(self, indexed: IndexedDataset, *, seq_length: int,
+                 vocab_size: int, token_ids: BertTokenIds,
+                 num_samples: int, seed: int = 1234,
+                 masked_lm_prob: float = 0.15, short_seq_prob: float = 0.1,
+                 max_ngram: int = 1, classification_head: bool = True,
+                 num_epochs: int = 100):
+        self.ds = indexed
+        self.seq_length = seq_length
+        self.vocab_size = vocab_size
+        self.ids = token_ids
+        self.seed = seed
+        self.classification_head = classification_head
+        self.masking = MaskingConfig(masked_lm_prob=masked_lm_prob,
+                                     max_ngram=max_ngram)
+        self.sample_index = build_sentence_sample_mapping(
+            indexed.document_indices, indexed.sequence_lengths,
+            num_epochs=num_epochs, max_num_samples=num_samples,
+            # -3 head-room for [CLS] and 2×[SEP] (reference passes
+            # sequence_length - 3 for the classification-head case).
+            max_seq_length=seq_length - 3, short_seq_prob=short_seq_prob,
+            seed=seed, min_num_sent=2 if classification_head else 1)
+        if len(self.sample_index) == 0:
+            raise ValueError(
+                "no BERT samples could be built — is the corpus "
+                "sentence-split (tools/preprocess_data.py "
+                "--split-sentences)?")
+
+    def __len__(self) -> int:
+        return len(self.sample_index)
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        first, end, target_len = self.sample_index[idx % len(self)]
+        rng = np.random.RandomState((self.seed + idx) % 2**32)
+        sents = [np.asarray(self.ds[i], np.int64)
+                 for i in range(first, end)]
+
+        # NSP: split sentences into contiguous segments A/B; 50% swapped.
+        pivot = len(sents)
+        is_random = 0
+        if self.classification_head:
+            pivot = 1 if len(sents) < 3 else rng.randint(1, len(sents))
+            is_random = int(rng.random_sample() < 0.5)
+        a = [t for s in sents[:pivot] for t in s]
+        b = [t for s in sents[pivot:] for t in s]
+        if is_random:
+            a, b = b, a
+
+        # Trim the pair from random ends to the target length (reference
+        # end-biased truncation).
+        while len(a) + len(b) > target_len:
+            longer = a if len(a) > len(b) else b
+            if rng.random_sample() < 0.5:
+                del longer[0]
+            else:
+                del longer[-1]
+
+        ids = self.ids
+        tokens = [ids.cls, *a, ids.sep]
+        types = [0] * len(tokens)
+        if b:
+            tokens += [*b, ids.sep]
+            types += [1] * (len(b) + 1)
+
+        masked, positions, mlm_labels = create_masked_lm_predictions(
+            tokens, self.vocab_size, ids.mask,
+            special_ids=(ids.cls, ids.sep, ids.pad), rng=rng,
+            cfg=self.masking)
+
+        s = self.seq_length
+        n = len(masked)
+        out_tokens = np.full((s,), ids.pad, np.int32)
+        out_tokens[:n] = masked
+        out_types = np.zeros((s,), np.int32)
+        out_types[:n] = types
+        padding_mask = np.zeros((s,), np.float32)
+        padding_mask[:n] = 1.0
+        # Unmasked positions carry label 0 (excluded via loss_mask); a -1
+        # sentinel would index out of bounds in take_along_axis CE.
+        labels = np.zeros((s,), np.int32)
+        labels[positions] = mlm_labels
+        loss_mask = np.zeros((s,), np.float32)
+        loss_mask[positions] = 1.0
+        return {
+            "tokens": out_tokens,
+            "labels": labels,
+            "loss_mask": loss_mask,
+            "padding_mask": padding_mask,
+            "tokentype_ids": out_types,
+            "is_random": np.int32(is_random),
+        }
+
+
+bert_batches = masked_batches

@@ -69,6 +69,13 @@ def _load_native() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_double), ctypes.c_int32, ctypes.c_int64,
         ]
+        lib.build_mapping.restype = ctypes.c_int64
+        lib.build_mapping.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_double, ctypes.c_uint64,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ]
         _LIB = lib
         return _LIB
 
@@ -140,6 +147,35 @@ def build_blending_indices(weights: np.ndarray, size: int
         ds_sample[i] = consumed[best]
         consumed[best] += 1
     return ds_idx, ds_sample
+
+
+def build_mapping_native(document_indices: np.ndarray,
+                         sentence_lengths: np.ndarray,
+                         num_epochs: int, max_num_samples: int,
+                         max_seq_length: int, short_seq_prob: float,
+                         seed: int, min_num_sent: int
+                         ) -> Optional[np.ndarray]:
+    """Native sentence-span sample mapping → int64 [N,3], or None when the
+    native library is unavailable (masked_dataset.py falls back to the
+    bit-identical numpy implementation — shared splitmix64 stream)."""
+    lib = _load_native()
+    if lib is None:
+        return None
+    docs = np.ascontiguousarray(document_indices, dtype=np.int64)
+    sizes = np.ascontiguousarray(sentence_lengths, dtype=np.int32)
+    n_docs = len(docs) - 1
+    args = (_ptr(docs, ctypes.c_int64), n_docs,
+            _ptr(sizes, ctypes.c_int32), num_epochs, max_num_samples,
+            max_seq_length, short_seq_prob, seed, min_num_sent)
+    count = lib.build_mapping(*args, None, 0)
+    if count < 0:
+        raise ValueError("build_mapping: invalid arguments")
+    out = np.zeros((count, 3), dtype=np.int64)
+    filled = lib.build_mapping(*args, _ptr(out, ctypes.c_int64), count)
+    if filled != count:
+        raise RuntimeError(
+            f"build_mapping pass disagreement: {count} vs {filled}")
+    return out
 
 
 def native_available() -> bool:

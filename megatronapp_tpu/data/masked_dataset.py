@@ -1,0 +1,210 @@
+"""Masked-workpiece sample construction over sentence-split corpora.
+
+The base layer for BERT and T5 pretraining data. Parity targets (fresh
+implementation, algorithm-level only):
+- /root/reference/megatron/core/datasets/masked_dataset.py
+  (MaskedWordPieceDataset: sentence-span sample index + masked-LM
+  prediction construction with n-gram spans and 80/10/10 replacement)
+- /root/reference/megatron/core/datasets/helpers.cpp:266 build_mapping
+  (the two-pass sentence-span index builder; native variant in
+  data/native/helpers.cpp, numpy fallback here).
+
+A "sentence-split" corpus is an IndexedDataset written with one SEQUENCE
+per sentence and document boundaries marking sentence runs
+(tools/preprocess_data.py --split-sentences).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def masked_batches(dataset, batch_size: int, start_idx: int = 0
+                   ) -> Iterator[Dict[str, np.ndarray]]:
+    """Global-batch iterator over an indexable sample dataset (wraps
+    around; resume via start_idx = consumed samples — the reference
+    consumed_train_samples bookkeeping). Shared by the BERT and T5
+    datasets."""
+    i = start_idx
+    while True:
+        samples = [dataset[j % len(dataset)]
+                   for j in range(i, i + batch_size)]
+        i += batch_size
+        yield {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+
+
+def build_sentence_sample_mapping(
+    document_indices: np.ndarray,
+    sentence_lengths: np.ndarray,
+    num_epochs: int,
+    max_num_samples: int,
+    max_seq_length: int,
+    short_seq_prob: float,
+    seed: int,
+    min_num_sent: int = 2,
+) -> np.ndarray:
+    """Map of (first_sentence, end_sentence, target_seq_length) triples.
+
+    Walks documents sentence by sentence, emitting a sample whenever the
+    accumulated token count reaches a target length (occasionally shortened
+    with probability short_seq_prob), then shuffles the map — the semantics
+    of the reference build_mapping (helpers.cpp:266-524). Documents with
+    fewer than min_num_sent sentences or any sentence longer than 512
+    tokens are skipped (reference LONG_SENTENCE_LEN).
+
+    Returns int64 [N, 3].
+    """
+    from megatronapp_tpu.data.helpers import build_mapping_native
+
+    native = build_mapping_native(
+        document_indices, sentence_lengths, num_epochs, max_num_samples,
+        max_seq_length, short_seq_prob, seed, min_num_sent)
+    if native is not None:
+        return native
+    return _build_mapping_np(
+        document_indices, sentence_lengths, num_epochs, max_num_samples,
+        max_seq_length, short_seq_prob, seed, min_num_sent)
+
+
+_LONG_SENTENCE_LEN = 512
+_U64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _splitmix64(state: int) -> Tuple[int, int]:
+    """(new_state, value) — bit-identical to the C++ splitmix64 in
+    data/native/helpers.cpp, so the numpy fallback and the native builder
+    produce the SAME sample map for the same seed."""
+    state = (state + 0x9E3779B97F4A7C15) & _U64
+    z = state
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _U64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _U64
+    return state, z ^ (z >> 31)
+
+
+def _build_mapping_np(docs, sizes, num_epochs, max_num_samples,
+                      max_seq_length, short_seq_prob, seed, min_num_sent):
+    state = int(seed) & _U64
+
+    def target_len(state):
+        state, r = _splitmix64(state)
+        if short_seq_prob > 0 and \
+                (r >> 11) * (1.0 / 9007199254740992.0) < short_seq_prob:
+            state, r2 = _splitmix64(state)
+            return state, 2 + int(r2 % (max_seq_length - 1))
+        return state, max_seq_length
+
+    triples: List[Tuple[int, int, int]] = []
+    for _epoch in range(num_epochs):
+        if max_num_samples > 0 and len(triples) >= max_num_samples:
+            break
+        for doc in range(len(docs) - 1):
+            first, last = int(docs[doc]), int(docs[doc + 1])
+            if last - first < min_num_sent:
+                continue
+            if np.any(sizes[first:last] > _LONG_SENTENCE_LEN):
+                continue
+            start = first
+            seq_len = 0
+            num_sent = 0
+            state, tgt = target_len(state)
+            for s in range(first, last):
+                seq_len += int(sizes[s])
+                num_sent += 1
+                remain = last - s - 1
+                if (seq_len >= tgt and remain > 1 and
+                        num_sent >= min_num_sent) or remain == 0:
+                    triples.append((start, s + 1, tgt))
+                    start = s + 1
+                    seq_len = 0
+                    num_sent = 0
+                    state, tgt = target_len(state)
+    if max_num_samples > 0:
+        triples = triples[:max_num_samples]
+    out = np.asarray(triples, dtype=np.int64).reshape(-1, 3)
+    # Fisher-Yates with the shared RNG (seed + 1 stream) — matches C++.
+    sstate = (int(seed) + 1) & _U64
+    for i in range(len(out) - 1, 0, -1):
+        sstate, r = _splitmix64(sstate)
+        j = int(r % (i + 1))
+        out[[i, j]] = out[[j, i]]
+    return out
+
+
+@dataclasses.dataclass
+class MaskingConfig:
+    """Masked-LM replacement policy (reference masked_dataset.py fields)."""
+    masked_lm_prob: float = 0.15
+    max_ngram: int = 1              # SpanBERT-style n-gram masking when > 1
+    mask_token_prob: float = 0.8    # replace with [MASK]
+    random_token_prob: float = 0.1  # replace with random token
+    # remaining probability: keep the original token
+
+
+def create_masked_lm_predictions(
+    tokens: Sequence[int],
+    vocab_size: int,
+    mask_id: int,
+    special_ids: Sequence[int],
+    rng: np.random.RandomState,
+    cfg: Optional[MaskingConfig] = None,
+    max_predictions: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(masked_tokens, masked_positions, masked_labels).
+
+    Selects ~masked_lm_prob of non-special positions (in shuffled n-gram
+    spans), replacing each with [MASK] (80%), a random token (10%), or the
+    original (10%) — reference _create_masked_lm_predictions
+    (masked_dataset.py:231).
+    """
+    cfg = cfg or MaskingConfig()
+    tokens = np.asarray(tokens, dtype=np.int64)
+    special = set(int(x) for x in special_ids)
+    candidates = [i for i, t in enumerate(tokens) if int(t) not in special]
+    n_pred = max(1, int(round(len(candidates) * cfg.masked_lm_prob)))
+    if max_predictions is not None:
+        n_pred = min(n_pred, max_predictions)
+
+    # Build candidate n-gram spans starting at shuffled positions; favor
+    # short spans (probability ∝ 1/n, the reference's ngram weighting).
+    order = list(candidates)
+    rng.shuffle(order)
+    if cfg.max_ngram > 1:
+        ngram_p = 1.0 / np.arange(1, cfg.max_ngram + 1)
+        ngram_p = ngram_p / ngram_p.sum()
+
+    covered = set()
+    positions: List[int] = []
+    for start in order:
+        if len(positions) >= n_pred:
+            break
+        if start in covered:
+            continue
+        n = 1
+        if cfg.max_ngram > 1:
+            n = 1 + rng.choice(cfg.max_ngram, p=ngram_p)
+        span = []
+        for i in range(start, min(start + n, len(tokens))):
+            if int(tokens[i]) in special or i in covered:
+                break
+            span.append(i)
+        if not span or len(positions) + len(span) > n_pred:
+            span = span[: n_pred - len(positions)]
+        for i in span:
+            covered.add(i)
+            positions.append(i)
+
+    positions.sort()
+    positions = np.asarray(positions, dtype=np.int64)
+    labels = tokens[positions].copy()
+    out = tokens.copy()
+    for pos in positions:
+        roll = rng.random_sample()
+        if roll < cfg.mask_token_prob:
+            out[pos] = mask_id
+        elif roll < cfg.mask_token_prob + cfg.random_token_prob:
+            out[pos] = rng.randint(0, vocab_size)
+        # else: keep original
+    return out, positions, labels

@@ -86,4 +86,116 @@ void build_blending_indices(int16_t* dataset_index,  // out [size]
     delete[] consumed;
 }
 
+// --------------------------------------------------------------------------
+// Sentence-span sample mapping (BERT/T5 masked datasets).
+//
+// Semantics of the reference build_mapping (helpers.cpp:266-561): walk
+// documents (runs of sentence-level sequences), accumulate sentences until
+// a target length (occasionally shortened with probability short_seq_prob)
+// is reached, emit (first_sentence, end_sentence, target_len) triples,
+// Fisher-Yates shuffle the map. Deterministic across the C++ and numpy
+// implementations via a shared splitmix64 RNG (not the reference's
+// std::mt19937 — bitwise parity with libstdc++ is not a goal; parity
+// between OUR two implementations is).
+
+static inline uint64_t splitmix64(uint64_t* state) {
+    uint64_t z = (*state += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+}
+
+static const int32_t kLongSentenceLen = 512;
+
+// Pass 1 (out == NULL): return the number of samples.
+// Pass 2 (out != NULL, capacity = value from pass 1): fill [N,3] int64
+// triples and shuffle. Returns the sample count, or -1 on bad args.
+int64_t build_mapping(const int64_t* docs,       // [n_docs + 1]
+                      int64_t n_docs,
+                      const int32_t* sizes,      // per-sentence token counts
+                      int32_t num_epochs,
+                      int64_t max_num_samples,
+                      int32_t max_seq_length,
+                      double short_seq_prob,
+                      uint64_t seed,
+                      int32_t min_num_sent,
+                      int64_t* out,              // NULL or [capacity * 3]
+                      int64_t capacity) {
+    if (num_epochs <= 0 || max_seq_length <= 1) return -1;
+    uint64_t rng = seed;
+    int64_t count = 0;
+    for (int32_t epoch = 0; epoch < num_epochs; ++epoch) {
+        if (max_num_samples > 0 && count >= max_num_samples) break;
+        for (int64_t doc = 0; doc < n_docs; ++doc) {
+            int64_t first = docs[doc];
+            int64_t last = docs[doc + 1];
+            if (last - first < min_num_sent) continue;
+            bool has_long = false;
+            for (int64_t s = first; s < last; ++s) {
+                if (sizes[s] > kLongSentenceLen) { has_long = true; break; }
+            }
+            if (has_long) continue;
+
+            int64_t start = first;
+            int64_t seq_len = 0;
+            int64_t num_sent = 0;
+            // Target-length draw: consumes one RNG value per draw in both
+            // implementations (keep in lock-step with helpers.py).
+            uint64_t r = splitmix64(&rng);
+            int64_t tgt = max_seq_length;
+            if (short_seq_prob > 0.0 &&
+                (double)(r >> 11) * (1.0 / 9007199254740992.0) <
+                    short_seq_prob) {
+                tgt = 2 + (int64_t)(splitmix64(&rng) %
+                                    (uint64_t)(max_seq_length - 1));
+            }
+            for (int64_t s = first; s < last; ++s) {
+                seq_len += sizes[s];
+                ++num_sent;
+                int64_t remain = last - s - 1;
+                if ((seq_len >= tgt && remain > 1 &&
+                     num_sent >= min_num_sent) || remain == 0) {
+                    // Writes past `capacity` are dropped (the final epoch
+                    // overshoots max_num_samples; pass 1's return is
+                    // already clamped) — but the RNG stream still advances
+                    // so both passes stay in lock-step.
+                    if (out != NULL && count < capacity) {
+                        out[count * 3] = start;
+                        out[count * 3 + 1] = s + 1;
+                        out[count * 3 + 2] = tgt;
+                    }
+                    ++count;
+                    start = s + 1;
+                    seq_len = 0;
+                    num_sent = 0;
+                    r = splitmix64(&rng);
+                    tgt = max_seq_length;
+                    if (short_seq_prob > 0.0 &&
+                        (double)(r >> 11) * (1.0 / 9007199254740992.0) <
+                            short_seq_prob) {
+                        tgt = 2 + (int64_t)(splitmix64(&rng) %
+                                            (uint64_t)(max_seq_length - 1));
+                    }
+                }
+            }
+        }
+    }
+    if (max_num_samples > 0 && count > max_num_samples)
+        count = max_num_samples;
+    if (out != NULL) {
+        if (count > capacity) count = capacity;
+        // Fisher-Yates with the shared RNG (seed + 1 stream).
+        uint64_t srng = seed + 1;
+        for (int64_t i = count - 1; i > 0; --i) {
+            int64_t j = (int64_t)(splitmix64(&srng) % (uint64_t)(i + 1));
+            for (int k = 0; k < 3; ++k) {
+                int64_t t = out[i * 3 + k];
+                out[i * 3 + k] = out[j * 3 + k];
+                out[j * 3 + k] = t;
+            }
+        }
+    }
+    return count;
+}
+
 }  // extern "C"

@@ -1,0 +1,142 @@
+"""T5 pretraining dataset: span corruption with sentinel tokens.
+
+Parity with /root/reference/megatron/core/datasets/t5_dataset.py
+(T5MaskedWordPieceDataset.__getitem__: sentence-span sample → n-gram span
+masking where each span collapses to one sentinel in the encoder stream and
+expands to sentinel+original tokens in the decoder stream; [BOS] decoder
+shift; padding) — fresh implementation over our sentence-split
+IndexedDataset.
+
+Batch fields match models/t5.py t5_loss (reference pretrain_t5.py names):
+  text_enc, text_dec, labels, loss_mask, enc_mask, dec_mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+from megatronapp_tpu.data.indexed_dataset import IndexedDataset
+from megatronapp_tpu.data.masked_dataset import (
+    MaskingConfig, build_sentence_sample_mapping,
+    create_masked_lm_predictions, masked_batches,
+)
+
+
+@dataclasses.dataclass
+class T5TokenIds:
+    """Special ids (reference reads bos/eos/pad/sentinel ids off the
+    tokenizer; sentinels are the trailing vocab ids in T5 convention)."""
+    bos: int
+    eos: int
+    pad: int
+    sentinels: List[int]            # e.g. <extra_id_0..99>
+
+
+class T5Dataset:
+    """Span-corruption encoder/decoder samples from a sentence-split
+    .bin/.idx corpus."""
+
+    def __init__(self, indexed: IndexedDataset, *, enc_seq_length: int,
+                 dec_seq_length: int, vocab_size: int, token_ids: T5TokenIds,
+                 num_samples: int, seed: int = 1234,
+                 masked_lm_prob: float = 0.15, short_seq_prob: float = 0.1,
+                 max_ngram: int = 3, num_epochs: int = 100):
+        self.ds = indexed
+        self.enc_len = enc_seq_length
+        self.dec_len = dec_seq_length
+        self.vocab_size = vocab_size
+        self.ids = token_ids
+        self.seed = seed
+        self.masking = MaskingConfig(masked_lm_prob=masked_lm_prob,
+                                     max_ngram=max_ngram,
+                                     # Spans always become sentinels —
+                                     # no random/keep replacement in T5.
+                                     mask_token_prob=1.0,
+                                     random_token_prob=0.0)
+        self.sample_index = build_sentence_sample_mapping(
+            indexed.document_indices, indexed.sequence_lengths,
+            num_epochs=num_epochs, max_num_samples=num_samples,
+            # Head-room for sentinel insertion + [EOS].
+            max_seq_length=enc_seq_length - 1,
+            short_seq_prob=short_seq_prob, seed=seed, min_num_sent=1)
+        if len(self.sample_index) == 0:
+            raise ValueError(
+                "no T5 samples could be built — is the corpus "
+                "sentence-split (tools/preprocess_data.py "
+                "--split-sentences)?")
+
+    def __len__(self) -> int:
+        return len(self.sample_index)
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        first, end, target_len = self.sample_index[idx % len(self)]
+        rng = np.random.RandomState((self.seed + idx) % 2**32)
+        tokens = [int(t) for i in range(first, end)
+                  for t in self.ds[i]][:target_len]
+
+        # Select span positions with the shared n-gram masker; a dedicated
+        # mask id marks selected positions, then contiguous runs collapse
+        # into sentinels.
+        marker = -1
+        masked, positions, labels_at = create_masked_lm_predictions(
+            tokens, self.vocab_size, marker, special_ids=(), rng=rng,
+            cfg=self.masking)
+        selected = set(int(p) for p in positions)
+        orig = np.asarray(tokens, np.int64)
+
+        enc: List[int] = []
+        dec: List[int] = [self.ids.bos]
+        tgt: List[int] = []
+        sentinel_i = 0
+        i = 0
+        n = len(tokens)
+        while i < n:
+            if i in selected:
+                sent = self.ids.sentinels[
+                    min(sentinel_i, len(self.ids.sentinels) - 1)]
+                sentinel_i += 1
+                enc.append(sent)
+                dec.append(sent)
+                tgt.append(sent)
+                while i < n and i in selected:
+                    dec.append(int(orig[i]))
+                    tgt.append(int(orig[i]))
+                    i += 1
+            else:
+                enc.append(int(orig[i]))
+                i += 1
+        tgt.append(self.ids.eos)
+        # The encoder stream terminates with EOS too (reference t5_dataset
+        # appends eos to the corrupted input) — the -1 head-room in the
+        # sample mapping reserves its slot.
+        enc.append(self.ids.eos)
+
+        enc = enc[: self.enc_len]
+        dec = dec[: self.dec_len]
+        tgt = tgt[: self.dec_len]
+
+        def pad_to(x, length, value):
+            out = np.full((length,), value, np.int32)
+            out[: len(x)] = x
+            return out
+
+        enc_mask = np.zeros((self.enc_len,), np.float32)
+        enc_mask[: len(enc)] = 1.0
+        dec_mask = np.zeros((self.dec_len,), np.float32)
+        dec_mask[: len(dec)] = 1.0
+        loss_mask = np.zeros((self.dec_len,), np.float32)
+        loss_mask[: len(tgt)] = 1.0
+        return {
+            "text_enc": pad_to(enc, self.enc_len, self.ids.pad),
+            "text_dec": pad_to(dec, self.dec_len, self.ids.pad),
+            "labels": pad_to(tgt, self.dec_len, self.ids.pad),
+            "loss_mask": loss_mask,
+            "enc_mask": enc_mask,
+            "dec_mask": dec_mask,
+        }
+
+
+t5_batches = masked_batches

@@ -48,6 +48,29 @@ class HuggingFaceTokenizer:
         return self._tok.decode(ids)
 
 
+class BertWordPieceTokenizer(HuggingFaceTokenizer):
+    """WordPiece tokenizer exposing the special ids the BERT dataset needs
+    (reference BertWordPieceTokenizer: cls/sep/mask/pad)."""
+
+    def __init__(self, name_or_path: str = "bert-base-uncased"):
+        super().__init__(name_or_path)
+        self.cls = self._tok.cls_token_id
+        self.sep = self._tok.sep_token_id
+        self.mask = self._tok.mask_token_id
+        self.pad = self._tok.pad_token_id
+        missing = [n for n in ("cls", "sep", "mask", "pad")
+                   if getattr(self, n) is None]
+        if missing:
+            raise ValueError(
+                f"tokenizer {name_or_path!r} lacks special tokens "
+                f"{missing} required for BERT pretraining — use a "
+                f"WordPiece tokenizer (e.g. bert-base-uncased)")
+
+    def tokenize(self, text: str) -> List[int]:
+        # Raw wordpieces without [CLS]/[SEP] — the dataset assembles those.
+        return self._tok.encode(text, add_special_tokens=False)
+
+
 class GPT2BPETokenizer(HuggingFaceTokenizer):
     """GPT-2 byte-level BPE (reference GPT2BPETokenizer; vocab/merges come
     from the HF hub or a local path)."""
@@ -72,6 +95,8 @@ def build_tokenizer(tokenizer_type: str, name_or_path: Optional[str] = None,
         return NullTokenizer(vocab_size)
     if tokenizer_type == "GPT2BPETokenizer":
         return GPT2BPETokenizer(name_or_path or "gpt2")
+    if tokenizer_type == "BertWordPieceTokenizer":
+        return BertWordPieceTokenizer(name_or_path or "bert-base-uncased")
     if tokenizer_type == "HuggingFaceTokenizer":
         assert name_or_path
         return HuggingFaceTokenizer(name_or_path)

@@ -87,7 +87,7 @@ def make_train_step(
 
     def step(state, batch):
         params = state["params"]
-        num_micro = batch["tokens"].shape[0]
+        num_micro = jax.tree.leaves(batch)[0].shape[0]
 
         if pipeline:
             (loss, aux), grads = grad_fn(params, batch)
@@ -179,6 +179,6 @@ def make_eval_step(loss_fn, ctx: MeshContext, state_shardings):
             loss, _ = loss_fn(state["params"], micro)
             return acc + loss, None
         total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), batch)
-        return total / batch["tokens"].shape[0]
+        return total / jax.tree.leaves(batch)[0].shape[0]
 
     return jax.jit(step, in_shardings=(state_shardings, b_sh))

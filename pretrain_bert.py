@@ -2,8 +2,10 @@
 
 Parity with /root/reference/pretrain_bert.py (masked-LM + NSP objectives).
 Uses the same argument system as pretrain_gpt.py; data comes from the
-synthetic masked-LM stream unless --data-path points at a tokenized corpus
-(documents are masked on the fly).
+synthetic masked-LM stream unless --data-path points at a sentence-split
+tokenized corpus (tools/preprocess_data.py --split-sentences), in which
+case samples are built by data/bert_dataset.py (sentence-span index via
+the native build_mapping, on-the-fly 80/10/10 masking, NSP pairs).
 """
 
 import time
@@ -25,6 +27,8 @@ from megatronapp_tpu.training.train import reshape_global_batch
 def main(argv=None):
     ap = build_parser("pretrain_bert (megatronapp-tpu)")
     ap.add_argument("--mask-prob", type=float, default=0.15)
+    ap.add_argument("--short-seq-prob", type=float, default=0.1)
+    ap.add_argument("--bert-no-binary-head", action="store_true")
     args = ap.parse_args(argv)
     gpt_cfg, parallel, training, opt_cfg = configs_from_args(args)
     # Re-flavor the architecture config for BERT (bidirectional, learned
@@ -40,7 +44,9 @@ def main(argv=None):
     optimizer = get_optimizer(opt_cfg, training.train_iters)
     state, shardings, _ = setup_train_state(
         jax.random.PRNGKey(training.seed),
-        lambda k: init_bert_params(k, cfg), optimizer, ctx)
+        lambda k: init_bert_params(
+            k, cfg, add_binary_head=not args.bert_no_binary_head),
+        optimizer, ctx)
 
     def loss_fn(params, micro):
         return bert_loss(params, micro, cfg, ctx=ctx)
@@ -51,13 +57,43 @@ def main(argv=None):
     # batches carry extra fields, so feed numpy and let jit shard by spec.
     num_micro = training.num_microbatches(ctx.dp * ctx.ep)
 
+    batch_iter = None
+    if args.data_path:
+        from megatronapp_tpu.data.bert_dataset import (
+            BertDataset, BertTokenIds, bert_batches,
+        )
+        from megatronapp_tpu.data.indexed_dataset import IndexedDataset
+        from megatronapp_tpu.data.tokenizers import build_tokenizer
+        tok = build_tokenizer(args.tokenizer_type,
+                              args.tokenizer_name_or_path,
+                              getattr(args, "vocab_size", None))
+        # Tokenizers without BERT specials (e.g. NullTokenizer over a
+        # pre-tokenized corpus) fall back to the conventional low ids.
+        def special(name, default):
+            v = getattr(tok, name, None)
+            return default if v is None else v
+        ids = BertTokenIds(cls=special("cls", 1), sep=special("sep", 2),
+                           mask=special("mask", 3), pad=special("pad", 0))
+        dataset = BertDataset(
+            IndexedDataset(args.data_path), seq_length=training.seq_length,
+            vocab_size=cfg.vocab_size, token_ids=ids,
+            num_samples=training.train_iters * training.global_batch_size,
+            seed=training.seed, masked_lm_prob=args.mask_prob,
+            short_seq_prob=args.short_seq_prob,
+            classification_head=not args.bert_no_binary_head)
+        batch_iter = bert_batches(dataset, training.global_batch_size)
+        print(f"BERT corpus: {len(dataset)} samples from {args.data_path}")
+
     losses = []
     t0 = time.perf_counter()
     with ctx.mesh:
         for it in range(training.train_iters):
-            batch = mock_bert_batch(it, training.global_batch_size,
-                                    training.seq_length, cfg.vocab_size,
-                                    mask_prob=args.mask_prob)
+            if batch_iter is not None:
+                batch = next(batch_iter)
+            else:
+                batch = mock_bert_batch(it, training.global_batch_size,
+                                        training.seq_length, cfg.vocab_size,
+                                        mask_prob=args.mask_prob)
             batch = reshape_global_batch(batch, num_micro)
             state, metrics = step_fn(state, batch)
             if (it + 1) % training.log_interval == 0 or \

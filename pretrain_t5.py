@@ -1,0 +1,127 @@
+"""T5 pretraining entry point (span-corruption objective).
+
+Parity with /root/reference/pretrain_t5.py: encoder/decoder LM trained on
+span-corrupted text. Data comes from a synthetic stream unless --data-path
+points at a sentence-split tokenized corpus (tools/preprocess_data.py
+--split-sentences), in which case samples are built by
+data/t5_dataset.py (sentinel span corruption).
+"""
+
+import time
+
+import jax
+import numpy as np
+
+from megatronapp_tpu.config.arguments import build_parser, configs_from_args
+from megatronapp_tpu.models.t5 import init_t5_params, t5_config, t5_loss
+from megatronapp_tpu.parallel.mesh import build_mesh
+from megatronapp_tpu.training.optimizer import get_optimizer
+from megatronapp_tpu.training.train import reshape_global_batch
+from megatronapp_tpu.training.train_state import setup_train_state
+from megatronapp_tpu.training.train_step import make_train_step
+
+
+def mock_t5_batch(seed, batch_size, enc_len, dec_len, vocab_size):
+    """Synthetic span-corruption-shaped batch."""
+    r = np.random.default_rng(seed)
+    enc = r.integers(3, vocab_size, size=(batch_size, enc_len))
+    dec = r.integers(3, vocab_size, size=(batch_size, dec_len))
+    labels = np.concatenate([dec[:, 1:], dec[:, :1]], axis=1)
+    return {
+        "text_enc": enc.astype(np.int32),
+        "text_dec": dec.astype(np.int32),
+        "labels": labels.astype(np.int32),
+        "loss_mask": np.ones((batch_size, dec_len), np.float32),
+        "enc_mask": np.ones((batch_size, enc_len), np.float32),
+    }
+
+
+def main(argv=None):
+    ap = build_parser("pretrain_t5 (megatronapp-tpu)")
+    ap.add_argument("--mask-prob", type=float, default=0.15)
+    ap.add_argument("--short-seq-prob", type=float, default=0.1)
+    ap.add_argument("--decoder-seq-length", type=int, default=None)
+    args = ap.parse_args(argv)
+    gpt_cfg, parallel, training, opt_cfg = configs_from_args(args)
+    import dataclasses
+    cfg = t5_config(**{f.name: getattr(gpt_cfg, f.name)
+                       for f in dataclasses.fields(gpt_cfg)
+                       if f.name not in ("position_embedding",
+                                         "attn_mask_type")})
+    dec_len = args.decoder_seq_length or max(training.seq_length // 4, 16)
+
+    ctx = build_mesh(parallel)
+    optimizer = get_optimizer(opt_cfg, training.train_iters)
+    state, shardings, _ = setup_train_state(
+        jax.random.PRNGKey(training.seed),
+        lambda k: init_t5_params(k, cfg), optimizer, ctx)
+
+    step_fn = make_train_step(
+        lambda p, micro: t5_loss(p, micro, cfg, ctx=ctx),
+        optimizer, opt_cfg, ctx, shardings, training.train_iters)
+    num_micro = training.num_microbatches(ctx.dp * ctx.ep)
+
+    batch_iter = None
+    if args.data_path:
+        from megatronapp_tpu.data.indexed_dataset import IndexedDataset
+        from megatronapp_tpu.data.t5_dataset import (
+            T5Dataset, T5TokenIds, t5_batches,
+        )
+        from megatronapp_tpu.data.tokenizers import build_tokenizer
+        tok = build_tokenizer(args.tokenizer_type,
+                              args.tokenizer_name_or_path,
+                              getattr(args, "vocab_size", None))
+        # Sentinel ids must not collide with real corpus tokens. Prefer
+        # the padded vocab region above the tokenizer's true vocab (those
+        # ids are never produced by tokenization); fall back to the top of
+        # the vocab with a warning (T5 tokenizers reserve <extra_id_*>
+        # there, but arbitrary tokenizers do not).
+        true_v = cfg.true_vocab_size or getattr(tok, "vocab_size", None)
+        if true_v and cfg.vocab_size > true_v:
+            sentinels = list(range(true_v, cfg.vocab_size))[:100]
+        else:
+            n_sent = min(100, max(cfg.vocab_size // 50, 1))
+            sentinels = list(range(cfg.vocab_size - n_sent, cfg.vocab_size))
+            print(f"warning: no padded vocab region; using top "
+                  f"{n_sent} vocab ids as sentinels (may collide with "
+                  f"real tokens)")
+        ids = T5TokenIds(
+            bos=getattr(tok, "bos", 1), eos=getattr(tok, "eod", 2) or 2,
+            pad=getattr(tok, "pad", 0), sentinels=sentinels)
+        dataset = T5Dataset(
+            IndexedDataset(args.data_path),
+            enc_seq_length=training.seq_length, dec_seq_length=dec_len,
+            vocab_size=cfg.vocab_size, token_ids=ids,
+            num_samples=training.train_iters * training.global_batch_size,
+            seed=training.seed, masked_lm_prob=args.mask_prob,
+            short_seq_prob=args.short_seq_prob)
+        batch_iter = t5_batches(dataset, training.global_batch_size)
+        print(f"T5 corpus: {len(dataset)} samples from {args.data_path}")
+
+    losses = []
+    t0 = time.perf_counter()
+    with ctx.mesh:
+        for it in range(training.train_iters):
+            if batch_iter is not None:
+                batch = next(batch_iter)
+                batch.pop("dec_mask", None)
+            else:
+                batch = mock_t5_batch(it, training.global_batch_size,
+                                      training.seq_length, dec_len,
+                                      cfg.vocab_size)
+            batch = reshape_global_batch(batch, num_micro)
+            state, metrics = step_fn(state, batch)
+            if (it + 1) % training.log_interval == 0 or \
+                    it + 1 == training.train_iters:
+                metrics = jax.device_get(metrics)
+                losses.append(float(metrics["loss"]))
+                print(f"iter {it+1:6d}/{training.train_iters} | "
+                      f"loss {float(metrics['loss']):.4f}")
+    dt = time.perf_counter() - t0
+    tokens = training.train_iters * training.global_batch_size * \
+        training.seq_length
+    print(f"done: final loss {losses[-1]:.4f}, {tokens/dt:,.0f} tok/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,195 @@
+"""Zero-shot GPT evaluation: LM perplexity + LAMBADA-style cloze accuracy.
+
+Parity with /root/reference/tasks/zeroshot_gpt/evaluate.py (+ datasets.py):
+- WikiText-style perplexity: the token stream is chunked into overlapping
+  windows (`--overlapping-eval` stride); each window scores only its new
+  tokens, and PPL = exp(total_nll / total_tokens).
+- LAMBADA cloze: accuracy of greedily predicting the final word's tokens
+  given the context.
+
+Runs against a live params pytree or a converted checkpoint; doubles as a
+whole-stack correctness check — on an HF-converted model the perplexity
+must match the HF implementation's (tests/test_tasks_eval.py).
+
+Usage:
+  python tasks/zeroshot_gpt.py --task wikitext --data-path corpus.txt \
+      --load-dir /ckpts/gpt2 --preset gpt2-125m \
+      --tokenizer-type GPT2BPETokenizer [--seq-length 1024]
+"""
+
+import argparse
+import json
+import math
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tasks/", 1)[0])
+
+import numpy as np
+
+
+def lm_nll(params, cfg, token_ids: np.ndarray, seq_length: int,
+           overlapping_eval: int = 0, batch_size: int = 8, ctx=None):
+    """Total negative log-likelihood of a token stream.
+
+    Returns (total_nll, total_predicted_tokens). Windows of seq_length
+    tokens advance by `overlapping_eval` (default: non-overlapping =
+    seq_length); in overlapping mode only the last `stride` tokens of each
+    window are scored — the reference's --overlapping-eval semantics.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from megatronapp_tpu.models.gpt import gpt_forward
+
+    stride = overlapping_eval or seq_length
+    n = len(token_ids)
+
+    @jax.jit
+    def window_nll(tokens, targets, mask):
+        logits, _ = gpt_forward(params, tokens, cfg, ctx=ctx)
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        return jnp.sum((logz - tgt) * mask)
+
+    total_nll = 0.0
+    total_tokens = 0
+    batch_tokens, batch_targets, batch_masks = [], [], []
+
+    def flush():
+        nonlocal total_nll
+        if not batch_tokens:
+            return
+        t = np.stack(batch_tokens)
+        g = np.stack(batch_targets)
+        m = np.stack(batch_masks)
+        total_nll_arr = window_nll(jnp.asarray(t), jnp.asarray(g),
+                                   jnp.asarray(m))
+        total_nll += float(jax.device_get(total_nll_arr))
+        batch_tokens.clear(); batch_targets.clear(); batch_masks.clear()
+
+    start = 0
+    prev_end = 1  # first not-yet-scored target position
+    while prev_end < n:
+        end = min(start + seq_length + 1, n)
+        window = token_ids[start:end]
+        tokens = window[:-1]
+        targets = window[1:]
+        # Score only positions not covered by a previous window (exactly
+        # once per token, including the final partial window).
+        new = end - prev_end
+        mask = np.zeros(len(targets), np.float32)
+        mask[len(targets) - new:] = 1.0
+        pad = seq_length - len(tokens)
+        if pad > 0:
+            tokens = np.pad(tokens, (0, pad))
+            targets = np.pad(targets, (0, pad))
+            mask = np.pad(mask, (0, pad))
+        batch_tokens.append(tokens.astype(np.int32))
+        batch_targets.append(targets.astype(np.int32))
+        batch_masks.append(mask)
+        total_tokens += new
+        if len(batch_tokens) == batch_size:
+            flush()
+        prev_end = end
+        if end == n:
+            break
+        start = start + stride if stride < seq_length else end - 1
+    flush()
+    return total_nll, total_tokens
+
+
+def evaluate_wikitext(params, cfg, token_ids, seq_length,
+                      overlapping_eval=0, ctx=None):
+    """→ {'nll', 'tokens', 'ppl', 'adjusted_ppl' omitted (no detok ratio)}"""
+    nll, count = lm_nll(params, cfg, np.asarray(token_ids), seq_length,
+                        overlapping_eval, ctx=ctx)
+    return {"nll": nll, "tokens": count,
+            "ppl": math.exp(nll / max(count, 1))}
+
+
+def evaluate_lambada(params, cfg, examples, seq_length, ctx=None):
+    """Cloze accuracy: `examples` is a list of (context_ids, target_ids);
+    correct iff EVERY target token is the greedy argmax given the prefix
+    (reference lambada strict match)."""
+    import jax
+    import jax.numpy as jnp
+
+    from megatronapp_tpu.models.gpt import gpt_forward
+
+    @jax.jit
+    def window_argmax(tokens):
+        logits, _ = gpt_forward(params, tokens, cfg, ctx=ctx)
+        return jnp.argmax(logits, axis=-1)
+
+    correct = 0
+    for context, target in examples:
+        ids = list(context) + list(target)
+        if len(ids) > seq_length:
+            ids = ids[-seq_length:]
+        tokens = np.asarray(ids[:-1], np.int32)[None]
+        pad = seq_length - tokens.shape[1]
+        if pad > 0:
+            tokens = np.pad(tokens, ((0, 0), (0, pad)))
+        pred = np.asarray(jax.device_get(window_argmax(jnp.asarray(tokens))))
+        k = len(target)
+        pos = len(ids) - 1 - k  # predictions for the k target tokens
+        if np.array_equal(pred[0, pos: pos + k], np.asarray(target)):
+            correct += 1
+    return {"accuracy": correct / max(len(examples), 1),
+            "correct": correct, "total": len(examples)}
+
+
+def main(argv=None):
+    from megatronapp_tpu.data.tokenizers import build_tokenizer
+    from megatronapp_tpu.models.presets import PRESETS
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--task", choices=["wikitext", "lambada"],
+                    default="wikitext")
+    ap.add_argument("--data-path", required=True,
+                    help="txt (wikitext) or jsonl with 'text' (lambada)")
+    ap.add_argument("--load-dir", required=True)
+    ap.add_argument("--preset", default="gpt2-125m")
+    ap.add_argument("--tokenizer-type", default="GPT2BPETokenizer")
+    ap.add_argument("--tokenizer-name-or-path", default=None)
+    ap.add_argument("--seq-length", type=int, default=1024)
+    ap.add_argument("--overlapping-eval", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from megatronapp_tpu.models.gpt import init_gpt_params
+    from megatronapp_tpu.training.checkpointing import CheckpointManager
+
+    cfg = PRESETS[args.preset]()
+    tok = build_tokenizer(args.tokenizer_type, args.tokenizer_name_or_path)
+    params0, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    mngr = CheckpointManager(args.load_dir)
+    restored = mngr.restore({"step": 0, "params": params0, "opt_state": {}})
+    mngr.close()
+    if restored is None:
+        raise FileNotFoundError(f"no checkpoint in {args.load_dir}")
+    params = restored["params"]
+
+    if args.task == "wikitext":
+        with open(args.data_path) as f:
+            ids = tok.tokenize(f.read())
+        res = evaluate_wikitext(params, cfg, ids, args.seq_length,
+                                args.overlapping_eval)
+    else:
+        examples = []
+        with open(args.data_path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                text = json.loads(line)["text"]
+                ctx_text, target = text.rsplit(" ", 1)
+                examples.append((tok.tokenize(ctx_text),
+                                 tok.tokenize(" " + target)))
+        res = evaluate_lambada(params, cfg, examples, args.seq_length)
+    print(json.dumps({"task": args.task, **res}))
+
+
+if __name__ == "__main__":
+    main()

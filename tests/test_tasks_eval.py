@@ -1,0 +1,115 @@
+"""Downstream eval harness tests (tasks/zeroshot_gpt.py).
+
+The strongest whole-stack correctness check available without hardware:
+perplexity computed by OUR stack on an HF-converted model must match the
+same quantity computed by the HF/torch stack (reference
+tasks/zeroshot_gpt/evaluate.py validated the same way against gpt2)."""
+
+import math
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, "tools")
+sys.path.insert(0, ".")
+
+from checkpoint.convert import convert_gpt2_state_dict  # noqa: E402
+from tasks.zeroshot_gpt import (  # noqa: E402
+    evaluate_lambada, evaluate_wikitext,
+)
+
+SEQ = 32
+VOCAB = 96
+
+
+@pytest.fixture(scope="module")
+def converted():
+    torch = pytest.importorskip("torch")
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    import jax.numpy as jnp
+    from megatronapp_tpu.config.transformer_config import (
+        PositionEmbeddingKind, TransformerConfig,
+    )
+
+    hf_cfg = GPT2Config(vocab_size=VOCAB, n_positions=SEQ, n_embd=32,
+                        n_layer=2, n_head=2, resid_pdrop=0.0,
+                        embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = GPT2LMHeadModel(hf_cfg).eval()
+    cfg = TransformerConfig(
+        num_layers=2, hidden_size=32, num_attention_heads=2,
+        vocab_size=VOCAB, max_position_embeddings=SEQ,
+        position_embedding=PositionEmbeddingKind.learned_absolute,
+        add_qkv_bias=True, compute_dtype=jnp.float32, remat_policy="none")
+    sd = {k: v.numpy() for k, v in hf.transformer.state_dict().items()}
+    return hf, convert_gpt2_state_dict(sd, cfg), cfg
+
+
+def hf_stream_nll(hf, ids, seq):
+    """Reference NLL over the same non-overlapping window chunking."""
+    import torch
+    total, count = 0.0, 0
+    start = 0
+    while start + 1 < len(ids):
+        window = ids[start: start + seq + 1]
+        t = torch.tensor(window[:-1])[None]
+        g = torch.tensor(window[1:])
+        with torch.no_grad():
+            logits = hf(t).logits[0]
+        nll = torch.nn.functional.cross_entropy(
+            logits, g, reduction="sum")
+        total += float(nll)
+        count += len(g)
+        if start + seq + 1 >= len(ids):
+            break
+        start += seq
+    return total, count
+
+
+class TestWikitextPPL:
+    def test_ppl_matches_hf(self, converted):
+        hf, params, cfg = converted
+        ids = list(np.random.default_rng(0).integers(0, VOCAB, 150))
+        res = evaluate_wikitext(params, cfg, ids, SEQ)
+        ref_nll, ref_count = hf_stream_nll(hf, ids, SEQ)
+        assert res["tokens"] == ref_count
+        assert abs(res["nll"] - ref_nll) / ref_nll < 1e-3
+        assert abs(res["ppl"] - math.exp(ref_nll / ref_count)) < 0.5
+
+    def test_overlapping_eval_scores_only_new_tokens(self, converted):
+        _, params, cfg = converted
+        ids = list(np.random.default_rng(0).integers(0, VOCAB, 100))
+        full = evaluate_wikitext(params, cfg, ids, SEQ)
+        overl = evaluate_wikitext(params, cfg, ids, SEQ,
+                                  overlapping_eval=SEQ // 2)
+        # Same number of predicted tokens, better (<=) conditional nll.
+        assert overl["tokens"] == full["tokens"]
+        assert overl["nll"] <= full["nll"] * 1.05
+
+
+class TestLambada:
+    def test_accuracy_matches_hf_greedy(self, converted):
+        import torch
+        hf, params, cfg = converted
+        rng = np.random.default_rng(1)
+        examples = []
+        for _ in range(12):
+            ctx_ids = list(rng.integers(0, VOCAB, int(rng.integers(8, 20))))
+            tgt = list(rng.integers(0, VOCAB, int(rng.integers(1, 3))))
+            examples.append((ctx_ids, tgt))
+        res = evaluate_lambada(params, cfg, examples, SEQ)
+
+        correct = 0
+        for ctx_ids, tgt in examples:
+            ids = ctx_ids + tgt
+            t = torch.tensor(ids[:-1])[None]
+            with torch.no_grad():
+                pred = hf(t).logits[0].argmax(-1).numpy()
+            k = len(tgt)
+            pos = len(ids) - 1 - k
+            if np.array_equal(pred[pos: pos + k], np.asarray(tgt)):
+                correct += 1
+        assert res["correct"] == correct
+        assert res["total"] == len(examples)

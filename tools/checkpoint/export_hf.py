@@ -1,0 +1,186 @@
+"""Checkpoint exporter: megatronapp-tpu parameter pytrees → HuggingFace.
+
+The inverse of tools/checkpoint/convert.py — parity with the reference's
+saver plugins (/root/reference/tools/checkpoint/saver_*.py and
+core/export/): load an Orbax checkpoint (or a live params pytree), emit an
+HF-layout state dict + config.json + model.safetensors that
+transformers.AutoModelForCausalLM can load.
+
+Round-trip property (tests/test_export_hf.py): HF → convert → export → HF
+state dicts bit-match, and logits agree through both stacks.
+
+Usage:
+  python tools/checkpoint/export_hf.py --model-type gpt2 \
+      --load-dir /ckpts/gpt2 --save-dir /export/gpt2_hf [--preset gpt2-125m]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
+
+import numpy as np
+
+
+def _unstack(block, num_layers):
+    """Stacked [L, ...] block params → list of per-layer dicts."""
+    import jax
+    return [jax.tree.map(lambda x: np.asarray(x[i], np.float32), block)
+            for i in range(num_layers)]
+
+
+def export_gpt2_state_dict(params, cfg):
+    """Our GPT param pytree → HF GPT-2 (transformer.*) state dict.
+
+    Inverse of convert.convert_gpt2_state_dict: HF GPT-2 Conv1D kernels are
+    [in, out] (no transpose); the fused c_attn re-concatenates our split
+    q/kv kernels; padded vocab rows are dropped back to the true vocab."""
+    sd = {}
+    true_v = cfg.true_vocab_size or cfg.vocab_size
+    sd["wte.weight"] = np.asarray(
+        params["embedding"]["word"], np.float32)[:true_v]
+    sd["wpe.weight"] = np.asarray(params["embedding"]["pos"], np.float32)
+    sd["ln_f.weight"] = np.asarray(params["final_ln_scale"], np.float32)
+    sd["ln_f.bias"] = np.asarray(params["final_ln_bias"], np.float32)
+    for i, lp in enumerate(_unstack(params["block"], cfg.num_layers)):
+        pre = f"h.{i}."
+        at = lp["attention"]
+        sd[pre + "ln_1.weight"] = lp["ln1_scale"]
+        sd[pre + "ln_1.bias"] = lp["ln1_bias"]
+        sd[pre + "ln_2.weight"] = lp["ln2_scale"]
+        sd[pre + "ln_2.bias"] = lp["ln2_bias"]
+        sd[pre + "attn.c_attn.weight"] = np.concatenate(
+            [at["q_kernel"], at["kv_kernel"]], axis=1)
+        sd[pre + "attn.c_attn.bias"] = np.concatenate(
+            [at["q_bias"], at["kv_bias"]])
+        sd[pre + "attn.c_proj.weight"] = at["out_kernel"]
+        sd[pre + "attn.c_proj.bias"] = at["out_bias"]
+        sd[pre + "mlp.c_fc.weight"] = lp["mlp"]["fc1_kernel"]
+        sd[pre + "mlp.c_fc.bias"] = lp["mlp"]["fc1_bias"]
+        sd[pre + "mlp.c_proj.weight"] = lp["mlp"]["fc2_kernel"]
+        sd[pre + "mlp.c_proj.bias"] = lp["mlp"]["fc2_bias"]
+    return sd
+
+
+def export_llama_state_dict(params, cfg):
+    """Our GPT param pytree (swiglu/rmsnorm/GQA flavor) → HF Llama state
+    dict. Inverse of convert.convert_llama_state_dict: HF Linear kernels
+    are [out, in] (transpose back); kv_kernel splits into k/v; fc1 splits
+    into gate/up."""
+    d = cfg.head_dim
+    nkv = cfg.num_query_groups
+    sd = {}
+    true_v = cfg.true_vocab_size or cfg.vocab_size
+    sd["model.embed_tokens.weight"] = np.asarray(
+        params["embedding"]["word"], np.float32)[:true_v]
+    sd["model.norm.weight"] = np.asarray(params["final_ln_scale"],
+                                         np.float32)
+    if "output" in params:
+        sd["lm_head.weight"] = np.asarray(params["output"], np.float32).T
+    for i, lp in enumerate(_unstack(params["block"], cfg.num_layers)):
+        pre = f"model.layers.{i}."
+        at = lp["attention"]
+        kv = at["kv_kernel"]
+        k_w, v_w = kv[:, : nkv * d], kv[:, nkv * d:]
+        fc1 = lp["mlp"]["fc1_kernel"]
+        f = fc1.shape[1] // 2
+        sd[pre + "input_layernorm.weight"] = lp["ln1_scale"]
+        sd[pre + "post_attention_layernorm.weight"] = lp["ln2_scale"]
+        sd[pre + "self_attn.q_proj.weight"] = at["q_kernel"].T
+        sd[pre + "self_attn.k_proj.weight"] = k_w.T
+        sd[pre + "self_attn.v_proj.weight"] = v_w.T
+        sd[pre + "self_attn.o_proj.weight"] = at["out_kernel"].T
+        sd[pre + "mlp.gate_proj.weight"] = fc1[:, :f].T
+        sd[pre + "mlp.up_proj.weight"] = fc1[:, f:].T
+        sd[pre + "mlp.down_proj.weight"] = lp["mlp"]["fc2_kernel"].T
+    return sd
+
+
+def hf_config_dict(model_type: str, cfg) -> dict:
+    """Minimal HF config.json for the exported weights."""
+    true_v = cfg.true_vocab_size or cfg.vocab_size
+    if model_type == "gpt2":
+        return {
+            "architectures": ["GPT2LMHeadModel"], "model_type": "gpt2",
+            "vocab_size": true_v, "n_positions": cfg.max_position_embeddings,
+            "n_embd": cfg.hidden_size, "n_layer": cfg.num_layers,
+            "n_head": cfg.num_attention_heads,
+            "resid_pdrop": 0.0, "embd_pdrop": 0.0, "attn_pdrop": 0.0,
+            "layer_norm_epsilon": cfg.layernorm_epsilon,
+        }
+    if model_type == "llama":
+        return {
+            "architectures": ["LlamaForCausalLM"], "model_type": "llama",
+            "vocab_size": true_v, "hidden_size": cfg.hidden_size,
+            "intermediate_size": cfg.ffn_hidden_size,
+            "num_hidden_layers": cfg.num_layers,
+            "num_attention_heads": cfg.num_attention_heads,
+            "num_key_value_heads": cfg.num_query_groups,
+            "max_position_embeddings": cfg.max_position_embeddings,
+            "rope_theta": cfg.rotary_base,
+            "rms_norm_eps": cfg.layernorm_epsilon,
+            "tie_word_embeddings": not cfg.untie_embeddings_and_output_weights,
+        }
+    raise ValueError(f"unknown model type {model_type}")
+
+
+EXPORTERS = {"gpt2": export_gpt2_state_dict,
+             "llama": export_llama_state_dict}
+
+# HF GPT-2 checkpoints live under the `transformer.` prefix inside
+# GPT2LMHeadModel; Llama uses `model.` which the exporter emits directly.
+_PREFIX = {"gpt2": "transformer.", "llama": ""}
+
+
+def save_hf_checkpoint(params, cfg, model_type: str, save_dir: str):
+    """Write model.safetensors + config.json loadable by transformers."""
+    os.makedirs(save_dir, exist_ok=True)
+    sd = EXPORTERS[model_type](params, cfg)
+    sd = {_PREFIX[model_type] + k: np.ascontiguousarray(v, np.float32)
+          for k, v in sd.items()}
+    from safetensors.numpy import save_file
+    save_file(sd, os.path.join(save_dir, "model.safetensors"))
+    with open(os.path.join(save_dir, "config.json"), "w") as f:
+        json.dump(hf_config_dict(model_type, cfg), f, indent=1)
+    return sd
+
+
+def main():
+    from megatronapp_tpu.models.presets import PRESETS
+    from megatronapp_tpu.training.checkpointing import CheckpointManager
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-type", required=True, choices=sorted(EXPORTERS))
+    ap.add_argument("--load-dir", required=True)
+    ap.add_argument("--save-dir", required=True)
+    ap.add_argument("--preset", default=None)
+    args = ap.parse_args()
+
+    if args.preset:
+        cfg = PRESETS[args.preset]()
+    else:
+        cfg = PRESETS["gpt2-125m" if args.model_type == "gpt2"
+                      else "llama3-8b"]()
+
+    # Restore needs a structure template: the preset's init pytree matches
+    # the converter's saved layout ({"step", "params", "opt_state": {}}).
+    import jax
+
+    from megatronapp_tpu.models.gpt import init_gpt_params
+    params0, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    template = {"step": 0, "params": params0, "opt_state": {}}
+    mngr = CheckpointManager(args.load_dir)
+    restored = mngr.restore(template)
+    mngr.close()
+    if restored is None:
+        raise FileNotFoundError(f"no checkpoint in {args.load_dir}")
+    sd = save_hf_checkpoint(restored["params"], cfg, args.model_type,
+                            args.save_dir)
+    n = sum(int(np.prod(v.shape)) for v in sd.values())
+    print(f"exported {n/1e6:.1f}M params → {args.save_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -6,8 +6,14 @@ IndexedDataset with EOD appended per document).
 Usage:
   python tools/preprocess_data.py --input corpus.jsonl \
       --output-prefix data/my_corpus --tokenizer-type GPT2BPETokenizer \
-      [--json-key text] [--append-eod]
+      [--json-key text] [--append-eod] [--split-sentences]
+
+--split-sentences stores each sentence as its own sequence with document
+boundaries preserved (reference --split-sentences; required for the
+BERT/T5 masked datasets, data/masked_dataset.py).
 """
+
+import re
 
 import argparse
 import json
@@ -33,6 +39,8 @@ def main():
     ap.add_argument("--vocab-size", type=int, default=None,
                     help="for NullTokenizer")
     ap.add_argument("--append-eod", action="store_true")
+    ap.add_argument("--split-sentences", action="store_true",
+                    help="one sequence per sentence (BERT/T5 datasets)")
     ap.add_argument("--log-interval", type=int, default=10000)
     args = ap.parse_args()
 
@@ -47,6 +55,26 @@ def main():
             if not line:
                 continue
             doc = json.loads(line)
+            if args.split_sentences:
+                # Period/question/exclamation-boundary splitter (the
+                # reference uses nltk punkt; a regex keeps this
+                # dependency-free).
+                sents = [x.strip() for x in
+                         re.split(r"(?<=[.!?])\s+", doc[args.json_key])
+                         if x.strip()]
+                sent_ids = [tok.tokenize(x) for x in sents]
+                sent_ids = [x for x in sent_ids if x]
+                if not sent_ids:
+                    continue
+                flat = [t for x in sent_ids for t in x]
+                writer.add_document(
+                    np.asarray(flat),
+                    sequence_lengths=[len(x) for x in sent_ids])
+                n_docs += 1
+                n_tokens += len(flat)
+                if n_docs % args.log_interval == 0:
+                    print(f"processed {n_docs} docs, {n_tokens} tokens")
+                continue
             ids = tok.tokenize(doc[args.json_key])
             if args.append_eod and tok.eod is not None:
                 ids = list(ids) + [tok.eod]
