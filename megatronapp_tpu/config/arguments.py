@@ -94,6 +94,10 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     g = ap.add_argument_group("training")  # _add_training_args parity
     g.add_argument("--micro-batch-size", type=int, default=1)
     g.add_argument("--global-batch-size", type=int, default=8)
+    g.add_argument("--rampup-batch-size", nargs=3, type=int, default=None,
+                   metavar=("START", "INCR", "SAMPLES"),
+                   help="linear global-batch rampup (reference "
+                        "--rampup-batch-size)")
     g.add_argument("--seq-length", type=int, default=1024)
     g.add_argument("--train-iters", type=int, default=100)
     g.add_argument("--seed", type=int, default=1234)
@@ -128,6 +132,13 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     g.add_argument("--save", default=None, metavar="DIR")
     g.add_argument("--load", default=None, metavar="DIR")
     g.add_argument("--save-interval", type=int, default=None)
+    g.add_argument("--use-checkpoint-args", action="store_true",
+                   help="apply args.json stored with the --load checkpoint "
+                        "as defaults (explicit flags still override; "
+                        "reference --use-checkpoint-args)")
+    g.add_argument("--config-yaml", default=None, metavar="FILE",
+                   help="YAML of flag values applied as defaults "
+                        "(reference yaml_arguments.py alternative)")
 
     g = ap.add_argument_group("data")  # _add_data_args parity
     g.add_argument("--data-path", default=None,
@@ -154,6 +165,82 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     g.add_argument("--trace-granularity", default="full",
                    choices=["full", "schedule", "collective"])
     return ap
+
+
+def parse_args(ap: argparse.ArgumentParser, argv=None):
+    """Parse with YAML-config and checkpoint-args defaults applied.
+
+    Resolution order (lowest → highest precedence): parser defaults →
+    --config-yaml values → --use-checkpoint-args stored values → explicit
+    CLI flags. Use this instead of ap.parse_args in entry points."""
+    import sys
+    argv = list(sys.argv[1:] if argv is None else argv)
+    pre, _ = ap.parse_known_args(argv)
+    defaults = {}
+    if getattr(pre, "config_yaml", None):
+        defaults.update(_flags_from_yaml(pre.config_yaml))
+    if getattr(pre, "use_checkpoint_args", False):
+        if not pre.load:
+            raise ValueError("--use-checkpoint-args requires --load")
+        stored = load_saved_args(pre.load) or {}
+        # Restore ARCHITECTURE/hyperparameter args only — run-control args
+        # (where to save, how long to run, IO paths) stay with the new
+        # invocation (reference --use-checkpoint-args skips the same set).
+        defaults.update({k: v for k, v in stored.items()
+                         if k not in _RUN_CONTROL_ARGS})
+    if defaults:
+        valid = {a.dest for a in ap._actions}
+        unknown = sorted(set(defaults) - valid)
+        if unknown:
+            raise ValueError(f"unknown config keys: {unknown}")
+        ap.set_defaults(**defaults)
+    return ap.parse_args(argv)
+
+
+def _flags_from_yaml(path: str) -> dict:
+    """{flag: value} from a YAML file; keys may use dashes or
+    underscores."""
+    import yaml
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    if not isinstance(raw, dict):
+        raise ValueError(f"{path}: expected a mapping of flag: value")
+    return {k.replace("-", "_"): v for k, v in raw.items()}
+
+
+_ARGS_FILE = "resolved_args.json"
+
+# Args --use-checkpoint-args must NOT resurrect from a stored run.
+_RUN_CONTROL_ARGS = frozenset({
+    "save", "load", "save_interval", "train_iters", "exit_interval",
+    "use_checkpoint_args", "config_yaml", "data_path", "metrics_jsonl",
+    "tensorboard_dir", "trace", "trace_dir", "log_interval",
+    "eval_interval", "eval_iters",
+})
+
+
+def save_resolved_args(args, save_dir: str):
+    """Persist the resolved flag namespace next to the checkpoint
+    (reference stores args inside the ckpt; a sidecar JSON keeps ours
+    format-agnostic)."""
+    import json
+    import os
+    os.makedirs(save_dir, exist_ok=True)
+    payload = {k: v for k, v in vars(args).items()
+               if isinstance(v, (int, float, str, bool, list, tuple,
+                                 type(None)))}
+    with open(os.path.join(save_dir, _ARGS_FILE), "w") as f:
+        json.dump(payload, f, indent=1)
+
+
+def load_saved_args(load_dir: str) -> Optional[dict]:
+    import json
+    import os
+    path = os.path.join(load_dir, _ARGS_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
 
 
 def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
@@ -259,6 +346,8 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
         raise ValueError("--seq-length exceeds --max-position-embeddings")
 
     training = TrainingConfig(
+        rampup_batch_size=(tuple(args.rampup_batch_size)
+                           if args.rampup_batch_size else None),
         metrics_jsonl=args.metrics_jsonl,
         tensorboard_dir=args.tensorboard_dir,
         rerun_mode=args.rerun_mode,

@@ -46,6 +46,10 @@ class TrainingConfig:
     save_dir: Optional[str] = None
     load_dir: Optional[str] = None
     exit_interval: Optional[int] = None
+    # Linear batch-size rampup (reference --rampup-batch-size
+    # "<start> <increment> <samples>"): grow the global batch from start to
+    # global_batch_size over the first `samples` consumed samples.
+    rampup_batch_size: Optional[tuple] = None
     # NaN/spike guard (reference rerun_state_machine result validation).
     check_for_nan_in_loss: bool = True
     loss_spike_factor: float = 10.0

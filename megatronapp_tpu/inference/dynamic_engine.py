@@ -1,0 +1,285 @@
+"""Dynamic inference engine: continuous batching over a slot-based KV cache.
+
+Parity with /root/reference/megatron/core/inference/engines/dynamic_engine.py
++ contexts/dynamic_context.py + scheduler.py: requests of different lengths
+enter a waiting queue; the engine admits them into free cache slots
+(prefill), decodes ONE token per step for every active slot, and retires
+finished requests — new requests join mid-flight without draining the batch.
+
+TPU-first: all shapes static. The shared cache is [L, max_batch, S_max,
+Hkv, D]; per-slot sequence lengths live in a [max_batch] int32 array; the
+decode step is ONE jit for all slots (per-row rope positions + per-row
+causal masks), and prefill runs through length-bucketed jits (a handful of
+compilations instead of one per prompt length).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.inference.engine import (
+    SamplingParams, init_kv_cache, mask_padded_vocab, sample_logits,
+)
+from megatronapp_tpu.models.gpt import gpt_embed, gpt_head, gpt_rope_tables
+from megatronapp_tpu.transformer.block import layer_forward
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request (reference inference_request.py analogue)."""
+    request_id: int
+    prompt: np.ndarray                  # [P] int32
+    max_new_tokens: int
+    sampling: SamplingParams
+    eod_id: Optional[int] = None
+    # Filled by the engine:
+    slot: int = -1
+    generated: list = dataclasses.field(default_factory=list)
+    finished: bool = False
+
+    @property
+    def tokens(self) -> np.ndarray:
+        return np.concatenate([self.prompt,
+                               np.asarray(self.generated, np.int32)])
+
+
+def _decode_step(params, tokens, cache, lengths, active,
+                 cfg: TransformerConfig):
+    """One-token decode for every slot.
+
+    tokens [B,1] (last token per slot), cache [L,B,Smax,...], lengths [B]
+    (tokens already in cache per slot), active [B] bool. Returns
+    (last_logits [B,V], new_cache)."""
+    b = tokens.shape[0]
+    max_len = cache[0].shape[2]
+    h = gpt_embed(params, tokens, cfg, position_ids=lengths[:, None])
+    cos_full, sin_full = gpt_rope_tables(cfg, max_len)
+    if cos_full is not None:
+        cos = jnp.take(cos_full, lengths, axis=0)[:, None]   # [B,1,half]
+        sin = jnp.take(sin_full, lengths, axis=0)[:, None]
+    else:
+        cos = sin = None
+
+    # Per-row causality: the new token (position lengths[b]) may attend
+    # cache positions <= lengths[b]; inactive rows are fully masked except
+    # self (keeps the softmax finite; results are discarded).
+    kv_pos = jnp.arange(max_len)
+    attend = kv_pos[None, :] <= lengths[:, None]             # [B,Smax]
+    mask = attend[:, None, None, :]                          # [B,1,1,Smax]
+
+    ck, cv = cache
+
+    def body(carry, layer_in):
+        hh = carry
+        layer_p, k_l, v_l, lid = layer_in
+        (hh, new_cache), _ = layer_forward(
+            layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
+            kv_cache=(k_l, v_l), cache_index=None,
+            cache_positions=lengths)
+        return hh, new_cache
+
+    h, new_caches = jax.lax.scan(
+        body, h, (params["block"], ck, cv, jnp.arange(cfg.num_layers)))
+    logits = gpt_head(params, h, cfg)[:, -1]
+    return logits, new_caches
+
+
+class DynamicInferenceEngine:
+    """Continuous-batching engine (reference DynamicInferenceEngine).
+
+    add_request() any time; step() decodes one token for every active
+    request and admits waiting requests into free slots. Finished requests
+    surface through the returned events and the optional token_callback.
+    """
+
+    def __init__(self, params, cfg: TransformerConfig, tokenizer=None,
+                 max_batch: int = 4, max_seq_len: Optional[int] = None,
+                 prefill_buckets: Tuple[int, ...] = (32, 128, 512)):
+        if cfg.multi_latent_attention:
+            raise NotImplementedError(
+                "dynamic batching currently supports standard attention "
+                "caches (MLA serves through the static engine)")
+        self.params = params
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.max_batch = max_batch
+        self.max_seq_len = max_seq_len or cfg.max_position_embeddings
+        self.prefill_buckets = tuple(
+            b for b in sorted(prefill_buckets) if b <= self.max_seq_len
+        ) or (self.max_seq_len,)
+
+        self.cache = init_kv_cache(cfg, max_batch, self.max_seq_len)
+        self.lengths = jnp.zeros((max_batch,), jnp.int32)
+        self.last_tokens = np.zeros((max_batch, 1), np.int32)
+        self.slots: List[Optional[Request]] = [None] * max_batch
+        self.waiting: deque = deque()
+        self._ids = itertools.count()
+        self._decode = jax.jit(
+            lambda p, t, c, l, a: _decode_step(p, t, c, l, a, cfg))
+        # Prefill reuses the static engine's whole-prompt forward on a
+        # [1, bucket] batch, then scatters the kv rows into the slot.
+        import functools
+
+        from megatronapp_tpu.inference.engine import _forward_with_cache
+        self._prefill = jax.jit(
+            functools.partial(_forward_with_cache, cfg=cfg))
+
+    # ---- request lifecycle ------------------------------------------------
+    def add_request(self, prompt_tokens, max_new_tokens: int,
+                    sampling: Optional[SamplingParams] = None,
+                    eod_id: Optional[int] = None) -> int:
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        if len(prompt) + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt({len(prompt)}) + max_new({max_new_tokens}) exceeds "
+                f"max_seq_len({self.max_seq_len})")
+        req = Request(next(self._ids), prompt, max_new_tokens,
+                      sampling or SamplingParams(), eod_id=eod_id)
+        self.waiting.append(req)
+        return req.request_id
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(
+            r is not None for r in self.slots)
+
+    def _admit(self) -> List[Request]:
+        admitted = []
+        for slot in range(self.max_batch):
+            if self.slots[slot] is not None or not self.waiting:
+                continue
+            req = self.waiting.popleft()
+            req.slot = slot
+            self.slots[slot] = req
+            self._prefill_into_slot(req)
+            admitted.append(req)
+        return admitted
+
+    def _prefill_into_slot(self, req: Request):
+        p_len = len(req.prompt)
+        bucket = next((b for b in self.prefill_buckets if b >= p_len),
+                      self.max_seq_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p_len] = req.prompt
+        tmp_cache = init_kv_cache(self.cfg, 1, self.max_seq_len)
+        logits, tmp_cache = self._prefill(self.params,
+                                          jnp.asarray(padded), tmp_cache, 0)
+        # Scatter the prompt's kv rows into this slot of the shared cache.
+        slot = req.slot
+        self.cache = tuple(
+            c.at[:, slot, :].set(t[:, 0, :]) for c, t in
+            zip(self.cache, tmp_cache))
+        self.lengths = self.lengths.at[slot].set(p_len)
+        # First generated token comes from the last PROMPT position.
+        logits_last = mask_padded_vocab(logits[0, p_len - 1], self.cfg)
+        tok = self._sample(logits_last[None], req)
+        self._record_token(req, int(tok[0]))
+
+    def _sample(self, logits, req: Request):
+        rng = jax.random.PRNGKey(
+            req.sampling.seed + len(req.generated) * 7919 + req.request_id)
+        return jax.device_get(sample_logits(logits, rng, req.sampling))
+
+    def _record_token(self, req: Request, tok: int):
+        req.generated.append(tok)
+        self.last_tokens[req.slot, 0] = tok
+        if (tok == req.eod_id or
+                len(req.generated) >= req.max_new_tokens):
+            req.finished = True
+
+    def _retire(self) -> List[Request]:
+        done = []
+        for slot, req in enumerate(self.slots):
+            if req is not None and req.finished:
+                done.append(req)
+                self.slots[slot] = None
+                self.lengths = self.lengths.at[slot].set(0)
+        return done
+
+    # ---- main loop --------------------------------------------------------
+    def step(self) -> Dict[str, List]:
+        """Admit → decode one token for all active slots → retire.
+
+        Returns {"admitted": [ids], "tokens": [(id, tok)], "finished":
+        [ids]} for this step."""
+        admitted = self._admit()
+        events = {"admitted": [r.request_id for r in admitted],
+                  "tokens": [(r.request_id, r.generated[-1])
+                             for r in admitted],
+                  "finished": []}
+
+        active = [r for r in self.slots
+                  if r is not None and not r.finished]
+        if active:
+            active_mask = jnp.asarray(
+                [self.slots[i] is not None and not self.slots[i].finished
+                 for i in range(self.max_batch)])
+            logits, self.cache = self._decode(
+                self.params, jnp.asarray(self.last_tokens), self.cache,
+                self.lengths, active_mask)
+            # The decode wrote each active row's kv at lengths[slot].
+            self.lengths = self.lengths + active_mask.astype(jnp.int32)
+            logits = mask_padded_vocab(logits, self.cfg)
+            for req in active:
+                tok = self._sample(logits[req.slot][None], req)
+                self._record_token(req, int(tok[0]))
+                events["tokens"].append((req.request_id, int(tok[0])))
+
+        events["finished"] = [r.request_id for r in self._retire()]
+        return events
+
+    def run_to_completion(self,
+                          token_callback: Optional[Callable] = None
+                          ) -> Dict[int, np.ndarray]:
+        """Drive step() until every request finishes; returns
+        {request_id: full token array}."""
+        results: Dict[int, np.ndarray] = {}
+        finished_reqs: Dict[int, Request] = {}
+        known: Dict[int, Request] = {}
+        while self.has_work:
+            for r in list(self.waiting) + [r for r in self.slots if r]:
+                known[r.request_id] = r
+            ev = self.step()
+            if token_callback is not None:
+                for rid, tok in ev["tokens"]:
+                    token_callback(rid, tok)
+            for rid in ev["finished"]:
+                finished_reqs[rid] = known[rid]
+        for rid, req in finished_reqs.items():
+            results[rid] = req.tokens
+        return results
+
+    def generate_text(self, prompts, max_new_tokens: int,
+                      sampling: Optional[SamplingParams] = None,
+                      token_callback: Optional[Callable] = None):
+        """String-level API (drop-in for StaticInferenceEngine
+        .generate_text — lets the REST/WS server run on the dynamic
+        engine)."""
+        assert self.tokenizer is not None, "tokenizer required"
+        eod = getattr(self.tokenizer, "eod", None)
+        rids = []
+        for prompt in prompts:
+            ids = np.asarray(self.tokenizer.tokenize(prompt), np.int32)
+            rids.append(self.add_request(ids, max_new_tokens, sampling,
+                                         eod_id=eod))
+        cb = None
+        if token_callback is not None:
+            def cb(rid, tok):
+                token_callback(rid, np.asarray([tok]), None)
+        results = self.run_to_completion(token_callback=cb)
+        texts = []
+        for prompt, rid in zip(prompts, rids):
+            n_prompt = len(self.tokenizer.tokenize(prompt))
+            new_ids = results[rid][n_prompt:].tolist()
+            if eod is not None and eod in new_ids:
+                new_ids = new_ids[: new_ids.index(eod)]
+            texts.append(self.tokenizer.detokenize(new_ids))
+        return texts

@@ -76,7 +76,17 @@ def sample_logits(logits: jnp.ndarray, rng, params: SamplingParams):
 
 
 def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int):
-    """[L, B, S_max, Hkv, D] K and V (static_context.py analogue)."""
+    """Per-layer decode cache (static_context.py analogue).
+
+    Standard attention: K and V [L, B, S_max, Hkv, D]. MLA: the COMPRESSED
+    cache — latent [L, B, S_max, kv_lora_rank] + shared roped key
+    [L, B, S_max, qk_pos_emb_head_dim] (reference MLA's storage win:
+    klat+dpe floats per token instead of 2*Hkv*D)."""
+    if cfg.multi_latent_attention:
+        return (jnp.zeros((cfg.num_layers, batch, max_len,
+                           cfg.kv_lora_rank), cfg.compute_dtype),
+                jnp.zeros((cfg.num_layers, batch, max_len,
+                           cfg.qk_pos_emb_head_dim), cfg.compute_dtype))
     shape = (cfg.num_layers, batch, max_len, cfg.num_query_groups,
              cfg.head_dim)
     return (jnp.zeros(shape, cfg.compute_dtype),

@@ -141,9 +141,10 @@ def packed_attention_mask(segment_ids: jnp.ndarray) -> jnp.ndarray:
     on top). Parity with the reference packed/THD formats
     (core/packed_seq_params.py + --reset-attention-mask /
     --reset-position-ids semantics; positions reset per segment in
-    packed_position_ids). Note: an explicit mask routes attention through
-    the reference impl (O(S²) scores), not the flash kernel — a
-    segment-aware flash variant is future work.
+    packed_position_ids). Utility for mask-based consumers; the model
+    path no longer densifies — the segment-aware flash kernel masks
+    in-block and the cp impls thread segments through their collectives
+    (transformer/attention.py).
 
     segment_ids [B,S] → bool mask [B,1,S,S] (True = may attend)."""
     same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
@@ -184,12 +185,10 @@ def gpt_forward(p, tokens: jnp.ndarray, cfg: TransformerConfig,
     b, s = tokens.shape
     packed_pos = None
     if segment_ids is not None:
-        if ctx is not None and ctx.cp > 1:
-            raise NotImplementedError(
-                "packed sequences (segment_ids) are not supported under "
-                "context parallelism yet")
         # Positions restart per segment (reference --reset-position-ids) —
-        # for BOTH the learned-absolute embedding and rope tables.
+        # for BOTH the learned-absolute embedding and rope tables. The
+        # segment mask itself is applied inside attention (flash in-block
+        # masking / cp collectives), NOT as a dense [B,S,S] mask.
         packed_pos = packed_position_ids(segment_ids)
     positions = packed_pos
     zz = (zigzag_active(cfg, ctx) and segment_ids is None
@@ -201,12 +200,8 @@ def gpt_forward(p, tokens: jnp.ndarray, cfg: TransformerConfig,
     h = gpt_embed(p, tokens, cfg, position_offset, position_ids=positions)
     cos, sin = gpt_rope_tables(cfg, s, position_offset,
                                positions=(positions[0] if zz else positions))
-    if segment_ids is not None:
-        seg_mask = packed_attention_mask(segment_ids)
-        attention_mask = (seg_mask if attention_mask is None
-                          else attention_mask & seg_mask)
     h, aux = block_forward(p["block"], h, cfg, cos, sin, attention_mask,
-                           ctx=ctx, zigzag=zz)
+                           ctx=ctx, zigzag=zz, segment_ids=segment_ids)
     logits = gpt_head(p, h, cfg)
     if zz and not zigzag_keep:
         logits = jnp.take(logits, jnp.asarray(zigzag_inverse_indices(
@@ -248,13 +243,17 @@ def gpt_head(p, h: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
 
 def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
                       cfg: TransformerConfig, ctx, vpp: int = 1,
-                      order_policy: str = "dfc"):
+                      order_policy: str = "dfc", segment_ids_mb=None):
     """Pipelined training loss over microbatched inputs [M, mb, S].
 
     Embedding and LM head run outside the pipeline body (compiler-sharded
     over dp/tp); the layer stack runs inside spmd_pipeline over the pp axis.
     The reference runs its schedules imperatively per rank
     (schedules.py:1918 1F1B); here the schedule is one jitted scan.
+
+    segment_ids_mb: optional [M, mb, S] packed map — segments and the
+    per-token rope tables ride the pipeline as per-microbatch aux inputs
+    (spmd_pipeline aux_mb).
     """
     from megatronapp_tpu.parallel.pipeline import spmd_pipeline
 
@@ -263,6 +262,10 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
     )
 
     m, mb, s = tokens_mb.shape
+    if segment_ids_mb is not None:
+        return _gpt_pipeline_loss_packed(
+            p, tokens_mb, targets_mb, loss_mask_mb, segment_ids_mb, cfg,
+            ctx, vpp, order_policy)
     positions = None
     if zigzag_active(cfg, ctx):
         # Zigzag cp layout (see gpt_forward): permute the sequence so each
@@ -310,3 +313,44 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
     logits = gpt_head(p, out_mb, cfg)
     loss, _ = cross_entropy_loss(logits, targets_mb, loss_mask_mb)
     return loss + aux, {"lm_loss": loss, "moe_aux_loss": aux}
+
+
+def _gpt_pipeline_loss_packed(p, tokens_mb, targets_mb, loss_mask_mb,
+                              segment_ids_mb, cfg: TransformerConfig, ctx,
+                              vpp: int, order_policy: str):
+    """Packed-sequence pipelined loss: per-token positions/rope tables and
+    segment ids flow as spmd_pipeline aux inputs; attention applies the
+    segment mask inside the pipeline body (reference packed/THD under pp)."""
+    from megatronapp_tpu.parallel.pipeline import spmd_pipeline
+
+    m, mb, s = tokens_mb.shape
+    flat_segs = segment_ids_mb.reshape(m * mb, s)
+    packed_pos = packed_position_ids(flat_segs)                # [M*mb, S]
+    h = gpt_embed(p, tokens_mb.reshape(m * mb, s), cfg, dtype=jnp.float32,
+                  position_ids=packed_pos)
+    h = h.reshape(m, mb, s, -1)
+
+    inv_freq, msc = rope_params(cfg)
+    aux = {"segs": segment_ids_mb}
+    if inv_freq is not None:
+        cos, sin = rotary.rope_cos_sin(packed_pos.reshape(m, mb, s),
+                                       inv_freq)              # [M,mb,S,half]
+        if msc != 1.0:
+            cos, sin = cos * msc, sin * msc
+        aux["cos"], aux["sin"] = cos, sin
+
+    def stage_fn(chunk_params, x, layer_offset, aux_m):
+        return block_forward(chunk_params, x, cfg, aux_m.get("cos"),
+                             aux_m.get("sin"), None,
+                             layer_offset=layer_offset, ctx=ctx,
+                             segment_ids=aux_m["segs"])
+
+    out_mb, aux_loss = spmd_pipeline(
+        stage_fn, p["block"], h, ctx, num_microbatches=m, vpp=vpp,
+        compute_dtype=cfg.compute_dtype, order_policy=order_policy,
+        aux_mb=aux)
+    aux_loss = aux_loss / m
+
+    logits = gpt_head(p, out_mb, cfg)
+    loss, _ = cross_entropy_loss(logits, targets_mb, loss_mask_mb)
+    return loss + aux_loss, {"lm_loss": loss, "moe_aux_loss": aux_loss}

@@ -54,10 +54,13 @@ def _block_scores(q, k, scale):
 
 
 def ring_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
-                   softmax_scale: Optional[float] = None):
+                   softmax_scale: Optional[float] = None,
+                   segment_ids=None):
     """Ring attention over the cp axis (inside shard_map).
 
     q,k,v: local [B, S/cp, H(q)/H(kv), D]. Returns [B, S/cp, H, D].
+    segment_ids: local [B, S/cp] packed map — kv segment ids ride the ring
+    with the k/v blocks and mask cross-segment scores.
     """
     cp = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -78,8 +81,9 @@ def ring_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
     l = zeros_like_vma((b, h, sq), jnp.float32, q)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
-    def block_update(o, m, l, k_blk, v_blk, src):
+    def block_update(o, m, l, k_blk, v_blk, src, kv_seg_blk=None):
         s = _block_scores(q, repeat_kv(k_blk, h), softmax_scale)  # [B,H,Sq,Skv]
+        blk_mask = None
         if causal:
             # Block-level: src > my → entirely masked; src == my → causal
             # within block; src < my → fully visible.
@@ -89,13 +93,20 @@ def ring_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
             blk_mask = jnp.where(
                 src == my, within,
                 jnp.broadcast_to(src < my, within.shape))
-            s = jnp.where(blk_mask[None, None], s, _NEG_INF)
+            blk_mask = jnp.broadcast_to(blk_mask[None, None],
+                                        s.shape)
+        if kv_seg_blk is not None:
+            seg = (segment_ids[:, None, :, None]
+                   == kv_seg_blk[:, None, None, :])
+            blk_mask = seg if blk_mask is None else blk_mask & seg
+        if blk_mask is not None:
+            s = jnp.where(blk_mask, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         # Guard fully-masked rows (m_new == -inf): keep exp argument finite.
         m_safe = jnp.maximum(m_new, _NEG_INF / 2)
         p = jnp.exp(s - m_safe[..., None])
-        if causal:
-            p = jnp.where(blk_mask[None, None], p, 0.0)
+        if blk_mask is not None:
+            p = jnp.where(blk_mask, p, 0.0)
         corr = jnp.exp(jnp.minimum(m - m_new, 0.0))
         corr = jnp.where(m <= _NEG_INF / 2, 0.0, corr)
         l = l * corr + jnp.sum(p, axis=-1)
@@ -107,20 +118,33 @@ def ring_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
 
     # Local block first, then cp-1 rotate-then-compute steps — the final
     # rotation (returning blocks home) would be wasted ICI traffic.
-    o, m, l = block_update(o, m, l, k, v, my)
+    o, m, l = block_update(o, m, l, k, v, my, segment_ids)
 
-    def body(carry, step):
-        o, m, l, k_blk, v_blk = carry
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        # After `step` rotations my shard holds the block originally from
-        # rank (my - step) mod cp.
-        src = (my - step) % cp
-        o, m, l = block_update(o, m, l, k_blk, v_blk, src)
-        return (o, m, l, k_blk, v_blk), None
+    if segment_ids is None:
+        def body(carry, step):
+            o, m, l, k_blk, v_blk = carry
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            # After `step` rotations my shard holds the block originally
+            # from rank (my - step) mod cp.
+            src = (my - step) % cp
+            o, m, l = block_update(o, m, l, k_blk, v_blk, src)
+            return (o, m, l, k_blk, v_blk), None
 
-    (o, m, l, _, _), _ = jax.lax.scan(body, (o, m, l, k, v),
-                                      jnp.arange(1, cp))
+        (o, m, l, _, _), _ = jax.lax.scan(body, (o, m, l, k, v),
+                                          jnp.arange(1, cp))
+    else:
+        def body(carry, step):
+            o, m, l, k_blk, v_blk, seg_blk = carry
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            seg_blk = jax.lax.ppermute(seg_blk, axis_name, perm)
+            src = (my - step) % cp
+            o, m, l = block_update(o, m, l, k_blk, v_blk, src, seg_blk)
+            return (o, m, l, k_blk, v_blk, seg_blk), None
+
+        (o, m, l, _, _, _), _ = jax.lax.scan(
+            body, (o, m, l, k, v, segment_ids), jnp.arange(1, cp))
     out = o / jnp.maximum(l, 1e-20)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Sq,H,D]
 
@@ -159,7 +183,8 @@ def zigzag_inverse_indices(seq_len: int, cp: int):
 
 def zigzag_ring_attention(q, k, v, axis_name: str = CP_AXIS,
                           causal: bool = True,
-                          softmax_scale: Optional[float] = None):
+                          softmax_scale: Optional[float] = None,
+                          segment_ids=None):
     """Causal-balanced ring attention over zigzag-laid-out sequences.
 
     q,k,v: local [B, S/cp, H, D] where the local block is [chunk_my ;
@@ -179,6 +204,9 @@ def zigzag_ring_attention(q, k, v, axis_name: str = CP_AXIS,
     type='p2p'); layout produced by get_batch_on_this_cp_rank-style
     permutation (training/utils.py).
     """
+    assert segment_ids is None, (
+        "packed sequences route through the contiguous ring "
+        "(zigzag_active excludes segment_ids)")
     if not causal:
         # Bidirectional attention has no imbalance; plain ring is optimal.
         return ring_attention(q, k, v, axis_name, causal=False,
@@ -260,7 +288,8 @@ def zigzag_ring_attention(q, k, v, axis_name: str = CP_AXIS,
 
 
 def ulysses_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
-                      softmax_scale: Optional[float] = None):
+                      softmax_scale: Optional[float] = None,
+                      segment_ids=None):
     """Ulysses-style all-to-all head-parallel attention (inside shard_map).
 
     Local [B, S/cp, H, D] → all-to-all → [B, S, H/cp, D] (full sequence,
@@ -284,17 +313,28 @@ def ulysses_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
                                   tiled=True)
 
     qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    attention_mask = None
+    if segment_ids is not None:
+        # Heads are sharded but the sequence is full after the a2a: gather
+        # the full segment map and densify (the a2a mode has no blockwise
+        # kernel to mask inside).
+        segs_full = jax.lax.all_gather(segment_ids, axis_name, axis=1,
+                                       tiled=True)
+        attention_mask = (segs_full[:, None, :, None]
+                          == segs_full[:, None, None, :])
     ctx = dot_product_attention(
         qh, kh, vh,
         mask_type=(AttnMaskType.causal if causal
                    else AttnMaskType.bidirectional),
+        attention_mask=attention_mask,
         softmax_scale=softmax_scale)
     return gather_heads(ctx)
 
 
 def allgather_attention(q, k, v, axis_name: str = CP_AXIS,
                         causal: bool = True,
-                        softmax_scale: Optional[float] = None):
+                        softmax_scale: Optional[float] = None,
+                        segment_ids=None):
     """All-gather K/V over cp, local q attends the full sequence (reference
     cp_comm_type='allgather')."""
     from megatronapp_tpu.ops.attention import dot_product_attention
@@ -305,10 +345,17 @@ def allgather_attention(q, k, v, axis_name: str = CP_AXIS,
     sq = q.shape[1]
     k_full = jax.lax.all_gather(k, axis_name, axis=1, tiled=True)
     v_full = jax.lax.all_gather(v, axis_name, axis=1, tiled=True)
+    attention_mask = None
+    if segment_ids is not None:
+        segs_full = jax.lax.all_gather(segment_ids, axis_name, axis=1,
+                                       tiled=True)
+        attention_mask = (segment_ids[:, None, :, None]
+                          == segs_full[:, None, None, :])
     return dot_product_attention(
         q, k_full, v_full,
         mask_type=(AttnMaskType.causal if causal
                    else AttnMaskType.bidirectional),
+        attention_mask=attention_mask,
         softmax_scale=softmax_scale,
         q_offset=my * sq)
 
@@ -340,11 +387,13 @@ def zigzag_active(cfg, ctx) -> bool:
 
 def context_attention(q, k, v, mesh, cp_comm_type: str = "p2p",
                       causal: bool = True,
-                      softmax_scale: Optional[float] = None):
+                      softmax_scale: Optional[float] = None,
+                      segment_ids=None):
     """Outer wrapper: shard_map over 'cp' (auto for all other axes).
 
     q,k,v: GLOBAL [B, S, H, D] arrays with S sharded over cp. Returns global
-    [B, S, H, D] with the same sharding.
+    [B, S, H, D] with the same sharding. segment_ids: GLOBAL [B, S] packed
+    map (sharded over cp alongside the sequence).
     """
     if cp_comm_type not in _CP_IMPLS:
         raise ValueError(
@@ -358,12 +407,21 @@ def context_attention(q, k, v, mesh, cp_comm_type: str = "p2p",
     # JAX build), q/k/v are already local seq blocks: call the impl directly.
     from megatronapp_tpu.parallel.collectives import current_manual_axes
     if CP_AXIS in current_manual_axes():
-        return fn(q, k, v)
+        return fn(q, k, v, segment_ids=segment_ids)
 
-    sm = jax.shard_map(
-        lambda q, k, v: fn(q, k, v),
+    if segment_ids is None:
+        sm = jax.jit(jax.shard_map(
+            lambda q, k, v: fn(q, k, v),
+            mesh=mesh,
+            in_specs=(P(None, CP_AXIS), P(None, CP_AXIS), P(None, CP_AXIS)),
+            out_specs=P(None, CP_AXIS),
+            axis_names={CP_AXIS}))
+        return sm(q, k, v)
+    sm = jax.jit(jax.shard_map(
+        lambda q, k, v, s: fn(q, k, v, segment_ids=s),
         mesh=mesh,
-        in_specs=(P(None, CP_AXIS), P(None, CP_AXIS), P(None, CP_AXIS)),
+        in_specs=(P(None, CP_AXIS), P(None, CP_AXIS), P(None, CP_AXIS),
+                  P(None, CP_AXIS)),
         out_specs=P(None, CP_AXIS),
-        axis_names={CP_AXIS})
-    return sm(q, k, v)
+        axis_names={CP_AXIS}))
+    return sm(q, k, v, segment_ids)

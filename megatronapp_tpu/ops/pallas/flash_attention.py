@@ -47,9 +47,14 @@ def _mask_rows(x, start, limit):
 # Forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc, m_scr, l_scr, *, scale, causal, block_q, block_kv,
-                num_kv, seq_q, seq_kv):
+def _fwd_kernel(*refs, scale, causal, block_q, block_kv,
+                num_kv, seq_q, seq_kv, has_segs):
+    if has_segs:
+        (q_ref, k_ref, v_ref, qs_ref, ks_ref,
+         o_ref, lse_ref, acc, m_scr, l_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr = refs
+        qs_ref = ks_ref = None
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -76,6 +81,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         valid = (rows < seq_q) & (cols < seq_kv)
         if causal:
             valid = valid & (rows >= cols)
+        if qs_ref is not None:
+            # Packed sequences: attend within-segment only (segment ids
+            # [bq,1] vs [1,bkv] broadcast to the score block).
+            valid = valid & (qs_ref[0] == ks_ref[0])
         s = jnp.where(valid, s, _NEG_INF)
 
         m_prev = m_scr[:, 0]
@@ -113,7 +122,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = lse[:, None]
 
 
-def _flash_forward(q, k, v, scale, causal, block_q, block_kv):
+def _flash_forward(q, k, v, scale, causal, block_q, block_kv, segs=None):
     b, h, sq, d = q.shape
     hkv, skv = k.shape[1], k.shape[2]
     group = h // hkv
@@ -124,19 +133,32 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_kv):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_kv=block_kv, num_kv=nk, seq_q=sq, seq_kv=skv)
+        block_kv=block_kv, num_kv=nk, seq_q=sq, seq_kv=skv,
+        has_segs=segs is not None)
 
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=(b, h, nq, nk),
-        in_specs=[
+    in_specs = [
             pl.BlockSpec((1, 1, block_q, d),
                          lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
             pl.BlockSpec((1, 1, block_kv, d),
                          lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
             pl.BlockSpec((1, 1, block_kv, d),
                          lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
-        ],
+    ]
+    inputs = [q, k, v]
+    if segs is not None:
+        q_segs, kv_segs = segs  # [B,Sq,1] / [B,1,Skv] int32
+        in_specs += [
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b_, h_, iq, ik: (b_, iq, 0)),
+            pl.BlockSpec((1, 1, block_kv),
+                         lambda b_, h_, iq, ik: (b_, 0, ik)),
+        ]
+        inputs += [q_segs, kv_segs]
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
@@ -153,7 +175,7 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_kv):
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*inputs)
     return out, lse[..., 0]
 
 
@@ -161,9 +183,15 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_kv):
 # Backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, block_q, block_kv, num_kv,
-                   seq_q, seq_kv):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_kv, num_kv,
+                   seq_q, seq_kv, has_segs):
+    if has_segs:
+        (q_ref, k_ref, v_ref, qs_ref, ks_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+        qs_ref = ks_ref = None
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -191,6 +219,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         valid = (rows < seq_q) & (cols < seq_kv)
         if causal:
             valid = valid & (rows >= cols)
+        if qs_ref is not None:
+            valid = valid & (qs_ref[0] == ks_ref[0])
         s = jnp.where(valid, s, _NEG_INF)
         p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(do.astype(v.dtype), v,
@@ -213,9 +243,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_kv, num_q, seq_q, seq_kv):
+def _bwd_dkv_kernel(*refs, scale, causal,
+                    block_q, block_kv, num_q, seq_q, seq_kv, has_segs):
+    if has_segs:
+        (q_ref, k_ref, v_ref, qs_ref, ks_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        qs_ref = ks_ref = None
     ik = pl.program_id(2)
     iq = pl.program_id(3)
 
@@ -245,6 +281,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         valid = (rows < seq_q) & (cols < seq_kv)
         if causal:
             valid = valid & (rows >= cols)
+        if qs_ref is not None:
+            valid = valid & (qs_ref[0] == ks_ref[0])
         s = jnp.where(valid, s, _NEG_INF)
         p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)  # [bq, bkv]
         # dv += p^T @ do
@@ -273,7 +311,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(res, g, scale, causal, block_q, block_kv):
+def _flash_backward(res, g, scale, causal, block_q, block_kv, segs=None):
     q, k, v, out, lse = res
     b, h, sq, d = q.shape
     hkv, skv = k.shape[1], k.shape[2]
@@ -288,54 +326,82 @@ def _flash_backward(res, g, scale, causal, block_q, block_kv):
     lse4 = lse[..., None]
     delta4 = delta[..., None]
 
+    dq_in_specs = [
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, iq, ik, g_=group: (b_, h_ // g_, ik, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, iq, ik, g_=group: (b_, h_ // g_, ik, 0)),
+    ]
+    dq_inputs = [q, k, v]
+    if segs is not None:
+        q_segs, kv_segs = segs
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b_, h_, iq, ik: (b_, iq, 0)),
+            pl.BlockSpec((1, 1, block_kv),
+                         lambda b_, h_, iq, ik: (b_, 0, ik)),
+        ]
+        dq_inputs += [q_segs, kv_segs]
+    dq_in_specs += [
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+    ]
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_kv=block_kv, num_kv=nk,
-                          seq_q=sq, seq_kv=skv),
+                          seq_q=sq, seq_kv=skv, has_segs=segs is not None),
         grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_kv, d),
-                         lambda b_, h_, iq, ik, g_=group: (b_, h_ // g_, ik, 0)),
-            pl.BlockSpec((1, 1, block_kv, d),
-                         lambda b_, h_, iq, ik, g_=group: (b_, h_ // g_, ik, 0)),
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, g, lse4, delta4)
+    )(*dq_inputs, g, lse4, delta4)
 
     # dk/dv computed at q-head granularity [B, H, Skv, D]; grouped heads are
     # reduced outside (GQA) — simple and correct; a fused variant can
     # accumulate in-kernel later.
+    dkv_in_specs = [
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, ik, iq, g_=group: (b_, h_ // g_, ik, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda b_, h_, ik, iq, g_=group: (b_, h_ // g_, ik, 0)),
+    ]
+    dkv_inputs = [q, k, v]
+    if segs is not None:
+        q_segs, kv_segs = segs
+        dkv_in_specs += [
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b_, h_, ik, iq: (b_, iq, 0)),
+            pl.BlockSpec((1, 1, block_kv),
+                         lambda b_, h_, ik, iq: (b_, 0, ik)),
+        ]
+        dkv_inputs += [q_segs, kv_segs]
+    dkv_in_specs += [
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
+    ]
+
     dk_full, dv_full = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_kv=block_kv, num_q=nq,
-                          seq_q=sq, seq_kv=skv),
+                          seq_q=sq, seq_kv=skv, has_segs=segs is not None),
         grid=(b, h, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_kv, d),
-                         lambda b_, h_, ik, iq, g_=group: (b_, h_ // g_, ik, 0)),
-            pl.BlockSpec((1, 1, block_kv, d),
-                         lambda b_, h_, ik, iq, g_=group: (b_, h_ // g_, ik, 0)),
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_kv, d),
                          lambda b_, h_, ik, iq: (b_, h_, ik, 0)),
@@ -351,7 +417,7 @@ def _flash_backward(res, g, scale, causal, block_q, block_kv):
             pltpu.VMEM((block_kv, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, g, lse4, delta4)
+    )(*dkv_inputs, g, lse4, delta4)
 
     if group > 1:
         dk = dk_full.reshape(b, hkv, group, skv, d).sum(axis=2)
@@ -383,13 +449,48 @@ def _bwd_rule(scale, causal, block_q, block_kv, res, g):
 _flash_attention_bhsd.defvjp(_fwd_rule, _bwd_rule)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_attention_seg_bhsd(q, k, v, q_segs, kv_segs, scale, causal,
+                              block_q, block_kv):
+    out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_kv,
+                            segs=(q_segs, kv_segs))
+    return out
+
+
+def _seg_fwd_rule(q, k, v, q_segs, kv_segs, scale, causal, block_q,
+                  block_kv):
+    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_kv,
+                              segs=(q_segs, kv_segs))
+    return out, (q, k, v, out, lse, q_segs, kv_segs)
+
+
+def _seg_bwd_rule(scale, causal, block_q, block_kv, res, g):
+    q, k, v, out, lse, q_segs, kv_segs = res
+    dq, dk, dv = _flash_backward((q, k, v, out, lse), g, scale, causal,
+                                 block_q, block_kv, segs=(q_segs, kv_segs))
+    # Integer segment ids take float0 cotangents.
+    import numpy as np
+    f0 = jax.dtypes.float0
+    return (dq, dk, dv, np.zeros(q_segs.shape, f0),
+            np.zeros(kv_segs.shape, f0))
+
+
+_flash_attention_seg_bhsd.defvjp(_seg_fwd_rule, _seg_bwd_rule)
+
+
 def flash_attention(q, k, v, causal: bool = True,
                     softmax_scale: Optional[float] = None,
-                    block_q: int = 512, block_kv: int = 512):
+                    block_q: int = 512, block_kv: int = 512,
+                    segment_ids: Optional[jnp.ndarray] = None):
     """Flash attention on [B, S, H, D] tensors (GQA-aware).
 
     Returns [B, Sq, H, D]. Drop-in for ops.attention.dot_product_attention's
     causal/bidirectional paths.
+
+    segment_ids: optional [B, S] int packing map — attention is restricted
+    to within-segment (packed sequences, reference THD/packed_seq_params
+    semantics) with the same O(S) memory profile; segment masking composes
+    with the causal block-skip.
     """
     b, sq, h, d = q.shape
     if softmax_scale is None:
@@ -397,6 +498,12 @@ def flash_attention(q, k, v, causal: bool = True,
     qt = jnp.swapaxes(q, 1, 2)   # [B,H,S,D]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = _flash_attention_bhsd(qt, kt, vt, float(softmax_scale), causal,
-                                block_q, block_kv)
+    if segment_ids is None:
+        out = _flash_attention_bhsd(qt, kt, vt, float(softmax_scale),
+                                    causal, block_q, block_kv)
+    else:
+        segs = segment_ids.astype(jnp.int32)
+        out = _flash_attention_seg_bhsd(
+            qt, kt, vt, segs[:, :, None], segs[:, None, :],
+            float(softmax_scale), causal, block_q, block_kv)
     return jnp.swapaxes(out, 1, 2)

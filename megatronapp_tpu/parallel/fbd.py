@@ -3,10 +3,10 @@
 Parity with the reference MegaFBD module (SURVEY §2.2): the reference splits
 each pipeline stage into a forward instance and a backward instance on
 different GPUs (rank parity picks fwd vs bwd, parallel_state.py:444-452; DP
-is halved :453), forward ranks run grad-free forward
-(forward_step_no_grad, schedules.py:355) and ship each input activation to
-the paired backward rank (send_corresponding_forward :1866), which
-recomputes forward WITH grad and runs backward
+is halved :453). Forward ranks run the grad-free forward
+(forward_step_no_grad, schedules.py:355) and SHIP activations to the paired
+backward rank (send_corresponding_forward, schedules.py:1866 →
+p2p_communication.py:723), which completes the gradient computation
 (forward_or_backward_pipelining_without_interleaving, schedules.py:2208).
 A thread/bitvector coordinator arbitrates collectives
 (virtual_tensor_parallel_communication.py:165-403).
@@ -16,24 +16,32 @@ meshes ... the coordinator problem disappears (XLA schedules collectives)
 but the placement policy remains"):
 
 - The device set splits into a FORWARD mesh and a BACKWARD mesh (DP halved
-  on each, exactly the reference's rank accounting).
-- The forward mesh runs the grad-free forward (loss/metrics/MegaScope
-  captures, NaN validation — everything the reference fwd instance
-  produces); the backward mesh recomputes forward with grad and applies the
-  update (the reference bwd instance's recompute-with-grad).
-- The two dispatches are asynchronous: while the backward mesh grinds
-  through grads for batch i, the forward mesh is already validating batch
-  i+1 — the overlap MegaFBD buys, without controller ranks or thread-level
-  collective emulation (the XLA runtime owns scheduling).
-- Updated params stream back to the forward mesh each step
-  (device_put across meshes rides ICI/DCN; the reference ships params
-  implicitly by running both instances from the same checkpoint).
+  on each — the reference's rank accounting).
+- Per microbatch, the forward mesh runs the vjp FORWARD pass and ships the
+  pullback's residuals (the saved activations) to the backward mesh — the
+  analogue of send_corresponding_forward, except the backward mesh applies
+  the transposed computation DIRECTLY instead of recomputing the forward
+  with grad (XLA autodiff makes the handoff exact: residuals + cotangent
+  in, parameter grads out; nothing is computed twice).
+- The two dispatch queues overlap WITHIN an optimizer step: while the
+  backward mesh grinds through the pullback of microbatch m, the forward
+  mesh is already computing microbatch m+1 — MegaFBD's overlap, without
+  controller ranks or thread-level collective emulation (the XLA runtime
+  owns scheduling, and the host loop never blocks between dispatches).
+- Gradients accumulate on the backward mesh; the optimizer update runs
+  there once per step and the new params stream back to the forward mesh
+  (the reference ships params implicitly by running both instances from
+  the same checkpoint).
+- Composes with tp/pp/cp: the loss_fn (including the pipelined
+  gpt_pipeline_loss) runs under each half-mesh's own compiler sharding; the
+  vjp residual transfer retargets each leaf's NamedSharding spec onto the
+  twin mesh (same axis names, disjoint devices).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -59,101 +67,146 @@ def split_fbd_meshes(parallel: ParallelConfig, devices=None
                                    forward_backward_disaggregating=False)
     fwd_ctx = build_mesh(half_cfg, devices=devices[: n // 2])
     bwd_ctx = build_mesh(half_cfg, devices=devices[n // 2:])
+    # Abstract-mesh collectives: the fwd pass's pullback must be executable
+    # on the twin mesh (see MeshContext.shard_map_mesh).
+    fwd_ctx.abstract_collectives = True
+    bwd_ctx.abstract_collectives = True
     return fwd_ctx, bwd_ctx
-
-
-class FBDExecutor:
-    """Runs training with forward and backward on disjoint meshes.
-
-    loss_fn(params, microbatch) -> (loss, metrics) as in make_train_step.
-    """
-
-    def __init__(self, loss_fn: Callable, optimizer, fwd_ctx: MeshContext,
-                 bwd_ctx: MeshContext, state, state_shardings):
-        self.fwd_ctx = fwd_ctx
-        self.bwd_ctx = bwd_ctx
-        self.optimizer = optimizer
-
-        # Master state lives on the backward mesh.
-        self.state = jax.device_put(
-            jax.device_get(state),
-            jax.tree.map(lambda s: _retarget(s, bwd_ctx), state_shardings))
-        self._params_shardings_bwd = jax.tree.map(
-            lambda s: _retarget(s, bwd_ctx), state_shardings)["params"]
-        self._params_shardings_fwd = jax.tree.map(
-            lambda s: _retarget(s, fwd_ctx), state_shardings)["params"]
-        # Mirror of params on the forward mesh.
-        self.params_fwd = jax.device_put(
-            jax.device_get(self.state["params"]), self._params_shardings_fwd)
-
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-
-        def fwd_only(params, batch_mb):
-            # Grad-free forward over the microbatches (reference
-            # forward_step_no_grad).
-            def body(acc, micro):
-                loss, _ = loss_fn(params, micro)
-                return acc + loss, None
-            total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
-                                    batch_mb)
-            return total / batch_mb["tokens"].shape[0]
-
-        def bwd_step(state, batch_mb):
-            # Microbatched grad accumulation (same math as the main path's
-            # make_train_step scan).
-            params = state["params"]
-
-            def accum(carry, micro):
-                g_acc, loss_acc = carry
-                (loss, _), g = grad_fn(params, micro)
-                return (jax.tree.map(lambda a, b: a + b.astype(a.dtype),
-                                     g_acc, g), loss_acc + loss), None
-
-            zeros = jax.tree.map(lambda q: jnp.zeros(q.shape, jnp.float32),
-                                 params)
-            (g_sum, loss_sum), _ = jax.lax.scan(
-                accum, (zeros, jnp.zeros((), jnp.float32)), batch_mb)
-            num_micro = batch_mb["tokens"].shape[0]
-            grads = jax.tree.map(lambda g: g / num_micro, g_sum)
-            updates, new_opt = optimizer.update(
-                grads, state["opt_state"], params)
-            new_params = jax.tree.map(
-                lambda p, u: p + u.astype(p.dtype), params, updates)
-            return ({"step": state["step"] + 1, "params": new_params,
-                     "opt_state": new_opt}, loss_sum / num_micro)
-
-        self._fwd_only = jax.jit(fwd_only)
-        self._bwd_step = jax.jit(bwd_step, donate_argnums=(0,))
-
-    def step(self, batch_mb: Dict[str, np.ndarray]) -> Dict[str, Any]:
-        """One disaggregated step over a microbatched batch
-        [num_micro, mb, S]: dispatch grad-free forward on the fwd mesh and
-        recompute+backward on the bwd mesh; both run concurrently (async
-        dispatch — losses are returned as DEVICE arrays so steps pipeline;
-        callers device_get only when logging), then params stream back to
-        the fwd mesh."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        fwd_sh = NamedSharding(
-            self.fwd_ctx.mesh,
-            P(None, *self.fwd_ctx.batch_spec(seq_sharded=False)))
-        bwd_sh = NamedSharding(
-            self.bwd_ctx.mesh,
-            P(None, *self.bwd_ctx.batch_spec(seq_sharded=False)))
-        micro_fwd = jax.device_put(batch_mb, fwd_sh)
-        micro_bwd = jax.device_put(batch_mb, bwd_sh)
-
-        with self.fwd_ctx.mesh:
-            fwd_loss = self._fwd_only(self.params_fwd, micro_fwd)
-        with self.bwd_ctx.mesh:
-            self.state, bwd_loss = self._bwd_step(self.state, micro_bwd)
-        # Stream updated params to the forward mesh (the reference's fwd
-        # instances likewise track their bwd twin's weights).
-        self.params_fwd = jax.device_put(self.state["params"],
-                                         self._params_shardings_fwd)
-        return {"loss": bwd_loss, "fwd_loss": fwd_loss}
 
 
 def _retarget(sharding, ctx: MeshContext):
     """Rebuild a NamedSharding against another mesh (same spec)."""
     from jax.sharding import NamedSharding
     return NamedSharding(ctx.mesh, sharding.spec)
+
+
+class FBDExecutor:
+    """Runs training with forward and backward on disjoint meshes.
+
+    loss_fn(params, batch, ctx) -> (loss, metrics); ctx is the half-mesh
+    the call executes on (fwd mesh for the forward pass — its transposed
+    pullback then runs on the bwd mesh).
+
+    pipeline=True: loss_fn consumes the whole microbatched batch at once
+    (the SPMD pipeline schedules microbatches internally), so one
+    fwd/ship/bwd handoff happens per optimizer step.
+    """
+
+    def __init__(self, loss_fn: Callable, optimizer, fwd_ctx: MeshContext,
+                 bwd_ctx: MeshContext, state, state_shardings,
+                 pipeline: bool = False):
+        self.fwd_ctx = fwd_ctx
+        self.bwd_ctx = bwd_ctx
+        self.optimizer = optimizer
+        self.pipeline = pipeline
+
+        # Master state lives on the backward mesh.
+        self.state = jax.device_put(
+            jax.device_get(state),
+            jax.tree.map(lambda s: _retarget(s, bwd_ctx), state_shardings))
+        self._params_shardings_fwd = jax.tree.map(
+            lambda s: _retarget(s, fwd_ctx), state_shardings)["params"]
+        # Mirror of params on the forward mesh.
+        self.params_fwd = jax.device_put(
+            jax.device_get(self.state["params"]), self._params_shardings_fwd)
+
+        def fwd_one(params, micro):
+            # vjp forward pass only (reference forward_step_no_grad, plus
+            # residual stashing): loss + metrics + the pullback whose
+            # pytree leaves are the saved activations.
+            loss, pullback, aux = jax.vjp(
+                lambda p: loss_fn(p, micro, fwd_ctx), params, has_aux=True)
+            return loss, aux, pullback
+
+        def bwd_accum(g_acc, loss_acc, pullback, loss):
+            # Transposed pass on the shipped residuals: cotangent 1.0 on
+            # the loss → parameter grads; accumulate in fp32.
+            (g,) = pullback(jnp.ones((), jnp.float32))
+            g_acc = jax.tree.map(lambda a, b: a + b.astype(a.dtype),
+                                 g_acc, g)
+            return g_acc, loss_acc + loss
+
+        def apply_update(state, g_sum, loss_sum, inv_num_micro):
+            params = state["params"]
+            grads = jax.tree.map(lambda g: g * inv_num_micro, g_sum)
+            import optax
+            grad_norm = optax.global_norm(grads)
+            updates, new_opt = optimizer.update(
+                grads, state["opt_state"], params)
+            new_params = jax.tree.map(
+                lambda p, u: p + u.astype(p.dtype), params, updates)
+            new_state = {"step": state["step"] + 1, "params": new_params,
+                         "opt_state": new_opt}
+            return new_state, loss_sum * inv_num_micro, grad_norm
+
+        self._fwd_one = jax.jit(fwd_one)
+        self._bwd_accum = jax.jit(bwd_accum, donate_argnums=(0, 1))
+        self._apply = jax.jit(apply_update, donate_argnums=(0, 1))
+        self._zeros = jax.jit(
+            lambda p: jax.tree.map(
+                lambda q: jnp.zeros(q.shape, jnp.float32), p))
+
+    def _ship(self, pullback):
+        """Move the pullback's residual leaves fwd→bwd mesh, preserving
+        each leaf's partitioning (same axis names on the twin mesh). This
+        is the activation handoff (reference p2p_communication.py:723)."""
+        leaves, treedef = jax.tree.flatten(pullback)
+        moved = [jax.device_put(
+            leaf, _retarget(leaf.sharding, self.bwd_ctx))
+            for leaf in leaves]
+        return jax.tree.unflatten(treedef, moved)
+
+    def step(self, batch_mb: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """One disaggregated step over a microbatched batch [M, mb, S].
+
+        The host loop dispatches fwd(m) and bwd(m-1) without blocking, so
+        the forward mesh computes microbatch m while the backward mesh
+        transposes microbatch m-1 (MegaFBD's overlap). Losses return as
+        DEVICE arrays; callers device_get only when logging."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        num_micro = jax.tree.leaves(batch_mb)[0].shape[0]
+        bwd_rep = NamedSharding(self.bwd_ctx.mesh, P())
+
+        g_acc = self._zeros(self.state["params"])
+        loss_acc = jax.device_put(jnp.zeros((), jnp.float32), bwd_rep)
+        fwd_loss_sum = None
+        if self.pipeline:
+            # The pipeline loss consumes [M, mb, S] whole; one handoff.
+            fwd_sh = NamedSharding(
+                self.fwd_ctx.mesh,
+                P(None, *self.fwd_ctx.batch_spec(seq_sharded=False)))
+            micros = [jax.device_put(batch_mb, fwd_sh)]
+            num_micro = 1
+        else:
+            fwd_sh = NamedSharding(
+                self.fwd_ctx.mesh,
+                P(*self.fwd_ctx.batch_spec(seq_sharded=False)))
+            micros = [jax.device_put(
+                jax.tree.map(lambda x: x[m], batch_mb), fwd_sh)
+                for m in range(num_micro)]
+        for micro in micros:
+            loss, aux, pullback = self._fwd_one(self.params_fwd, micro)
+            # Mean over microbatches (stays on the fwd mesh) so the
+            # fwd/bwd loss cross-check compares like with like.
+            fwd_loss_sum = (loss if fwd_loss_sum is None
+                            else fwd_loss_sum + loss)
+            # Ship residuals + per-microbatch loss to the backward mesh.
+            pb_b = self._ship(pullback)
+            loss_b = jax.device_put(loss, bwd_rep)
+            g_acc, loss_acc = self._bwd_accum(g_acc, loss_acc, pb_b, loss_b)
+
+        self.state, mean_loss, grad_norm = self._apply(
+            self.state, g_acc, loss_acc, 1.0 / num_micro)
+        # Stream updated params to the forward mesh for the next step.
+        self.params_fwd = jax.device_put(self.state["params"],
+                                         self._params_shardings_fwd)
+        return {"loss": mean_loss,
+                "fwd_loss": fwd_loss_sum / len(micros),
+                "grad_norm": grad_norm}
+
+    def set_state(self, state):
+        """Install a restored checkpoint state (bwd-mesh master + fwd
+        params mirror)."""
+        self.state = state
+        self.params_fwd = jax.device_put(
+            jax.device_get(state["params"]), self._params_shardings_fwd)

@@ -30,6 +30,18 @@ class MeshContext:
 
     mesh: Mesh
     parallel: ParallelConfig
+    # FBD half-meshes set this: shard_maps then bind the ABSTRACT mesh
+    # (axis names only) and resolve devices from argument shardings, so a
+    # vjp pullback traced on the forward mesh can execute on the backward
+    # mesh. Default False — eager abstract-mesh shard_maps on unsharded
+    # args are not supported by this XLA build.
+    abstract_collectives: bool = False
+
+    @property
+    def shard_map_mesh(self):
+        """The mesh object to pass to jax.shard_map."""
+        return (self.mesh.abstract_mesh if self.abstract_collectives
+                else self.mesh)
 
     # --- degree accessors (parity with parallel_state get_*_world_size) ---
     @property

@@ -69,6 +69,7 @@ def spmd_pipeline(
     vpp: int = 1,
     compute_dtype=jnp.bfloat16,
     order_policy: str = "dfc",
+    aux_mb: Any = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Run the pipelined layer stack.
 
@@ -88,6 +89,14 @@ def spmd_pipeline(
     tp/dp/cp/ep. Rematerialization is stage_fn's responsibility (the block's
     remat_policy wraps each layer, so the schedule stores only per-layer
     inputs per in-flight microbatch — the 1F1B memory profile).
+
+    aux_mb: optional pytree of [M, ...] per-microbatch side inputs (packed
+    segment ids, per-token rope tables). Unlike activations these do NOT
+    ride the stage ring — every stage indexes the microbatch it is
+    currently processing directly (the schedule makes m a pure function of
+    (step, stage)); stage_fn then takes a 4th argument with the indexed
+    pytree. Leaves with a sequence axis (dim 2 of [M, mb, S, ...]) are
+    cp-sharded like the activations.
     pipe_params: [pp, vpp, Lc, ...] pytree (leading axis sharded over pp).
     h_mb: [M, mb, S, H] microbatched hidden states (e.g. embeddings) — must
     be fp32 when pp > 1 (cast to compute_dtype happens inside; see body).
@@ -101,11 +110,21 @@ def spmd_pipeline(
         merged = jax.tree.map(lambda x: x.reshape(-1, *x.shape[3:]),
                               pipe_params)
 
-        def body(aux, h):
-            out, a = stage_fn(merged, h, 0)
-            return aux + a, out
+        if aux_mb is None:
+            def body(acc, h):
+                out, a = stage_fn(merged, h, 0)
+                return acc + a, out
 
-        aux, outs = jax.lax.scan(body, jnp.zeros((), jnp.float32), h_mb)
+            aux, outs = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                     h_mb)
+        else:
+            def body(acc, inp):
+                h, aux_m = inp
+                out, a = stage_fn(merged, h, 0, aux_m)
+                return acc + a, out
+
+            aux, outs = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                     (h_mb, aux_mb))
         return outs, aux
     if order_policy not in ("dfc", "bfc"):
         raise ValueError(f"order_policy must be 'dfc' or 'bfc', got "
@@ -128,14 +147,16 @@ def spmd_pipeline(
             chunk_params = jax.tree.map(lambda x, c=c: x[:, c:c + 1],
                                         pipe_params)
 
-            def shifted(p_, x, off, _c=c):
+            def shifted(p_, x, off, *rest, _c=c):
                 # Global layer index = (c*pp + stage)*Lc; the inner vpp=1
-                # schedule supplies stage*Lc.
-                return stage_fn(p_, x, off + _c * pp * lc)
+                # schedule supplies stage*Lc. *rest forwards the optional
+                # per-microbatch aux pytree.
+                return stage_fn(p_, x, off + _c * pp * lc, *rest)
 
             out, aux = spmd_pipeline(
                 shifted, chunk_params, h, ctx, M, vpp=1,
-                compute_dtype=compute_dtype, order_policy="dfc")
+                compute_dtype=compute_dtype, order_policy="dfc",
+                aux_mb=aux_mb)
             aux_total = aux_total + aux
             h = out.astype(jnp.float32)
         return out, aux_total
@@ -151,13 +172,15 @@ def spmd_pipeline(
     cp = ctx.cp
     manual_axes = {PP_AXIS} | ({CP_AXIS} if cp > 1 else set())
 
-    def body(params_local, h_mb_in):
+    def body(params_local, h_mb_in, aux_mb_in):
         # params_local: [1, vpp, Lc, ...]; h_mb_in: [M, mb, S(/cp), H].
         # h_mb_in MUST be fp32 at this boundary: its transpose-psum (and the
         # pcast below) must not be a bf16 manual all-reduce (XLA:CPU bug —
         # see collectives.zeros_like_vma). Casting to the compute dtype
         # happens per injection, after the pcast.
         h_mb_in = jax.lax.pcast(h_mb_in, (PP_AXIS,), to="varying")
+        aux_mb_in = jax.tree.map(
+            lambda a: jax.lax.pcast(a, (PP_AXIS,), to="varying"), aux_mb_in)
         stage = jax.lax.axis_index(PP_AXIS)
         params_s = jax.tree.map(lambda x: x[0], params_local)
         if cp > 1:
@@ -198,7 +221,13 @@ def spmd_pipeline(
                                                        keepdims=False),
                 params_s)
             layer_offset = (chunk * pp + stage) * layers_per_chunk
-            y, a = stage_fn(chunk_params, x, layer_offset)
+            if aux_mb_in:
+                aux_m = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, m_safe, keepdims=False), aux_mb_in)
+                y, a = stage_fn(chunk_params, x, layer_offset, aux_m)
+            else:
+                y, a = stage_fn(chunk_params, x, layer_offset)
             aux = aux + jnp.where(active, a, 0.0)
 
             # Last stage, last chunk → collect output.
@@ -226,10 +255,18 @@ def spmd_pipeline(
     h_spec = P(None, None, CP_AXIS) if cp > 1 else P(None)
     out_spec = (P(PP_AXIS, None, None, CP_AXIS) if cp > 1
                 else P(PP_AXIS))
-    sm = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(P(PP_AXIS), h_spec),
+    aux_mb = {} if aux_mb is None else aux_mb
+    if cp > 1:
+        # Leaves [M, mb, S, ...]: sequence axis (dim 2) cp-sharded.
+        aux_specs = jax.tree.map(
+            lambda a: P(*([None, None, CP_AXIS]
+                          + [None] * (a.ndim - 3))), aux_mb)
+    else:
+        aux_specs = jax.tree.map(lambda a: P(None), aux_mb)
+    sm = jax.jit(jax.shard_map(
+        body, mesh=ctx.shard_map_mesh,
+        in_specs=(P(PP_AXIS), h_spec, aux_specs),
         out_specs=(out_spec, P(PP_AXIS)),
-        axis_names=manual_axes)
-    outputs_all, aux_all = sm(pipe_params, h_mb)
+        axis_names=manual_axes))
+    outputs_all, aux_all = sm(pipe_params, h_mb, aux_mb)
     return outputs_all[-1], aux_all[0]

@@ -168,9 +168,18 @@ class TrainingScopeServer:
                 await ws.send_json({"type": "error", "message": str(e)})
         return ws
 
+    async def handle_index(self, request):
+        import os
+
+        from aiohttp import web
+        path = os.path.join(os.path.dirname(__file__), "frontend",
+                            "index.html")
+        return web.FileResponse(path)
+
     def build_app(self):
         from aiohttp import web
         app = web.Application()
+        app.router.add_get("/", self.handle_index)
         app.router.add_get("/ws", self.handle_ws)
         return app
 

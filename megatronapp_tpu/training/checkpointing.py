@@ -64,3 +64,54 @@ class CheckpointManager:
 
     def close(self):
         self._mngr.close()
+
+
+class LocalCheckpointManager:
+    """Fast non-persistent local checkpoints (reference
+    --non-persistent-ckpt-type local, training.py:700-727:
+    LocalCheckpointManager + CliqueReplicationStrategy).
+
+    Latest-only flat .npz with atomic rename: cheap enough to save every
+    few steps for fast node-failure restarts, independent of the durable
+    Orbax checkpoints. Multi-host replication (the clique strategy) maps to
+    each process writing its own file; a restarted process can read any
+    clique member's copy over the shared/local filesystem.
+    """
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._path = os.path.join(
+            self.directory, f"local_ckpt_p{jax.process_index()}.npz")
+
+    def save(self, step: int, state: Any):
+        leaves, treedef = jax.tree.flatten(jax.device_get(state))
+        payload = {f"leaf_{i}": np.asarray(x)
+                   for i, x in enumerate(leaves)}
+        payload["__step__"] = np.asarray(step)
+        tmp = self._path + ".tmp"
+        np.savez(tmp, **payload)
+        # np.savez appends .npz to names without it.
+        os.replace(tmp + ".npz" if os.path.exists(tmp + ".npz") else tmp,
+                   self._path)
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        if not os.path.exists(self._path):
+            return None
+        with np.load(self._path) as z:
+            return int(z["__step__"])
+
+    def restore(self, state_struct: Any) -> Optional[Any]:
+        """Restore into the structure (and shardings) of state_struct."""
+        if not os.path.exists(self._path):
+            return None
+        leaves, treedef = jax.tree.flatten(state_struct)
+        with np.load(self._path) as z:
+            new_leaves = [z[f"leaf_{i}"] for i in range(len(leaves))]
+        restored = jax.tree.unflatten(treedef, new_leaves)
+        leaf_shardings = [getattr(x, "sharding", None) for x in leaves]
+        if all(s is not None for s in leaf_shardings):
+            restored = jax.device_put(
+                restored, jax.tree.unflatten(treedef, leaf_shardings))
+        return restored

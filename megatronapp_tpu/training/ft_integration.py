@@ -1,0 +1,144 @@
+"""Fault-tolerance integration: heartbeat monitor with section timeouts.
+
+Parity with /root/reference/megatron/training/ft_integration.py (the
+NVIDIA resiliency-ext "rank monitor" bridge): the training process emits
+heartbeats tagged with the current SECTION (setup / step / checkpointing);
+a watchdog thread flags the run as hung when the active section exceeds its
+timeout, and `maybe_setup_simulated_fault` injects a delayed hang/crash for
+drills (reference maybe_setup_simulated_fault).
+
+TPU-native notes: heartbeats also land in a small JSON file
+(`<dir>/heartbeat.json`, atomic rename) so an EXTERNAL supervisor — the
+analogue of the reference's separate rank-monitor process — can detect a
+dead/hung training process from outside even when the in-process watchdog
+is itself wedged by the same hang.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+@dataclasses.dataclass
+class FTConfig:
+    """Section timeouts in seconds (reference --calc-ft-timeouts
+    defaults)."""
+    setup_timeout: float = 600.0
+    step_timeout: float = 180.0
+    checkpointing_timeout: float = 600.0
+    check_interval: float = 5.0
+    heartbeat_dir: Optional[str] = None
+
+
+class HeartbeatMonitor:
+    """In-process watchdog + on-disk heartbeat file."""
+
+    def __init__(self, cfg: FTConfig,
+                 on_timeout: Optional[Callable[[str, float], None]] = None):
+        self.cfg = cfg
+        self.on_timeout = on_timeout or self._default_on_timeout
+        self._section = "setup"
+        self._last_beat = time.monotonic()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.timed_out_sections: list = []
+
+    # -- section lifecycle -------------------------------------------------
+    def start_section(self, section: str):
+        assert section in ("setup", "step", "checkpointing"), section
+        with self._lock:
+            self._section = section
+            self._last_beat = time.monotonic()
+        self._write_heartbeat()
+
+    def beat(self):
+        with self._lock:
+            self._last_beat = time.monotonic()
+        self._write_heartbeat()
+
+    def _timeout_for(self, section: str) -> float:
+        return {"setup": self.cfg.setup_timeout,
+                "step": self.cfg.step_timeout,
+                "checkpointing": self.cfg.checkpointing_timeout}[section]
+
+    # -- watchdog ----------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.cfg.check_interval * 2)
+
+    def _run(self):
+        while not self._stop.wait(self.cfg.check_interval):
+            with self._lock:
+                section = self._section
+                idle = time.monotonic() - self._last_beat
+            limit = self._timeout_for(section)
+            if idle > limit:
+                self.timed_out_sections.append(section)
+                self.on_timeout(section, idle)
+
+    def _default_on_timeout(self, section: str, idle: float):
+        print(f"ft: section {section!r} exceeded its timeout "
+              f"({idle:.0f}s > {self._timeout_for(section):.0f}s) — "
+              f"rank appears hung", flush=True)
+
+    def _write_heartbeat(self):
+        if not self.cfg.heartbeat_dir:
+            return
+        os.makedirs(self.cfg.heartbeat_dir, exist_ok=True)
+        path = os.path.join(self.cfg.heartbeat_dir, "heartbeat.json")
+        tmp = path + ".tmp"
+        with self._lock:
+            payload = {"section": self._section, "ts": time.time(),
+                       "pid": os.getpid()}
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+
+
+def read_heartbeat(heartbeat_dir: str,
+                   stale_after: float = 60.0) -> Dict:
+    """External-supervisor view: {'alive': bool, 'section', 'age'} from the
+    heartbeat file (the out-of-process detection path)."""
+    path = os.path.join(heartbeat_dir, "heartbeat.json")
+    if not os.path.exists(path):
+        return {"alive": False, "section": None, "age": None}
+    with open(path) as f:
+        hb = json.load(f)
+    age = time.time() - hb["ts"]
+    return {"alive": age < stale_after, "section": hb["section"],
+            "age": age}
+
+
+def maybe_setup_simulated_fault(kind: Optional[str], delay_s: float,
+                                target: Optional[Callable] = None):
+    """Schedule a fault for FT drills (reference
+    maybe_setup_simulated_fault): kind 'hang' blocks the caller-provided
+    target hook; 'exit' hard-exits the process after `delay_s`."""
+    if not kind:
+        return None
+    assert kind in ("hang", "exit"), kind
+
+    def fire():
+        time.sleep(delay_s)
+        if kind == "exit":
+            print(f"ft: simulated fault 'exit' firing after {delay_s}s",
+                  flush=True)
+            os._exit(42)
+        if target is not None:
+            target()
+
+    t = threading.Thread(target=fire, daemon=True)
+    t.start()
+    return t

@@ -59,6 +59,33 @@ class TensorBoardSink:
             self._w.close()
 
 
+class WandbSink:
+    """Optional (reference training/wandb_utils.py): degrades to a no-op
+    with a warning when the wandb package is absent (this image ships
+    without it — the sink exists for deployments that have it)."""
+
+    def __init__(self, project: str, name: Optional[str] = None,
+                 config: Optional[dict] = None, warn=None):
+        try:
+            import wandb
+            self._run = wandb.init(project=project, name=name,
+                                   config=config or {})
+            self._wandb = wandb
+        except Exception as e:
+            if warn is not None:
+                warn(f"wandb sink disabled: {type(e).__name__}: {e}")
+            self._run = None
+
+    def log(self, step: int, metrics: Dict[str, float]):
+        if self._run is None:
+            return
+        self._wandb.log(dict(metrics), step=step)
+
+    def close(self):
+        if self._run is not None:
+            self._run.finish()
+
+
 class MetricsLogger:
     def __init__(self):
         self._sinks: List = []
@@ -69,6 +96,11 @@ class MetricsLogger:
 
     def add_tensorboard(self, log_dir: str, warn=None):
         self._sinks.append(TensorBoardSink(log_dir, warn=warn))
+        return self
+
+    def add_wandb(self, project: str, name: Optional[str] = None,
+                  config: Optional[dict] = None, warn=None):
+        self._sinks.append(WandbSink(project, name, config, warn=warn))
         return self
 
     def log(self, step: int, metrics: Dict[str, float]):

@@ -51,6 +51,30 @@ def reshape_global_batch(batch: Dict[str, np.ndarray], num_micro: int
     return {k: r(v) for k, v in batch.items()}
 
 
+class _RowBuffer:
+    """Takes exactly-n sample rows from a fixed-size batch stream without
+    dropping any (batch-size rampup consumes fewer rows than the stream's
+    batch size; leftovers carry into the next step so consumed-samples
+    bookkeeping matches the stream position exactly)."""
+
+    def __init__(self, batch_iter):
+        self._iter = batch_iter
+        self._buf: Optional[Dict[str, np.ndarray]] = None
+
+    def take(self, n: int) -> Dict[str, np.ndarray]:
+        while self._buf is None or                 next(iter(self._buf.values())).shape[0] < n:
+            nxt = next(self._iter)
+            if self._buf is None:
+                self._buf = dict(nxt)
+            else:
+                self._buf = {k: np.concatenate([self._buf[k], nxt[k]])
+                             for k in self._buf}
+        out = {k: v[:n] for k, v in self._buf.items()}
+        rest = {k: v[n:] for k, v in self._buf.items()}
+        self._buf = (rest if next(iter(rest.values())).shape[0] else None)
+        return out
+
+
 def gpt_microbatch_loss(cfg: TransformerConfig, ctx=None):
     def loss_fn(params, micro):
         loss, metrics = gpt_loss(params, micro["tokens"], micro["labels"],
@@ -69,6 +93,7 @@ def pretrain_gpt(
     ctx: Optional[MeshContext] = None,
     log_fn: Callable[[str], None] = print,
     batch_iter_factory: Optional[Callable] = None,
+    eval_batch_iter: Optional[Iterator[Dict[str, np.ndarray]]] = None,
 ) -> TrainResult:
     """End-to-end GPT pretraining loop. Returns final state + stats."""
     if parallel_cfg.forward_backward_disaggregating:
@@ -78,6 +103,12 @@ def pretrain_gpt(
         ctx = build_mesh(parallel_cfg)
     dp_total = ctx.dp * ctx.ep
     num_micro = train_cfg.num_microbatches(dp_total)
+    from megatronapp_tpu.training.num_microbatches_calculator import (
+        build_calculator,
+    )
+    batch_calc = build_calculator(
+        train_cfg.global_batch_size, train_cfg.micro_batch_size, dp_total,
+        train_cfg.rampup_batch_size)
 
     optimizer = get_optimizer(opt_cfg, train_cfg.train_iters)
     rng = jax.random.PRNGKey(train_cfg.seed)
@@ -111,11 +142,15 @@ def pretrain_gpt(
         if loader is not None and loader is not ckpt:
             loader.close()
 
+    # Consumed-samples bookkeeping honors the rampup schedule on resume
+    # (reference consumed_train_samples accumulates ACTUAL batch sizes).
+    consumed = 0
+    for _ in range(start_step):
+        consumed += batch_calc.get(consumed)[0]
     if batch_iter is None:
         # Fast-forward the data stream past already-consumed samples on
         # resume (reference consumed_train_samples bookkeeping) — via the
         # caller's factory for real datasets, the mock stream otherwise.
-        consumed = start_step * train_cfg.global_batch_size
         if batch_iter_factory is not None:
             batch_iter = batch_iter_factory(consumed)
         else:
@@ -126,28 +161,26 @@ def pretrain_gpt(
 
     if ctx.pp > 1:
         def loss_fn(params, batch_mb):
-            if "segment_ids" in batch_mb:
-                raise NotImplementedError(
-                    "packed sequences (segment_ids) are not supported in "
-                    "the pipelined path yet; run with "
-                    "pipeline_parallel=1")
             return gpt_pipeline_loss(
                 params, batch_mb["tokens"], batch_mb["labels"],
                 batch_mb["loss_mask"], model_cfg, ctx, vpp=vpp,
-                order_policy=parallel_cfg.pipeline_order_policy)
+                order_policy=parallel_cfg.pipeline_order_policy,
+                segment_ids_mb=batch_mb.get("segment_ids"))
     else:
         loss_fn = gpt_microbatch_loss(model_cfg, ctx=ctx)
     eval_step_fn = None
-    eval_iter = None
-    if train_cfg.eval_interval and ctx.pp == 1:
+    if train_cfg.eval_interval:
         # Held-out evaluation (reference evaluate_and_print_results,
-        # training.py eval loop): a distinct data stream (different seed)
-        # scored with the forward-only step.
+        # training.py eval loop): the caller-provided eval stream when
+        # given (real validation data), else a distinct mock stream
+        # (different seed). Works under pp>1 via the pipelined eval step.
         from megatronapp_tpu.training.train_step import make_eval_step
-        eval_step_fn = make_eval_step(loss_fn, ctx, shardings)
-        eval_iter = mock_batches(
-            train_cfg.seq_length, model_cfg.vocab_size,
-            train_cfg.global_batch_size, seed=train_cfg.seed + 1)
+        eval_step_fn = make_eval_step(loss_fn, ctx, shardings,
+                                      pipeline=ctx.pp > 1)
+        if eval_batch_iter is None:
+            eval_batch_iter = mock_batches(
+                train_cfg.seq_length, model_cfg.vocab_size,
+                train_cfg.global_batch_size, seed=train_cfg.seed + 1)
 
     step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
                               train_cfg.train_iters,
@@ -211,13 +244,19 @@ def pretrain_gpt(
     window_start = time.perf_counter()
     step_time_ms = 0.0
     tokens_per_sec = 0.0
-    tokens_per_step = train_cfg.global_batch_size * train_cfg.seq_length
 
     last_sync_iter = start_step
+    rows = _RowBuffer(batch_iter)
     with ctx.mesh:
         for it in range(start_step, train_cfg.train_iters):
             tracer.iteration_begin(it)
-            batch = reshape_global_batch(next(batch_iter), num_micro)
+            cur_gbs, cur_micro = batch_calc.get(consumed)
+            # Rampup consumes exactly cur_gbs rows from the stream (each
+            # distinct size is its own compiled step shape; leftovers
+            # carry over — no samples dropped).
+            batch = reshape_global_batch(rows.take(cur_gbs), cur_micro)
+            consumed += cur_gbs
+            tokens_per_step = cur_gbs * train_cfg.seq_length
             straggler.start()
             with tracer.scope("train-step"):
                 active_fn = traced_step_fn if tracer.active else step_fn
@@ -305,7 +344,8 @@ def pretrain_gpt(
                     (it + 1) % train_cfg.eval_interval == 0:
                 totals = []
                 for _ in range(train_cfg.eval_iters):
-                    ebatch = reshape_global_batch(next(eval_iter), num_micro)
+                    ebatch = reshape_global_batch(next(eval_batch_iter),
+                                                  num_micro)
                     totals.append(eval_step_fn(state, ebatch))
                 eval_loss = float(jax.device_get(
                     jnp.mean(jnp.stack(totals))))
@@ -338,31 +378,23 @@ def pretrain_gpt(
 def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
                       batch_iter=None, log_fn=print) -> TrainResult:
     """MegaFBD training path: forward and backward on disjoint sub-meshes
-    (parallel/fbd.py). DP is halved on each mesh; the forward mesh runs the
-    grad-free forward while the backward mesh computes the update for the
-    same batch, and dispatches overlap (losses stay on device between log
-    intervals)."""
+    (parallel/fbd.py). DP is halved on each mesh; per microbatch the
+    forward mesh runs the vjp forward pass and ships the residuals to the
+    backward mesh, which applies the transposed pass and the optimizer
+    update — dispatches overlap across the two meshes. Composes with
+    tp/pp/cp (each half-mesh runs the same loss_fn as the main path,
+    including the SPMD pipeline)."""
     from megatronapp_tpu.parallel.fbd import FBDExecutor, split_fbd_meshes
 
-    if parallel_cfg.pipeline_parallel > 1 or \
-            parallel_cfg.context_parallel > 1:
+    if train_cfg.rampup_batch_size:
         raise NotImplementedError(
-            "forward/backward disaggregation currently composes with "
-            "tp/dp only (pp/cp sub-mesh support pending)")
-    for field, val in (("save_dir", train_cfg.save_dir),
-                       ("load_dir", train_cfg.load_dir),
-                       ("trace", train_cfg.trace),
-                       ("metrics_jsonl", train_cfg.metrics_jsonl),
-                       ("tensorboard_dir", train_cfg.tensorboard_dir)):
-        if val:
-            raise NotImplementedError(
-                f"TrainingConfig.{field} is not supported under "
-                f"forward_backward_disaggregating yet")
-
+            "rampup_batch_size is not supported under "
+            "forward_backward_disaggregating yet")
     fwd_ctx, bwd_ctx = split_fbd_meshes(parallel_cfg)
     log_fn(f"FBD: forward mesh {dict(fwd_ctx.mesh.shape)} | backward mesh "
            f"{dict(bwd_ctx.mesh.shape)}")
     num_micro = train_cfg.num_microbatches(bwd_ctx.dp * bwd_ctx.ep)
+    vpp = parallel_cfg.virtual_pipeline_parallel
 
     if batch_iter is None:
         batch_iter = mock_batches(train_cfg.seq_length, model_cfg.vocab_size,
@@ -373,27 +405,106 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
     rng = jax.random.PRNGKey(train_cfg.seed)
     with bwd_ctx.mesh:
         state, shardings, _ = setup_train_state(
-            rng, lambda k: init_gpt_params(k, model_cfg), optimizer,
-            bwd_ctx)
-    loss_fn = gpt_microbatch_loss(model_cfg)
+            rng,
+            lambda k: init_gpt_params(k, model_cfg, pp=bwd_ctx.pp, vpp=vpp),
+            optimizer, bwd_ctx)
+
+    if bwd_ctx.pp > 1:
+        # Pipelined loss on each half-mesh: the executor feeds the WHOLE
+        # microbatched batch per fwd call (the pipeline schedules
+        # microbatches internally), so grad accumulation degenerates to a
+        # single fwd/bwd pair per step.
+        def loss_fn(params, batch_whole, _ctx):
+            return gpt_pipeline_loss(
+                params, batch_whole["tokens"], batch_whole["labels"],
+                batch_whole["loss_mask"], model_cfg, _ctx, vpp=vpp,
+                order_policy=parallel_cfg.pipeline_order_policy)
+    else:
+        def loss_fn(params, micro, _ctx):
+            loss, metrics = gpt_loss(params, micro["tokens"],
+                                     micro["labels"], micro["loss_mask"],
+                                     model_cfg, ctx=_ctx)
+            return loss, metrics
     executor = FBDExecutor(loss_fn, optimizer, fwd_ctx, bwd_ctx, state,
-                           shardings)
+                           shardings, pipeline=bwd_ctx.pp > 1)
+
+    # Checkpointing on the backward-mesh master state (reference FBD's
+    # save_checkpoint_legacy analogue — ours reuses the standard manager).
+    ckpt = None
+    start_step = 0
+    if train_cfg.save_dir:
+        ckpt = CheckpointManager(train_cfg.save_dir,
+                                 save_interval=train_cfg.save_interval)
+    restore_dir = train_cfg.load_dir or train_cfg.save_dir
+    if restore_dir:
+        loader = (CheckpointManager(train_cfg.load_dir)
+                  if train_cfg.load_dir and
+                  train_cfg.load_dir != train_cfg.save_dir else ckpt)
+        restored = loader.restore(executor.state) if loader else None
+        if restored is not None:
+            executor.set_state(restored)
+            start_step = int(jax.device_get(restored["step"]))
+            log_fn(f"resumed from checkpoint at step {start_step}")
+        if loader is not None and loader is not ckpt:
+            loader.close()
+
+    from megatronapp_tpu.training.metrics import MetricsLogger
+    metrics_logger = MetricsLogger()
+    if jax.process_index() == 0:
+        if train_cfg.metrics_jsonl:
+            metrics_logger.add_jsonl(train_cfg.metrics_jsonl)
+        if train_cfg.tensorboard_dir:
+            metrics_logger.add_tensorboard(train_cfg.tensorboard_dir,
+                                           warn=log_fn)
+    tracer = get_tracer()
+    if train_cfg.trace:
+        # Host-side scopes only: FBD spans two meshes; in-graph phase
+        # markers are a per-mesh concept (the bwd mesh carries the
+        # schedule), so trace covers dispatch-level timing.
+        tracer.configure(
+            enabled=True, trace_dir=train_cfg.trace_dir,
+            interval=train_cfg.trace_interval,
+            continuous_iterations=train_cfg.continuous_trace_iterations,
+            granularity=train_cfg.trace_granularity, mesh_ctx=bwd_ctx)
 
     losses = []
     t0 = time.perf_counter()
-    for it in range(train_cfg.train_iters):
+    for it in range(start_step, train_cfg.train_iters):
+        tracer.iteration_begin(it)
         batch = reshape_global_batch(next(batch_iter), num_micro)
-        out = executor.step(batch)
+        with tracer.scope("train-step"):
+            out = executor.step(batch)
         if (it + 1) % train_cfg.log_interval == 0 or \
                 it + 1 == train_cfg.train_iters:
             loss = float(jax.device_get(out["loss"]))
             fwd_loss = float(jax.device_get(out["fwd_loss"]))
+            grad_norm = float(jax.device_get(out["grad_norm"]))
             losses.append(loss)
             log_fn(f"iter {it+1:6d}/{train_cfg.train_iters} | "
-                   f"loss {loss:.4f} | fwd-mesh loss {fwd_loss:.4f}")
+                   f"loss {loss:.4f} | fwd-mesh loss {fwd_loss:.4f} | "
+                   f"grad_norm {grad_norm:.3f}")
+            metrics_logger.log(it + 1, {"loss": loss, "fwd_loss": fwd_loss,
+                                        "grad_norm": grad_norm})
+        tracer.iteration_end(it)
+        if tracer.active:
+            tracer.save()
+        if ckpt is not None and train_cfg.save_interval and \
+                (it + 1) % train_cfg.save_interval == 0:
+            ckpt.save(it + 1, jax.device_get(executor.state))
     dt = time.perf_counter() - t0
-    tokens = train_cfg.train_iters * train_cfg.global_batch_size * \
-        train_cfg.seq_length
+    if ckpt is not None:
+        final_step = int(jax.device_get(executor.state["step"]))
+        if train_cfg.save_interval and ckpt.latest_step != final_step:
+            ckpt.save(final_step, jax.device_get(executor.state),
+                      force=True)
+        ckpt.wait()
+        ckpt.close()
+    if train_cfg.trace:
+        tracer.finalize()
+    metrics_logger.close()
+    tokens = (train_cfg.train_iters - start_step) * \
+        train_cfg.global_batch_size * train_cfg.seq_length
     return TrainResult(state=executor.state, losses=losses,
-                       tokens_per_sec=tokens / dt,
-                       step_time_ms=dt / train_cfg.train_iters * 1e3)
+                       tokens_per_sec=tokens / max(dt, 1e-9),
+                       step_time_ms=dt / max(
+                           train_cfg.train_iters - start_step, 1) * 1e3)

@@ -170,11 +170,19 @@ def make_train_step(
     )
 
 
-def make_eval_step(loss_fn, ctx: MeshContext, state_shardings):
-    """Forward-only loss (reference evaluate(), training.py eval loop)."""
+def make_eval_step(loss_fn, ctx: MeshContext, state_shardings,
+                   pipeline: bool = False):
+    """Forward-only loss (reference evaluate(), training.py eval loop).
+
+    pipeline=True: loss_fn consumes the whole microbatched batch (the SPMD
+    pipeline schedules internally), matching make_train_step."""
     b_sh = batch_shardings(ctx)
 
     def step(state, batch):
+        if pipeline:
+            loss, _ = loss_fn(state["params"], batch)
+            return loss
+
         def body(acc, micro):
             loss, _ = loss_fn(state["params"], micro)
             return acc + loss, None

@@ -68,14 +68,20 @@ def attention_forward(
     rope_cos: Optional[jnp.ndarray] = None,
     rope_sin: Optional[jnp.ndarray] = None,
     attention_mask: Optional[jnp.ndarray] = None,
-    kv_cache=None, cache_index=None,
+    kv_cache=None, cache_index=None, cache_positions=None,
     layer_id=None, ctx=None, zigzag: bool = False,
+    segment_ids: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """x: [B, S, H] → [B, S, H]. Returns (out, new_kv_cache).
 
     zigzag: the CALLER laid the sequence out in zigzag cp order (model-side
     permutation, models/gpt.py) — required before the zigzag ring kernel may
-    be dispatched; models that don't permute keep the contiguous ring."""
+    be dispatched; models that don't permute keep the contiguous ring.
+
+    segment_ids: [B, S] packed-sequence map; the flash kernel masks
+    in-block (O(S) memory), the reference impl builds the dense
+    block-diagonal mask, and the cp impls thread segments through their
+    collectives."""
     b, s, h = x.shape
     d = cfg.head_dim
     nq, nkv = cfg.num_attention_heads, cfg.num_query_groups
@@ -111,14 +117,25 @@ def attention_forward(
         k = rotary.apply_rope(k, rope_cos, rope_sin)
 
     new_cache = None
+    mask_type = cfg.attn_mask_type
     if kv_cache is not None:
-        # Decode path: append k,v at cache_index (static_context.py analogue).
         ck, cv = kv_cache
-        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_index, axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, cache_index, axis=1)
+        if cache_positions is not None:
+            # Continuous-batching decode (dynamic_context.py analogue):
+            # each row appends at ITS OWN position; causality comes from
+            # the caller's per-row attention_mask, not a scalar offset.
+            ck = ck.at[jnp.arange(b), cache_positions].set(k[:, 0])
+            cv = cv.at[jnp.arange(b), cache_positions].set(v[:, 0])
+            mask_type = AttnMaskType.bidirectional
+        else:
+            # Static decode: append k,v at cache_index (static_context.py).
+            ck = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_index,
+                                                     axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(cv, v, cache_index,
+                                                     axis=1)
+            q_offset = cache_index
         k, v = ck, cv
         new_cache = (ck, cv)
-        q_offset = cache_index
 
     # Note: the reference's apply_query_key_layer_scaling is numerically
     # neutral (it divides QK by layer_number for fp16 range safety and
@@ -138,8 +155,9 @@ def attention_forward(
         comm = ("p2p_zigzag" if zigzag and zigzag_active(cfg, ctx)
                 else cfg.cp_comm_type)
         attn_out = context_attention(
-            q, k, v, ctx.mesh, comm,
-            causal=cfg.attn_mask_type == AttnMaskType.causal)
+            q, k, v, ctx.shard_map_mesh, comm,
+            causal=cfg.attn_mask_type == AttnMaskType.causal,
+            segment_ids=segment_ids)
     else:
         from megatronapp_tpu.parallel.collectives import current_manual_axes
 
@@ -175,25 +193,47 @@ def attention_forward(
                     DP_AXIS, EP_AXIS, TP_AXIS,
                 )
                 spec = P((DP_AXIS, EP_AXIS), None, TP_AXIS, None)
-                flash = jax.shard_map(
-                    lambda q_, k_, v_: flash_attention(
-                        q_, k_, v_, causal=causal,
-                        block_q=cfg.flash_block_q,
-                        block_kv=cfg.flash_block_kv),
-                    mesh=ctx.mesh, in_specs=(spec, spec, spec),
-                    out_specs=spec,
-                    axis_names={DP_AXIS, EP_AXIS, TP_AXIS},
-                    # pallas out_shapes carry no vma info; the kernel is
-                    # purely local (no collectives), so skip vma checking.
-                    check_vma=False)
-                attn_out = flash(q, k, v)
+                seg_spec = P((DP_AXIS, EP_AXIS), None)
+                if segment_ids is None:
+                    flash = jax.jit(jax.shard_map(
+                        lambda q_, k_, v_: flash_attention(
+                            q_, k_, v_, causal=causal,
+                            block_q=cfg.flash_block_q,
+                            block_kv=cfg.flash_block_kv),
+                        mesh=ctx.shard_map_mesh,
+                        in_specs=(spec, spec, spec),
+                        out_specs=spec,
+                        axis_names={DP_AXIS, EP_AXIS, TP_AXIS},
+                        # pallas out_shapes carry no vma info; the kernel
+                        # is purely local (no collectives), so skip vma
+                        # checking.
+                        check_vma=False))
+                    attn_out = flash(q, k, v)
+                else:
+                    flash = jax.jit(jax.shard_map(
+                        lambda q_, k_, v_, s_: flash_attention(
+                            q_, k_, v_, causal=causal,
+                            block_q=cfg.flash_block_q,
+                            block_kv=cfg.flash_block_kv, segment_ids=s_),
+                        mesh=ctx.shard_map_mesh,
+                        in_specs=(spec, spec, spec, seg_spec),
+                        out_specs=spec,
+                        axis_names={DP_AXIS, EP_AXIS, TP_AXIS},
+                        check_vma=False))
+                    attn_out = flash(q, k, v, segment_ids)
             else:
                 attn_out = flash_attention(
                     q, k, v, causal=causal,
-                    block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv)
+                    block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv,
+                    segment_ids=segment_ids)
         else:
+            if segment_ids is not None:
+                seg_mask = (segment_ids[:, None, :, None]
+                            == segment_ids[:, None, None, :])
+                attention_mask = (seg_mask if attention_mask is None
+                                  else attention_mask & seg_mask)
             attn_out = dot_product_attention(
-                q, k, v, mask_type=cfg.attn_mask_type,
+                q, k, v, mask_type=mask_type,
                 attention_mask=attention_mask, softmax_scale=None,
                 softmax_in_fp32=cfg.attention_softmax_in_fp32,
                 q_offset=q_offset)

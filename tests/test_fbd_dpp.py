@@ -70,6 +70,117 @@ class TestFBD:
                                    atol=1e-3)
         assert res_fbd.losses[-1] < res_fbd.losses[0]
 
+    @pytest.mark.parametrize("compose", ["pp", "cp"])
+    def test_fbd_composes_with_pp_cp(self, devices8, compose):
+        """FBD + pipeline / context parallelism: each half-mesh runs the
+        full parallel loss; losses bit-match a same-degree non-FBD run
+        (round-1 raises lifted; shard_maps bind the abstract mesh so the
+        fwd-traced pullback executes on the bwd mesh)."""
+        from tests.test_training import learnable_batches
+
+        model = tiny(num_layers=4 if compose == "pp" else 2,
+                     compute_dtype=jnp.float32)
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                               seq_length=32, train_iters=4, log_interval=2)
+        opt = OptimizerConfig(lr=1e-3, lr_decay_iters=4)
+
+        kw = (dict(pipeline_parallel=2) if compose == "pp"
+              else dict(context_parallel=2))
+        par_base = ParallelConfig(data_parallel=2, **kw)
+        ctx = build_mesh(par_base, devices=devices8[:4])
+        res_base = pretrain_gpt(model, par_base, train, opt, ctx=ctx,
+                                batch_iter=learnable_batches(32, 128, 8))
+        par_fbd = ParallelConfig(data_parallel=4,
+                                 forward_backward_disaggregating=True, **kw)
+        res_fbd = pretrain_gpt(model, par_fbd, train, opt,
+                               batch_iter=learnable_batches(32, 128, 8))
+        np.testing.assert_allclose(res_fbd.losses, res_base.losses,
+                                   atol=5e-5)
+
+    def test_fbd_backward_consumes_shipped_residuals(self, devices8):
+        """True disaggregation: the backward step's computation consumes
+        the SHIPPED residuals — its flop count is ~2 units (transpose
+        only), not 3 (recompute-forward + transpose), so it must be
+        strictly below the full grad step's cost."""
+        from megatronapp_tpu.models.gpt import gpt_loss, init_gpt_params
+        from megatronapp_tpu.parallel.fbd import FBDExecutor
+        from megatronapp_tpu.training.optimizer import get_optimizer
+        from megatronapp_tpu.training.train_state import setup_train_state
+
+        model = tiny(compute_dtype=jnp.float32, remat_policy="none")
+        par = ParallelConfig(forward_backward_disaggregating=True)
+        from megatronapp_tpu.parallel.fbd import split_fbd_meshes
+        fwd_ctx, bwd_ctx = split_fbd_meshes(par, devices=devices8[:4])
+        optimizer = get_optimizer(OptimizerConfig(lr=1e-3), 4)
+        with bwd_ctx.mesh:
+            state, shardings, _ = setup_train_state(
+                jax.random.PRNGKey(0),
+                lambda k: init_gpt_params(k, model), optimizer, bwd_ctx)
+
+        def loss_fn(p, micro, _ctx):
+            return gpt_loss(p, micro["tokens"], micro["labels"],
+                            micro["loss_mask"], model, ctx=_ctx)
+
+        ex = FBDExecutor(loss_fn, optimizer, fwd_ctx, bwd_ctx, state,
+                         shardings)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 128, (1, 2, 32)).astype(np.int32)
+        micro = {"tokens": jnp.asarray(tokens[0]),
+                 "labels": jnp.asarray(np.roll(tokens[0], -1, -1)),
+                 "loss_mask": jnp.ones((2, 32), jnp.float32)}
+        # Cost analysis of the two compiled halves vs a monolithic grad.
+        fwd_cost = ex._fwd_one.lower(
+            ex.params_fwd, micro).compile().cost_analysis()
+        _, _, pb = ex._fwd_one(ex.params_fwd, micro)
+        pb_b = ex._ship(pb)
+        g0 = ex._zeros(ex.state["params"])
+        l0 = jnp.zeros((), jnp.float32)
+        bwd_cost = ex._bwd_accum.lower(
+            g0, l0, pb_b, l0).compile().cost_analysis()
+        full = jax.jit(jax.grad(
+            lambda p: loss_fn(p, micro, fwd_ctx)[0]))
+        full_cost = full.lower(ex.params_fwd).compile().cost_analysis()
+        f_fwd = fwd_cost.get("flops", 0)
+        f_bwd = bwd_cost.get("flops", 0)
+        f_full = full_cost.get("flops", 0)
+        # bwd alone must be well below fwd+bwd (no forward recompute) and
+        # the split halves must roughly tile the monolithic cost.
+        assert f_bwd < 0.85 * f_full, (f_bwd, f_full)
+        assert f_fwd + f_bwd < 1.25 * f_full, (f_fwd, f_bwd, f_full)
+
+    def test_fbd_checkpoint_and_metrics(self, devices8, tmp_path):
+        """Round-1 guards lifted: checkpointing + metrics sinks work under
+        FBD (state lives on the backward mesh)."""
+        import json
+        import os
+
+        from tests.test_training import learnable_batches
+
+        model = tiny(compute_dtype=jnp.float32)
+        jsonl = os.path.join(str(tmp_path), "metrics.jsonl")
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=16,
+                               seq_length=32, train_iters=4, log_interval=2,
+                               save_dir=str(tmp_path / "ckpt"),
+                               save_interval=2, metrics_jsonl=jsonl)
+        par = ParallelConfig(forward_backward_disaggregating=True)
+        res = pretrain_gpt(model, par, train, OptimizerConfig(lr=1e-3),
+                           batch_iter=learnable_batches(32, 128, 16))
+        assert os.path.exists(jsonl)
+        rows = [json.loads(x) for x in open(jsonl)]
+        assert rows and "loss" in rows[-1]
+        assert os.path.isdir(tmp_path / "ckpt")
+        # Resume from the checkpoint: starts at the saved step.
+        logs = []
+        train2 = TrainingConfig(micro_batch_size=2, global_batch_size=16,
+                                seq_length=32, train_iters=6,
+                                log_interval=2,
+                                save_dir=str(tmp_path / "ckpt"),
+                                save_interval=100)
+        pretrain_gpt(model, par, train2, OptimizerConfig(lr=1e-3),
+                     batch_iter=learnable_batches(32, 128, 16),
+                     log_fn=logs.append)
+        assert any("resumed from checkpoint at step 4" in x for x in logs)
+
 
 class TestDPPOrderPolicy:
     @pytest.mark.parametrize("policy", ["dfc", "bfc"])

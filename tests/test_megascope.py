@@ -177,3 +177,50 @@ class TestTrainingScopeServer:
             await client.close()
 
         asyncio.run(run())
+
+    def test_python_client_and_frontend(self, devices8):
+        """The packaged client (scope/client.py) drives a real socket
+        server end-to-end and the golden-payload contract validates; the
+        web UI ships and is served at /."""
+        from aiohttp.test_utils import TestServer as ATestServer
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.scope.client import (
+            ScopeClient, validate_payloads,
+        )
+        from megatronapp_tpu.scope.ws_server import (
+            TrainingScopeServer, TrainingScopeSession,
+        )
+
+        model = tiny_cfg()
+        par = ParallelConfig()
+        ctx = build_mesh(par, devices=devices8[:1])
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=2,
+                               seq_length=16, train_iters=10,
+                               log_interval=10)
+        session = TrainingScopeSession(model, par, train,
+                                       OptimizerConfig(lr=1e-3), ctx=ctx)
+        srv = TrainingScopeServer(session)
+        vis = {"MLP1": [0], "Result": [0]}
+
+        async def run():
+            import aiohttp
+            server = ATestServer(srv.build_app())
+            await server.start_server()
+            url = f"ws://127.0.0.1:{server.port}/ws"
+            client = ScopeClient(url)
+            async with aiohttp.ClientSession() as s:
+                payloads = await client._run_step_async(
+                    vis, None, {"pixels": 4, "method": "mean"}, session=s)
+                # Frontend served at /.
+                async with s.get(f"http://127.0.0.1:{server.port}/") as r:
+                    assert r.status == 200
+                    html = await r.text()
+                    assert "run_training_step" in html
+                    assert "MegaScope" in html
+            await server.close()
+            return payloads
+
+        payloads = asyncio.run(run())
+        validate_payloads(payloads, vis)
+        sites = {p.get("site") for p in payloads}
+        assert "mlp1" in sites and "result" in sites

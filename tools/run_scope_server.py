@@ -1,0 +1,41 @@
+"""Launch MegaScope: training WS server + web UI.
+
+Parity with the reference flow (test_scripts/readme.md MegaScope section:
+run the server, open the frontend, step training interactively). Serves
+the packaged UI at http://HOST:PORT/ and the WS endpoint at /ws.
+
+Usage:
+  python tools/run_scope_server.py --num-layers 2 --hidden-size 64 \
+      --num-attention-heads 4 --vocab-size 128 \
+      --micro-batch-size 2 --global-batch-size 2 --seq-length 32 \
+      --train-iters 1000 [--ws-port 5656]
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
+
+
+def main(argv=None):
+    from megatronapp_tpu.config.arguments import (
+        build_parser, configs_from_args,
+    )
+    from megatronapp_tpu.scope.ws_server import (
+        TrainingScopeServer, TrainingScopeSession,
+    )
+
+    ap = build_parser("MegaScope training server (megatronapp-tpu)")
+    ap.add_argument("--ws-host", default="0.0.0.0")
+    ap.add_argument("--ws-port", type=int, default=5656)
+    args = ap.parse_args(argv)
+    model, parallel, training, opt = configs_from_args(args)
+
+    session = TrainingScopeSession(model, parallel, training, opt)
+    srv = TrainingScopeServer(session, host=args.ws_host, port=args.ws_port)
+    print(f"MegaScope UI: http://{args.ws_host}:{args.ws_port}/ "
+          f"(WS at /ws) — send run_training_step or click 'step'")
+    srv.run()
+
+
+if __name__ == "__main__":
+    main()

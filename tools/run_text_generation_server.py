@@ -19,6 +19,9 @@ def main():
     import jax
 
     from megatronapp_tpu.data.tokenizers import build_tokenizer
+    from megatronapp_tpu.inference.dynamic_engine import (
+        DynamicInferenceEngine,
+    )
     from megatronapp_tpu.inference.engine import StaticInferenceEngine
     from megatronapp_tpu.inference.server import TextGenerationServer
     from megatronapp_tpu.models.gpt import init_gpt_params
@@ -34,6 +37,9 @@ def main():
     ap.add_argument("--port", type=int, default=5000)
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--max-seq-len", type=int, default=None)
+    ap.add_argument("--engine", choices=["static", "dynamic"],
+                    default="static")
+    ap.add_argument("--max-batch", type=int, default=4)
     args = ap.parse_args()
 
     cfg = PRESETS[args.preset]()
@@ -47,6 +53,12 @@ def main():
         mngr.close()
     tok = build_tokenizer(args.tokenizer_type, args.tokenizer_name_or_path,
                           vocab_size=cfg.vocab_size)
+    if getattr(args, "engine", "static") == "dynamic":
+        engine = DynamicInferenceEngine(
+            params, cfg, tokenizer=tok, max_batch=args.max_batch,
+            max_seq_len=args.max_seq_len)
+        TextGenerationServer(engine, args.host, args.port).run()
+        return
     engine = StaticInferenceEngine(params, cfg, tokenizer=tok,
                                    max_seq_len=args.max_seq_len)
     print(f"serving on {args.host}:{args.port} (PUT /api, WS /ws)")
