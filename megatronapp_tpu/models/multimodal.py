@@ -1,0 +1,110 @@
+"""Multimodal (vision-language) model: LLaVA-style ViT → projector → GPT.
+
+Parity with /root/reference/megatron/core/models/multimodal/
+llava_model.py + pretrain_vlm.py: a vision encoder embeds the image into
+a sequence of visual tokens; a 2-layer MLP projector maps them into the
+language model's embedding space; the language model consumes
+[visual tokens ‖ text embeddings] with causal attention, and the loss is
+computed on text positions only (image positions masked out).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.models.gpt import (
+    gpt_head, gpt_rope_tables, init_gpt_params,
+)
+from megatronapp_tpu.models.vision import (
+    VitSpec, init_vit_params, vit_backbone,
+)
+from megatronapp_tpu.ops.activations import gelu
+from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
+from megatronapp_tpu.transformer.block import block_forward
+
+
+def init_vlm_params(rng, lm_cfg: TransformerConfig,
+                    vis_cfg: TransformerConfig, spec: VitSpec):
+    """{'vision', 'projector', 'lm'} param tree + logical axes."""
+    k_vis, k_proj1, k_proj2, k_lm = jax.random.split(rng, 4)
+    std = lm_cfg.init_method_std
+    vis_p, vis_ax = init_vit_params(k_vis, vis_cfg, spec, with_head=False)
+    lm_p, lm_ax = init_gpt_params(k_lm, lm_cfg)
+    p = {
+        "vision": vis_p,
+        "projector": {
+            "fc1": jax.random.normal(
+                k_proj1, (vis_cfg.hidden_size, lm_cfg.hidden_size),
+                lm_cfg.params_dtype) * std,
+            "fc1_bias": jnp.zeros((lm_cfg.hidden_size,),
+                                  lm_cfg.params_dtype),
+            "fc2": jax.random.normal(
+                k_proj2, (lm_cfg.hidden_size, lm_cfg.hidden_size),
+                lm_cfg.params_dtype) * std,
+            "fc2_bias": jnp.zeros((lm_cfg.hidden_size,),
+                                  lm_cfg.params_dtype),
+        },
+        "lm": lm_p,
+    }
+    ax = {
+        "vision": vis_ax,
+        "projector": {"fc1": (None, "embed"), "fc1_bias": ("embed",),
+                      "fc2": ("embed", "embed"), "fc2_bias": ("embed",)},
+        "lm": lm_ax,
+    }
+    return p, ax
+
+
+def project_visual(p, visual: jnp.ndarray, dt) -> jnp.ndarray:
+    """2-layer MLP projector (reference llava mlp adapter)."""
+    y = gelu(visual.astype(dt) @ p["fc1"].astype(dt)
+             + p["fc1_bias"].astype(dt))
+    return y @ p["fc2"].astype(dt) + p["fc2_bias"].astype(dt)
+
+
+def vlm_forward(p, images: jnp.ndarray, tokens: jnp.ndarray,
+                lm_cfg: TransformerConfig, vis_cfg: TransformerConfig,
+                spec: VitSpec, ctx=None):
+    """images [B,H,W,C] + tokens [B,S_text] → logits [B, V_img+S_text, V].
+
+    Visual tokens prefix the text sequence (LLaVA layout); rope positions
+    run over the CONCATENATED sequence.
+    """
+    dt = lm_cfg.compute_dtype
+    b, s_text = tokens.shape
+    visual = vit_backbone(p["vision"], images, vis_cfg, spec, ctx=ctx)
+    # Drop CLS: the LM consumes the patch tokens (reference uses the
+    # encoder grid features).
+    visual = project_visual(p["projector"], visual[:, 1:], dt)
+    n_vis = visual.shape[1]
+
+    emb = p["lm"]["embedding"]
+    text = jnp.take(emb["word"], tokens, axis=0).astype(dt)
+    if "pos" in emb:
+        text = text + jnp.take(
+            emb["pos"], jnp.arange(n_vis, n_vis + s_text), axis=0
+        ).astype(dt)
+        visual = visual + jnp.take(
+            emb["pos"], jnp.arange(n_vis), axis=0).astype(dt)
+    h = jnp.concatenate([visual, text], axis=1)
+    cos, sin = gpt_rope_tables(lm_cfg, n_vis + s_text)
+    h, aux = block_forward(p["lm"]["block"], h, lm_cfg, cos, sin, None,
+                           ctx=ctx)
+    return gpt_head(p["lm"], h, lm_cfg), aux, n_vis
+
+
+def vlm_loss(p, images, tokens, targets, loss_mask,
+             lm_cfg: TransformerConfig, vis_cfg: TransformerConfig,
+             spec: VitSpec, ctx=None):
+    """CE on TEXT positions only (pretrain_vlm.py loss parity: image
+    positions carry no labels)."""
+    logits, aux, n_vis = vlm_forward(p, images, tokens, lm_cfg, vis_cfg,
+                                     spec, ctx=ctx)
+    text_logits = logits[:, n_vis:]
+    loss, _ = cross_entropy_loss(text_logits, targets, loss_mask)
+    return loss + aux, {"lm_loss": loss, "moe_aux_loss": aux}

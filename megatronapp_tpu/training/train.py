@@ -98,7 +98,8 @@ def pretrain_gpt(
     """End-to-end GPT pretraining loop. Returns final state + stats."""
     if parallel_cfg.forward_backward_disaggregating:
         return _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg,
-                                 opt_cfg, batch_iter, log_fn)
+                                 opt_cfg, batch_iter, log_fn,
+                                 batch_iter_factory=batch_iter_factory)
     if ctx is None:
         ctx = build_mesh(parallel_cfg)
     dp_total = ctx.dp * ctx.ep
@@ -376,7 +377,8 @@ def pretrain_gpt(
 
 
 def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
-                      batch_iter=None, log_fn=print) -> TrainResult:
+                      batch_iter=None, log_fn=print,
+                      batch_iter_factory=None) -> TrainResult:
     """MegaFBD training path: forward and backward on disjoint sub-meshes
     (parallel/fbd.py). DP is halved on each mesh; per microbatch the
     forward mesh runs the vjp forward pass and ships the residuals to the
@@ -395,11 +397,6 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
            f"{dict(bwd_ctx.mesh.shape)}")
     num_micro = train_cfg.num_microbatches(bwd_ctx.dp * bwd_ctx.ep)
     vpp = parallel_cfg.virtual_pipeline_parallel
-
-    if batch_iter is None:
-        batch_iter = mock_batches(train_cfg.seq_length, model_cfg.vocab_size,
-                                  train_cfg.global_batch_size,
-                                  seed=train_cfg.seed)
 
     optimizer = get_optimizer(opt_cfg, train_cfg.train_iters)
     rng = jax.random.PRNGKey(train_cfg.seed)
@@ -447,6 +444,19 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
             log_fn(f"resumed from checkpoint at step {start_step}")
         if loader is not None and loader is not ckpt:
             loader.close()
+
+    # Fast-forward the data stream past consumed samples on resume (same
+    # bookkeeping as the main path; FBD has no rampup, so consumed is
+    # step-linear).
+    if batch_iter is None:
+        consumed = start_step * train_cfg.global_batch_size
+        if batch_iter_factory is not None:
+            batch_iter = batch_iter_factory(consumed)
+        else:
+            batch_iter = mock_batches(
+                train_cfg.seq_length, model_cfg.vocab_size,
+                train_cfg.global_batch_size, seed=train_cfg.seed,
+                start_idx=consumed)
 
     from megatronapp_tpu.training.metrics import MetricsLogger
     metrics_logger = MetricsLogger()

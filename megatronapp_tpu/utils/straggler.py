@@ -72,6 +72,50 @@ class StragglerDetector:
         return outlier
 
 
+def probe_chip_rtts(devices=None, size: int = 256, repeats: int = 3,
+                    warmup: int = 1):
+    """Per-chip round-trip probe: dispatch a small matmul to EACH device
+    and time put→compute→get individually.
+
+    The per-chip complement the reference gets from pynvml telemetry
+    (core/utils.py:1030 collects per-GPU power/temp/clock): TPU counters
+    are not host-visible, but a per-device RTT isolates a slow/hung chip
+    the aggregate step time can't attribute. Returns
+    [{'device', 'rtt_ms'}...] sorted worst-first.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    x = np.ones((size, size), np.float32)
+    f = jax.jit(lambda a: a @ a)
+    results = []
+    for d in devices:
+        xs = jax.device_put(jnp.asarray(x), d)
+        for _ in range(warmup):
+            jax.device_get(f(xs))
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            jax.device_get(f(xs))
+        results.append({"device": str(d),
+                        "rtt_ms": (time.perf_counter() - t0) / repeats
+                        * 1e3})
+    return sorted(results, key=lambda r: -r["rtt_ms"])
+
+
+def detect_slow_chips(rtts, ratio_threshold: float = 2.0):
+    """Flag devices whose probe RTT exceeds ratio_threshold × the median
+    (the per-chip stage of straggler localization; MegaScan's trace
+    detector — trace/detect.py — is the op-granularity stage)."""
+    if not rtts:
+        return []
+    times = sorted(r["rtt_ms"] for r in rtts)
+    median = times[len(times) // 2]
+    return [r for r in rtts if r["rtt_ms"] > ratio_threshold * median]
+
+
 _DETECTOR = StragglerDetector()
 
 

@@ -91,6 +91,28 @@ class _NullTimer:
 _NULL = _NullTimer()
 
 
+class _BarrierTimer:
+    """View over a Timer that applies the registry's barrier_fn on
+    start/stop (reference Timer(barrier=True) semantics: all ranks /
+    pending device work synchronize before the measurement edges)."""
+
+    def __init__(self, timer: Timer, barrier_fn: Callable):
+        self._t = timer
+        self._b = barrier_fn
+
+    def start(self):
+        self._t.start(barrier_fn=self._b)
+
+    def stop(self):
+        self._t.stop(barrier_fn=self._b)
+
+    def elapsed(self, reset: bool = True) -> float:
+        return self._t.elapsed(reset=reset)
+
+    def reset(self):
+        self._t.reset()
+
+
 class Timers:
     """Registry with log-level gating (reference Timers.__call__).
 
@@ -110,11 +132,14 @@ class Timers:
 
     def __call__(self, name: str, log_level: int = 0, barrier: bool = False):
         if name in self._timers:
-            return self._timers[name]
-        if log_level > self.log_level:
+            t = self._timers[name]
+        elif log_level > self.log_level:
             return _NULL
-        t = self._timers.setdefault(name, Timer(name))
-        self._levels[name] = log_level
+        else:
+            t = self._timers.setdefault(name, Timer(name))
+            self._levels[name] = log_level
+        if barrier and self.barrier_fn is not None:
+            return _BarrierTimer(t, self.barrier_fn)
         return t
 
     def elapsed_all(self, reset: bool = True) -> Dict[str, float]:
@@ -147,17 +172,16 @@ class Timers:
 
     @staticmethod
     def _reduce(value: float):
-        """(min, max) across processes — multi-host reduction via a tiny
-        psum when more than one process exists, else identity."""
+        """(min, max) across processes: all-gather the scalar via
+        multihost_utils when multi-host, identity on a single process."""
         import jax
         if jax.process_count() == 1:
             return value, value
-        import jax.numpy as jnp
-        arr = jnp.asarray([value])
-        lo = float(jax.device_get(
-            jax.pmin(arr, axis_name=None)
-            if hasattr(jax, "pmin") else arr)[0])
-        return lo, value
+        import numpy as np
+        from jax.experimental import multihost_utils
+        allv = np.asarray(multihost_utils.process_allgather(
+            np.asarray([value])))
+        return float(allv.min()), float(allv.max())
 
 
 _GLOBAL_TIMERS: Optional[Timers] = None

@@ -1,0 +1,93 @@
+"""Retro (retrieval-augmented) pretraining entry point.
+
+Parity with /root/reference/pretrain_retro.py: decoder with chunked
+cross-attention to retrieved neighbors (synthetic token/neighbor stream
+unless a retrieval database is wired in — reference tools/retro builds
+one offline).
+"""
+
+import time
+
+import jax
+import numpy as np
+
+from megatronapp_tpu.config.arguments import build_parser, configs_from_args
+from megatronapp_tpu.models.retro import (
+    RetroSpec, init_retro_params, retro_loss,
+)
+from megatronapp_tpu.parallel.mesh import build_mesh
+from megatronapp_tpu.training.optimizer import get_optimizer
+from megatronapp_tpu.training.train import reshape_global_batch
+from megatronapp_tpu.training.train_state import setup_train_state
+from megatronapp_tpu.training.train_step import make_train_step
+
+
+def main(argv=None):
+    ap = build_parser("pretrain_retro (megatronapp-tpu)")
+    ap.add_argument("--retro-chunk-length", type=int, default=64)
+    ap.add_argument("--retro-num-neighbors", type=int, default=2)
+    ap.add_argument("--retro-retrieved-length", type=int, default=128)
+    ap.add_argument("--retro-encoder-layers", type=int, default=2)
+    args = ap.parse_args(argv)
+    cfg, parallel, training, opt_cfg = configs_from_args(args)
+    spec = RetroSpec(chunk_length=args.retro_chunk_length,
+                     num_neighbors=args.retro_num_neighbors,
+                     retrieved_length=args.retro_retrieved_length,
+                     cca_layers=tuple(
+                         range(1, cfg.num_layers, 3)) or (1,))
+    import dataclasses
+
+    from megatronapp_tpu.config.transformer_config import AttnMaskType
+    enc_cfg = dataclasses.replace(
+        cfg, num_layers=args.retro_encoder_layers,
+        attn_mask_type=AttnMaskType.bidirectional)
+
+    ctx = build_mesh(parallel)
+    optimizer = get_optimizer(opt_cfg, training.train_iters)
+    state, shardings, _ = setup_train_state(
+        jax.random.PRNGKey(training.seed),
+        lambda k: init_retro_params(k, cfg, enc_cfg, spec), optimizer,
+        ctx)
+
+    def loss_fn(p, micro):
+        return retro_loss(p, micro["tokens"], micro["neighbors"],
+                          micro["labels"], micro["loss_mask"], cfg,
+                          enc_cfg, spec, ctx=ctx)
+
+    step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
+                              training.train_iters)
+    num_micro = training.num_microbatches(ctx.dp * ctx.ep)
+    n_chunks = training.seq_length // spec.chunk_length
+
+    rng = np.random.default_rng(training.seed)
+    losses = []
+    t0 = time.perf_counter()
+    with ctx.mesh:
+        for it in range(training.train_iters):
+            toks = rng.integers(0, cfg.vocab_size, (
+                training.global_batch_size, training.seq_length)
+            ).astype(np.int32)
+            batch = reshape_global_batch({
+                "tokens": toks,
+                "neighbors": rng.integers(0, cfg.vocab_size, (
+                    training.global_batch_size, n_chunks,
+                    spec.num_neighbors, spec.retrieved_length)
+                ).astype(np.int32),
+                "labels": np.roll(toks, -1, axis=1),
+                "loss_mask": np.ones_like(toks, np.float32),
+            }, num_micro)
+            state, metrics = step_fn(state, batch)
+            if (it + 1) % training.log_interval == 0 or \
+                    it + 1 == training.train_iters:
+                metrics = jax.device_get(metrics)
+                losses.append(float(metrics["loss"]))
+                print(f"iter {it+1:6d}/{training.train_iters} | "
+                      f"loss {float(metrics['loss']):.4f}")
+    dt = time.perf_counter() - t0
+    tokens = training.train_iters * training.global_batch_size * \
+        training.seq_length
+    print(f"done: final loss {losses[-1]:.4f}, {tokens/dt:,.0f} tok/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Vision (ViT) classification pretraining entry point.
+
+Parity with /root/reference/pretrain_vision_classify.py: ViT backbone +
+classification head on image/label batches (synthetic stream unless a
+loader is wired in).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatronapp_tpu.config.arguments import build_parser, configs_from_args
+from megatronapp_tpu.models.vision import (
+    VitSpec, init_vit_params, vit_classification_loss, vit_config,
+)
+from megatronapp_tpu.parallel.mesh import build_mesh
+from megatronapp_tpu.training.optimizer import get_optimizer
+from megatronapp_tpu.training.train import reshape_global_batch
+from megatronapp_tpu.training.train_state import setup_train_state
+from megatronapp_tpu.training.train_step import make_train_step
+
+
+def main(argv=None):
+    ap = build_parser("pretrain_vision_classify (megatronapp-tpu)")
+    ap.add_argument("--img-size", type=int, default=224)
+    ap.add_argument("--patch-dim", type=int, default=16)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    args = ap.parse_args(argv)
+    gpt_cfg, parallel, training, opt_cfg = configs_from_args(args)
+    spec = VitSpec(image_size=args.img_size, patch_size=args.patch_dim,
+                   num_classes=args.num_classes)
+    import dataclasses
+    cfg = vit_config(**{f.name: getattr(gpt_cfg, f.name)
+                        for f in dataclasses.fields(gpt_cfg)
+                        if f.name not in ("position_embedding",
+                                          "attn_mask_type",
+                                          "add_qkv_bias",
+                                          "max_position_embeddings")},
+                     max_position_embeddings=1 + spec.num_patches)
+
+    ctx = build_mesh(parallel)
+    optimizer = get_optimizer(opt_cfg, training.train_iters)
+    state, shardings, _ = setup_train_state(
+        jax.random.PRNGKey(training.seed),
+        lambda k: init_vit_params(k, cfg, spec), optimizer, ctx)
+
+    def loss_fn(p, micro):
+        return vit_classification_loss(p, micro["images"],
+                                       micro["labels"], cfg, spec, ctx=ctx)
+
+    step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
+                              training.train_iters)
+    num_micro = training.num_microbatches(ctx.dp * ctx.ep)
+
+    rng = np.random.default_rng(training.seed)
+    losses = []
+    t0 = time.perf_counter()
+    with ctx.mesh:
+        for it in range(training.train_iters):
+            batch = reshape_global_batch({
+                "images": rng.normal(size=(
+                    training.global_batch_size, spec.image_size,
+                    spec.image_size, spec.num_channels)
+                ).astype(np.float32),
+                "labels": rng.integers(
+                    0, spec.num_classes,
+                    training.global_batch_size).astype(np.int32),
+            }, num_micro)
+            state, metrics = step_fn(state, batch)
+            if (it + 1) % training.log_interval == 0 or \
+                    it + 1 == training.train_iters:
+                metrics = jax.device_get(metrics)
+                losses.append(float(metrics["loss"]))
+                print(f"iter {it+1:6d}/{training.train_iters} | "
+                      f"loss {float(metrics['loss']):.4f} | "
+                      f"acc {float(metrics['accuracy']):.3f}")
+    dt = time.perf_counter() - t0
+    print(f"done: final loss {losses[-1]:.4f}, "
+          f"{training.train_iters * training.global_batch_size / dt:.1f} "
+          f"img/s")
+
+
+if __name__ == "__main__":
+    main()

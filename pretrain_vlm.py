@@ -1,0 +1,86 @@
+"""Vision-language (LLaVA-style) pretraining entry point.
+
+Parity with /root/reference/pretrain_vlm.py: ViT encoder → MLP projector →
+GPT decoder over [visual ‖ text], loss on text positions (synthetic
+image/caption stream unless a loader is wired in).
+"""
+
+import time
+
+import jax
+import numpy as np
+
+from megatronapp_tpu.config.arguments import build_parser, configs_from_args
+from megatronapp_tpu.models.multimodal import init_vlm_params, vlm_loss
+from megatronapp_tpu.models.vision import VitSpec, vit_config
+from megatronapp_tpu.parallel.mesh import build_mesh
+from megatronapp_tpu.training.optimizer import get_optimizer
+from megatronapp_tpu.training.train import reshape_global_batch
+from megatronapp_tpu.training.train_state import setup_train_state
+from megatronapp_tpu.training.train_step import make_train_step
+
+
+def main(argv=None):
+    ap = build_parser("pretrain_vlm (megatronapp-tpu)")
+    ap.add_argument("--img-size", type=int, default=224)
+    ap.add_argument("--patch-dim", type=int, default=16)
+    ap.add_argument("--vision-num-layers", type=int, default=2)
+    ap.add_argument("--vision-hidden-size", type=int, default=None)
+    args = ap.parse_args(argv)
+    lm_cfg, parallel, training, opt_cfg = configs_from_args(args)
+    spec = VitSpec(image_size=args.img_size, patch_size=args.patch_dim)
+    vis_cfg = vit_config(
+        num_layers=args.vision_num_layers,
+        hidden_size=args.vision_hidden_size or lm_cfg.hidden_size // 2,
+        num_attention_heads=max(lm_cfg.num_attention_heads // 2, 1),
+        vocab_size=1, max_position_embeddings=1 + spec.num_patches,
+        compute_dtype=lm_cfg.compute_dtype)
+
+    ctx = build_mesh(parallel)
+    optimizer = get_optimizer(opt_cfg, training.train_iters)
+    state, shardings, _ = setup_train_state(
+        jax.random.PRNGKey(training.seed),
+        lambda k: init_vlm_params(k, lm_cfg, vis_cfg, spec), optimizer,
+        ctx)
+
+    def loss_fn(p, micro):
+        return vlm_loss(p, micro["images"], micro["tokens"],
+                        micro["labels"], micro["loss_mask"], lm_cfg,
+                        vis_cfg, spec, ctx=ctx)
+
+    step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
+                              training.train_iters)
+    num_micro = training.num_microbatches(ctx.dp * ctx.ep)
+
+    rng = np.random.default_rng(training.seed)
+    losses = []
+    t0 = time.perf_counter()
+    with ctx.mesh:
+        for it in range(training.train_iters):
+            toks = rng.integers(0, lm_cfg.vocab_size, (
+                training.global_batch_size, training.seq_length)
+            ).astype(np.int32)
+            batch = reshape_global_batch({
+                "images": rng.normal(size=(
+                    training.global_batch_size, spec.image_size,
+                    spec.image_size, spec.num_channels)
+                ).astype(np.float32),
+                "tokens": toks,
+                "labels": np.roll(toks, -1, axis=1),
+                "loss_mask": np.ones_like(toks, np.float32),
+            }, num_micro)
+            state, metrics = step_fn(state, batch)
+            if (it + 1) % training.log_interval == 0 or \
+                    it + 1 == training.train_iters:
+                metrics = jax.device_get(metrics)
+                losses.append(float(metrics["loss"]))
+                print(f"iter {it+1:6d}/{training.train_iters} | "
+                      f"loss {float(metrics['loss']):.4f}")
+    dt = time.perf_counter() - t0
+    tokens = training.train_iters * training.global_batch_size * \
+        training.seq_length
+    print(f"done: final loss {losses[-1]:.4f}, {tokens/dt:,.0f} tok/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -185,3 +185,44 @@ class TestTracedTraining:
               and e["args"]["iteration"] == 0]
         assert len(fw) >= 2
         assert os.path.exists(os.path.join(trace_dir, "agg.json"))
+
+
+class TestTraceAnalytics:
+    def test_report_from_real_trace(self, devices8, tmp_path):
+        """Offline analytics (reference profiling/process_*.py parity) over
+        a real traced training run: iteration stats, compute/comm ratio,
+        phase windows."""
+        from tests.test_training import learnable_batches
+
+        from megatronapp_tpu.config.parallel_config import ParallelConfig
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.trace.analytics import analyze
+        from megatronapp_tpu.training.train import pretrain_gpt
+
+        model = TransformerConfig(num_layers=2, hidden_size=64,
+                                  num_attention_heads=4, vocab_size=128,
+                                  max_position_embeddings=64)
+        par = ParallelConfig()
+        ctx = build_mesh(par, devices=devices8[:1])
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=4,
+                               seq_length=32, train_iters=4,
+                               log_interval=2, trace=True,
+                               trace_interval=2,
+                               continuous_trace_iterations=1,
+                               trace_dir=str(tmp_path))
+        pretrain_gpt(model, par, train, OptimizerConfig(lr=1e-3), ctx=ctx,
+                     batch_iter=learnable_batches(32, 128, 4),
+                     log_fn=lambda m: None)
+        report = analyze(str(tmp_path))
+        assert report["iteration_time"]["iterations"] >= 1
+        assert report["iteration_time"]["mean_us"] > 0
+        # The traced step carries phase spans on the CPU backend.
+        assert report["phases"], report
+        for pid, d in report["compute_comm"].items():
+            assert 0.0 <= d["comm_fraction"] <= 1.0
