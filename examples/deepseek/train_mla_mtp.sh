@@ -1,0 +1,13 @@
+#!/bin/bash
+# DeepSeek-style MLA + MTP + MoE (reference MLATransformerConfig +
+# multi_token_prediction.py + mixtral-style EP).
+python pretrain_gpt.py \
+    --num-layers 12 --hidden-size 1024 --num-attention-heads 16 \
+    --multi-latent-attention --kv-lora-rank 256 --qk-head-dim 64 \
+    --qk-pos-emb-head-dim 32 --v-head-dim 64 \
+    --mtp-num-layers 1 --mtp-loss-scaling-factor 0.1 \
+    --num-experts 8 --moe-router-topk 2 --moe-aux-loss-coeff 0.01 \
+    --expert-model-parallel-size 4 \
+    --seq-length 2048 --max-position-embeddings 2048 \
+    --micro-batch-size 1 --global-batch-size 32 \
+    --train-iters 1000 --lr 1e-4 "$@"

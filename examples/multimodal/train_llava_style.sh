@@ -1,0 +1,9 @@
+#!/bin/bash
+# LLaVA-style vision-language pretraining (reference pretrain_vlm.py /
+# examples/multimodal llava scripts).
+python pretrain_vlm.py \
+    --num-layers 12 --hidden-size 768 --num-attention-heads 12 \
+    --seq-length 256 --max-position-embeddings 1024 \
+    --img-size 224 --patch-dim 16 --vision-num-layers 6 \
+    --micro-batch-size 2 --global-batch-size 16 \
+    --train-iters 1000 --lr 1e-4 "$@"

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Retro with chunked cross-attention (reference pretrain_retro.py /
+# examples retro configs; neighbors from a retrieval DB or synthetic).
+python pretrain_retro.py \
+    --num-layers 12 --hidden-size 768 --num-attention-heads 12 \
+    --seq-length 1024 --max-position-embeddings 1024 \
+    --retro-chunk-length 64 --retro-num-neighbors 2 \
+    --retro-retrieved-length 128 \
+    --micro-batch-size 2 --global-batch-size 16 \
+    --train-iters 1000 --lr 1e-4 "$@"

@@ -1,0 +1,11 @@
+#!/bin/bash
+# T5-base span corruption with the pipelined encoder/decoder (the TPU-first
+# redesign of the reference --pipeline-model-parallel-split-rank; reference
+# examples/t5).
+python pretrain_t5.py \
+    --num-layers 12 --hidden-size 768 --num-attention-heads 12 \
+    --vocab-size 32128 --seq-length 512 --max-position-embeddings 512 \
+    --decoder-seq-length 128 \
+    --micro-batch-size 4 --global-batch-size 32 \
+    --pipeline-model-parallel-size 2 \
+    --train-iters 1000 --lr 1e-4 "$@"

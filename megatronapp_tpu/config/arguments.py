@@ -55,6 +55,10 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
                    help="named model preset (models/presets.py); flags "
                         "override preset fields they explicitly set")
 
+    g = ap.add_argument_group("mtp")  # multi_token_prediction.py parity
+    g.add_argument("--mtp-num-layers", type=int, default=None)
+    g.add_argument("--mtp-loss-scaling-factor", type=float, default=0.1)
+
     g = ap.add_argument_group("mla")  # MLATransformerConfig parity
     g.add_argument("--multi-latent-attention", action="store_true")
     g.add_argument("--q-lora-rank", type=int, default=None)
@@ -78,6 +82,10 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     g.add_argument("--tensor-model-parallel-size", type=int, default=1)
     g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
     g.add_argument("--context-parallel-size", type=int, default=1)
+    g.add_argument("--hierarchical-context-parallel-sizes", nargs=2,
+                   type=int, default=None, metavar=("A2A", "RING"),
+                   help="inner a2a x outer ring sizes for "
+                        "cp-comm-type a2a+p2p (reference flag)")
     g.add_argument("--expert-model-parallel-size", type=int, default=1)
     g.add_argument("--num-layers-per-virtual-pipeline-stage", type=int,
                    default=None)
@@ -305,6 +313,8 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
             moe_layer_freq=args.moe_layer_freq,
             moe_shared_expert_intermediate_size=(
                 args.moe_shared_expert_intermediate_size),
+            mtp_num_layers=args.mtp_num_layers,
+            mtp_loss_scaling_factor=args.mtp_loss_scaling_factor,
             multi_latent_attention=args.multi_latent_attention,
             q_lora_rank=args.q_lora_rank,
             kv_lora_rank=args.kv_lora_rank,
@@ -312,6 +322,9 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
             qk_pos_emb_head_dim=args.qk_pos_emb_head_dim,
             v_head_dim=args.v_head_dim,
             cp_comm_type=args.cp_comm_type,
+            hierarchical_cp_a2a_size=(
+                args.hierarchical_context_parallel_sizes[0]
+                if args.hierarchical_context_parallel_sizes else 2),
             remat_policy=args.recompute_granularity,
             compute_dtype=jnp.float32 if args.fp32 else jnp.bfloat16,
         )
@@ -342,6 +355,17 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
     if args.seq_length % (args.context_parallel_size or 1) != 0:
         raise ValueError("--seq-length must be divisible by "
                          "--context-parallel-size")
+    if args.hierarchical_context_parallel_sizes:
+        a2a_sz, ring_sz = args.hierarchical_context_parallel_sizes
+        if a2a_sz * ring_sz != args.context_parallel_size:
+            raise ValueError(
+                f"--hierarchical-context-parallel-sizes {a2a_sz} {ring_sz} "
+                f"must multiply to --context-parallel-size "
+                f"({args.context_parallel_size})")
+        if args.cp_comm_type != "a2a+p2p":
+            raise ValueError(
+                "--hierarchical-context-parallel-sizes requires "
+                "--cp-comm-type a2a+p2p")
     if args.seq_length > model.max_position_embeddings:
         raise ValueError("--seq-length exceeds --max-position-embeddings")
 

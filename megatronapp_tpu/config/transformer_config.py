@@ -109,6 +109,12 @@ class TransformerConfig:
     # Layer frequency: 1 = every layer is MoE; k = every k-th layer.
     moe_layer_freq: int = 1
 
+    # Multi-token prediction (DeepSeek-V3; reference
+    # multi_token_prediction.py + transformer_config mtp_num_layers /
+    # mtp_loss_scaling_factor).
+    mtp_num_layers: Optional[int] = None
+    mtp_loss_scaling_factor: float = 0.1
+
     # Multi-latent attention (DeepSeek-style MLA; reference multi_latent_attention.py:44).
     multi_latent_attention: bool = False
     q_lora_rank: Optional[int] = None
@@ -130,6 +136,9 @@ class TransformerConfig:
     # transformer_config.py:458-462): 'p2p' ring / 'a2a' Ulysses /
     # 'allgather'.
     cp_comm_type: str = "p2p"
+    # Inner all-to-all group size for cp_comm_type='a2a+p2p' (reference
+    # --hierarchical-context-parallel-sizes inner dimension).
+    hierarchical_cp_a2a_size: int = 2
     # Causal 'p2p' ring uses the load-balanced zigzag layout (rank i holds
     # chunks i and 2cp-1-i — the reference's TE ring behavior). Disable to
     # force the contiguous-layout ring (debug/oracle comparisons).

@@ -57,13 +57,16 @@ def init_gpt_params(rng, cfg: TransformerConfig, pp: int = 1, vpp: int = 1):
         from megatronapp_tpu.parallel.pipeline import (
             reshape_params_for_pipeline,
         )
-        if cfg.is_moe and cfg.moe_layer_freq > 1:
-            raise NotImplementedError(
-                "pipeline parallelism with moe_layer_freq > 1 group-scan "
-                "layout is not supported yet")
-        if cfg.num_layers % (pp * vpp) != 0:
+        # moe_layer_freq > 1 pipelines in GROUP units: the group-scan
+        # layout {moe: [G,...], dense: [G, freq-1, ...]} reshapes its
+        # leading G axis exactly like the uniform L axis (each pipeline
+        # "layer" is one {1 moe + freq-1 dense} group).
+        units = (cfg.num_layers // cfg.moe_layer_freq
+                 if cfg.is_moe and cfg.moe_layer_freq > 1
+                 else cfg.num_layers)
+        if units % (pp * vpp) != 0:
             raise ValueError(
-                f"num_layers={cfg.num_layers} not divisible by "
+                f"{units} pipeline units (layers/groups) not divisible by "
                 f"pp*vpp={pp * vpp}")
         p["block"] = reshape_params_for_pipeline(p["block"], pp, vpp)
         from megatronapp_tpu.parallel.sharding import is_logical_axes
@@ -74,6 +77,13 @@ def init_gpt_params(rng, cfg: TransformerConfig, pp: int = 1, vpp: int = 1):
         p["output"] = jax.random.normal(
             k_out, (cfg.hidden_size, cfg.vocab_size), cfg.params_dtype) * std
         ax["output"] = ("embed", "vocab")
+    if cfg.mtp_num_layers:
+        if pp > 1:
+            raise NotImplementedError(
+                "multi-token prediction under pipeline parallelism is not "
+                "supported yet (reference places MTP on the last stage)")
+        from megatronapp_tpu.transformer.mtp import init_mtp_params
+        p["mtp"], ax["mtp"] = init_mtp_params(k_out, cfg)
     return p, ax
 
 
@@ -167,8 +177,10 @@ def gpt_forward(p, tokens: jnp.ndarray, cfg: TransformerConfig,
                 attention_mask: Optional[jnp.ndarray] = None,
                 position_offset: int = 0, ctx=None,
                 segment_ids: Optional[jnp.ndarray] = None,
-                zigzag_keep: bool = False):
-    """tokens [B,S] → (logits [B,S,V] fp32, moe_aux_loss).
+                zigzag_keep: bool = False, return_hidden: bool = False):
+    """tokens [B,S] → (logits [B,S,V] fp32, moe_aux_loss) —
+    (+ pre-head hidden states and rope tables when return_hidden, for the
+    MTP depth modules).
 
     segment_ids: optional [B,S] packing map — attention is restricted to
     within-segment (packed sequences).
@@ -206,6 +218,8 @@ def gpt_forward(p, tokens: jnp.ndarray, cfg: TransformerConfig,
     if zz and not zigzag_keep:
         logits = jnp.take(logits, jnp.asarray(zigzag_inverse_indices(
             s, ctx.cp)), axis=1)
+    if return_hidden:
+        return logits, aux, h, (cos, sin)
     return logits, aux
 
 
@@ -217,8 +231,25 @@ def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
     from megatronapp_tpu.ops.context_parallel import (
         zigzag_active, zigzag_indices,
     )
-    logits, aux = gpt_forward(p, tokens, cfg, ctx=ctx,
-                              segment_ids=segment_ids, zigzag_keep=True)
+    mtp_metrics = {}
+    if cfg.mtp_num_layers:
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "multi token prediction + sequence packing is not "
+                "supported (reference multi_token_prediction.py assert)")
+        from megatronapp_tpu.transformer.mtp import mtp_loss as _mtp_loss
+        logits, aux, hid, (cos, sin) = gpt_forward(
+            p, tokens, cfg, ctx=ctx, zigzag_keep=True, return_hidden=True)
+        mtp_scaled, mtp_mean = _mtp_loss(
+            p["mtp"], hid, lambda t: gpt_embed(p, t, cfg),
+            lambda hh: gpt_head(p, hh, cfg), tokens, targets, loss_mask,
+            cfg, cos, sin, ctx=ctx)
+        aux = aux + mtp_scaled
+        mtp_metrics["mtp_loss"] = mtp_mean
+    else:
+        logits, aux = gpt_forward(p, tokens, cfg, ctx=ctx,
+                                  segment_ids=segment_ids,
+                                  zigzag_keep=True)
     if zigzag_active(cfg, ctx) and segment_ids is None:
         # Logits are in zigzag order — permute targets/mask to match (the
         # masked-mean CE is permutation-invariant).
@@ -227,7 +258,8 @@ def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
         if loss_mask is not None:
             loss_mask = jnp.take(loss_mask, idx, axis=1)
     loss, _ = cross_entropy_loss(logits, targets, loss_mask)
-    return loss + aux, {"lm_loss": loss, "moe_aux_loss": aux}
+    return loss + aux, {"lm_loss": loss, "moe_aux_loss": aux,
+                        **mtp_metrics}
 
 
 def gpt_head(p, h: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
@@ -286,7 +318,13 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
     h = h.reshape(m, mb, s, -1)
     cos, sin = gpt_rope_tables(cfg, s, positions=positions)
 
+    # Pipeline offsets count scan units; with the moe group-scan each unit
+    # is moe_layer_freq layers (layer ids feed scope captures/disturbance).
+    unit_layers = (cfg.moe_layer_freq
+                   if cfg.is_moe and cfg.moe_layer_freq > 1 else 1)
+
     def stage_fn(chunk_params, x, layer_offset):
+        layer_offset = layer_offset * unit_layers
         cos_l, sin_l = cos, sin
         from megatronapp_tpu.config.parallel_config import CP_AXIS
         from megatronapp_tpu.parallel.collectives import current_manual_axes

@@ -123,9 +123,8 @@ def vit_classify(p, images: jnp.ndarray, cfg: TransformerConfig,
 def vit_classification_loss(p, images, labels, cfg: TransformerConfig,
                             spec: VitSpec, ctx=None):
     """CE over classes (pretrain_vision_classify.py loss parity)."""
+    from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
     logits = vit_classify(p, images, cfg, spec, ctx=ctx)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    tgt = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
-    loss = jnp.mean(logz - tgt)
+    loss, _ = cross_entropy_loss(logits[:, None], labels[:, None])
     acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
     return loss, {"lm_loss": loss, "accuracy": acc}

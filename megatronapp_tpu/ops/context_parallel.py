@@ -65,6 +65,7 @@ def ring_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
     cp = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, sq, h, d = q.shape
+    dv = v.shape[-1]  # may differ from d (MLA: nope+rope keys vs values)
     if softmax_scale is None:
         softmax_scale = 1.0 / (d ** 0.5)
     # GQA: K/V ride the ring un-repeated (fewer bytes per ppermute hop);
@@ -76,7 +77,7 @@ def ring_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
     from megatronapp_tpu.parallel.collectives import (
         full_like_vma, zeros_like_vma,
     )
-    o = zeros_like_vma((b, h, sq, d), jnp.float32, q)
+    o = zeros_like_vma((b, h, sq, dv), jnp.float32, q)
     m = full_like_vma((b, h, sq), _NEG_INF, jnp.float32, q)
     l = zeros_like_vma((b, h, sq), jnp.float32, q)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
@@ -214,6 +215,7 @@ def zigzag_ring_attention(q, k, v, axis_name: str = CP_AXIS,
     cp = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, sq, h, d = q.shape
+    dv = v.shape[-1]
     c = sq // 2  # one global chunk
     if softmax_scale is None:
         softmax_scale = 1.0 / (d ** 0.5)
@@ -360,17 +362,114 @@ def allgather_attention(q, k, v, axis_name: str = CP_AXIS,
         q_offset=my * sq)
 
 
+def hierarchical_attention(q, k, v, axis_name: str = CP_AXIS,
+                           causal: bool = True,
+                           softmax_scale: Optional[float] = None,
+                           segment_ids=None, a2a_size: int = 2):
+    """Hierarchical CP (reference cp_comm_type='a2a+p2p',
+    transformer_config.py:458-462 + hierarchical CP groups
+    parallel_state.py:100-121): Ulysses head-scatter WITHIN inner groups of
+    `a2a_size` adjacent ranks (cheap links), ring P2P ACROSS the
+    ring_size = cp/a2a_size outer groups (one KV span per hop).
+
+    After the inner all-to-all each rank holds its inner group's contiguous
+    sequence span [g*S/ring, (g+1)*S/ring) with H/a2a_size heads; the outer
+    ring rotates K/V spans with group-granular causal skipping (diagonal
+    span gets the within-span causal mask, earlier spans are fully
+    visible). Requires heads % a2a_size == 0 and contiguous cp sharding.
+    """
+    if segment_ids is not None:
+        raise NotImplementedError(
+            "packed sequences under hierarchical (a2a+p2p) cp are not "
+            "supported; use 'p2p' or 'a2a'")
+    cp = jax.lax.axis_size(axis_name)
+    assert cp % a2a_size == 0, (cp, a2a_size)
+    ring_size = cp // a2a_size
+    my = jax.lax.axis_index(axis_name)
+    my_group = my // a2a_size
+    inner_groups = [[g * a2a_size + i for i in range(a2a_size)]
+                    for g in range(ring_size)]
+
+    def scatter_heads(x):
+        # [B, S/cp, H, D] → [B, S/ring, H/a2a, D] within the inner group.
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True,
+                                  axis_index_groups=inner_groups)
+
+    def gather_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True,
+                                  axis_index_groups=inner_groups)
+
+    q, k, v = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    b, sq, h, d = q.shape
+    dv = v.shape[-1]
+    if softmax_scale is None:
+        softmax_scale = 1.0 / (d ** 0.5)
+    # Ring across outer groups: rank r exchanges with r+a2a_size (same
+    # inner position, next group) — each hop moves one sequence span.
+    perm = [(r, (r + a2a_size) % cp) for r in range(cp)]
+
+    def block_update(o, m, l, k_blk, v_blk, src_group):
+        s_ = _block_scores(q, repeat_kv(k_blk, h), softmax_scale)
+        if causal:
+            q_pos = jnp.arange(sq)
+            kv_pos = jnp.arange(k_blk.shape[1])
+            within = q_pos[:, None] >= kv_pos[None, :]
+            blk_mask = jnp.where(
+                src_group == my_group, within,
+                jnp.broadcast_to(src_group < my_group, within.shape))
+            s_ = jnp.where(blk_mask[None, None], s_, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_, axis=-1))
+        m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+        pr = jnp.exp(s_ - m_safe[..., None])
+        if causal:
+            pr = jnp.where(blk_mask[None, None], pr, 0.0)
+        corr = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        corr = jnp.where(m <= _NEG_INF / 2, 0.0, corr)
+        l = l * corr + jnp.sum(pr, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", pr.astype(v_blk.dtype),
+                        repeat_kv(v_blk, h),
+                        preferred_element_type=jnp.float32)
+        o = o * corr[..., None] + pv
+        return o, m_new, l
+
+    from megatronapp_tpu.parallel.collectives import (
+        full_like_vma, zeros_like_vma,
+    )
+    o = zeros_like_vma((b, h, sq, dv), jnp.float32, q)
+    m = full_like_vma((b, h, sq), _NEG_INF, jnp.float32, q)
+    l = zeros_like_vma((b, h, sq), jnp.float32, q)
+    o, m, l = block_update(o, m, l, k, v, my_group)
+
+    def body(carry, step):
+        o, m, l, k_blk, v_blk = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src_group = (my_group - step) % ring_size
+        o, m, l = block_update(o, m, l, k_blk, v_blk, src_group)
+        return (o, m, l, k_blk, v_blk), None
+
+    if ring_size > 1:
+        (o, m, l, _, _), _ = jax.lax.scan(body, (o, m, l, k, v),
+                                          jnp.arange(1, ring_size))
+    out = o / jnp.maximum(l, 1e-20)[..., None]
+    out = jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    return gather_heads(out)
+
+
 _CP_IMPLS = {
     "p2p": ring_attention,
     "p2p_zigzag": zigzag_ring_attention,
     "a2a": ulysses_attention,
     "allgather": allgather_attention,
+    "a2a+p2p": hierarchical_attention,
 }
 # Authoritative set of valid cp_comm_type CONFIG values (reference names;
 # 'p2p' auto-upgrades to the zigzag impl for causal attention when
 # TransformerConfig.cp_zigzag — the internal 'p2p_zigzag' key is not a
 # user-facing config value).
-CP_COMM_TYPES = frozenset({"p2p", "a2a", "allgather"})
+CP_COMM_TYPES = frozenset({"p2p", "a2a", "allgather", "a2a+p2p"})
 
 
 def zigzag_active(cfg, ctx) -> bool:
@@ -381,14 +480,17 @@ def zigzag_active(cfg, ctx) -> bool:
     safely keep the contiguous ring."""
     from megatronapp_tpu.config.transformer_config import AttnMaskType
     return (ctx is not None and ctx.cp > 1 and cfg.cp_comm_type == "p2p"
-            and cfg.cp_zigzag
+            and cfg.cp_zigzag and not cfg.multi_latent_attention
+            # MTP depth modules roll tokens/labels in natural order; the
+            # zigzag permutation would misalign h with emb(t_{i+k}).
+            and not cfg.mtp_num_layers
             and cfg.attn_mask_type == AttnMaskType.causal)
 
 
 def context_attention(q, k, v, mesh, cp_comm_type: str = "p2p",
                       causal: bool = True,
                       softmax_scale: Optional[float] = None,
-                      segment_ids=None):
+                      segment_ids=None, a2a_size: int = 2):
     """Outer wrapper: shard_map over 'cp' (auto for all other axes).
 
     q,k,v: GLOBAL [B, S, H, D] arrays with S sharded over cp. Returns global
@@ -400,7 +502,9 @@ def context_attention(q, k, v, mesh, cp_comm_type: str = "p2p",
             f"cp_comm_type must be one of {sorted(_CP_IMPLS)}, got "
             f"{cp_comm_type!r}")
     impl = _CP_IMPLS[cp_comm_type]
-    fn = functools.partial(impl, causal=causal, softmax_scale=softmax_scale)
+    extra = ({"a2a_size": a2a_size} if cp_comm_type == "a2a+p2p" else {})
+    fn = functools.partial(impl, causal=causal, softmax_scale=softmax_scale,
+                           **extra)
 
     # If 'cp' is ALREADY manual in the ambient context (we're inside the
     # pp(+cp) pipeline shard_map — nested shard_maps are unreliable in this

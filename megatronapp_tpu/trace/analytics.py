@@ -50,8 +50,11 @@ def compute_comm_ratio(events: List[dict]) -> Dict:
     """Total compute vs communication span time per process (reference
     process_send_compute.py ratio)."""
     per_pid = defaultdict(lambda: {"compute_us": 0.0, "comm_us": 0.0})
+    # Wrapper spans contain the phase spans — counting both would double
+    # every microsecond (train-step wraps forward/backward/grad-sync).
+    wrappers = {"iteration", "train-step"}
     for e in events:
-        if e.get("ph") != "X" or e.get("name") == "iteration":
+        if e.get("ph") != "X" or e.get("name") in wrappers:
             continue
         bucket = "comm_us" if is_comm_event(e["name"]) else "compute_us"
         per_pid[e.get("pid", 0)][bucket] += e["dur"]

@@ -157,7 +157,8 @@ def attention_forward(
         attn_out = context_attention(
             q, k, v, ctx.shard_map_mesh, comm,
             causal=cfg.attn_mask_type == AttnMaskType.causal,
-            segment_ids=segment_ids)
+            segment_ids=segment_ids,
+            a2a_size=cfg.hierarchical_cp_a2a_size)
     else:
         from megatronapp_tpu.parallel.collectives import current_manual_axes
 

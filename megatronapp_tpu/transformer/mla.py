@@ -79,10 +79,6 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     Decode recomputes k_nope/v from the cached latent via kv_up each step
     (the storage-optimal variant; weight absorption into q is a further
     flop optimization)."""
-    if ctx is not None and ctx.cp > 1:
-        raise NotImplementedError(
-            "MLA under context parallelism is not implemented yet (needs "
-            "the ring/a2a path for the concatenated nope+rope heads)")
     from megatronapp_tpu.scope.disturbance import get_disturbance
     from megatronapp_tpu.scope.hooks import scope_capture
     _dist = get_disturbance()
@@ -116,6 +112,10 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     new_cache = None
     s_kv = s
     if kv_cache is not None:
+        if ctx is not None and ctx.cp > 1:
+            raise NotImplementedError(
+                "MLA decode with a KV cache under context parallelism is "
+                "not supported (each shard would attend only local KV)")
         # Append the normed latent + roped shared key at cache_index; the
         # whole cached history reconstitutes k_nope/v below.
         c_lat, c_pe = kv_cache
@@ -150,11 +150,27 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     k_full = scope_capture("qkv_k", k_full, layer_id)
     v = scope_capture("qkv_v", v, layer_id)
     scale = 1.0 / jnp.sqrt(jnp.float32(dqk + dpe))
-    out = dot_product_attention(
-        q_full, k_full, v, mask_type=cfg.attn_mask_type,
-        attention_mask=attention_mask, softmax_scale=scale,
-        softmax_in_fp32=cfg.attention_softmax_in_fp32,
-        q_offset=0 if cache_index is None else cache_index)
+    if ctx is not None and ctx.cp > 1 and kv_cache is None:
+        # Context parallelism over the concatenated nope+rope heads
+        # (values have a different head dim — the cp impls handle
+        # d_v != d_qk). Contiguous modes only: MLA is excluded from the
+        # zigzag layout (zigzag_active).
+        from megatronapp_tpu.config.transformer_config import AttnMaskType
+        from megatronapp_tpu.ops.context_parallel import context_attention
+        if attention_mask is not None:
+            raise NotImplementedError(
+                "MLA + explicit attention mask under cp is unsupported")
+        out = context_attention(
+            q_full, k_full, v, ctx.shard_map_mesh, cfg.cp_comm_type,
+            causal=cfg.attn_mask_type == AttnMaskType.causal,
+            softmax_scale=float(1.0 / (dqk + dpe) ** 0.5),
+            a2a_size=cfg.hierarchical_cp_a2a_size)
+    else:
+        out = dot_product_attention(
+            q_full, k_full, v, mask_type=cfg.attn_mask_type,
+            attention_mask=attention_mask, softmax_scale=scale,
+            softmax_in_fp32=cfg.attention_softmax_in_fp32,
+            q_offset=0 if cache_index is None else cache_index)
     out = scope_capture("context", out, layer_id)
     out = out.reshape(b, s, nq * dv) @ _dist.apply(
         "weight", p["out_kernel"], layer_id).astype(dt)

@@ -1,0 +1,98 @@
+"""Multi-token prediction (MTP).
+
+Parity with /root/reference/megatron/core/transformer/
+multi_token_prediction.py (MultiTokenPredictionLayer, DeepSeek-V3 recipe):
+D sequential depth modules each predict one additional future token while
+keeping the causal chain — depth k combines RMSNorm(h^{k-1}) with
+RMSNorm(emb(t_{i+k})) through a linear projection, runs one shared-spec
+transformer layer, and scores with the SHARED output head; the auxiliary
+loss is mtp_loss_scaling_factor × mean over depths.
+
+TPU-first: depth modules are a Python loop over D (D is small and static);
+each depth is the same scan-free layer body the main stack uses, so XLA
+fuses it into the step program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
+from megatronapp_tpu.ops.normalization import rms_norm
+from megatronapp_tpu.transformer.block import (
+    init_layer_params, layer_forward,
+)
+
+
+def init_mtp_params(rng, cfg: TransformerConfig):
+    """[D] list of depth modules: input norms + 2H→H projection + one
+    transformer layer (embedding/head are SHARED with the main model)."""
+    h = cfg.hidden_size
+    depths = []
+    axes = []
+    for k in range(cfg.mtp_num_layers or 0):
+        kp, kl = jax.random.split(jax.random.fold_in(rng, k))
+        layer_p, layer_ax = init_layer_params(kl, cfg)
+        depths.append({
+            "hnorm_scale": jnp.ones((h,), cfg.params_dtype),
+            "enorm_scale": jnp.ones((h,), cfg.params_dtype),
+            "proj": jax.random.normal(kp, (2 * h, h), cfg.params_dtype)
+            * cfg.init_method_std,
+            "layer": layer_p,
+        })
+        axes.append({
+            "hnorm_scale": ("embed",), "enorm_scale": ("embed",),
+            "proj": (None, "embed"), "layer": layer_ax,
+        })
+    return depths, axes
+
+
+def mtp_loss(mtp_params, h: jnp.ndarray, embed_fn, head_fn,
+             tokens: jnp.ndarray, labels: jnp.ndarray,
+             loss_mask: Optional[jnp.ndarray], cfg: TransformerConfig,
+             rope_cos=None, rope_sin=None, ctx=None
+             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Auxiliary MTP loss (reference MTPLossAutoScaler path).
+
+    h: [B,S,H] main-stack output (pre final-norm/head); embed_fn(tokens) →
+    [B,S,H]; head_fn(h) → logits. Depth k (1-based) predicts labels rolled
+    left by k; the trailing k positions are masked out (roll_tensor
+    semantics, multi_token_prediction.py:119).
+
+    Returns (scaled_total, per_depth_mean) — add scaled_total to the LM
+    loss; log per_depth_mean (track_mtp_metrics analogue).
+    """
+    d_depths = len(mtp_params)
+    if d_depths == 0:
+        z = jnp.zeros((), jnp.float32)
+        return z, z
+    b, s = tokens.shape
+    if loss_mask is None:
+        loss_mask = jnp.ones((b, s), jnp.float32)
+
+    total = jnp.zeros((), jnp.float32)
+    for k, dp in enumerate(mtp_params, start=1):
+        # Embedding of token t_{i+k} at position i.
+        toks_k = jnp.roll(tokens, -k, axis=1)
+        emb_k = embed_fn(toks_k)
+        x = jnp.concatenate(
+            [rms_norm(h, dp["hnorm_scale"], cfg.layernorm_epsilon),
+             rms_norm(emb_k, dp["enorm_scale"], cfg.layernorm_epsilon)],
+            axis=-1).astype(cfg.compute_dtype)
+        x = x @ dp["proj"].astype(cfg.compute_dtype)
+        (h, _), _ = layer_forward(dp["layer"], x, cfg, rope_cos, rope_sin,
+                                  None, layer_id=None, ctx=ctx)
+        logits = head_fn(h)
+        labels_k = jnp.roll(labels, -k, axis=1)
+        # Positions whose target rolled past the end contribute nothing.
+        valid = (jnp.arange(s) < s - k).astype(jnp.float32)[None, :]
+        mask_k = jnp.roll(loss_mask, -k, axis=1) * valid
+        loss_k, _ = cross_entropy_loss(logits, labels_k, mask_k)
+        total = total + loss_k
+    mean = total / d_depths
+    scale = cfg.mtp_loss_scaling_factor
+    return scale * mean, mean

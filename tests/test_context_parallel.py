@@ -182,3 +182,58 @@ class TestCPTraining:
             results[cp] = res.losses
         assert results[2][-1] < results[2][0]
         np.testing.assert_allclose(results[1], results[2], atol=1e-4)
+
+
+class TestHierarchicalCP:
+    @pytest.mark.parametrize("a2a_size", [2, 4])
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, devices8, a2a_size, causal):
+        """a2a+p2p: Ulysses within inner groups, ring across — matches the
+        dense oracle for every factorization of cp=8."""
+        from jax.sharding import PartitionSpec as P
+        from megatronapp_tpu.config.transformer_config import AttnMaskType
+        from megatronapp_tpu.ops.context_parallel import (
+            hierarchical_attention,
+        )
+        cp = 8
+        mesh = jax.sharding.Mesh(np.array(devices8[:cp]), ("cp",))
+        b, s, h, d = 2, 8 * cp, 8, 16
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h, d))
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d))
+        ref = dot_product_attention(
+            q, k, v, mask_type=(AttnMaskType.causal if causal
+                                else AttnMaskType.bidirectional))
+        f = jax.jit(jax.shard_map(
+            lambda a, b_, c: hierarchical_attention(
+                a, b_, c, axis_name="cp", causal=causal,
+                a2a_size=a2a_size),
+            mesh=mesh, in_specs=(P(None, "cp"),) * 3,
+            out_specs=P(None, "cp"), axis_names={"cp"}))
+        np.testing.assert_allclose(np.asarray(f(q, k, v)),
+                                   np.asarray(ref), atol=3e-5)
+
+    def test_model_level_training(self, devices8):
+        """GPT trains with cp_comm_type='a2a+p2p' and tracks the cp=1 run."""
+        import dataclasses
+
+        from tests.test_training import learnable_batches
+        model_kw = dict(num_layers=2, hidden_size=64,
+                        num_attention_heads=4, vocab_size=128,
+                        max_position_embeddings=64,
+                        compute_dtype=jnp.float32,
+                        cp_comm_type="a2a+p2p",
+                        hierarchical_cp_a2a_size=2)
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                               seq_length=32, train_iters=6,
+                               log_interval=3)
+        opt = OptimizerConfig(lr=1e-3, lr_decay_iters=6)
+        results = {}
+        for cp in (1, 4):
+            model = TransformerConfig(**model_kw)
+            par = ParallelConfig(context_parallel=cp)
+            ctx = build_mesh(par, devices=devices8[:max(cp, 1)])
+            res = pretrain_gpt(model, par, train, opt, ctx=ctx,
+                               batch_iter=learnable_batches(32, 128, 8))
+            results[cp] = res.losses
+        np.testing.assert_allclose(results[4], results[1], atol=1e-4)
