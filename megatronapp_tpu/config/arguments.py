@@ -93,7 +93,7 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     g.add_argument("--use-distributed-optimizer", action="store_true",
                    default=True)
     g.add_argument("--cp-comm-type", default="p2p",
-                   choices=["p2p", "a2a", "allgather"])
+                   choices=["p2p", "a2a", "allgather", "a2a+p2p"])
     # MegaFBD / MegaDPP flags (reference arguments.py:2197-2205).
     g.add_argument("--forward-backward-disaggregating", action="store_true")
     g.add_argument("--use-dpp", action="store_true",

@@ -1,0 +1,108 @@
+"""Cross-process batch prefetching over the C++ shared-memory ring.
+
+This is where MegaDPP's shm transport earns its keep on TPU (SURVEY §2.7:
+"keep a C++ shm ring for host-side staging"): inter-CHIP activation traffic
+belongs to XLA collectives, but host-side BATCH PREPARATION (tokenization,
+masking, sample-index gathers) is Python work that otherwise serializes
+with step dispatch. A producer PROCESS builds batches and pushes each
+field's array through `runtime/shm_ring.ShmRing` (zero-copy writes into
+/dev/shm, SPSC lock-free); the trainer pops ready batches — data prep
+overlaps device execution across a process boundary, the same
+producer/consumer structure as the reference's background sender/receiver
+threads (shm_tensor_new_rdma.cpp:1478-1646).
+
+Wire protocol per batch: one uint8 JSON header (field names) then one
+array per field in header order (ShmRing frames carry dtype/shape).
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import time
+from typing import Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from megatronapp_tpu.runtime.shm_ring import ShmRing
+
+
+def _producer(name: str, factory: Callable[[], Iterator],
+              num_batches: int, capacity: int):
+    ring = ShmRing(name, create=False)
+    it = factory()
+    try:
+        for _ in range(num_batches):
+            batch = next(it)
+            keys = sorted(batch)
+            header = json.dumps({"keys": keys}).encode()
+            payloads = [np.frombuffer(header, np.uint8)] + [
+                np.ascontiguousarray(batch[k]) for k in keys]
+            for arr in payloads:
+                while not ring.push_array(arr):
+                    time.sleep(0.0005)
+    finally:
+        ring.close()
+
+
+class ShmPrefetcher:
+    """Iterator over batches produced in a separate process.
+
+    factory() must be picklable (a module-level function or partial) and
+    return the batch iterator when called INSIDE the producer process.
+    """
+
+    def __init__(self, factory: Callable[[], Iterator],
+                 num_batches: int, capacity: int = 1 << 26,
+                 name: Optional[str] = None):
+        self.name = name or f"/mta_prefetch_{time.time_ns() & 0xFFFFFF}"
+        self.ring = ShmRing(self.name, capacity=capacity)
+        self.num_batches = num_batches
+        self._served = 0
+        ctx = mp.get_context("spawn")
+        self.proc = ctx.Process(
+            target=_producer,
+            args=(self.name, factory, num_batches, capacity), daemon=True)
+        self.proc.start()
+
+    def _pop(self, timeout: float = 300.0) -> np.ndarray:
+        deadline = time.monotonic() + timeout
+        while True:
+            arr = self.ring.pop_array()
+            if arr is not None:
+                return arr
+            if not self.proc.is_alive():
+                # Drain: the producer may have pushed its final frames
+                # right before exiting.
+                arr = self.ring.pop_array()
+                if arr is not None:
+                    return arr
+                raise RuntimeError(
+                    "prefetch producer died before finishing")
+            if time.monotonic() > deadline:
+                raise TimeoutError("prefetch pop timed out")
+            time.sleep(0.0005)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        if self._served >= self.num_batches:
+            raise StopIteration
+        header = json.loads(self._pop().tobytes().decode())
+        batch = {key: self._pop() for key in header["keys"]}
+        self._served += 1
+        return batch
+
+    def close(self):
+        if self.proc.is_alive():
+            self.proc.terminate()
+        self.proc.join(timeout=5)
+        self.ring.close()
+        self.ring.unlink()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -240,12 +240,16 @@ def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
         from megatronapp_tpu.transformer.mtp import mtp_loss as _mtp_loss
         logits, aux, hid, (cos, sin) = gpt_forward(
             p, tokens, cfg, ctx=ctx, zigzag_keep=True, return_hidden=True)
-        mtp_scaled, mtp_mean = _mtp_loss(
+        mtp_scaled, mtp_mean, mtp_layer_aux = _mtp_loss(
             p["mtp"], hid, lambda t: gpt_embed(p, t, cfg),
             lambda hh: gpt_head(p, hh, cfg), tokens, targets, loss_mask,
             cfg, cos, sin, ctx=ctx)
-        aux = aux + mtp_scaled
+        # Keep 'moe_aux_loss' pure: the depth layers' router losses join
+        # it (unscaled, like main-stack layers); the scaled MTP CE is
+        # carried separately into the total.
+        aux = aux + mtp_layer_aux
         mtp_metrics["mtp_loss"] = mtp_mean
+        mtp_metrics["_mtp_scaled"] = mtp_scaled
     else:
         logits, aux = gpt_forward(p, tokens, cfg, ctx=ctx,
                                   segment_ids=segment_ids,
@@ -258,8 +262,11 @@ def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
         if loss_mask is not None:
             loss_mask = jnp.take(loss_mask, idx, axis=1)
     loss, _ = cross_entropy_loss(logits, targets, loss_mask)
-    return loss + aux, {"lm_loss": loss, "moe_aux_loss": aux,
-                        **mtp_metrics}
+    mtp_scaled_term = mtp_metrics.pop("_mtp_scaled",
+                                      jnp.zeros((), jnp.float32))
+    return loss + aux + mtp_scaled_term, {"lm_loss": loss,
+                                          "moe_aux_loss": aux,
+                                          **mtp_metrics}
 
 
 def gpt_head(p, h: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:

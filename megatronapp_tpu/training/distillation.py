@@ -1,0 +1,71 @@
+"""Knowledge distillation loss (post-training).
+
+Parity with /root/reference/megatron/post_training/algos/distillation.py
+(ModelOpt logits-distillation: student trains against softened teacher
+distributions mixed with the hard-label CE). The reference delegates to the
+modelopt package; the math is small and backend-agnostic, so it lives here
+natively: loss = alpha * T² * KL(teacher_T ‖ student_T)
+              + (1 - alpha) * CE(student, labels).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
+
+
+def soft_kl_loss(student_logits: jnp.ndarray, teacher_logits: jnp.ndarray,
+                 temperature: float = 1.0,
+                 loss_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Token-mean KL(teacher ‖ student) at temperature T (fp32), scaled by
+    T² (the standard Hinton correction so gradients are T-invariant)."""
+    t = float(temperature)
+    s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    te = jax.nn.log_softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    per_token = jnp.sum(jnp.exp(te) * (te - s), axis=-1)
+    if loss_mask is None:
+        return t * t * jnp.mean(per_token)
+    loss_mask = loss_mask.astype(jnp.float32)
+    return t * t * jnp.sum(per_token * loss_mask) / jnp.maximum(
+        jnp.sum(loss_mask), 1.0)
+
+
+def distillation_loss(student_logits: jnp.ndarray,
+                      teacher_logits: jnp.ndarray,
+                      labels: jnp.ndarray,
+                      loss_mask: Optional[jnp.ndarray] = None,
+                      temperature: float = 2.0,
+                      alpha: float = 0.5
+                      ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Combined KD objective (reference logits-distillation recipe)."""
+    kd = soft_kl_loss(student_logits, teacher_logits, temperature,
+                      loss_mask)
+    ce, _ = cross_entropy_loss(student_logits, labels, loss_mask)
+    total = alpha * kd + (1.0 - alpha) * ce
+    return total, {"kd_loss": kd, "lm_loss": ce}
+
+
+def make_distillation_loss_fn(student_loss_cfg, teacher_params,
+                              teacher_cfg, temperature: float = 2.0,
+                              alpha: float = 0.5, ctx=None):
+    """loss_fn(student_params, micro) for make_train_step: the frozen
+    teacher forward runs inside the same jit (stop_gradient), so XLA
+    overlaps teacher and student compute."""
+    from megatronapp_tpu.models.gpt import gpt_forward
+
+    def loss_fn(params, micro):
+        s_logits, aux = gpt_forward(params, micro["tokens"],
+                                    student_loss_cfg, ctx=ctx)
+        t_logits, _ = gpt_forward(teacher_params, micro["tokens"],
+                                  teacher_cfg, ctx=ctx)
+        t_logits = jax.lax.stop_gradient(t_logits)
+        total, metrics = distillation_loss(
+            s_logits, t_logits, micro["labels"], micro.get("loss_mask"),
+            temperature=temperature, alpha=alpha)
+        return total + aux, {**metrics, "moe_aux_loss": aux}
+
+    return loss_fn

@@ -63,18 +63,21 @@ def mtp_loss(mtp_params, h: jnp.ndarray, embed_fn, head_fn,
     left by k; the trailing k positions are masked out (roll_tensor
     semantics, multi_token_prediction.py:119).
 
-    Returns (scaled_total, per_depth_mean) — add scaled_total to the LM
-    loss; log per_depth_mean (track_mtp_metrics analogue).
+    Returns (scaled_total, per_depth_mean, layer_aux) — add scaled_total
+    AND layer_aux (the depth layers' own MoE router losses, unscaled like
+    the main stack's) to the LM loss; log per_depth_mean
+    (track_mtp_metrics analogue).
     """
     d_depths = len(mtp_params)
     if d_depths == 0:
         z = jnp.zeros((), jnp.float32)
-        return z, z
+        return z, z, z
     b, s = tokens.shape
     if loss_mask is None:
         loss_mask = jnp.ones((b, s), jnp.float32)
 
     total = jnp.zeros((), jnp.float32)
+    layer_aux = jnp.zeros((), jnp.float32)
     for k, dp in enumerate(mtp_params, start=1):
         # Embedding of token t_{i+k} at position i.
         toks_k = jnp.roll(tokens, -k, axis=1)
@@ -84,8 +87,11 @@ def mtp_loss(mtp_params, h: jnp.ndarray, embed_fn, head_fn,
              rms_norm(emb_k, dp["enorm_scale"], cfg.layernorm_epsilon)],
             axis=-1).astype(cfg.compute_dtype)
         x = x @ dp["proj"].astype(cfg.compute_dtype)
-        (h, _), _ = layer_forward(dp["layer"], x, cfg, rope_cos, rope_sin,
-                                  None, layer_id=None, ctx=ctx)
+        (h, _), l_aux = layer_forward(dp["layer"], x, cfg, rope_cos,
+                                      rope_sin, None, layer_id=None,
+                                      ctx=ctx)
+        if l_aux is not None:
+            layer_aux = layer_aux + l_aux
         logits = head_fn(h)
         labels_k = jnp.roll(labels, -k, axis=1)
         # Positions whose target rolled past the end contribute nothing.
@@ -95,4 +101,4 @@ def mtp_loss(mtp_params, h: jnp.ndarray, embed_fn, head_fn,
         total = total + loss_k
     mean = total / d_depths
     scale = cfg.mtp_loss_scaling_factor
-    return scale * mean, mean
+    return scale * mean, mean, layer_aux

@@ -1,0 +1,239 @@
+"""Sequence-classification finetuning (GLUE/RACE-style).
+
+Parity with /root/reference/tasks/finetune_utils.py + tasks/glue/
+(finetune a pretrained BERT encoder with a classification head over
+labeled sentence pairs; epoch loop with dev-set accuracy). Data format:
+TSV with `label<TAB>text_a[<TAB>text_b]` (the GLUE processors reduce to
+this shape).
+
+Usage:
+  python tasks/finetune.py --task classify --train-data train.tsv \
+      --valid-data dev.tsv --num-classes 2 \
+      --load-dir /ckpts/bert --tokenizer-type BertWordPieceTokenizer \
+      --epochs 3 --seq-length 128 ...
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tasks/", 1)[0])
+
+import numpy as np
+
+
+def read_tsv(path):
+    """[(label:int, text_a, text_b|None)] from label<TAB>a[<TAB>b] lines."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            if len(parts) < 2 or not parts[0].strip():
+                continue
+            rows.append((int(parts[0]), parts[1],
+                         parts[2] if len(parts) > 2 else None))
+    return rows
+
+
+def build_classification_batch(rows, tokenizer, ids, seq_length):
+    """BERT-style [CLS] a [SEP] b [SEP] batches with tokentype ids."""
+    tokens = np.full((len(rows), seq_length), ids.pad, np.int32)
+    types = np.zeros((len(rows), seq_length), np.int32)
+    mask = np.zeros((len(rows), seq_length), np.float32)
+    labels = np.zeros((len(rows),), np.int32)
+    for i, (label, a, b) in enumerate(rows):
+        ta = tokenizer.tokenize(a)
+        tb = tokenizer.tokenize(b) if b else []
+        # Truncate the longer side first (reference
+        # clean_text/truncation policy).
+        while len(ta) + len(tb) > seq_length - 3:
+            (ta if len(ta) >= len(tb) else tb).pop()
+        seq = [ids.cls, *ta, ids.sep]
+        tt = [0] * len(seq)
+        if tb:
+            seq += [*tb, ids.sep]
+            tt += [1] * (len(tb) + 1)
+        tokens[i, : len(seq)] = seq
+        types[i, : len(seq)] = tt
+        mask[i, : len(seq)] = 1.0
+        labels[i] = label
+    return {"tokens": tokens, "tokentype_ids": types,
+            "padding_mask": mask, "labels": labels}
+
+
+def classification_loss(params, batch, cfg, num_classes, ctx=None):
+    """CLS-pooled classification CE + accuracy (reference finetune_utils
+    _cross_entropy_forward_step): BERT embeddings → encoder → tanh pooler
+    over [CLS] → classifier dense (the LM head is bypassed)."""
+    import jax.numpy as jnp
+
+    from megatronapp_tpu.config.transformer_config import NormKind
+    from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
+    from megatronapp_tpu.ops.normalization import apply_norm
+    from megatronapp_tpu.transformer.block import block_forward
+    emb = params["embedding"]
+    h = jnp.take(emb["word"], batch["tokens"], axis=0)
+    h = h + jnp.take(emb["pos"],
+                     jnp.arange(batch["tokens"].shape[1]), axis=0)
+    h = h + jnp.take(emb["tokentype"], batch["tokentype_ids"], axis=0)
+    h = apply_norm(NormKind.layernorm, h, params["emb_ln_scale"],
+                   params["emb_ln_bias"], cfg.layernorm_epsilon)
+    h = h.astype(cfg.compute_dtype)
+    attn = batch["padding_mask"][:, None, None, :].astype(bool)
+    h, _ = block_forward(params["block"], h, cfg, None, None, attn,
+                         ctx=ctx)
+    ch = params["classifier"]
+    pooled = jnp.tanh(h[:, 0].astype(jnp.float32)
+                      @ ch["pooler"].astype(jnp.float32)
+                      + ch["pooler_bias"].astype(jnp.float32))
+    cls_logits = pooled @ ch["dense"].astype(jnp.float32) \
+        + ch["dense_bias"].astype(jnp.float32)
+    loss, _ = cross_entropy_loss(cls_logits[:, None],
+                                 batch["labels"][:, None])
+    acc = jnp.mean((jnp.argmax(cls_logits, -1)
+                    == batch["labels"]).astype(jnp.float32))
+    return loss, {"lm_loss": loss, "accuracy": acc}
+
+
+def init_classifier_head(rng, cfg, num_classes):
+    import jax
+    import jax.numpy as jnp
+    h = cfg.hidden_size
+    k1, k2 = jax.random.split(rng)
+    std = cfg.init_method_std
+    return {
+        "pooler": jax.random.normal(k1, (h, h), cfg.params_dtype) * std,
+        "pooler_bias": jnp.zeros((h,), cfg.params_dtype),
+        "dense": jax.random.normal(k2, (h, num_classes),
+                                   cfg.params_dtype) * std,
+        "dense_bias": jnp.zeros((num_classes,), cfg.params_dtype),
+    }, {
+        "pooler": ("embed", "embed"), "pooler_bias": ("embed",),
+        "dense": ("embed", None), "dense_bias": (None,),
+    }
+
+
+def finetune_classification(train_rows, valid_rows, tokenizer, ids, cfg,
+                            num_classes, *, epochs=3, batch_size=16,
+                            lr=2e-5, seq_length=128, seed=0,
+                            pretrained_params=None, log_fn=print):
+    """Epoch loop (reference finetune_utils.finetune): train on train_rows,
+    report dev accuracy each epoch. Returns (params, best_accuracy)."""
+    import jax
+    import jax.numpy as jnp
+
+    from megatronapp_tpu.config.training_config import OptimizerConfig
+    from megatronapp_tpu.models.bert import init_bert_params
+    from megatronapp_tpu.training.optimizer import get_optimizer
+
+    rng = jax.random.PRNGKey(seed)
+    params, _ = init_bert_params(rng, cfg, add_binary_head=False)
+    if pretrained_params is not None:
+        # Graft the pretrained encoder; keep the fresh classifier.
+        for key in pretrained_params:
+            if key in params:
+                params[key] = pretrained_params[key]
+    params["classifier"], _ = init_classifier_head(rng, cfg, num_classes)
+
+    steps_per_epoch = max(len(train_rows) // batch_size, 1)
+    optimizer = get_optimizer(OptimizerConfig(lr=lr, lr_warmup_iters=0),
+                              epochs * steps_per_epoch)
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch, step_i):
+        del step_i
+        (loss, metrics), g = jax.value_and_grad(
+            lambda p: classification_loss(p, batch, cfg, num_classes),
+            has_aux=True)(params)
+        updates, opt_state = optimizer.update(g, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u.astype(p.dtype), params,
+                              updates)
+        return params, opt_state, loss, metrics
+
+    @jax.jit
+    def evaluate(params, batch):
+        return classification_loss(params, batch, cfg, num_classes)[1]
+
+    rng_np = np.random.default_rng(seed)
+    best = 0.0
+    for epoch in range(epochs):
+        order = rng_np.permutation(len(train_rows))
+        for s in range(steps_per_epoch):
+            idx = order[s * batch_size: (s + 1) * batch_size]
+            rows = [train_rows[i] for i in idx]
+            batch = build_classification_batch(rows, tokenizer, ids,
+                                               seq_length)
+            params, opt_state, loss, metrics = step(
+                params, opt_state, batch, s)
+        # Dev accuracy (single padded batch per eval chunk).
+        correct = total = 0
+        for s in range(0, len(valid_rows), batch_size):
+            rows = valid_rows[s: s + batch_size]
+            m = evaluate(params, build_classification_batch(
+                rows, tokenizer, ids, seq_length))
+            correct += float(m["accuracy"]) * len(rows)
+            total += len(rows)
+        acc = correct / max(total, 1)
+        best = max(best, acc)
+        log_fn(f"epoch {epoch+1}/{epochs} | train loss "
+               f"{float(loss):.4f} | dev acc {acc:.4f}")
+    return params, best
+
+
+def main(argv=None):
+    from megatronapp_tpu.data.bert_dataset import BertTokenIds
+    from megatronapp_tpu.data.tokenizers import build_tokenizer
+    from megatronapp_tpu.models.bert import bert_config
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--train-data", required=True)
+    ap.add_argument("--valid-data", required=True)
+    ap.add_argument("--num-classes", type=int, required=True)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=2e-5)
+    ap.add_argument("--seq-length", type=int, default=128)
+    ap.add_argument("--num-layers", type=int, default=12)
+    ap.add_argument("--hidden-size", type=int, default=768)
+    ap.add_argument("--num-attention-heads", type=int, default=12)
+    ap.add_argument("--vocab-size", type=int, default=30592)
+    ap.add_argument("--tokenizer-type", default="BertWordPieceTokenizer")
+    ap.add_argument("--tokenizer-name-or-path", default=None)
+    ap.add_argument("--load-dir", default=None)
+    args = ap.parse_args(argv)
+
+    tok = build_tokenizer(args.tokenizer_type, args.tokenizer_name_or_path,
+                          args.vocab_size)
+    ids = BertTokenIds(cls=getattr(tok, "cls", 1),
+                       sep=getattr(tok, "sep", 2),
+                       mask=getattr(tok, "mask", 3),
+                       pad=getattr(tok, "pad", 0))
+    cfg = bert_config(num_layers=args.num_layers,
+                      hidden_size=args.hidden_size,
+                      num_attention_heads=args.num_attention_heads,
+                      vocab_size=args.vocab_size,
+                      max_position_embeddings=args.seq_length)
+    pretrained = None
+    if args.load_dir:
+        import jax
+
+        from megatronapp_tpu.models.bert import init_bert_params
+        from megatronapp_tpu.training.checkpointing import CheckpointManager
+        tmpl, _ = init_bert_params(jax.random.PRNGKey(0), cfg)
+        mngr = CheckpointManager(args.load_dir)
+        restored = mngr.restore({"step": 0, "params": tmpl,
+                                 "opt_state": {}})
+        mngr.close()
+        if restored is not None:
+            pretrained = restored["params"]
+
+    _, best = finetune_classification(
+        read_tsv(args.train_data), read_tsv(args.valid_data), tok, ids,
+        cfg, args.num_classes, epochs=args.epochs,
+        batch_size=args.batch_size, lr=args.lr,
+        seq_length=args.seq_length, pretrained_params=pretrained)
+    print(f"best dev accuracy: {best:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -291,3 +291,48 @@ class TestShmRing:
         assert len(got) == len(arrs)
         for a, b in zip(arrs, got):
             np.testing.assert_array_equal(a, b)
+
+
+def _prefetch_factory():
+    from megatronapp_tpu.data.mock import mock_batches
+    return mock_batches(32, 128, 8, seed=7)
+
+
+class TestShmPrefetch:
+    """The shm ring integrated into a real path: cross-process batch
+    prefetching (round-1 weak #12 — the ring was a demo, not a
+    transport)."""
+
+    def test_cross_process_batch_parity(self):
+        from megatronapp_tpu.data.mock import mock_batches
+        from megatronapp_tpu.data.prefetch import ShmPrefetcher
+        with ShmPrefetcher(_prefetch_factory, num_batches=5) as pf:
+            got = list(pf)
+        ref = mock_batches(32, 128, 8, seed=7)
+        assert len(got) == 5
+        for b in got:
+            r = next(ref)
+            assert sorted(b) == sorted(r)
+            for k in b:
+                np.testing.assert_array_equal(b[k], r[k])
+
+    def test_training_through_the_ring(self, devices8):
+        from megatronapp_tpu.data.prefetch import ShmPrefetcher
+        model = tiny()
+        par = ParallelConfig()
+        ctx = build_mesh(par, devices=devices8[:1])
+        train = TrainingConfig(micro_batch_size=4, global_batch_size=8,
+                               seq_length=32, train_iters=4,
+                               log_interval=2)
+        with ShmPrefetcher(_prefetch_factory, num_batches=4) as pf:
+            res = pretrain_gpt(model, par, train, OptimizerConfig(lr=1e-3),
+                               ctx=ctx, batch_iter=pf)
+        assert np.isfinite(res.losses[-1])
+
+    def test_producer_failure_surfaces(self):
+        from megatronapp_tpu.data.prefetch import ShmPrefetcher
+        with pytest.raises((RuntimeError, TimeoutError)):
+            with ShmPrefetcher(_prefetch_factory, num_batches=50) as pf:
+                pf.proc.terminate()
+                pf.proc.join()
+                list(pf)

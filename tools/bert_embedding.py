@@ -1,0 +1,132 @@
+"""BERT text embedding tool.
+
+Parity with /root/reference/tools/bert_embedding/ (embed.py: batch texts
+through a BERT encoder, mean-pool the final hidden states into one vector
+per text; used to build the Retro retrieval database). Output: .npy
+[num_texts, hidden].
+
+Usage:
+  python tools/bert_embedding.py --input texts.txt --output emb.npy \
+      --load-dir /ckpts/bert --tokenizer-type BertWordPieceTokenizer ...
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
+
+import numpy as np
+
+
+def embed_texts(params, cfg, tokenizer, ids, texts, seq_length=128,
+                batch_size=32):
+    """Mean-pooled (over real tokens) final hidden states [N, H]."""
+    import jax
+    import jax.numpy as jnp
+
+    from megatronapp_tpu.config.transformer_config import NormKind
+    from megatronapp_tpu.ops.normalization import apply_norm
+    from megatronapp_tpu.transformer.block import block_forward
+
+    @jax.jit
+    def encode(tokens, mask):
+        emb = params["embedding"]
+        h = jnp.take(emb["word"], tokens, axis=0)
+        h = h + jnp.take(emb["pos"], jnp.arange(tokens.shape[1]), axis=0)
+        h = h + emb["tokentype"][0]
+        h = apply_norm(NormKind.layernorm, h, params["emb_ln_scale"],
+                       params["emb_ln_bias"], cfg.layernorm_epsilon)
+        h = h.astype(cfg.compute_dtype)
+        attn = mask[:, None, None, :].astype(bool)
+        h, _ = block_forward(params["block"], h, cfg, None, None, attn)
+        h = h.astype(jnp.float32) * mask[..., None]
+        return jnp.sum(h, axis=1) / jnp.maximum(
+            jnp.sum(mask, axis=1, keepdims=True), 1.0)
+
+    out = []
+    for s in range(0, len(texts), batch_size):
+        chunk = texts[s: s + batch_size]
+        tokens = np.full((len(chunk), seq_length), ids.pad, np.int32)
+        mask = np.zeros((len(chunk), seq_length), np.float32)
+        for i, text in enumerate(chunk):
+            t = [ids.cls, *tokenizer.tokenize(text)[: seq_length - 2],
+                 ids.sep]
+            tokens[i, : len(t)] = t
+            mask[i, : len(t)] = 1.0
+        out.append(np.asarray(jax.device_get(
+            encode(jnp.asarray(tokens), jnp.asarray(mask)))))
+    return np.concatenate(out, axis=0)
+
+
+def knn_neighbors(embeddings: np.ndarray, k: int,
+                  exclude_self: bool = True) -> np.ndarray:
+    """Brute-force cosine kNN → [N, k] neighbor indices (the retrieval
+    step of the reference retro pipeline; faiss-free)."""
+    x = embeddings / np.maximum(
+        np.linalg.norm(embeddings, axis=1, keepdims=True), 1e-9)
+    sim = x @ x.T
+    if exclude_self:
+        np.fill_diagonal(sim, -np.inf)
+    return np.argsort(-sim, axis=1)[:, :k]
+
+
+def main(argv=None):
+    from megatronapp_tpu.data.bert_dataset import BertTokenIds
+    from megatronapp_tpu.data.tokenizers import build_tokenizer
+    from megatronapp_tpu.models.bert import bert_config, init_bert_params
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", required=True, help="one text per line")
+    ap.add_argument("--output", required=True, help=".npy embeddings")
+    ap.add_argument("--neighbors-output", default=None,
+                    help="also write [N,k] kNN indices")
+    ap.add_argument("--num-neighbors", type=int, default=2)
+    ap.add_argument("--seq-length", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-layers", type=int, default=12)
+    ap.add_argument("--hidden-size", type=int, default=768)
+    ap.add_argument("--num-attention-heads", type=int, default=12)
+    ap.add_argument("--vocab-size", type=int, default=30592)
+    ap.add_argument("--tokenizer-type", default="BertWordPieceTokenizer")
+    ap.add_argument("--tokenizer-name-or-path", default=None)
+    ap.add_argument("--load-dir", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    tok = build_tokenizer(args.tokenizer_type, args.tokenizer_name_or_path,
+                          args.vocab_size)
+    ids = BertTokenIds(cls=getattr(tok, "cls", 1),
+                       sep=getattr(tok, "sep", 2),
+                       mask=getattr(tok, "mask", 3),
+                       pad=getattr(tok, "pad", 0))
+    cfg = bert_config(num_layers=args.num_layers,
+                      hidden_size=args.hidden_size,
+                      num_attention_heads=args.num_attention_heads,
+                      vocab_size=args.vocab_size,
+                      max_position_embeddings=args.seq_length)
+    params, _ = init_bert_params(jax.random.PRNGKey(0), cfg)
+    if args.load_dir:
+        from megatronapp_tpu.training.checkpointing import CheckpointManager
+        mngr = CheckpointManager(args.load_dir)
+        restored = mngr.restore({"step": 0, "params": params,
+                                 "opt_state": {}})
+        mngr.close()
+        if restored is not None:
+            params = restored["params"]
+
+    with open(args.input) as f:
+        texts = [line.strip() for line in f if line.strip()]
+    emb = embed_texts(params, cfg, tok, ids, texts,
+                      seq_length=args.seq_length,
+                      batch_size=args.batch_size)
+    np.save(args.output, emb)
+    print(f"embedded {len(texts)} texts → {args.output} {emb.shape}")
+    if args.neighbors_output:
+        nbrs = knn_neighbors(emb, args.num_neighbors)
+        np.save(args.neighbors_output, nbrs)
+        print(f"kNN neighbors → {args.neighbors_output} {nbrs.shape}")
+
+
+if __name__ == "__main__":
+    main()
