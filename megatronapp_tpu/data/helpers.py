@@ -76,6 +76,19 @@ def _load_native() -> Optional[ctypes.CDLL]:
             ctypes.c_int32, ctypes.c_double, ctypes.c_uint64,
             ctypes.c_int32, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
         ]
+        lib.build_exhaustive_blending_indices.restype = None
+        lib.build_exhaustive_blending_indices.argtypes = [
+            ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+        ]
+        lib.build_blocks_mapping.restype = ctypes.c_int64
+        lib.build_blocks_mapping.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ]
         _LIB = lib
         return _LIB
 
@@ -175,6 +188,117 @@ def build_mapping_native(document_indices: np.ndarray,
     if filled != count:
         raise RuntimeError(
             f"build_mapping pass disagreement: {count} vs {filled}")
+    return out
+
+
+def build_exhaustive_blending_indices(sizes: np.ndarray
+                                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Blend datasets drawing EXACTLY sizes[d] samples from dataset d
+    (reference build_exhaustive_blending_indices, helpers.cpp:21-74):
+    largest-deficit-first with size-proportional weights, datasets drop
+    out of contention once exhausted. Deterministic."""
+    sizes = np.ascontiguousarray(sizes, dtype=np.int64)
+    total = int(sizes.sum())
+    ds_idx = np.zeros(total, dtype=np.int16)
+    ds_sample = np.zeros(total, dtype=np.int64)
+    lib = _load_native()
+    if lib is not None:
+        lib.build_exhaustive_blending_indices(
+            _ptr(ds_idx, ctypes.c_int16), _ptr(ds_sample, ctypes.c_int64),
+            _ptr(sizes, ctypes.c_int64), len(sizes))
+        return ds_idx, ds_sample
+    weights = sizes / total if total else sizes.astype(np.float64)
+    consumed = np.zeros(len(sizes), dtype=np.int64)
+    spent = sizes == 0
+    for i in range(total):
+        err = weights * max(float(i), 1.0) - consumed
+        err[spent] = -np.inf
+        best = int(np.argmax(err))
+        ds_idx[i] = best
+        ds_sample[i] = consumed[best]
+        consumed[best] += 1
+        if consumed[best] >= sizes[best]:
+            spent[best] = True
+    return ds_idx, ds_sample
+
+
+def _splitmix64(state: np.ndarray) -> int:
+    """One splitmix64 draw; `state` is a 1-element uint64 array (shared
+    stream with the C++ implementation)."""
+    with np.errstate(over="ignore"):
+        state[0] += np.uint64(0x9E3779B97F4A7C15)
+        z = state[0]
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return int(z ^ (z >> np.uint64(31)))
+
+
+def build_blocks_mapping(document_indices: np.ndarray,
+                         sentence_lengths: np.ndarray,
+                         title_lengths: np.ndarray,
+                         num_epochs: int, max_num_samples: int,
+                         max_seq_length: int, seed: int,
+                         use_one_sent_blocks: bool = False) -> np.ndarray:
+    """Block sample map for ICT/REALM retrieval pretraining → int64 [N,4]
+    (first_sentence, end_sentence, doc, block_id). Reference semantics:
+    build_blocks_mapping_impl (helpers.cpp:564-804); per-doc target length
+    is max_seq_length - title_len so the title can be prepended. Native
+    path with bit-identical numpy fallback (shared shuffle stream)."""
+    docs = np.ascontiguousarray(document_indices, dtype=np.int64)
+    sizes = np.ascontiguousarray(sentence_lengths, dtype=np.int32)
+    titles = np.ascontiguousarray(title_lengths, dtype=np.int32)
+    n_docs = len(docs) - 1
+    min_num_sent = 1 if use_one_sent_blocks else 2
+    lib = _load_native()
+    if lib is not None:
+        args = (_ptr(docs, ctypes.c_int64), n_docs,
+                _ptr(sizes, ctypes.c_int32), _ptr(titles, ctypes.c_int32),
+                num_epochs, max_num_samples, max_seq_length, seed,
+                min_num_sent)
+        count = lib.build_blocks_mapping(*args, None, 0)
+        if count < 0:
+            raise ValueError("build_blocks_mapping: invalid arguments")
+        out = np.zeros((count, 4), dtype=np.int64)
+        filled = lib.build_blocks_mapping(
+            *args, _ptr(out, ctypes.c_int64), count)
+        if filled != count:
+            raise RuntimeError(
+                f"build_blocks_mapping pass disagreement: {count} vs "
+                f"{filled}")
+        return out
+    # numpy fallback — same traversal, same shuffle stream.
+    rows = []
+    long_sent = 512  # kLongSentenceLen
+    for epoch in range(num_epochs):
+        if max_num_samples > 0 and len(rows) >= max_num_samples:
+            break
+        block_id = 0
+        for doc in range(n_docs):
+            first, last = int(docs[doc]), int(docs[doc + 1])
+            remain = last - first
+            if remain < min_num_sent:
+                continue
+            if np.any(sizes[first:last] > long_sent):
+                continue
+            tgt = max_seq_length - int(titles[doc])
+            start, seq_len, num_sent = first, 0, 0
+            for s in range(first, last):
+                seq_len += int(sizes[s])
+                num_sent += 1
+                remain -= 1
+                if ((seq_len >= tgt and remain >= min_num_sent and
+                     num_sent >= min_num_sent) or remain == 0):
+                    rows.append((start, s + 1, doc, block_id))
+                    block_id += 1
+                    start = s + 1
+                    seq_len, num_sent = 0, 0
+    if max_num_samples > 0:
+        rows = rows[:max_num_samples]
+    out = np.asarray(rows, dtype=np.int64).reshape(-1, 4)
+    state = np.array([np.uint64(seed + 1)], dtype=np.uint64)
+    for i in range(len(out) - 1, 0, -1):
+        j = _splitmix64(state) % (i + 1)
+        out[[i, j]] = out[[j, i]]
     return out
 
 

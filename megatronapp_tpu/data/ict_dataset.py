@@ -1,0 +1,130 @@
+"""ICT (Inverse Cloze Task) dataset for biencoder retrieval pretraining.
+
+Parity with /root/reference/megatron/legacy/data/ict_dataset.py
+(ICTDataset): the corpus is a sentence-split IndexedDataset plus a titles
+IndexedDataset (one title per document); blocks come from the native
+build_blocks_mapping (sentence spans closed at max_seq_length -
+title_len); each sample draws one sentence as the pseudo-query and keeps
+it in the context block query_in_block_prob of the time
+(ict_dataset.py:92-99).
+
+Layout of the emitted pairs (concat_and_pad_tokens semantics):
+  query:   [CLS] sentence [SEP]                    (padded to seq_length)
+  context: [CLS] title [SEP] block [SEP]           (padded to seq_length)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from megatronapp_tpu.data.helpers import build_blocks_mapping
+from megatronapp_tpu.data.indexed_dataset import IndexedDataset
+
+
+@dataclass
+class IctTokenIds:
+    cls: int = 1
+    sep: int = 2
+    pad: int = 0
+
+
+class ICTDataset:
+    """len() = number of blocks; [i] → dict of query/context arrays."""
+
+    def __init__(self, block_dataset: IndexedDataset,
+                 title_dataset: IndexedDataset, *, seq_length: int,
+                 token_ids: Optional[IctTokenIds] = None,
+                 num_epochs: int = 1, max_num_samples: int = 0,
+                 query_in_block_prob: float = 0.1, seed: int = 1,
+                 use_one_sent_blocks: bool = False):
+        self.block = block_dataset
+        self.titles = title_dataset
+        self.seq_length = seq_length
+        self.ids = token_ids or IctTokenIds()
+        self.query_in_block_prob = query_in_block_prob
+        self.rng = np.random.default_rng(seed)
+        docs = np.asarray(block_dataset.document_indices)
+        # Lengths come straight from the .idx — no data reads.
+        title_lengths = np.asarray(title_dataset.sequence_lengths,
+                                   dtype=np.int32)[:len(docs) - 1]
+        self.mapping = build_blocks_mapping(
+            docs, np.asarray(block_dataset.sequence_lengths),
+            title_lengths, num_epochs, max_num_samples,
+            # Reserve [CLS] .. [SEP] .. [SEP] like the reference's
+            # title_pad_offset=3.
+            seq_length - 3, seed,
+            use_one_sent_blocks=use_one_sent_blocks)
+
+    def __len__(self) -> int:
+        return len(self.mapping)
+
+    def _pad(self, pieces: List[np.ndarray]) -> Dict[str, np.ndarray]:
+        toks = np.concatenate(pieces)[:self.seq_length]
+        out = np.full(self.seq_length, self.ids.pad, dtype=np.int32)
+        out[:len(toks)] = toks
+        mask = np.zeros(self.seq_length, dtype=np.int32)
+        mask[:len(toks)] = 1
+        return out, mask
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        start, end, doc, block_id = (int(v) for v in self.mapping[idx])
+        sentences = [np.asarray(self.block[i], dtype=np.int32)
+                     for i in range(start, end)]
+        q_idx = int(self.rng.integers(0, len(sentences)))
+        if self.rng.random() < self.query_in_block_prob or \
+                len(sentences) == 1:
+            query = sentences[q_idx].copy()
+        else:
+            query = sentences.pop(q_idx)
+        title = np.asarray(self.titles[doc], dtype=np.int32)
+        cls_ = np.array([self.ids.cls], dtype=np.int32)
+        sep = np.array([self.ids.sep], dtype=np.int32)
+        block_body = (np.concatenate(sentences)
+                      [:self.seq_length - 3 - len(title)])
+        q_tokens, q_mask = self._pad([cls_, query[:self.seq_length - 2],
+                                      sep])
+        c_tokens, c_mask = self._pad([cls_, title, sep, block_body, sep])
+        return {
+            "query_tokens": q_tokens, "query_pad_mask": q_mask,
+            "context_tokens": c_tokens, "context_pad_mask": c_mask,
+            "block_data": np.array([start, end, doc, block_id],
+                                   dtype=np.int64),
+        }
+
+
+def ict_batches(dataset: ICTDataset, batch_size: int,
+                start_idx: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    """Cyclic batch iterator (block_data excluded — train fields only)."""
+    i = start_idx
+    n = len(dataset)
+    if n == 0:
+        raise ValueError("ICT dataset is empty (corpus too small for "
+                         "the block size)")
+    while True:
+        rows = [dataset[(i + j) % n] for j in range(batch_size)]
+        i = (i + batch_size) % n
+        yield {k: np.stack([r[k] for r in rows])
+               for k in ("query_tokens", "query_pad_mask",
+                         "context_tokens", "context_pad_mask")}
+
+
+def mock_ict_batch(seed: int, batch_size: int, seq_length: int,
+                   vocab_size: int) -> Dict[str, np.ndarray]:
+    """Synthetic ICT batch: each context is a bag of tokens, the query is
+    a subset of them — learnable by lexical overlap."""
+    r = np.random.default_rng(seed)
+    ctx = r.integers(5, vocab_size, size=(batch_size, seq_length),
+                     dtype=np.int64).astype(np.int32)
+    q = np.full((batch_size, seq_length), 0, dtype=np.int32)
+    q_len = max(4, seq_length // 4)
+    for b in range(batch_size):
+        sel = r.choice(seq_length, size=q_len, replace=False)
+        q[b, :q_len] = ctx[b, np.sort(sel)]
+    ones = np.ones((batch_size, seq_length), dtype=np.int32)
+    q_mask = np.zeros_like(ones)
+    q_mask[:, :q_len] = 1
+    return {"query_tokens": q, "query_pad_mask": q_mask,
+            "context_tokens": ctx, "context_pad_mask": ones}

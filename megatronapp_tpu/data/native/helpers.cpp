@@ -198,4 +198,127 @@ int64_t build_mapping(const int64_t* docs,       // [n_docs + 1]
     return count;
 }
 
+// Exhaustive blending: draw EXACTLY sizes[d] samples from dataset d (the
+// reference build_exhaustive_blending_indices, helpers.cpp:21-74 semantics):
+// weights derive from sizes, the largest-deficit dataset wins each step,
+// and a dataset leaves the candidate set once exhausted. Total output
+// length = sum(sizes). Deterministic, no RNG.
+void build_exhaustive_blending_indices(
+        int16_t* dataset_index,        // out [sum(sizes)]
+        int64_t* dataset_sample_index, // out [sum(sizes)]
+        const int64_t* sizes,
+        int32_t num_datasets) {
+    int64_t total = 0;
+    for (int32_t d = 0; d < num_datasets; ++d) total += sizes[d];
+    int64_t* consumed = new int64_t[num_datasets];
+    bool* spent = new bool[num_datasets];
+    double* weights = new double[num_datasets];
+    for (int32_t d = 0; d < num_datasets; ++d) {
+        consumed[d] = 0;
+        spent[d] = (sizes[d] == 0);
+        weights[d] = total > 0
+            ? static_cast<double>(sizes[d]) / static_cast<double>(total)
+            : 0.0;
+    }
+    for (int64_t i = 0; i < total; ++i) {
+        double step = i > 0 ? static_cast<double>(i) : 1.0;
+        int32_t best = -1;
+        double best_err = 0.0;
+        for (int32_t d = 0; d < num_datasets; ++d) {
+            if (spent[d]) continue;
+            double err = weights[d] * step -
+                         static_cast<double>(consumed[d]);
+            if (best < 0 || err > best_err) {
+                best_err = err;
+                best = d;
+            }
+        }
+        dataset_index[i] = static_cast<int16_t>(best);
+        dataset_sample_index[i] = consumed[best];
+        if (++consumed[best] >= sizes[best]) spent[best] = true;
+    }
+    delete[] weights;
+    delete[] spent;
+    delete[] consumed;
+}
+
+// Block sample mapping for ICT/REALM-style retrieval pretraining
+// (reference build_blocks_mapping_impl, helpers.cpp:564-804 semantics):
+// walk each document's sentences, close a block when the accumulated
+// length reaches max_seq_length - title_len(doc) (leaving at least
+// min_num_sent sentences for the next block), and record
+// (first_sentence, end_sentence, doc, block_id) quadruples; block_id is
+// unique within an epoch. Fisher-Yates shuffle at the end.
+//
+// Two-pass contract like build_mapping above: out == NULL returns the
+// count; second call fills [capacity, 4] int64.
+int64_t build_blocks_mapping(const int64_t* docs,      // [n_docs + 1]
+                             int64_t n_docs,
+                             const int32_t* sizes,     // per-sentence tokens
+                             const int32_t* title_sizes,  // [n_docs]
+                             int32_t num_epochs,
+                             int64_t max_num_samples,
+                             int32_t max_seq_length,
+                             uint64_t seed,
+                             int32_t min_num_sent,  // 1 = one-sent blocks
+                             int64_t* out,          // NULL or [capacity*4]
+                             int64_t capacity) {
+    if (num_epochs <= 0 || max_seq_length <= 1 || min_num_sent < 1)
+        return -1;
+    int64_t count = 0;
+    for (int32_t epoch = 0; epoch < num_epochs; ++epoch) {
+        if (max_num_samples > 0 && count >= max_num_samples) break;
+        int64_t block_id = 0;
+        for (int64_t doc = 0; doc < n_docs; ++doc) {
+            int64_t first = docs[doc];
+            int64_t last = docs[doc + 1];
+            int64_t remain = last - first;
+            if (remain < min_num_sent) continue;
+            bool has_long = false;
+            for (int64_t s = first; s < last; ++s) {
+                if (sizes[s] > kLongSentenceLen) { has_long = true; break; }
+            }
+            if (has_long) continue;
+            int64_t tgt = max_seq_length - title_sizes[doc];
+            int64_t start = first;
+            int64_t seq_len = 0;
+            int64_t num_sent = 0;
+            for (int64_t s = first; s < last; ++s) {
+                seq_len += sizes[s];
+                ++num_sent;
+                --remain;
+                if ((seq_len >= tgt && remain >= min_num_sent &&
+                     num_sent >= min_num_sent) || remain == 0) {
+                    if (out != NULL && count < capacity) {
+                        out[count * 4] = start;
+                        out[count * 4 + 1] = s + 1;
+                        out[count * 4 + 2] = doc;
+                        out[count * 4 + 3] = block_id;
+                    }
+                    ++count;
+                    ++block_id;
+                    start = s + 1;
+                    seq_len = 0;
+                    num_sent = 0;
+                }
+            }
+        }
+    }
+    if (max_num_samples > 0 && count > max_num_samples)
+        count = max_num_samples;
+    if (out != NULL) {
+        if (count > capacity) count = capacity;
+        uint64_t srng = seed + 1;
+        for (int64_t i = count - 1; i > 0; --i) {
+            int64_t j = (int64_t)(splitmix64(&srng) % (uint64_t)(i + 1));
+            for (int k = 0; k < 4; ++k) {
+                int64_t t = out[i * 4 + k];
+                out[i * 4 + k] = out[j * 4 + k];
+                out[j * 4 + k] = t;
+            }
+        }
+    }
+    return count;
+}
+
 }  // extern "C"

@@ -39,6 +39,15 @@ def _producer(name: str, factory: Callable[[], Iterator],
             payloads = [np.frombuffer(header, np.uint8)] + [
                 np.ascontiguousarray(batch[k]) for k in keys]
             for arr in payloads:
+                # Fail fast on frames that can never fit (push would
+                # otherwise spin forever and the consumer would time out
+                # with a misleading error). ~64B covers frame framing +
+                # dtype/shape metadata.
+                if arr.nbytes + 64 > capacity:
+                    raise ValueError(
+                        f"batch field of {arr.nbytes} bytes exceeds ring "
+                        f"capacity {capacity}; raise ShmPrefetcher("
+                        f"capacity=...)")
                 while not ring.push_array(arr):
                     time.sleep(0.0005)
     finally:
@@ -56,6 +65,7 @@ class ShmPrefetcher:
                  num_batches: int, capacity: int = 1 << 26,
                  name: Optional[str] = None):
         self.name = name or f"/mta_prefetch_{time.time_ns() & 0xFFFFFF}"
+        self.capacity = capacity
         self.ring = ShmRing(self.name, capacity=capacity)
         self.num_batches = num_batches
         self._served = 0
@@ -68,13 +78,15 @@ class ShmPrefetcher:
     def _pop(self, timeout: float = 300.0) -> np.ndarray:
         deadline = time.monotonic() + timeout
         while True:
-            arr = self.ring.pop_array()
+            # Receive buffer must admit anything the ring can hold — the
+            # pop-side default (64MB) is smaller than large capacities.
+            arr = self.ring.pop_array(max_len=self.capacity)
             if arr is not None:
                 return arr
             if not self.proc.is_alive():
                 # Drain: the producer may have pushed its final frames
                 # right before exiting.
-                arr = self.ring.pop_array()
+                arr = self.ring.pop_array(max_len=self.capacity)
                 if arr is not None:
                     return arr
                 raise RuntimeError(

@@ -88,11 +88,15 @@ def init_bert_params(rng, cfg: TransformerConfig, num_tokentypes: int = 2,
     return p, ax
 
 
-def bert_forward(p, tokens, cfg: TransformerConfig,
-                 padding_mask: Optional[jnp.ndarray] = None,
-                 tokentype_ids: Optional[jnp.ndarray] = None, ctx=None):
-    """tokens [B,S] (+ padding_mask [B,S] 1=real) →
-    (lm_logits [B,S,V], binary_logits [B,2] | None)."""
+def bert_encode(p, tokens, cfg: TransformerConfig,
+                padding_mask: Optional[jnp.ndarray] = None,
+                tokentype_ids: Optional[jnp.ndarray] = None,
+                ctx=None) -> jnp.ndarray:
+    """Shared BERT encoder trunk: word+pos+tokentype embeddings → embedding
+    LN → bidirectional block with padding mask. tokens [B,S] → h [B,S,H].
+    Reused by the LM model below, the classification finetune head
+    (tasks/finetune.py), the embedding tool (tools/bert_embedding.py) and
+    the biencoder towers (models/biencoder.py)."""
     b, s = tokens.shape
     emb = p["embedding"]
     h = jnp.take(emb["word"], tokens, axis=0)
@@ -110,6 +114,17 @@ def bert_forward(p, tokens, cfg: TransformerConfig,
         # [B,1,1,S] True=attend; bidirectional otherwise.
         attn_mask = padding_mask[:, None, None, :].astype(bool)
     h, _ = block_forward(p["block"], h, cfg, None, None, attn_mask, ctx=ctx)
+    return h
+
+
+def bert_forward(p, tokens, cfg: TransformerConfig,
+                 padding_mask: Optional[jnp.ndarray] = None,
+                 tokentype_ids: Optional[jnp.ndarray] = None, ctx=None):
+    """tokens [B,S] (+ padding_mask [B,S] 1=real) →
+    (lm_logits [B,S,V], binary_logits [B,2] | None)."""
+    emb = p["embedding"]
+    h = bert_encode(p, tokens, cfg, padding_mask=padding_mask,
+                    tokentype_ids=tokentype_ids, ctx=ctx)
 
     # LM head (bert_lm_head: dense+gelu+LN then tied projection).
     lm = p["lm_head"]

@@ -1,0 +1,123 @@
+"""Biencoder retrieval model (ICT / REALM-style pretraining).
+
+Parity with /root/reference/megatron/legacy/model/biencoder_model.py
+(biencoder_model_provider: query tower + context tower, each a BERT encoder
+with a pooled retrieval head) and /root/reference/pretrain_ict.py
+(in-batch softmax over q·c^T scores, diagonal labels, optional
+1/sqrt(hidden) score scaling, top-k retrieval accuracies).
+
+TPU-first design notes: the reference all-gathers query/context embeddings
+across the data-parallel group with a hand-written autograd function
+(pretrain_ict.py:46-72 AllgatherFromDataParallelRegion). Here the loss is
+computed over the *global* batch inside one jitted step; with dp-sharded
+inputs XLA inserts the all-gather for the [B_global, B_global] score
+matmul on its own, and the backward gather/scatter falls out of
+differentiation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import (
+    NormKind, TransformerConfig,
+)
+from megatronapp_tpu.ops.normalization import apply_norm
+from megatronapp_tpu.transformer.block import block_forward, init_block_params
+
+
+def _init_tower(rng, cfg: TransformerConfig, num_tokentypes: int):
+    """One BERT-style encoder tower + linear retrieval head over pooled
+    CLS (reference PretrainedBertModel + get_linear_layer head)."""
+    k_emb, k_pos, k_tt, k_block, k_head = jax.random.split(rng, 5)
+    std = cfg.init_method_std
+    h = cfg.hidden_size
+    p = {
+        "embedding": {
+            "word": jax.random.normal(
+                k_emb, (cfg.vocab_size, h), cfg.params_dtype) * std,
+            "pos": jax.random.normal(
+                k_pos, (cfg.max_position_embeddings, h),
+                cfg.params_dtype) * std,
+            "tokentype": jax.random.normal(
+                k_tt, (num_tokentypes, h), cfg.params_dtype) * std,
+        },
+        "emb_ln_scale": jnp.ones((h,), cfg.params_dtype),
+        "emb_ln_bias": jnp.zeros((h,), cfg.params_dtype),
+        "head": jax.random.normal(k_head, (h, h), cfg.params_dtype) * std,
+        "head_bias": jnp.zeros((h,), cfg.params_dtype),
+    }
+    ax = {
+        "embedding": {"word": ("vocab", "embed"), "pos": ("pos", "embed"),
+                      "tokentype": (None, "embed")},
+        "emb_ln_scale": ("embed",),
+        "emb_ln_bias": ("embed",),
+        "head": ("embed", "embed"),
+        "head_bias": ("embed",),
+    }
+    p["block"], ax["block"] = init_block_params(k_block, cfg)
+    return p, ax
+
+
+def init_biencoder_params(rng, cfg: TransformerConfig,
+                          num_tokentypes: int = 2, shared: bool = False):
+    """(params, logical_axes). `shared` ties the two towers
+    (--biencoder-shared-query-context-model)."""
+    kq, kc = jax.random.split(rng)
+    pq, axq = _init_tower(kq, cfg, num_tokentypes)
+    if shared:
+        return {"query": pq, "shared": True}, {"query": axq}
+    pc, axc = _init_tower(kc, cfg, num_tokentypes)
+    return ({"query": pq, "context": pc},
+            {"query": axq, "context": axc})
+
+
+def tower_embed(tower, tokens, cfg: TransformerConfig,
+                padding_mask: Optional[jnp.ndarray] = None,
+                tokentype_ids: Optional[jnp.ndarray] = None,
+                ctx=None) -> jnp.ndarray:
+    """tokens [B,S] → pooled retrieval embedding [B,H] (CLS position
+    through the linear head)."""
+    from megatronapp_tpu.models.bert import bert_encode
+    h = bert_encode(tower, tokens, cfg, padding_mask=padding_mask,
+                    tokentype_ids=tokentype_ids, ctx=ctx)
+    pooled = h[:, 0].astype(jnp.float32)
+    return pooled @ tower["head"].astype(jnp.float32) \
+        + tower["head_bias"].astype(jnp.float32)
+
+
+def biencoder_embed(p, tokens, cfg: TransformerConfig, *, kind: str,
+                    padding_mask=None, ctx=None) -> jnp.ndarray:
+    """kind = 'query' | 'context'; shared models route both through the
+    query tower."""
+    tower = p["query"] if (kind == "query" or p.get("shared")) \
+        else p["context"]
+    return tower_embed(tower, tokens, cfg, padding_mask=padding_mask,
+                       ctx=ctx)
+
+
+def ict_loss(p, batch, cfg: TransformerConfig, ctx=None,
+             score_scaling: bool = False, report_topk=(1, 5)):
+    """In-batch retrieval softmax (pretrain_ict.py loss_func): scores are
+    q·c^T over the global batch, label i is context i."""
+    q = biencoder_embed(p, batch["query_tokens"], cfg, kind="query",
+                        padding_mask=batch.get("query_pad_mask"), ctx=ctx)
+    c = biencoder_embed(p, batch["context_tokens"], cfg, kind="context",
+                        padding_mask=batch.get("context_pad_mask"), ctx=ctx)
+    scores = q @ c.T
+    if score_scaling:
+        scores = scores / jnp.sqrt(float(cfg.hidden_size))
+    n = scores.shape[0]
+    logp = jax.nn.log_softmax(scores, axis=-1)
+    labels = jnp.arange(n)
+    loss = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+    metrics = {"loss": loss}
+    # top-k retrieval accuracy (retriever_report_topk_accuracies).
+    rank_of_true = (scores >= jnp.take_along_axis(
+        scores, labels[:, None], axis=1)).sum(axis=1)
+    for k in report_topk:
+        metrics[f"top{k}_acc"] = (rank_of_true <= k).mean() * 100.0
+    return loss, metrics

@@ -96,8 +96,11 @@ def detect_stage2(events: List[dict], related: Dict[int, Set[int]],
         evs = [by_id[i] for i in ids if i in by_id]
         if not any(e["pid"] == pid for e in evs):
             continue
-        if not any(e["name"].startswith(p) for p in COLLECTIVE_PREFIXES
-                   for e in evs[:1]):
+        # Events in a related set share a name by construction
+        # (dependency matching key), but tolerate heterogeneous sets from
+        # hand-built traces: require at least one collective member.
+        if not any(e["name"].startswith(p) for e in evs
+                   for p in COLLECTIVE_PREFIXES):
             continue
         total += 1
         mine = [e for e in evs if e["pid"] == pid]

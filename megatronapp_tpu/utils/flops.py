@@ -12,12 +12,13 @@ from megatronapp_tpu.config.transformer_config import (
     ActivationKind, TransformerConfig,
 )
 
-# Peak bf16 FLOP/s per chip for MFU math (TPU v5e ≈ 394 TFLOP/s bf16;
-# v5p ≈ 459; override with the actual platform at call sites if known).
+# Peak bf16 FLOP/s per chip for MFU math (TPU v5e = 197 TFLOP/s bf16 —
+# the oft-quoted 394 is the int8 TOPS figure; v5p ≈ 459 bf16; override
+# with the actual platform at call sites if known).
 TPU_PEAK_FLOPS = {
-    "v5litepod": 394e12,
-    "v5 lite": 394e12,
-    "v5e": 394e12,
+    "v5litepod": 197e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
     "v5p": 459e12,
     "v4": 275e12,
     "v6e": 918e12,

@@ -1,0 +1,101 @@
+"""ICT (Inverse Cloze Task) biencoder pretraining entry point.
+
+Parity with /root/reference/pretrain_ict.py: BERT-style query/context
+towers trained with an in-batch retrieval softmax (diagonal labels) over
+blocks built by the native build_blocks_mapping. --data-path must point at
+a sentence-split corpus (tools/preprocess_data.py --split-sentences) and
+--titles-data-path at a one-title-per-document companion; without them a
+synthetic lexical-overlap stream is used.
+"""
+
+import time
+
+import jax
+
+from megatronapp_tpu.config.arguments import build_parser, configs_from_args
+from megatronapp_tpu.models.bert import bert_config
+from megatronapp_tpu.models.biencoder import ict_loss, init_biencoder_params
+from megatronapp_tpu.parallel.mesh import build_mesh
+from megatronapp_tpu.training.optimizer import get_optimizer
+from megatronapp_tpu.training.train import reshape_global_batch
+from megatronapp_tpu.training.train_state import setup_train_state
+from megatronapp_tpu.training.train_step import make_train_step
+
+
+def main(argv=None):
+    ap = build_parser("pretrain_ict (megatronapp-tpu)")
+    ap.add_argument("--titles-data-path", type=str, default=None)
+    ap.add_argument("--query-in-block-prob", type=float, default=0.1)
+    ap.add_argument("--use-one-sent-docs", action="store_true")
+    ap.add_argument("--retriever-score-scaling", action="store_true")
+    ap.add_argument("--biencoder-shared-query-context-model",
+                    action="store_true")
+    args = ap.parse_args(argv)
+    gpt_cfg, parallel, training, opt_cfg = configs_from_args(args)
+    import dataclasses
+    cfg = bert_config(**{f.name: getattr(gpt_cfg, f.name)
+                         for f in dataclasses.fields(gpt_cfg)
+                         if f.name not in ("position_embedding",
+                                           "attn_mask_type",
+                                           "add_qkv_bias")})
+
+    ctx = build_mesh(parallel)
+    optimizer = get_optimizer(opt_cfg, training.train_iters)
+    state, shardings, _ = setup_train_state(
+        jax.random.PRNGKey(training.seed),
+        lambda k: init_biencoder_params(
+            k, cfg, shared=args.biencoder_shared_query_context_model),
+        optimizer, ctx)
+
+    def loss_fn(params, micro):
+        return ict_loss(params, micro, cfg, ctx=ctx,
+                        score_scaling=args.retriever_score_scaling)
+
+    step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
+                              training.train_iters)
+    num_micro = training.num_microbatches(ctx.dp * ctx.ep)
+
+    batch_iter = None
+    if args.data_path:
+        if not args.titles_data_path:
+            raise SystemExit("--titles-data-path is required with "
+                             "--data-path (one title per document)")
+        from megatronapp_tpu.data.ict_dataset import ICTDataset, ict_batches
+        from megatronapp_tpu.data.indexed_dataset import IndexedDataset
+        dataset = ICTDataset(
+            IndexedDataset(args.data_path),
+            IndexedDataset(args.titles_data_path),
+            seq_length=training.seq_length,
+            num_epochs=max(1, training.train_iters *
+                           training.global_batch_size // 1000 + 1),
+            query_in_block_prob=args.query_in_block_prob,
+            seed=training.seed,
+            use_one_sent_blocks=args.use_one_sent_docs)
+        batch_iter = ict_batches(dataset, training.global_batch_size)
+        print(f"ICT corpus: {len(dataset)} blocks from {args.data_path}")
+
+    t0 = time.perf_counter()
+    last = None
+    with ctx.mesh:
+        for it in range(training.train_iters):
+            if batch_iter is not None:
+                batch = next(batch_iter)
+            else:
+                from megatronapp_tpu.data.ict_dataset import mock_ict_batch
+                batch = mock_ict_batch(it, training.global_batch_size,
+                                       training.seq_length, cfg.vocab_size)
+            batch = reshape_global_batch(batch, num_micro)
+            state, metrics = step_fn(state, batch)
+            if (it + 1) % training.log_interval == 0 or \
+                    it + 1 == training.train_iters:
+                metrics = jax.device_get(metrics)
+                last = metrics
+                print(f"iter {it+1:6d}/{training.train_iters} | "
+                      f"loss {float(metrics['loss']):.4f} | "
+                      f"top1 {float(metrics.get('top1_acc', 0)):.1f}%")
+    dt = time.perf_counter() - t0
+    print(f"done: final loss {float(last['loss']):.4f} in {dt:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

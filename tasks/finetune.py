@@ -43,9 +43,11 @@ def build_classification_batch(rows, tokenizer, ids, seq_length):
     for i, (label, a, b) in enumerate(rows):
         ta = tokenizer.tokenize(a)
         tb = tokenizer.tokenize(b) if b else []
-        # Truncate the longer side first (reference
-        # clean_text/truncation policy).
-        while len(ta) + len(tb) > seq_length - 3:
+        # Truncate the longer side first (reference clean_text/truncation
+        # policy); budget = seq_length minus specials ([CLS] a [SEP] for
+        # singles, [CLS] a [SEP] b [SEP] for pairs).
+        budget = seq_length - (3 if tb else 2)
+        while len(ta) + len(tb) > budget:
             (ta if len(ta) >= len(tb) else tb).pop()
         seq = [ids.cls, *ta, ids.sep]
         tt = [0] * len(seq)
@@ -66,21 +68,11 @@ def classification_loss(params, batch, cfg, num_classes, ctx=None):
     over [CLS] → classifier dense (the LM head is bypassed)."""
     import jax.numpy as jnp
 
-    from megatronapp_tpu.config.transformer_config import NormKind
+    from megatronapp_tpu.models.bert import bert_encode
     from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
-    from megatronapp_tpu.ops.normalization import apply_norm
-    from megatronapp_tpu.transformer.block import block_forward
-    emb = params["embedding"]
-    h = jnp.take(emb["word"], batch["tokens"], axis=0)
-    h = h + jnp.take(emb["pos"],
-                     jnp.arange(batch["tokens"].shape[1]), axis=0)
-    h = h + jnp.take(emb["tokentype"], batch["tokentype_ids"], axis=0)
-    h = apply_norm(NormKind.layernorm, h, params["emb_ln_scale"],
-                   params["emb_ln_bias"], cfg.layernorm_epsilon)
-    h = h.astype(cfg.compute_dtype)
-    attn = batch["padding_mask"][:, None, None, :].astype(bool)
-    h, _ = block_forward(params["block"], h, cfg, None, None, attn,
-                         ctx=ctx)
+    h = bert_encode(params, batch["tokens"], cfg,
+                    padding_mask=batch["padding_mask"],
+                    tokentype_ids=batch["tokentype_ids"], ctx=ctx)
     ch = params["classifier"]
     pooled = jnp.tanh(h[:, 0].astype(jnp.float32)
                       @ ch["pooler"].astype(jnp.float32)
@@ -135,8 +127,11 @@ def finetune_classification(train_rows, valid_rows, tokenizer, ids, cfg,
     params["classifier"], _ = init_classifier_head(rng, cfg, num_classes)
 
     steps_per_epoch = max(len(train_rows) // batch_size, 1)
-    optimizer = get_optimizer(OptimizerConfig(lr=lr, lr_warmup_iters=0),
-                              epochs * steps_per_epoch)
+    # min_lr must sit below the finetune LR (2e-5 default is smaller than
+    # OptimizerConfig's pretrain-scale min_lr) or "decay" would raise it.
+    optimizer = get_optimizer(
+        OptimizerConfig(lr=lr, min_lr=0.0, lr_warmup_iters=0),
+        epochs * steps_per_epoch)
     opt_state = optimizer.init(params)
 
     @jax.jit

@@ -24,21 +24,11 @@ def embed_texts(params, cfg, tokenizer, ids, texts, seq_length=128,
     import jax
     import jax.numpy as jnp
 
-    from megatronapp_tpu.config.transformer_config import NormKind
-    from megatronapp_tpu.ops.normalization import apply_norm
-    from megatronapp_tpu.transformer.block import block_forward
+    from megatronapp_tpu.models.bert import bert_encode
 
     @jax.jit
     def encode(tokens, mask):
-        emb = params["embedding"]
-        h = jnp.take(emb["word"], tokens, axis=0)
-        h = h + jnp.take(emb["pos"], jnp.arange(tokens.shape[1]), axis=0)
-        h = h + emb["tokentype"][0]
-        h = apply_norm(NormKind.layernorm, h, params["emb_ln_scale"],
-                       params["emb_ln_bias"], cfg.layernorm_epsilon)
-        h = h.astype(cfg.compute_dtype)
-        attn = mask[:, None, None, :].astype(bool)
-        h, _ = block_forward(params["block"], h, cfg, None, None, attn)
+        h = bert_encode(params, tokens, cfg, padding_mask=mask)
         h = h.astype(jnp.float32) * mask[..., None]
         return jnp.sum(h, axis=1) / jnp.maximum(
             jnp.sum(mask, axis=1, keepdims=True), 1.0)
