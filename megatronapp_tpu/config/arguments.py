@@ -35,6 +35,10 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     g.add_argument("--num-query-groups", type=int, default=None)
     g.add_argument("--ffn-hidden-size", type=int, default=None)
     g.add_argument("--kv-channels", type=int, default=None)
+    g.add_argument("--heterogeneous-layers-config-path", type=str,
+                   default=None)
+    g.add_argument("--heterogeneous-layers-config-encoded-json", type=str,
+                   default=None)
     g.add_argument("--vocab-size", type=int, default=50304)
     g.add_argument("--max-position-embeddings", type=int, default=2048)
     g.add_argument("--position-embedding-type", default="rope",
@@ -251,6 +255,21 @@ def load_saved_args(load_dir: str) -> Optional[dict]:
         return json.load(f)
 
 
+def _hetero_json(args):
+    """--heterogeneous-layers-config-{path,encoded-json} → encoded JSON
+    (reference arguments.py _add_heterogeneous_args; the path is read once
+    and carried as the encoded string, heterogeneous_config.py:196-205)."""
+    encoded = getattr(args, "heterogeneous_layers_config_encoded_json",
+                      None)
+    path = getattr(args, "heterogeneous_layers_config_path", None)
+    if encoded:
+        return encoded
+    if path:
+        with open(path) as f:
+            return f.read()
+    return None
+
+
 def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
                                      TrainingConfig, OptimizerConfig]:
     """Build + cross-validate the four configs (validate_args parity)."""
@@ -327,6 +346,7 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
                 if args.hierarchical_context_parallel_sizes else 2),
             remat_policy=args.recompute_granularity,
             compute_dtype=jnp.float32 if args.fp32 else jnp.bfloat16,
+            heterogeneous_layers_config_json=_hetero_json(args),
         )
 
     vpp = 1

@@ -153,7 +153,24 @@ class TransformerConfig:
     flash_block_q: int = 512
     flash_block_kv: int = 512
 
+    # Heterogeneous per-layer structure (reference
+    # heterogeneous_config.py HeterogeneousTransformerConfig): the HF
+    # Nemotron "block_configs" JSON (encoded string). When set, layers
+    # follow their individual specs (no-op / linear-replacement /
+    # per-layer GQA + FFN sizes) and the block unrolls instead of
+    # scanning.
+    heterogeneous_layers_config_json: Optional[str] = None
+
     def __post_init__(self):
+        self.hetero_block_specs = None
+        if self.heterogeneous_layers_config_json:
+            from megatronapp_tpu.transformer.heterogeneous import (
+                parse_block_configs,
+            )
+            self.hetero_block_specs = parse_block_configs(
+                self.heterogeneous_layers_config_json,
+                num_attention_heads=self.num_attention_heads,
+                hidden_size=self.hidden_size)
         if self.ffn_hidden_size is None:
             if self.activation in (ActivationKind.swiglu, ActivationKind.geglu):
                 self.ffn_hidden_size = int(4 * self.hidden_size * 2 / 3)

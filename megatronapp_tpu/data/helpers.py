@@ -248,6 +248,10 @@ def build_blocks_mapping(document_indices: np.ndarray,
     sizes = np.ascontiguousarray(sentence_lengths, dtype=np.int32)
     titles = np.ascontiguousarray(title_lengths, dtype=np.int32)
     n_docs = len(docs) - 1
+    if len(titles) < n_docs:
+        raise ValueError(
+            f"title_lengths has {len(titles)} entries but the block "
+            f"dataset has {n_docs} documents — wrong titles companion?")
     min_num_sent = 1 if use_one_sent_blocks else 2
     lib = _load_native()
     if lib is not None:

@@ -22,11 +22,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from megatronapp_tpu.config.transformer_config import (
-    NormKind, TransformerConfig,
-)
-from megatronapp_tpu.ops.normalization import apply_norm
-from megatronapp_tpu.transformer.block import block_forward, init_block_params
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.transformer.block import init_block_params
 
 
 def _init_tower(rng, cfg: TransformerConfig, num_tokentypes: int):

@@ -48,6 +48,12 @@ def reshape_params_for_pipeline(stacked_params, pp: int, vpp: int = 1):
     """[L, ...]-stacked layer params → [pp, vpp, L/(pp*vpp), ...] with the
     interleaved chunk→stage assignment (global layer (c*pp+s)*Lc + i ↦
     position [s, c, i])."""
+    if isinstance(stacked_params, list):
+        raise NotImplementedError(
+            "heterogeneous per-layer configs (unstacked params) do not "
+            "compose with pipeline parallelism; run hetero models with "
+            "pp=1 (reference get_config_for_layer builds per-layer specs "
+            "on one pipeline too)")
 
     def r(x):
         L = x.shape[0]

@@ -150,6 +150,11 @@ def init_block_params(rng, cfg: TransformerConfig, num_layers: int = None):
     uniform (TPU-first: one compiled group body).
     """
     n = num_layers or cfg.num_layers
+    if getattr(cfg, "hetero_block_specs", None):
+        from megatronapp_tpu.transformer.heterogeneous import (
+            init_hetero_block_params,
+        )
+        return init_hetero_block_params(rng, cfg)
     freq = cfg.moe_layer_freq if cfg.is_moe else 1
     if freq == 1:
         keys = jax.random.split(rng, n)
@@ -178,6 +183,17 @@ def block_forward(stacked_p, x: jnp.ndarray, cfg: TransformerConfig,
                   layer_offset: int = 0, ctx=None, zigzag: bool = False,
                   segment_ids=None):
     """Run all stacked layers via lax.scan. Returns (x, moe_aux_sum)."""
+    if getattr(cfg, "hetero_block_specs", None):
+        if segment_ids is not None or zigzag:
+            raise NotImplementedError(
+                "heterogeneous per-layer configs do not compose with "
+                "packed sequences or zigzag CP yet")
+        from megatronapp_tpu.transformer.heterogeneous import (
+            hetero_block_forward,
+        )
+        return hetero_block_forward(
+            stacked_p, x, cfg, rope_cos, rope_sin, attention_mask,
+            layer_offset=layer_offset, ctx=ctx)
     hetero = isinstance(stacked_p, dict) and "dense" in stacked_p
 
     def run_layer(layer_p, h, lid):

@@ -1,0 +1,206 @@
+"""Heterogeneous per-layer transformer configs (Llama-Nemotron style).
+
+Parity with /root/reference/megatron/core/transformer/heterogeneous/
+heterogeneous_config.py (HeterogeneousTransformerConfig): individual layers
+may differ — attention or MLP can be a no-op or replaced with a single
+linear layer (linear_replacements.py), GQA group counts and MLP
+intermediate sizes can vary per layer. The config format is the
+HuggingFace Nemotron "block_configs" JSON list
+(heterogeneous_config.py:166-189).
+
+TPU-first design: the uniform stack compiles as one scanned layer body
+(transformer/block.py); heterogeneous stacks can't share one body, so the
+block unrolls — a Python loop over per-layer params at trace time, each
+layer under the same remat policy. Compile time grows with depth, but each
+layer body is exactly the shape XLA already optimizes, and no_op halves
+vanish entirely instead of being masked.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import (
+    NormKind, TransformerConfig,
+)
+from megatronapp_tpu.ops.normalization import apply_norm
+from megatronapp_tpu.transformer.attention import (
+    attention_forward, init_attention_params,
+)
+from megatronapp_tpu.transformer.mlp import init_mlp_params, mlp_forward
+
+OP_NORMAL = "normal"
+OP_NOOP = "noop"
+OP_LINEAR = "linear"
+
+
+@dataclasses.dataclass(frozen=True)
+class HeteroBlockSpec:
+    """Resolved per-layer structure."""
+    attention: str = OP_NORMAL          # normal | noop | linear
+    num_query_groups: Optional[int] = None
+    mlp: str = OP_NORMAL                # normal | noop | linear
+    ffn_hidden_size: Optional[int] = None
+
+
+def _ffn_mult_to_intermediate_size(ffn_mult: float, hidden: int) -> int:
+    """2/3 rule + round up to a multiple of 256
+    (heterogeneous_config.py:101-130)."""
+    size = int(2 * ffn_mult * hidden / 3)
+    return size if size % 256 == 0 else size + 256 - (size % 256)
+
+
+def parse_block_configs(encoded_json: str, *, num_attention_heads: int,
+                        hidden_size: int) -> Tuple[HeteroBlockSpec, ...]:
+    """HF Nemotron config JSON (or a bare block_configs list) →
+    HeteroBlockSpec tuple. Accepts both `num_query_groups` and the HF
+    `n_heads_in_group` spelling (heterogeneous_config.py:38-51)."""
+    doc = json.loads(encoded_json)
+    blocks = doc["block_configs"] if isinstance(doc, dict) else doc
+    specs = []
+    for block in blocks:
+        attn = block.get("attention", {})
+        if attn.get("no_op"):
+            a_op, nqg = OP_NOOP, None
+        elif attn.get("replace_with_linear"):
+            a_op, nqg = OP_LINEAR, None
+        else:
+            a_op = OP_NORMAL
+            nqg = attn.get("num_query_groups")
+            if nqg is None:
+                nhg = attn.get("n_heads_in_group")
+                if nhg:
+                    if num_attention_heads % nhg:
+                        raise ValueError(
+                            f"num_attention_heads ({num_attention_heads}) "
+                            f"not a multiple of n_heads_in_group ({nhg})")
+                    nqg = num_attention_heads // nhg
+        mlp = block.get("ffn", block.get("mlp", {}))
+        if mlp.get("no_op"):
+            m_op, ffn = OP_NOOP, None
+        elif mlp.get("replace_with_linear"):
+            m_op, ffn = OP_LINEAR, None
+        else:
+            m_op = OP_NORMAL
+            ffn = mlp.get("ffn_hidden_size")
+            if ffn is None and mlp.get("ffn_mult") is not None:
+                ffn = _ffn_mult_to_intermediate_size(
+                    float(mlp["ffn_mult"]), hidden_size)
+        specs.append(HeteroBlockSpec(a_op, nqg, m_op, ffn))
+    return tuple(specs)
+
+
+def layer_cfg_for_spec(cfg: TransformerConfig,
+                       spec: HeteroBlockSpec) -> TransformerConfig:
+    """Per-layer TransformerConfig with the spec's overrides
+    (reference get_config_for_layer, heterogeneous_config.py:229)."""
+    over = {}
+    if spec.num_query_groups is not None:
+        over["num_query_groups"] = spec.num_query_groups
+    if spec.ffn_hidden_size is not None:
+        over["ffn_hidden_size"] = spec.ffn_hidden_size
+    if not over:
+        return cfg
+    # Drop the block-configs JSON from the per-layer copy: replace()
+    # re-runs __post_init__, and re-parsing the L-entry JSON per layer
+    # would be O(L²); the per-layer cfg only feeds attention/MLP shapes.
+    return dataclasses.replace(cfg, heterogeneous_layers_config_json=None,
+                               **over)
+
+
+def init_hetero_block_params(rng, cfg: TransformerConfig):
+    """Per-layer (unstacked) params + logical axes; layer i follows
+    cfg.hetero_block_specs[i]."""
+    specs = cfg.hetero_block_specs
+    if len(specs) != cfg.num_layers:
+        raise ValueError(
+            f"heterogeneous block_configs has {len(specs)} entries for "
+            f"num_layers={cfg.num_layers}")
+    out_std = cfg.init_method_std / jnp.sqrt(2.0 * cfg.num_layers)
+    h = cfg.hidden_size
+    params: List[dict] = []
+    axes: List[dict] = []
+    keys = jax.random.split(rng, len(specs))
+    for key, spec in zip(keys, specs):
+        k_attn, k_mlp = jax.random.split(key)
+        lcfg = layer_cfg_for_spec(cfg, spec)
+        p, ax = {}, {}
+
+        def add_norm(name):
+            p[f"{name}_scale"] = jnp.ones((h,), cfg.params_dtype)
+            ax[f"{name}_scale"] = ("embed",)
+            if cfg.normalization == NormKind.layernorm:
+                p[f"{name}_bias"] = jnp.zeros((h,), cfg.params_dtype)
+                ax[f"{name}_bias"] = ("embed",)
+
+        if spec.attention == OP_NORMAL:
+            add_norm("ln1")
+            p["attention"], ax["attention"] = init_attention_params(
+                k_attn, lcfg, out_std)
+        elif spec.attention == OP_LINEAR:
+            add_norm("ln1")
+            p["attn_linear"] = jax.random.normal(
+                k_attn, (h, h), cfg.params_dtype) * out_std
+            ax["attn_linear"] = ("embed", "embed")
+
+        if spec.mlp == OP_NORMAL:
+            add_norm("ln2")
+            p["mlp"], ax["mlp"] = init_mlp_params(k_mlp, lcfg, out_std)
+        elif spec.mlp == OP_LINEAR:
+            add_norm("ln2")
+            p["mlp_linear"] = jax.random.normal(
+                k_mlp, (h, h), cfg.params_dtype) * out_std
+            ax["mlp_linear"] = ("embed", "embed")
+
+        params.append(p)
+        axes.append(ax)
+    return params, axes
+
+
+def hetero_block_forward(per_layer_params, x: jnp.ndarray,
+                         cfg: TransformerConfig, rope_cos=None,
+                         rope_sin=None, attention_mask=None,
+                         layer_offset: int = 0, ctx=None):
+    """Unrolled heterogeneous stack. Returns (x, aux=0.0)."""
+    from megatronapp_tpu.transformer.block import _remat_wrap
+
+    specs = cfg.hetero_block_specs
+
+    def one_layer(p, x, spec: HeteroBlockSpec, lid: int):
+        lcfg = layer_cfg_for_spec(cfg, spec)
+        if spec.attention != OP_NOOP:
+            residual = x
+            hdn = apply_norm(cfg.normalization, x, p["ln1_scale"],
+                             p.get("ln1_bias"), cfg.layernorm_epsilon)
+            if spec.attention == OP_LINEAR:
+                out = hdn.astype(cfg.compute_dtype) @ \
+                    p["attn_linear"].astype(cfg.compute_dtype)
+            else:
+                out, _ = attention_forward(
+                    p["attention"], hdn, lcfg, rope_cos, rope_sin,
+                    attention_mask, layer_id=lid, ctx=ctx)
+            x = residual + out.astype(residual.dtype)
+        if spec.mlp != OP_NOOP:
+            residual = x
+            hdn = apply_norm(cfg.normalization, x, p["ln2_scale"],
+                             p.get("ln2_bias"), cfg.layernorm_epsilon)
+            if spec.mlp == OP_LINEAR:
+                out = hdn.astype(cfg.compute_dtype) @ \
+                    p["mlp_linear"].astype(cfg.compute_dtype)
+            else:
+                out = mlp_forward(p["mlp"], hdn, lcfg, layer_id=lid)
+            x = residual + out.astype(residual.dtype)
+        return x
+
+    for i, (p, spec) in enumerate(zip(per_layer_params, specs)):
+        body = _remat_wrap(
+            lambda p_, x_, s=spec, l=layer_offset + i: one_layer(
+                p_, x_, s, l),
+            cfg.remat_policy)
+        x = body(p, x)
+    return x, jnp.zeros((), jnp.float32)

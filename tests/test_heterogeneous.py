@@ -1,0 +1,154 @@
+"""Heterogeneous per-layer transformer config tests.
+
+Reference strategy: the Nemotron block_configs JSON drives per-layer
+structure (no-op / linear replacement / per-layer GQA + FFN sizes,
+heterogeneous_config.py). Checks: parsing (incl. n_heads_in_group and
+ffn_mult rounding), parameter structure, forward equivalence of an
+all-normal hetero stack vs the uniform scanned stack, no-op semantics,
+and gradient flow through mixed stacks.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.models.gpt import gpt_forward, gpt_loss, init_gpt_params
+from megatronapp_tpu.transformer.heterogeneous import (
+    HeteroBlockSpec, _ffn_mult_to_intermediate_size, parse_block_configs,
+)
+
+CFG_KW = dict(num_layers=3, hidden_size=32, num_attention_heads=4,
+              vocab_size=64, max_position_embeddings=32,
+              attention_impl="reference", remat_policy="none")
+
+
+def nemotron_json(blocks):
+    return json.dumps({"block_configs": blocks})
+
+
+NORMAL = {"attention": {"n_heads_in_group": 1, "no_op": False,
+                        "replace_with_linear": False},
+          "ffn": {"ffn_mult": 1.0, "no_op": False,
+                  "replace_with_linear": False}}
+
+
+class TestParsing:
+    def test_nemotron_format(self):
+        js = nemotron_json([
+            NORMAL,
+            {"attention": {"n_heads_in_group": None, "no_op": True,
+                           "replace_with_linear": False},
+             "ffn": {"ffn_mult": 2.625, "no_op": False,
+                     "replace_with_linear": False}},
+            {"attention": {"n_heads_in_group": 2, "no_op": False,
+                           "replace_with_linear": True},
+             "ffn": {"no_op": False, "replace_with_linear": True}},
+        ])
+        specs = parse_block_configs(js, num_attention_heads=4,
+                                    hidden_size=32)
+        assert specs[0] == HeteroBlockSpec(
+            "normal", 4, "normal", _ffn_mult_to_intermediate_size(1.0, 32))
+        assert specs[1].attention == "noop"
+        assert specs[1].mlp == "normal"
+        assert specs[2].attention == "linear"
+        assert specs[2].mlp == "linear"
+
+    def test_ffn_mult_rounding(self):
+        # 2/3 rule rounded up to a multiple of 256
+        # (heterogeneous_config.py find_multiple).
+        assert _ffn_mult_to_intermediate_size(2.625, 4096) % 256 == 0
+        assert _ffn_mult_to_intermediate_size(2.625, 4096) >= \
+            int(2 * 2.625 * 4096 / 3)
+
+    def test_bad_heads_in_group(self):
+        js = nemotron_json([{"attention": {"n_heads_in_group": 3},
+                             "ffn": {"ffn_mult": 1.0}}])
+        with pytest.raises(ValueError):
+            parse_block_configs(js, num_attention_heads=4, hidden_size=32)
+
+
+class TestHeteroForward:
+    def test_noop_layers_are_identity(self):
+        """A stack whose every layer is attention-noop + mlp-noop must be
+        the identity on hidden states → logits equal embedding-only
+        model's."""
+        js = nemotron_json([
+            {"attention": {"no_op": True}, "ffn": {"no_op": True}}
+            for _ in range(3)])
+        cfg = TransformerConfig(heterogeneous_layers_config_json=js,
+                                **CFG_KW)
+        p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.arange(16, dtype=jnp.int32)[None, :] % 64
+        logits, _ = gpt_forward(p, toks, cfg)
+        # Rebuild with 0 effective layers by comparing against an
+        # embedding→final-norm→head pass of the same params.
+        from megatronapp_tpu.models.gpt import gpt_embed, gpt_head
+        h = gpt_embed(p, toks, cfg)
+        ref = gpt_head(p, h.astype(cfg.compute_dtype), cfg)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_mixed_stack_trains(self):
+        """Mixed normal/linear/noop stack: loss is finite, grads flow to
+        every present parameter, per-layer ffn sizes honored."""
+        js = nemotron_json([
+            NORMAL,
+            {"attention": {"no_op": True},
+             "ffn": {"ffn_mult": 2.0}},
+            {"attention": {"replace_with_linear": True},
+             "ffn": {"replace_with_linear": True}},
+        ])
+        cfg = TransformerConfig(heterogeneous_layers_config_json=js,
+                                **CFG_KW)
+        p, ax = init_gpt_params(jax.random.PRNGKey(1), cfg)
+        layers = p["block"]
+        assert "attention" in layers[0] and "mlp" in layers[0]
+        assert "attention" not in layers[1] and "mlp" in layers[1]
+        assert "attn_linear" in layers[2] and "mlp_linear" in layers[2]
+        f0 = layers[0]["mlp"]["fc1_kernel"].shape[1]
+        f1 = layers[1]["mlp"]["fc1_kernel"].shape[1]
+        assert f1 == _ffn_mult_to_intermediate_size(2.0, 32)
+        assert f0 == _ffn_mult_to_intermediate_size(1.0, 32)
+
+        toks = jnp.arange(32, dtype=jnp.int32)[None, :] % 64
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt_loss(p, toks, toks, None, cfg)[0])(p)
+        assert np.isfinite(float(loss))
+        flat = jax.tree.leaves(jax.tree.map(
+            lambda g: float(jnp.abs(g).sum()), grads))
+        assert all(np.isfinite(v) for v in flat)
+        # every layer's params receive gradient
+        for lp in jax.tree.leaves(grads["block"]):
+            assert float(jnp.abs(lp).sum()) > 0
+
+    def test_all_normal_matches_uniform_stack(self):
+        """An all-normal hetero stack with uniform sizes computes the same
+        function family as the scanned stack: loss gap after copying
+        params layer-by-layer is exactly 0."""
+        cfg_u = TransformerConfig(compute_dtype=jnp.float32, **CFG_KW)
+        js = nemotron_json([
+            {"attention": {"num_query_groups": 4},
+             "ffn": {"ffn_hidden_size": cfg_u.ffn_hidden_size}}
+            for _ in range(3)])
+        cfg_h = TransformerConfig(heterogeneous_layers_config_json=js,
+                                  compute_dtype=jnp.float32, **CFG_KW)
+        pu, _ = init_gpt_params(jax.random.PRNGKey(2), cfg_u)
+        ph, _ = init_gpt_params(jax.random.PRNGKey(3), cfg_h)
+        # copy stacked params into the per-layer list
+        for i in range(3):
+            ph["block"][i] = jax.tree.map(lambda s, i=i: s[i],
+                                          pu["block"])
+        for key in ("embedding", "final_ln_scale"):
+            ph[key] = pu[key]
+        if "final_ln_bias" in pu:
+            ph["final_ln_bias"] = pu["final_ln_bias"]
+        toks = jnp.arange(16, dtype=jnp.int32)[None, :] % 64
+        lu, _ = gpt_forward(pu, toks, cfg_u)
+        lh, _ = gpt_forward(ph, toks, cfg_h)
+        np.testing.assert_allclose(np.asarray(lu), np.asarray(lh),
+                                   rtol=2e-5, atol=2e-5)
