@@ -168,6 +168,8 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
                    choices=["disabled", "validate_results"])
     g.add_argument("--error-injection-rate", type=float, default=0.0)
     g.add_argument("--log-straggler", action="store_true")
+    g.add_argument("--run-workload-inspector-server", action="store_true")
+    g.add_argument("--workload-inspector-port", type=int, default=0)
 
     g = ap.add_argument_group("megascan")  # reference arguments.py:2705ff
     g.add_argument("--trace", action="store_true")
@@ -397,6 +399,8 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
         rerun_mode=args.rerun_mode,
         error_injection_rate=args.error_injection_rate,
         log_straggler=args.log_straggler,
+        run_workload_inspector_server=args.run_workload_inspector_server,
+        workload_inspector_port=args.workload_inspector_port,
         micro_batch_size=args.micro_batch_size,
         global_batch_size=args.global_batch_size,
         seq_length=args.seq_length,

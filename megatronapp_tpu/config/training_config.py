@@ -59,6 +59,10 @@ class TrainingConfig:
     error_injection_rate: float = 0.0
     # Host-side straggler detector (reference --log-straggler).
     log_straggler: bool = False
+    # Workload-inspector HTTP server (reference
+    # --run-workload-inspector-server): /status, /straggler/*, /probe.
+    run_workload_inspector_server: bool = False
+    workload_inspector_port: int = 0
     # Metrics sinks (reference --tensorboard-dir / wandb analogues).
     metrics_jsonl: Optional[str] = None
     tensorboard_dir: Optional[str] = None

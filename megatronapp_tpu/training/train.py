@@ -239,6 +239,12 @@ def pretrain_gpt(
     straggler = get_straggler_detector()
     if train_cfg.log_straggler:
         straggler.enable()
+    inspector = None
+    if train_cfg.run_workload_inspector_server and jax.process_index() == 0:
+        from megatronapp_tpu.utils.inspector import get_inspector
+        inspector = get_inspector()
+        port = inspector.start(train_cfg.workload_inspector_port)
+        log_fn(f"workload inspector: http://127.0.0.1:{port}/status")
 
     losses = []
     window_tokens = 0
@@ -332,6 +338,13 @@ def pretrain_gpt(
                     f"{step_time_ms:.1f} ms/step | "
                     f"{tokens_per_sec:,.0f} tok/s | "
                     f"{tflops:.1f} TFLOP/s/dev")
+                if inspector is not None:
+                    inspector.update(
+                        step=it + 1, loss=loss,
+                        tokens_per_sec=round(tokens_per_sec, 1),
+                        step_time_ms=round(step_time_ms, 2),
+                        tflops_per_device=round(tflops, 2),
+                        consumed_samples=consumed)
                 metrics_logger.log(it + 1, {
                     **metrics,
                     "tokens_per_sec": tokens_per_sec,
@@ -369,6 +382,8 @@ def pretrain_gpt(
         ckpt.close()
     if train_cfg.trace:
         tracer.finalize()
+    if inspector is not None:
+        inspector.stop()
     metrics_logger.close()
 
     return TrainResult(state=state, losses=losses,

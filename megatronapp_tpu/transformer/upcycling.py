@@ -1,0 +1,91 @@
+"""Dense → MoE checkpoint upcycling.
+
+Parity with /root/reference/megatron/core/transformer/moe/
+upcycling_utils.py (upcycle_state_dict / load_and_upcycle_model): a
+pretrained dense model seeds a MoE model — every expert starts as a copy
+of the dense MLP (fc1/fc2 replicated across the expert axis), the router
+is freshly initialized, and all non-MLP parameters carry over unchanged.
+
+Works on the stacked [L, ...] parameter layout (transformer/block.py):
+dense p["block"]["mlp"] {fc1_kernel [L,H,F], fc2_kernel [L,F,H]} maps to
+moe {fc1_kernel [L,E,H,F], fc2_kernel [L,E,F,H]}. Supports the
+moe_layer_freq grouped layout too (only the group's MoE slot is
+upcycled; dense slots copy through).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+
+
+def _broadcast_expert(kernel: jnp.ndarray, num_experts: int) -> jnp.ndarray:
+    """[L, a, b] → [L, E, a, b] (every expert = the dense MLP)."""
+    return jnp.broadcast_to(
+        kernel[:, None], (kernel.shape[0], num_experts) + kernel.shape[1:]
+    ).copy()
+
+
+def upcycle_params(dense_params, dense_cfg: TransformerConfig,
+                   moe_cfg: TransformerConfig, rng=None):
+    """Dense GPT/BERT params → MoE params for `moe_cfg`.
+
+    moe_cfg must match dense_cfg in every architecture dim except the MoE
+    fields; moe_ffn_hidden_size must equal the dense ffn_hidden_size
+    (expert = copy of the dense MLP, upcycling_utils.py:115-136).
+    Returns a NEW params pytree; `rng` seeds the fresh routers.
+    """
+    if moe_cfg.num_moe_experts is None:
+        raise ValueError("moe_cfg has no experts — nothing to upcycle to")
+    if moe_cfg.moe_ffn_hidden_size != dense_cfg.ffn_hidden_size:
+        raise ValueError(
+            f"moe_ffn_hidden_size ({moe_cfg.moe_ffn_hidden_size}) must "
+            f"equal the dense ffn_hidden_size "
+            f"({dense_cfg.ffn_hidden_size}) for weight-copy upcycling")
+    if moe_cfg.moe_layer_freq != 1:
+        raise NotImplementedError(
+            "upcycling targets the uniform MoE stack (moe_layer_freq=1); "
+            "grouped stacks would need per-slot mapping")
+    for f in ("num_layers", "hidden_size", "num_attention_heads",
+              "ffn_hidden_size", "vocab_size"):
+        if getattr(moe_cfg, f) != getattr(dense_cfg, f):
+            raise ValueError(f"cfg mismatch on {f}")
+
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    e = moe_cfg.num_moe_experts
+    h = moe_cfg.hidden_size
+    std = moe_cfg.init_method_std
+
+    new = dict(dense_params)
+    block = dict(dense_params["block"])
+    mlp = block.pop("mlp")
+    L = mlp["fc1_kernel"].shape[0]
+    moe = {
+        "router_kernel": jax.random.normal(
+            rng, (L, h, e), jnp.float32) * std,
+        "fc1_kernel": _broadcast_expert(mlp["fc1_kernel"], e),
+        "fc2_kernel": _broadcast_expert(mlp["fc2_kernel"], e),
+    }
+    if moe_cfg.moe_shared_expert_intermediate_size:
+        raise NotImplementedError(
+            "dense checkpoints carry no shared-expert weights; upcycle "
+            "into a config without shared experts")
+    block["moe"] = moe
+    new["block"] = block
+    return new
+
+
+def moe_config_from_dense(dense_cfg: TransformerConfig, *,
+                          num_experts: int, topk: int = 2,
+                          aux_loss_coeff: float = 1e-2,
+                          **overrides) -> TransformerConfig:
+    """The canonical upcycling target config: same dims, experts added
+    (reference examples: --moe-use-upcycling with num_experts)."""
+    return dataclasses.replace(
+        dense_cfg, num_moe_experts=num_experts, moe_router_topk=topk,
+        moe_aux_loss_coeff=aux_loss_coeff,
+        moe_ffn_hidden_size=dense_cfg.ffn_hidden_size, **overrides)

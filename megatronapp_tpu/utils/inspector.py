@@ -1,0 +1,111 @@
+"""Workload-inspector HTTP server.
+
+Parity with /root/reference/megatron/training/arguments.py:1346-1351
+(--run-workload-inspector-server, started training.py:2026-2032) and the
+StragglerDetector's curl on/off port (core/utils.py:1030, toggled via
+`curl host:port/...`): a tiny stdlib HTTP endpoint on the trainer host
+that exposes live run state as JSON and lets an operator flip the
+straggler detector at runtime without touching the process.
+
+Endpoints:
+  GET /status              — step, losses, throughput, timers, straggler
+  GET /straggler/enable    — turn the step-time detector on
+  GET /straggler/disable   — off
+  GET /probe               — per-chip RTT probe (slow-chip localization)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+
+class WorkloadInspector:
+    """Shared mutable run state + HTTP server."""
+
+    def __init__(self):
+        self._state: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    def update(self, **fields):
+        with self._lock:
+            self._state.update(fields)
+
+    def snapshot(self) -> Dict[str, Any]:
+        from megatronapp_tpu.utils.straggler import get_straggler_detector
+        from megatronapp_tpu.utils.timers import get_timers
+        det = get_straggler_detector()
+        with self._lock:
+            snap = dict(self._state)
+        snap["straggler"] = {
+            "enabled": det.enabled,
+            "flagged_steps": [r.step for r in det.flagged[-16:]],
+            "window_samples": len(det.window),
+        }
+        try:
+            snap["timers_s"] = get_timers().elapsed_all(reset=False)
+        except Exception:
+            pass
+        return snap
+
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        """Start serving; returns the bound port (0 = ephemeral)."""
+        inspector = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence request logging
+                pass
+
+            def do_GET(self):
+                from megatronapp_tpu.utils.straggler import (
+                    detect_slow_chips, get_straggler_detector,
+                    probe_chip_rtts,
+                )
+                det = get_straggler_detector()
+                if self.path.startswith("/straggler/enable"):
+                    det.enable()
+                    body = {"straggler": "enabled"}
+                elif self.path.startswith("/straggler/disable"):
+                    det.disable()
+                    body = {"straggler": "disabled"}
+                elif self.path.startswith("/probe"):
+                    rtts = probe_chip_rtts()
+                    body = {"rtts": rtts,
+                            "slow": detect_slow_chips(rtts)}
+                elif self.path.startswith("/status"):
+                    body = inspector.snapshot()
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                payload = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+_INSPECTOR = WorkloadInspector()
+
+
+def get_inspector() -> WorkloadInspector:
+    return _INSPECTOR

@@ -107,6 +107,65 @@ class TestRerunStateMachine:
         rsm.reports.clear()
 
 
+class TestWorkloadInspector:
+    def test_endpoints_during_training(self, devices8):
+        """Inspector serves live /status during a real run and toggles
+        the straggler detector (reference --run-workload-inspector-server
+        + the StragglerDetector curl port)."""
+        import json as _json
+        import urllib.request
+
+        from megatronapp_tpu.config.parallel_config import ParallelConfig
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.training.train import pretrain_gpt
+        from megatronapp_tpu.utils.inspector import get_inspector
+        from megatronapp_tpu.utils.straggler import (
+            get_straggler_detector,
+        )
+
+        model = TransformerConfig(num_layers=2, hidden_size=64,
+                                  num_attention_heads=4, vocab_size=128,
+                                  max_position_embeddings=64)
+        par = ParallelConfig()
+        ctx = build_mesh(par, devices=devices8[:1])
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=2,
+                               seq_length=16, train_iters=3,
+                               log_interval=1,
+                               run_workload_inspector_server=True)
+        pretrain_gpt(model, par, train, OptimizerConfig(lr=1e-3), ctx=ctx,
+                     log_fn=lambda s: None)
+        # Server is stopped at end of train; restart and query the final
+        # published state.
+        insp = get_inspector()
+        port = insp.start(0)
+        try:
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                    return _json.loads(r.read().decode())
+
+            status = get("/status")
+            assert status["step"] == 3
+            assert status["tokens_per_sec"] > 0
+            assert "straggler" in status
+            det = get_straggler_detector()
+            was = det.enabled
+            assert get("/straggler/enable")["straggler"] == "enabled"
+            assert det.enabled
+            assert get("/straggler/disable")["straggler"] == "disabled"
+            assert not det.enabled
+            if was:
+                det.enable()
+        finally:
+            insp.stop()
+
+
 class TestStraggler:
     def test_flags_outlier(self):
         det = StragglerDetector(window=32, z_threshold=3.0, min_samples=4)

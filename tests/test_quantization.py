@@ -1,0 +1,124 @@
+"""Post-training int8 quantization tests.
+
+Checks: per-channel quantize/dequantize error bounds, pytree selection
+(kernels yes, norms/biases no), npz round-trip through the tool, and the
+whole-model check — logits of a quantized-then-dequantized GPT must stay
+close (max |Δlogit| small, argmax preserved on most positions).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.inference.quantization import (
+    dequantize_params, is_quantized_leaf, quantize_leaf, quantize_params,
+)
+from megatronapp_tpu.models.gpt import gpt_forward, init_gpt_params
+
+CFG = dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+           vocab_size=128, max_position_embeddings=64,
+           attention_impl="reference", remat_policy="none",
+           compute_dtype=jnp.float32)
+
+
+class TestLeaf:
+    def test_roundtrip_error_bound(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(0, 0.05, (64, 128)).astype(np.float32)
+        entry = quantize_leaf(w)
+        assert entry["q"].dtype == np.int8
+        back = np.asarray(dequantize_params(entry))
+        # per-channel scale → error ≤ scale/2 per element
+        scale = entry["scale"]
+        assert np.all(np.abs(back - w) <= scale / 2 + 1e-9)
+
+    def test_per_layer_scales_on_stacked_kernels(self):
+        """[L,H,F] stacks must get independent scales per layer: a layer
+        with 10x-smaller weights keeps its resolution."""
+        rng = np.random.default_rng(1)
+        big = rng.normal(0, 0.5, (16, 32)).astype(np.float32)
+        small = big * 0.1
+        stacked = np.stack([big, small])
+        entry = quantize_leaf(stacked)
+        assert entry["scale"].shape == (2, 1, 32)
+        back = np.asarray(dequantize_params(entry))
+        # relative error of the small layer unaffected by the big one
+        rel = np.abs(back[1] - small).max() / np.abs(small).max()
+        assert rel < 0.01, rel
+
+    def test_router_not_quantized(self):
+        cfg = TransformerConfig(num_moe_experts=4, moe_router_topk=2,
+                                **CFG)
+        p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        q, report = quantize_params(p)
+        assert not is_quantized_leaf(q["block"]["moe"]["router_kernel"])
+        assert is_quantized_leaf(q["block"]["moe"]["fc1_kernel"])
+        assert not any("router" in k for k in report)
+
+    def test_selection(self):
+        cfg = TransformerConfig(**CFG)
+        p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        q, report = quantize_params(p)
+        # norm scales untouched, attention kernels quantized
+        assert not is_quantized_leaf(q["final_ln_scale"])
+        assert is_quantized_leaf(q["block"]["attention"]["q_kernel"])
+        assert len(report) > 0
+
+
+class TestModelParity:
+    def test_logits_close_after_quant(self):
+        cfg = TransformerConfig(**CFG)
+        p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.arange(32, dtype=jnp.int32)[None, :] % 128
+        ref, _ = gpt_forward(p, toks, cfg)
+        q, _ = quantize_params(p)
+        pq = dequantize_params(q)
+        out, _ = gpt_forward(pq, toks, cfg)
+        ref, out = np.asarray(ref), np.asarray(out)
+        # top-1 agreement on ≥ 90% of positions
+        agree = (ref.argmax(-1) == out.argmax(-1)).mean()
+        assert agree >= 0.9, agree
+        # and logits stay in the same regime
+        assert np.max(np.abs(ref - out)) < 0.5 * np.max(np.abs(ref))
+
+
+class TestTool:
+    def test_bf16_leaves_survive_npz(self, tmp_path):
+        """npz can't represent ml_dtypes.bfloat16 — unquantized bf16
+        leaves must round-trip via the recorded-cast path, not as void
+        arrays."""
+        from tools.checkpoint.quantize import (
+            load_quantized_params, save_quantized,
+        )
+        import ml_dtypes
+        tree = {"ln_scale": np.ones(8, ml_dtypes.bfloat16),
+                "w_kernel": np.ones((4, 8), np.float32)}
+        q, _ = quantize_params(tree)
+        path = os.path.join(str(tmp_path), "bf.npz")
+        save_quantized(path, q)
+        back = load_quantized_params(path)
+        assert np.asarray(back["ln_scale"]).dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(back["ln_scale"], np.float32), np.ones(8))
+
+    def test_npz_roundtrip(self, tmp_path):
+        from tools.checkpoint.quantize import (
+            load_quantized_params, save_quantized,
+        )
+        cfg = TransformerConfig(**CFG)
+        p, _ = init_gpt_params(jax.random.PRNGKey(1), cfg)
+        q, report = quantize_params(p)
+        path = os.path.join(str(tmp_path), "q.npz")
+        save_quantized(path, q, report)
+        back_q = load_quantized_params(path, dequantize=False)
+        # quantized leaves survive with int8 payloads
+        assert is_quantized_leaf(back_q["block"]["attention"]["q_kernel"])
+        back = load_quantized_params(path)
+        ref = dequantize_params(q)
+        for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(back)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=1e-6, atol=1e-6)

@@ -30,6 +30,9 @@ def main():
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--load-dir", default=None)
+    ap.add_argument("--load-quantized", default=None,
+                    help="int8 .npz from tools/checkpoint/quantize.py "
+                         "(dequantized on load)")
     ap.add_argument("--preset", default="gpt2-125m",
                     choices=sorted(PRESETS))
     ap.add_argument("--tokenizer-type", default="NullTokenizer")
@@ -44,7 +47,11 @@ def main():
 
     cfg = PRESETS[args.preset]()
     params, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
-    if args.load_dir:
+    if args.load_quantized:
+        from tools.checkpoint.quantize import load_quantized_params
+        params = load_quantized_params(args.load_quantized)
+        print(f"loaded int8-quantized params from {args.load_quantized}")
+    elif args.load_dir:
         mngr = CheckpointManager(args.load_dir)
         state = mngr.restore({"step": 0, "params": params, "opt_state": {}})
         if state is not None:
