@@ -8,9 +8,8 @@ is freshly initialized, and all non-MLP parameters carry over unchanged.
 
 Works on the stacked [L, ...] parameter layout (transformer/block.py):
 dense p["block"]["mlp"] {fc1_kernel [L,H,F], fc2_kernel [L,F,H]} maps to
-moe {fc1_kernel [L,E,H,F], fc2_kernel [L,E,F,H]}. Supports the
-moe_layer_freq grouped layout too (only the group's MoE slot is
-upcycled; dense slots copy through).
+moe {fc1_kernel [L,E,H,F], fc2_kernel [L,E,F,H]}. Targets the uniform
+MoE stack only (moe_layer_freq=1); grouped stacks raise.
 """
 
 from __future__ import annotations

@@ -104,12 +104,99 @@ def init_classifier_head(rng, cfg, num_classes):
     }
 
 
+def read_multichoice_jsonl(path):
+    """[(label:int, context, question, [options])] from JSONL rows
+    {"context","question","options","label"} (RACE articles reduce to
+    this shape; reference tasks/race/data.py builds the same per-choice
+    sequences)."""
+    import json
+    rows = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            d = json.loads(line)
+            rows.append((int(d["label"]), d["context"], d["question"],
+                         list(d["options"])))
+    return rows
+
+
+def build_multichoice_batch(rows, tokenizer, ids, seq_length,
+                            max_qa_length=128):
+    """RACE-style per-choice sequences: each question expands to
+    NUM_CHOICES rows [CLS] context [SEP] question option [SEP] that
+    collapse into the batch dim (reference RaceDataset.sample_multiplier,
+    tasks/race/data.py:42-44). Returns batch with tokens [B*C, S] and
+    labels [B]."""
+    n_choices = len(rows[0][3])
+    if any(len(r[3]) != n_choices for r in rows):
+        raise ValueError(
+            "multichoice rows disagree on option count: "
+            f"{sorted({len(r[3]) for r in rows})} — labels would "
+            "misalign with choice scores")
+    expanded = []
+    for label, context, question, options in rows:
+        tc_full = tokenizer.tokenize(context)  # once per row, not per opt
+        for opt in options:
+            qa = tokenizer.tokenize(f"{question} {opt}")
+            # QA capped so [CLS] + ≥1 context token + [SEP] qa [SEP]
+            # always fits (reference truncates the QA to max_qa_length
+            # and the context to the remainder).
+            qa = qa[:min(max_qa_length, seq_length - 4)]
+            expanded.append((tc_full, qa))
+    tokens = np.full((len(expanded), seq_length), ids.pad, np.int32)
+    types = np.zeros((len(expanded), seq_length), np.int32)
+    mask = np.zeros((len(expanded), seq_length), np.float32)
+    for i, (tc_full, qa_tokens) in enumerate(expanded):
+        budget = seq_length - 3 - len(qa_tokens)
+        tc = tc_full[:max(budget, 1)]
+        seq = [ids.cls, *tc, ids.sep, *qa_tokens, ids.sep]
+        tt = [0] * (len(tc) + 2) + [1] * (len(qa_tokens) + 1)
+        tokens[i, : len(seq)] = seq
+        types[i, : len(seq)] = tt
+        mask[i, : len(seq)] = 1.0
+    labels = np.asarray([r[0] for r in rows], np.int32)
+    return {"tokens": tokens, "tokentype_ids": types,
+            "padding_mask": mask, "labels": labels,
+            "num_choices": n_choices}
+
+
+def multichoice_loss(params, batch, cfg, num_choices, ctx=None):
+    """Score each choice-sequence with the 1-logit head, softmax over the
+    choices (reference RACE: classification head num_classes=1 with the
+    sample multiplier collapsing into batch)."""
+    import jax.numpy as jnp
+
+    from megatronapp_tpu.models.bert import bert_encode
+    from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
+    h = bert_encode(params, batch["tokens"], cfg,
+                    padding_mask=batch["padding_mask"],
+                    tokentype_ids=batch["tokentype_ids"], ctx=ctx)
+    ch = params["classifier"]
+    pooled = jnp.tanh(h[:, 0].astype(jnp.float32)
+                      @ ch["pooler"].astype(jnp.float32)
+                      + ch["pooler_bias"].astype(jnp.float32))
+    scores = pooled @ ch["dense"].astype(jnp.float32) \
+        + ch["dense_bias"].astype(jnp.float32)          # [B*C, 1]
+    scores = scores.reshape(-1, num_choices)             # [B, C]
+    loss, _ = cross_entropy_loss(scores[:, None],
+                                 batch["labels"][:, None])
+    acc = jnp.mean((jnp.argmax(scores, -1)
+                    == batch["labels"]).astype(jnp.float32))
+    return loss, {"lm_loss": loss, "accuracy": acc}
+
+
 def finetune_classification(train_rows, valid_rows, tokenizer, ids, cfg,
                             num_classes, *, epochs=3, batch_size=16,
                             lr=2e-5, seq_length=128, seed=0,
-                            pretrained_params=None, log_fn=print):
+                            pretrained_params=None, log_fn=print,
+                            multichoice=False):
     """Epoch loop (reference finetune_utils.finetune): train on train_rows,
-    report dev accuracy each epoch. Returns (params, best_accuracy)."""
+    report dev accuracy each epoch. Returns (params, best_accuracy).
+
+    multichoice=True switches to RACE semantics: rows are
+    (label, context, question, options), the head has 1 logit, and
+    softmax runs over the expanded choice sequences."""
     import jax
     import jax.numpy as jnp
 
@@ -124,7 +211,23 @@ def finetune_classification(train_rows, valid_rows, tokenizer, ids, cfg,
         for key in pretrained_params:
             if key in params:
                 params[key] = pretrained_params[key]
+    if multichoice:
+        num_classes = 1
+        num_choices = len(train_rows[0][3])
     params["classifier"], _ = init_classifier_head(rng, cfg, num_classes)
+
+    def build(rows):
+        if multichoice:
+            b = build_multichoice_batch(rows, tokenizer, ids, seq_length)
+            b.pop("num_choices")  # static; closed over in loss_for
+            return b
+        return build_classification_batch(rows, tokenizer, ids,
+                                          seq_length)
+
+    def loss_for(p, batch):
+        if multichoice:
+            return multichoice_loss(p, batch, cfg, num_choices)
+        return classification_loss(p, batch, cfg, num_classes)
 
     steps_per_epoch = max(len(train_rows) // batch_size, 1)
     # min_lr must sit below the finetune LR (2e-5 default is smaller than
@@ -138,8 +241,7 @@ def finetune_classification(train_rows, valid_rows, tokenizer, ids, cfg,
     def step(params, opt_state, batch, step_i):
         del step_i
         (loss, metrics), g = jax.value_and_grad(
-            lambda p: classification_loss(p, batch, cfg, num_classes),
-            has_aux=True)(params)
+            lambda p: loss_for(p, batch), has_aux=True)(params)
         updates, opt_state = optimizer.update(g, opt_state, params)
         params = jax.tree.map(lambda p, u: p + u.astype(p.dtype), params,
                               updates)
@@ -147,7 +249,7 @@ def finetune_classification(train_rows, valid_rows, tokenizer, ids, cfg,
 
     @jax.jit
     def evaluate(params, batch):
-        return classification_loss(params, batch, cfg, num_classes)[1]
+        return loss_for(params, batch)[1]
 
     rng_np = np.random.default_rng(seed)
     best = 0.0
@@ -156,16 +258,13 @@ def finetune_classification(train_rows, valid_rows, tokenizer, ids, cfg,
         for s in range(steps_per_epoch):
             idx = order[s * batch_size: (s + 1) * batch_size]
             rows = [train_rows[i] for i in idx]
-            batch = build_classification_batch(rows, tokenizer, ids,
-                                               seq_length)
             params, opt_state, loss, metrics = step(
-                params, opt_state, batch, s)
+                params, opt_state, build(rows), s)
         # Dev accuracy (single padded batch per eval chunk).
         correct = total = 0
         for s in range(0, len(valid_rows), batch_size):
             rows = valid_rows[s: s + batch_size]
-            m = evaluate(params, build_classification_batch(
-                rows, tokenizer, ids, seq_length))
+            m = evaluate(params, build(rows))
             correct += float(m["accuracy"]) * len(rows)
             total += len(rows)
         acc = correct / max(total, 1)
@@ -181,9 +280,15 @@ def main(argv=None):
     from megatronapp_tpu.models.bert import bert_config
 
     ap = argparse.ArgumentParser()
+    ap.add_argument("--task", default="classify",
+                    choices=["classify", "multichoice"],
+                    help="classify = GLUE-style TSV pairs; multichoice = "
+                         "RACE-style JSONL (context/question/options)")
     ap.add_argument("--train-data", required=True)
     ap.add_argument("--valid-data", required=True)
-    ap.add_argument("--num-classes", type=int, required=True)
+    ap.add_argument("--num-classes", type=int, default=None,
+                    help="required for --task classify; ignored for "
+                         "multichoice (1-logit head over choices)")
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--batch-size", type=int, default=16)
     ap.add_argument("--lr", type=float, default=2e-5)
@@ -222,11 +327,16 @@ def main(argv=None):
         if restored is not None:
             pretrained = restored["params"]
 
+    if args.task == "classify" and args.num_classes is None:
+        ap.error("--num-classes is required for --task classify")
+    reader = (read_multichoice_jsonl if args.task == "multichoice"
+              else read_tsv)
     _, best = finetune_classification(
-        read_tsv(args.train_data), read_tsv(args.valid_data), tok, ids,
+        reader(args.train_data), reader(args.valid_data), tok, ids,
         cfg, args.num_classes, epochs=args.epochs,
         batch_size=args.batch_size, lr=args.lr,
-        seq_length=args.seq_length, pretrained_params=pretrained)
+        seq_length=args.seq_length, pretrained_params=pretrained,
+        multichoice=args.task == "multichoice")
     print(f"best dev accuracy: {best:.4f}")
 
 
