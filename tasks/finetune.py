@@ -62,14 +62,12 @@ def build_classification_batch(rows, tokenizer, ids, seq_length):
             "padding_mask": mask, "labels": labels}
 
 
-def classification_loss(params, batch, cfg, num_classes, ctx=None):
-    """CLS-pooled classification CE + accuracy (reference finetune_utils
-    _cross_entropy_forward_step): BERT embeddings → encoder → tanh pooler
-    over [CLS] → classifier dense (the LM head is bypassed)."""
+def _pooled_logits(params, batch, cfg, ctx=None):
+    """Shared scoring path: encoder → tanh pooler over [CLS] →
+    classifier dense. [B', num_classes] fp32."""
     import jax.numpy as jnp
 
     from megatronapp_tpu.models.bert import bert_encode
-    from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
     h = bert_encode(params, batch["tokens"], cfg,
                     padding_mask=batch["padding_mask"],
                     tokentype_ids=batch["tokentype_ids"], ctx=ctx)
@@ -77,8 +75,18 @@ def classification_loss(params, batch, cfg, num_classes, ctx=None):
     pooled = jnp.tanh(h[:, 0].astype(jnp.float32)
                       @ ch["pooler"].astype(jnp.float32)
                       + ch["pooler_bias"].astype(jnp.float32))
-    cls_logits = pooled @ ch["dense"].astype(jnp.float32) \
+    return pooled @ ch["dense"].astype(jnp.float32) \
         + ch["dense_bias"].astype(jnp.float32)
+
+
+def classification_loss(params, batch, cfg, num_classes, ctx=None):
+    """CLS-pooled classification CE + accuracy (reference finetune_utils
+    _cross_entropy_forward_step): BERT embeddings → encoder → tanh pooler
+    over [CLS] → classifier dense (the LM head is bypassed)."""
+    import jax.numpy as jnp
+
+    from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
+    cls_logits = _pooled_logits(params, batch, cfg, ctx=ctx)
     loss, _ = cross_entropy_loss(cls_logits[:, None],
                                  batch["labels"][:, None])
     acc = jnp.mean((jnp.argmax(cls_logits, -1)
@@ -134,6 +142,11 @@ def build_multichoice_batch(rows, tokenizer, ids, seq_length,
             "multichoice rows disagree on option count: "
             f"{sorted({len(r[3]) for r in rows})} — labels would "
             "misalign with choice scores")
+    bad = [r[0] for r in rows if not 0 <= r[0] < n_choices]
+    if bad:
+        raise ValueError(
+            f"multichoice labels out of range [0,{n_choices}): {bad[:5]} "
+            "— take_along_axis would silently clamp them")
     expanded = []
     for label, context, question, options in rows:
         tc_full = tokenizer.tokenize(context)  # once per row, not per opt
@@ -167,18 +180,9 @@ def multichoice_loss(params, batch, cfg, num_choices, ctx=None):
     sample multiplier collapsing into batch)."""
     import jax.numpy as jnp
 
-    from megatronapp_tpu.models.bert import bert_encode
     from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
-    h = bert_encode(params, batch["tokens"], cfg,
-                    padding_mask=batch["padding_mask"],
-                    tokentype_ids=batch["tokentype_ids"], ctx=ctx)
-    ch = params["classifier"]
-    pooled = jnp.tanh(h[:, 0].astype(jnp.float32)
-                      @ ch["pooler"].astype(jnp.float32)
-                      + ch["pooler_bias"].astype(jnp.float32))
-    scores = pooled @ ch["dense"].astype(jnp.float32) \
-        + ch["dense_bias"].astype(jnp.float32)          # [B*C, 1]
-    scores = scores.reshape(-1, num_choices)             # [B, C]
+    scores = _pooled_logits(params, batch, cfg, ctx=ctx)  # [B*C, 1]
+    scores = scores.reshape(-1, num_choices)              # [B, C]
     loss, _ = cross_entropy_loss(scores[:, None],
                                  batch["labels"][:, None])
     acc = jnp.mean((jnp.argmax(scores, -1)

@@ -1,0 +1,181 @@
+"""Open-retrieval QA (ORQA-style) retrieval evaluation.
+
+Parity with /root/reference/tasks/orqa/evaluate_orqa.py +
+orqa/unsupervised/qa_utils (NQ-style eval): embed every evidence block
+with the biencoder's context tower and each question with the query
+tower, retrieve top-k blocks by inner product, and score a hit when a
+retrieved block contains the answer (token-subsequence containment — the
+reference matches answer strings in block text).
+
+Inputs: the ICT corpus layout (sentence-split blocks .bin/.idx + titles
+companion, data/ict_dataset.py) and a queries JSONL of
+{"question": "...", "answers": ["...", ...]}.
+
+Usage:
+  python tasks/orqa_eval.py --data-path blocks --titles-data-path titles \
+      --queries qa.jsonl --load-dir ckpt_biencoder --seq-length 128
+"""
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tasks/", 1)[0])
+
+import numpy as np
+
+
+def _pad_batch(seqs, seq_length, pad):
+    tokens = np.full((len(seqs), seq_length), pad, np.int32)
+    mask = np.zeros((len(seqs), seq_length), np.float32)
+    for i, s in enumerate(seqs):
+        s = s[:seq_length]
+        tokens[i, : len(s)] = s
+        mask[i, : len(s)] = 1.0
+    return tokens, mask
+
+
+def _contains_subseq(haystack: np.ndarray, needle) -> bool:
+    n = len(needle)
+    if n == 0 or n > len(haystack):
+        return False
+    needle = np.asarray(needle)
+    # all windows of length n
+    windows = np.lib.stride_tricks.sliding_window_view(haystack, n)
+    return bool((windows == needle).all(axis=1).any())
+
+
+def evaluate_retrieval(params, cfg, block_ds, titles_ds, queries, *,
+                       tokenizer, ids, seq_length=128, batch_size=32,
+                       topk=(1, 5, 20), log_fn=print):
+    """queries: [{'question': str, 'answers': [str]}]. Returns
+    {f'top{k}_acc': float} over the evidence blocks built exactly like
+    ICT context blocks (one block per build_blocks_mapping span)."""
+    import jax
+
+    from megatronapp_tpu.data.ict_dataset import ICTDataset, IctTokenIds
+    from megatronapp_tpu.models.biencoder import biencoder_embed
+
+    if not queries:
+        raise ValueError("no queries to evaluate")
+    ict = ICTDataset(block_ds, titles_ds, seq_length=seq_length,
+                     token_ids=IctTokenIds(cls=ids.cls, sep=ids.sep,
+                                           pad=ids.pad),
+                     num_epochs=1, query_in_block_prob=1.0)
+    n_blocks = len(ict)
+    if n_blocks == 0:
+        raise ValueError("no evidence blocks (corpus too small)")
+
+    embed_ctx = jax.jit(lambda t, m: biencoder_embed(
+        params, t, cfg, kind="context", padding_mask=m))
+    embed_q = jax.jit(lambda t, m: biencoder_embed(
+        params, t, cfg, kind="query", padding_mask=m))
+
+    # Evidence embeddings + raw block token streams for answer matching.
+    ctx_emb = []
+    block_tokens = []
+    for s in range(0, n_blocks, batch_size):
+        rows = [ict[i] for i in range(s, min(s + batch_size, n_blocks))]
+        t = np.stack([r["context_tokens"] for r in rows])
+        m = np.stack([r["context_pad_mask"] for r in rows])
+        ctx_emb.append(np.asarray(embed_ctx(t, m.astype(np.float32))))
+        for r in rows:
+            start, end, doc, _ = r["block_data"]
+            block_tokens.append(np.concatenate(
+                [np.asarray(block_ds[i]) for i in range(start, end)]))
+    ctx_emb = np.concatenate(ctx_emb)
+    log_fn(f"embedded {n_blocks} evidence blocks")
+
+    hits = {k: 0 for k in topk}
+    kmax = max(topk)
+    for s in range(0, len(queries), batch_size):
+        chunk = queries[s: s + batch_size]
+        # Match the ICT training query format exactly:
+        # [CLS] q[:seq_length-2] [SEP] (ict_dataset.py _pad) — blunt
+        # truncation after the fact would drop the SEP on long questions.
+        seqs = [[ids.cls,
+                 *tokenizer.tokenize(q["question"])[:seq_length - 2],
+                 ids.sep]
+                for q in chunk]
+        t, m = _pad_batch(seqs, seq_length, ids.pad)
+        q_emb = np.asarray(embed_q(t, m))
+        scores = q_emb @ ctx_emb.T            # [B, n_blocks]
+        order = np.argsort(-scores, axis=1)[:, :kmax]
+        for qi, q in enumerate(chunk):
+            answers = [tokenizer.tokenize(a) for a in q["answers"]]
+            rank_hit = None
+            for rank, bi in enumerate(order[qi]):
+                if any(_contains_subseq(block_tokens[bi], a)
+                       for a in answers):
+                    rank_hit = rank
+                    break
+            for k in topk:
+                if rank_hit is not None and rank_hit < k:
+                    hits[k] += 1
+    n = len(queries)
+    accs = {f"top{k}_acc": hits[k] / n for k in topk}
+    log_fn(" | ".join(f"top-{k}: {hits[k]/n:.4f}" for k in topk) +
+           f"  ({n} questions, {n_blocks} blocks)")
+    return accs
+
+
+def main(argv=None):
+    from megatronapp_tpu.data.bert_dataset import BertTokenIds
+    from megatronapp_tpu.data.indexed_dataset import IndexedDataset
+    from megatronapp_tpu.data.tokenizers import build_tokenizer
+    from megatronapp_tpu.models.bert import bert_config
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-path", required=True)
+    ap.add_argument("--titles-data-path", required=True)
+    ap.add_argument("--queries", required=True,
+                    help="JSONL {'question','answers'}")
+    ap.add_argument("--load-dir", default=None)
+    ap.add_argument("--seq-length", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-layers", type=int, default=12)
+    ap.add_argument("--hidden-size", type=int, default=768)
+    ap.add_argument("--num-attention-heads", type=int, default=12)
+    ap.add_argument("--vocab-size", type=int, default=30592)
+    ap.add_argument("--tokenizer-type", default="BertWordPieceTokenizer")
+    ap.add_argument("--tokenizer-name-or-path", default=None)
+    ap.add_argument("--report-topk-accuracies", type=int, nargs="+",
+                    default=[1, 5, 20])
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from megatronapp_tpu.models.biencoder import init_biencoder_params
+    from megatronapp_tpu.training.checkpointing import CheckpointManager
+
+    tok = build_tokenizer(args.tokenizer_type, args.tokenizer_name_or_path,
+                          args.vocab_size)
+    ids = BertTokenIds(cls=getattr(tok, "cls", 1) or 1,
+                       sep=getattr(tok, "sep", 2) or 2,
+                       mask=getattr(tok, "mask", 3) or 3,
+                       pad=getattr(tok, "pad", 0) or 0)
+    cfg = bert_config(num_layers=args.num_layers,
+                      hidden_size=args.hidden_size,
+                      num_attention_heads=args.num_attention_heads,
+                      vocab_size=args.vocab_size,
+                      max_position_embeddings=args.seq_length)
+    params, _ = init_biencoder_params(jax.random.PRNGKey(0), cfg)
+    if args.load_dir:
+        mngr = CheckpointManager(args.load_dir)
+        restored = mngr.restore({"step": 0, "params": params,
+                                 "opt_state": {}})
+        mngr.close()
+        if restored is not None:
+            params = restored["params"]
+            print(f"loaded biencoder checkpoint step {restored['step']}")
+
+    queries = [json.loads(l) for l in open(args.queries) if l.strip()]
+    evaluate_retrieval(
+        params, cfg, IndexedDataset(args.data_path),
+        IndexedDataset(args.titles_data_path), queries, tokenizer=tok,
+        ids=ids, seq_length=args.seq_length, batch_size=args.batch_size,
+        topk=tuple(args.report_topk_accuracies))
+
+
+if __name__ == "__main__":
+    main()

@@ -179,3 +179,68 @@ class TestBiencoder:
         _, m_final = ict_loss(p, batch0, cfg)
         assert float(m_final["loss"]) < float(m0["loss"]) * 0.5
         assert float(m_final["top1_acc"]) > 60.0  # chance = 6.25%
+
+
+class TestOrqaEval:
+    def test_retrieval_eval_end_to_end(self, tmp_path):
+        """Oracle check: questions drawn verbatim from a block must
+        retrieve it near-perfectly once the biencoder is trained on the
+        same lexical-overlap structure; untrained, accuracy is ~chance.
+        Uses the real corpus + eval pipeline (tasks/orqa_eval.py)."""
+        import optax
+
+        from megatronapp_tpu.data.bert_dataset import BertTokenIds
+        from megatronapp_tpu.data.tokenizers import NullTokenizer
+        from tasks.orqa_eval import _contains_subseq, evaluate_retrieval
+
+        # subsequence matcher sanity
+        assert _contains_subseq(np.array([1, 2, 3, 4]), [2, 3])
+        assert not _contains_subseq(np.array([1, 2, 3]), [3, 2])
+        assert not _contains_subseq(np.array([1]), [1, 2])
+
+        ds, titles = write_blocks_corpus(tmp_path, n_docs=20)
+        cfg = bert_config(num_layers=2, hidden_size=64,
+                          num_attention_heads=4, vocab_size=128,
+                          max_position_embeddings=64,
+                          attention_impl="reference")
+        p, _ = init_biencoder_params(jax.random.PRNGKey(0), cfg)
+        tok = NullTokenizer(128)
+        ids = BertTokenIds(cls=1, sep=2, mask=3, pad=0)
+
+        # Queries: a sentence from a block; answer = that sentence.
+        ict = ICTDataset(ds, titles, seq_length=64, seed=0,
+                         query_in_block_prob=1.0)
+        queries = []
+        for i in range(min(12, len(ict))):
+            start, end, doc, _ = (int(v) for v in ict.mapping[i])
+            sent = np.asarray(ds[start])[:20]
+            text = " ".join(str(t) for t in sent)
+            queries.append({"question": text, "answers": [text]})
+
+        accs = evaluate_retrieval(
+            p, cfg, ds, titles, queries, tokenizer=tok, ids=ids,
+            seq_length=64, batch_size=8, topk=(1, 5),
+            log_fn=lambda s: None)
+        assert 0.0 <= accs["top1_acc"] <= 1.0
+        # Train the biencoder briefly on ICT batches from this corpus,
+        # then accuracy must beat the untrained baseline.
+        from megatronapp_tpu.models.biencoder import ict_loss
+        opt = optax.adam(1e-3)
+        st = opt.init(p)
+
+        @jax.jit
+        def step(p, st, batch):
+            (l, m), g = jax.value_and_grad(
+                lambda p: ict_loss(p, batch, cfg), has_aux=True)(p)
+            up, st = opt.update(g, st)
+            return optax.apply_updates(p, up), st
+
+        it = ict_batches(ict, 8)
+        for _ in range(30):
+            p, st = step(p, st, next(it))
+        accs2 = evaluate_retrieval(
+            p, cfg, ds, titles, queries, tokenizer=tok, ids=ids,
+            seq_length=64, batch_size=8, topk=(1, 5),
+            log_fn=lambda s: None)
+        assert accs2["top5_acc"] >= accs["top5_acc"]
+        assert accs2["top5_acc"] > 0.3
