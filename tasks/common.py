@@ -1,0 +1,42 @@
+"""Shared helpers for the downstream-task CLIs (tasks/*.py).
+
+One canonical version of the tokenizer/token-id assembly and the
+checkpoint-restore boilerplate that every task entry needs (reference
+tasks/main.py + finetune_utils share the analogous setup)."""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tasks/", 1)[0])
+
+
+def build_tok_and_ids(tokenizer_type, tokenizer_name_or_path, vocab_size):
+    """(tokenizer, BertTokenIds) with conventional low-id fallbacks for
+    tokenizers without BERT specials (e.g. NullTokenizer)."""
+    from megatronapp_tpu.data.bert_dataset import BertTokenIds
+    from megatronapp_tpu.data.tokenizers import build_tokenizer
+
+    tok = build_tokenizer(tokenizer_type, tokenizer_name_or_path,
+                          vocab_size)
+
+    def special(name, default):
+        v = getattr(tok, name, None)
+        return default if v is None else v
+
+    ids = BertTokenIds(cls=special("cls", 1), sep=special("sep", 2),
+                       mask=special("mask", 3), pad=special("pad", 0))
+    return tok, ids
+
+
+def restore_params(load_dir, template_params, log_fn=print):
+    """Orbax-restore `params` from a training checkpoint dir, or None."""
+    if not load_dir:
+        return None
+    from megatronapp_tpu.training.checkpointing import CheckpointManager
+    mngr = CheckpointManager(load_dir)
+    restored = mngr.restore({"step": 0, "params": template_params,
+                             "opt_state": {}})
+    mngr.close()
+    if restored is None:
+        return None
+    log_fn(f"loaded checkpoint step {restored['step']} from {load_dir}")
+    return restored["params"]

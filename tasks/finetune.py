@@ -218,6 +218,12 @@ def finetune_classification(train_rows, valid_rows, tokenizer, ids, cfg,
     if multichoice:
         num_classes = 1
         num_choices = len(train_rows[0][3])
+        vbad = {len(r[3]) for r in valid_rows} - {num_choices}
+        if vbad:
+            raise ValueError(
+                f"valid set has option counts {sorted(vbad)} but the "
+                f"train set has {num_choices} — scores would be "
+                "misgrouped at the reshape")
     params["classifier"], _ = init_classifier_head(rng, cfg, num_classes)
 
     def build(rows):
@@ -279,8 +285,6 @@ def finetune_classification(train_rows, valid_rows, tokenizer, ids, cfg,
 
 
 def main(argv=None):
-    from megatronapp_tpu.data.bert_dataset import BertTokenIds
-    from megatronapp_tpu.data.tokenizers import build_tokenizer
     from megatronapp_tpu.models.bert import bert_config
 
     ap = argparse.ArgumentParser()
@@ -306,12 +310,10 @@ def main(argv=None):
     ap.add_argument("--load-dir", default=None)
     args = ap.parse_args(argv)
 
-    tok = build_tokenizer(args.tokenizer_type, args.tokenizer_name_or_path,
-                          args.vocab_size)
-    ids = BertTokenIds(cls=getattr(tok, "cls", 1),
-                       sep=getattr(tok, "sep", 2),
-                       mask=getattr(tok, "mask", 3),
-                       pad=getattr(tok, "pad", 0))
+    from tasks.common import build_tok_and_ids, restore_params
+    tok, ids = build_tok_and_ids(args.tokenizer_type,
+                                 args.tokenizer_name_or_path,
+                                 args.vocab_size)
     cfg = bert_config(num_layers=args.num_layers,
                       hidden_size=args.hidden_size,
                       num_attention_heads=args.num_attention_heads,
@@ -322,14 +324,8 @@ def main(argv=None):
         import jax
 
         from megatronapp_tpu.models.bert import init_bert_params
-        from megatronapp_tpu.training.checkpointing import CheckpointManager
         tmpl, _ = init_bert_params(jax.random.PRNGKey(0), cfg)
-        mngr = CheckpointManager(args.load_dir)
-        restored = mngr.restore({"step": 0, "params": tmpl,
-                                 "opt_state": {}})
-        mngr.close()
-        if restored is not None:
-            pretrained = restored["params"]
+        pretrained = restore_params(args.load_dir, tmpl)
 
     if args.task == "classify" and args.num_classes is None:
         ap.error("--num-classes is required for --task classify")

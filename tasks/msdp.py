@@ -1,0 +1,137 @@
+"""Knowledge-grounded dialogue (MSDP) prompting + evaluation metrics.
+
+Parity with /root/reference/tasks/msdp/ (multi-stage dialogue prompting:
+metrics.py token-F1 over normalized text, evaluate.py F1 scoring of
+generated responses vs ground truth, prompt.py few-shot prompt assembly
+served through the generation engine). The KILT/WoW data prep of
+preprocessing.py reduces to the same line-per-example text interface.
+
+Library surface:
+  normalize_answer, f1_score, corpus_f1  — response-vs-gold scoring
+  distinct_n                              — generation diversity
+  build_knowledge_prompt, build_response_prompt — few-shot assembly
+  evaluate_file                           — CLI: guesses vs answers files
+"""
+
+import argparse
+import re
+import sys
+from collections import Counter
+from typing import List, Sequence, Tuple
+
+sys.path.insert(0, __file__.rsplit("/tasks/", 1)[0])
+
+_ARTICLES = re.compile(r"\b(a|an|the)\b")
+_PUNCT = re.compile(r"[!\"#$%&()*+,\-./:;<=>?@\[\]\\^`{|}~_']")
+
+
+def normalize_answer(s: str) -> str:
+    """Lowercase, strip punctuation/articles/extra whitespace (the
+    standard SQuAD/ParlAI normalization the reference uses)."""
+    s = _PUNCT.sub(" ", s.lower())
+    s = _ARTICLES.sub(" ", s)
+    return " ".join(s.split())
+
+
+def f1_score(guess: str, answer: str) -> Tuple[float, float, float]:
+    """(precision, recall, f1) over normalized token multisets."""
+    pred = normalize_answer(guess).split()
+    gold = normalize_answer(answer).split()
+    common = Counter(pred) & Counter(gold)
+    overlap = sum(common.values())
+    if overlap == 0:
+        return 0.0, 0.0, 0.0
+    p = overlap / len(pred)
+    r = overlap / len(gold)
+    return p, r, 2 * p * r / (p + r)
+
+
+def corpus_f1(guesses: Sequence[str], answers: Sequence[str]
+              ) -> Tuple[float, float, float]:
+    """Mean (p, r, f1) over pairs (reference F1Metric.compute_all_pairs
+    semantics)."""
+    if len(guesses) != len(answers):
+        raise ValueError(f"{len(guesses)} guesses vs {len(answers)} "
+                         "answers")
+    if not guesses:
+        raise ValueError("nothing to score")
+    triples = [f1_score(g, a) for g, a in zip(guesses, answers)]
+    n = len(triples)
+    return (sum(t[0] for t in triples) / n,
+            sum(t[1] for t in triples) / n,
+            sum(t[2] for t in triples) / n)
+
+
+def distinct_n(texts: Sequence[str], n: int = 2) -> float:
+    """Fraction of distinct n-grams across generations (diversity
+    metric reported alongside F1 in dialogue eval)."""
+    grams = Counter()
+    for t in texts:
+        toks = normalize_answer(t).split()
+        for i in range(len(toks) - n + 1):
+            grams[tuple(toks[i: i + n])] += 1
+    total = sum(grams.values())
+    return len(grams) / total if total else 0.0
+
+
+def build_knowledge_prompt(examples: List[dict], topic: str,
+                           dialogue: List[str]) -> str:
+    """Stage-1 prompt (knowledge generation): few-shot examples of
+    (topic, last turn → knowledge), then the query (reference
+    prompt.py knowledge-generation stage)."""
+    parts = []
+    for ex in examples:
+        parts.append(f"( {ex['topic']} ) {ex['turn']} => {ex['knowledge']}")
+    parts.append(f"( {topic} ) {dialogue[-1]} =>")
+    return "\n".join(parts)
+
+
+def build_response_prompt(examples: List[dict], topic: str,
+                          dialogue: List[str], knowledge: str) -> str:
+    """Stage-2 prompt (response generation): few-shot examples of
+    (turn + knowledge → response)."""
+    parts = []
+    for ex in examples:
+        parts.append(f"Topic: {ex['topic']}. User says: {ex['turn']} "
+                     f"We know that: {ex['knowledge']} "
+                     f"System replies: {ex['response']}")
+    parts.append(f"Topic: {topic}. User says: {dialogue[-1]} "
+                 f"We know that: {knowledge} System replies:")
+    return "\n".join(parts)
+
+
+def evaluate_file(guess_path: str, answer_path: str, log_fn=print):
+    """Line-aligned generation file vs ground-truth file → metrics
+    (reference evaluate.py evaluate_f1)."""
+    # Keep line alignment: blank generations are legitimate (scored 0),
+    # so only the trailing newline's empty element is dropped — dropping
+    # interior blanks independently on each side would silently mis-pair
+    # every line after them.
+    def read_lines(path):
+        with open(path) as f:
+            lines = [l.rstrip("\n") for l in f]
+        if lines and lines[-1] == "":
+            lines.pop()
+        return lines
+
+    guesses = read_lines(guess_path)
+    answers = read_lines(answer_path)
+    p, r, f1 = corpus_f1(guesses, answers)
+    d1, d2 = distinct_n(guesses, 1), distinct_n(guesses, 2)
+    log_fn(f"precision {p:.4f} | recall {r:.4f} | F1 {f1:.4f} | "
+           f"distinct-1 {d1:.4f} | distinct-2 {d2:.4f} "
+           f"({len(guesses)} pairs)")
+    return {"precision": p, "recall": r, "f1": f1,
+            "distinct_1": d1, "distinct_2": d2}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(__doc__)
+    ap.add_argument("--guess-file", required=True)
+    ap.add_argument("--answer-file", required=True)
+    args = ap.parse_args(argv)
+    evaluate_file(args.guess_file, args.answer_file)
+
+
+if __name__ == "__main__":
+    main()

@@ -100,7 +100,13 @@ def evaluate_retrieval(params, cfg, block_ds, titles_ds, queries, *,
         t, m = _pad_batch(seqs, seq_length, ids.pad)
         q_emb = np.asarray(embed_q(t, m))
         scores = q_emb @ ctx_emb.T            # [B, n_blocks]
-        order = np.argsort(-scores, axis=1)[:, :kmax]
+        # argpartition (O(n)) then sort only the kmax candidates — a full
+        # argsort is O(n log n) per batch over the whole corpus.
+        kk = min(kmax, scores.shape[1])
+        cand = np.argpartition(-scores, kk - 1, axis=1)[:, :kk]
+        order = np.take_along_axis(
+            cand, np.argsort(-np.take_along_axis(scores, cand, axis=1),
+                             axis=1), axis=1)
         for qi, q in enumerate(chunk):
             answers = [tokenizer.tokenize(a) for a in q["answers"]]
             rank_hit = None
@@ -120,10 +126,9 @@ def evaluate_retrieval(params, cfg, block_ds, titles_ds, queries, *,
 
 
 def main(argv=None):
-    from megatronapp_tpu.data.bert_dataset import BertTokenIds
     from megatronapp_tpu.data.indexed_dataset import IndexedDataset
-    from megatronapp_tpu.data.tokenizers import build_tokenizer
     from megatronapp_tpu.models.bert import bert_config
+    from tasks.common import build_tok_and_ids, restore_params
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--data-path", required=True)
@@ -146,28 +151,17 @@ def main(argv=None):
     import jax
 
     from megatronapp_tpu.models.biencoder import init_biencoder_params
-    from megatronapp_tpu.training.checkpointing import CheckpointManager
 
-    tok = build_tokenizer(args.tokenizer_type, args.tokenizer_name_or_path,
-                          args.vocab_size)
-    ids = BertTokenIds(cls=getattr(tok, "cls", 1) or 1,
-                       sep=getattr(tok, "sep", 2) or 2,
-                       mask=getattr(tok, "mask", 3) or 3,
-                       pad=getattr(tok, "pad", 0) or 0)
+    tok, ids = build_tok_and_ids(args.tokenizer_type,
+                                 args.tokenizer_name_or_path,
+                                 args.vocab_size)
     cfg = bert_config(num_layers=args.num_layers,
                       hidden_size=args.hidden_size,
                       num_attention_heads=args.num_attention_heads,
                       vocab_size=args.vocab_size,
                       max_position_embeddings=args.seq_length)
     params, _ = init_biencoder_params(jax.random.PRNGKey(0), cfg)
-    if args.load_dir:
-        mngr = CheckpointManager(args.load_dir)
-        restored = mngr.restore({"step": 0, "params": params,
-                                 "opt_state": {}})
-        mngr.close()
-        if restored is not None:
-            params = restored["params"]
-            print(f"loaded biencoder checkpoint step {restored['step']}")
+    params = restore_params(args.load_dir, params) or params
 
     queries = [json.loads(l) for l in open(args.queries) if l.strip()]
     evaluate_retrieval(

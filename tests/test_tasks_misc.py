@@ -1,0 +1,94 @@
+"""Vision-classification finetune and MSDP dialogue-metric tests
+(tasks/vision_classify.py, tasks/msdp.py — reference tasks/vision/ and
+tasks/msdp/)."""
+
+import numpy as np
+import pytest
+
+from tasks.msdp import (
+    build_knowledge_prompt, build_response_prompt, corpus_f1, distinct_n,
+    evaluate_file, f1_score, normalize_answer,
+)
+
+
+class TestMsdpMetrics:
+    def test_normalize(self):
+        assert normalize_answer("The  Cat, sat!") == "cat sat"
+        assert normalize_answer("An apple a day.") == "apple day"
+
+    def test_f1_exact_and_disjoint(self):
+        assert f1_score("the cat sat", "cat sat the")[2] == \
+            pytest.approx(1.0)
+        assert f1_score("dog", "cat")[2] == 0.0
+        p, r, f1 = f1_score("cat sat here now", "the cat sat")
+        assert p == pytest.approx(2 / 4)
+        assert r == pytest.approx(2 / 2)
+        assert f1 == pytest.approx(2 * 0.5 * 1.0 / 1.5)
+
+    def test_corpus_f1_and_validation(self):
+        p, r, f1 = corpus_f1(["cat", "dog"], ["cat", "dog"])
+        assert f1 == pytest.approx(1.0)
+        with pytest.raises(ValueError):
+            corpus_f1(["a"], ["a", "b"])
+        with pytest.raises(ValueError):
+            corpus_f1([], [])
+
+    def test_distinct_n(self):
+        assert distinct_n(["cat cat cat cat"], 1) == pytest.approx(0.25)
+        assert distinct_n(["cat dog", "bird fish"], 2) == 1.0
+        assert distinct_n([], 2) == 0.0
+
+    def test_prompts(self):
+        ex = [{"topic": "jazz", "turn": "who started it",
+               "knowledge": "jazz began in New Orleans",
+               "response": "it began in New Orleans"}]
+        k = build_knowledge_prompt(ex, "rock", ["tell me about rock"])
+        assert k.endswith("( rock ) tell me about rock =>")
+        assert "jazz began in New Orleans" in k
+        r = build_response_prompt(ex, "rock", ["tell me about rock"],
+                                  "rock evolved from blues")
+        assert r.endswith("System replies:")
+        assert "rock evolved from blues" in r
+
+    def test_evaluate_file(self, tmp_path):
+        g = tmp_path / "g.txt"
+        a = tmp_path / "a.txt"
+        g.write_text("the cat sat\nhello world\n")
+        a.write_text("cat sat\nhello there\n")
+        out = evaluate_file(str(g), str(a), log_fn=lambda s: None)
+        assert 0 < out["f1"] < 1
+        assert out["distinct_2"] > 0
+
+
+class TestVisionFinetune:
+    def test_learns_quadrant_task(self):
+        """ViT finetune loop learns a synthetic bright-quadrant task to
+        high dev accuracy (whole-loop correctness)."""
+        from megatronapp_tpu.models.vision import VitSpec, vit_config
+        from tasks.vision_classify import evaluate_accuracy, finetune_vision
+
+        rng = np.random.default_rng(0)
+
+        def make(n):
+            imgs = rng.normal(0, 0.1, (n, 16, 16, 3)).astype(np.float32)
+            labels = rng.integers(0, 4, n).astype(np.int32)
+            for i, lab in enumerate(labels):
+                r, c = divmod(int(lab), 2)
+                imgs[i, r * 8:(r + 1) * 8, c * 8:(c + 1) * 8] += 1.0
+            return imgs, labels
+
+        ti, tl = make(192)
+        vi, vl = make(48)
+        cfg = vit_config(num_layers=2, hidden_size=64,
+                         num_attention_heads=4,
+                         max_position_embeddings=17,
+                         attention_impl="reference")
+        spec = VitSpec(image_size=16, patch_size=4, num_classes=4)
+        params, best = finetune_vision(
+            ti, tl, vi, vl, cfg, spec, epochs=4, batch_size=32,
+            lr=1e-3, log_fn=lambda s: None)
+        assert best > 0.8, best
+        # evaluate_accuracy pads the ragged tail chunk correctly
+        acc = evaluate_accuracy(params, cfg, spec, vi[:33], vl[:33],
+                                batch_size=32)
+        assert acc > 0.7
