@@ -207,6 +207,86 @@ class StaticInferenceEngine:
         return texts
 
 
+class MambaInferenceEngine:
+    """Server-compatible generation engine for pure-Mamba models
+    (reference: the mamba text-generation server under tools/; decode is
+    O(1)-state recurrent instead of KV-cached attention).
+
+    Exposes the same generate/generate_text surface the
+    TextGenerationServer drives on StaticInferenceEngine."""
+
+    def __init__(self, params, cfg, mcfg, tokenizer=None):
+        from megatronapp_tpu.models.mamba import (
+            mamba_decode_step, mamba_prefill,
+        )
+        self.params = params
+        self.cfg = cfg
+        self.mcfg = mcfg
+        self.tokenizer = tokenizer
+        self.max_seq_len = cfg.max_position_embeddings
+        # jit once per engine — per-request lambdas would re-trace and
+        # recompile every call.
+        self._prefill = jax.jit(
+            lambda p, t: mamba_prefill(p, t, cfg, mcfg))
+        self._step = jax.jit(
+            lambda p, s, t: mamba_decode_step(p, s, t, cfg, mcfg),
+            donate_argnums=(1,))
+
+    def generate(self, prompt_tokens: np.ndarray, max_new_tokens: int,
+                 sampling: Optional[SamplingParams] = None,
+                 eod_id: Optional[int] = None,
+                 token_callback: Optional[Callable] = None) -> np.ndarray:
+        """Same contract as StaticInferenceEngine.generate: full sampling
+        (greedy/temperature/top-k/top-p), padded-vocab masking, eod early
+        stop, max_seq_len bound."""
+        sampling = sampling or SamplingParams()
+        prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
+        b, s_prompt = prompt_tokens.shape
+        if s_prompt + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt+new ({s_prompt + max_new_tokens}) exceeds "
+                f"max_seq_len ({self.max_seq_len})")
+        rng = jax.random.PRNGKey(sampling.seed)
+        logits, states = self._prefill(self.params, prompt_tokens)
+        logits_last = mask_padded_vocab(logits[:, -1], self.cfg)
+        out = [prompt_tokens]
+        finished = np.zeros((b,), bool)
+        for step in range(max_new_tokens):
+            rng, krng = jax.random.split(rng)
+            next_tok = sample_logits(logits_last, krng, sampling)
+            next_tok = next_tok.astype(jnp.int32)
+            tok_host = np.asarray(jax.device_get(next_tok))
+            if token_callback is not None:
+                token_callback(step, tok_host,
+                               np.asarray(jax.device_get(logits_last)))
+            if eod_id is not None:
+                finished |= tok_host == eod_id
+            out.append(next_tok[:, None])
+            if eod_id is not None and finished.all():
+                break
+            if step == max_new_tokens - 1:
+                break
+            logits_last, states = self._step(self.params, states, next_tok)
+            logits_last = mask_padded_vocab(logits_last, self.cfg)
+        return np.asarray(jax.device_get(jnp.concatenate(out, axis=1)))
+
+    def generate_text(self, prompts, max_new_tokens: int,
+                      sampling: Optional[SamplingParams] = None,
+                      token_callback: Optional[Callable] = None):
+        assert self.tokenizer is not None, "tokenizer required"
+        eod = getattr(self.tokenizer, "eod", None)
+        texts = []
+        for prompt in prompts:
+            ids = np.asarray([self.tokenizer.tokenize(prompt)], np.int32)
+            out = self.generate(ids, max_new_tokens, sampling,
+                                eod_id=eod, token_callback=token_callback)
+            new_ids = out[0, ids.shape[1]:].tolist()
+            if eod is not None and eod in new_ids:
+                new_ids = new_ids[: new_ids.index(eod)]
+            texts.append(self.tokenizer.detokenize(new_ids))
+        return texts
+
+
 def beam_search(engine: StaticInferenceEngine, prompt_tokens: np.ndarray,
                 max_new_tokens: int, beam_width: int = 4,
                 length_penalty: float = 1.0,

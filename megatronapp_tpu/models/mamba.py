@@ -84,11 +84,12 @@ def init_mamba_mixer_params(rng, cfg: TransformerConfig, mcfg: MambaConfig):
     return p, ax
 
 
-def _selective_scan(u, dt, A, B, C, D):
+def _selective_scan(u, dt, A, B, C, D, return_h: bool = False):
     """u,dt [B,S,E]; A [E,N]; B,C [B,S,N]; D [E] → y [B,S,E].
 
     h_t = exp(dt_t A) h_{t-1} + dt_t B_t u_t ;  y_t = C_t · h_t + D u_t.
     Runs as a parallel associative scan over the sequence axis.
+    return_h also yields the final state h_S [B,E,N] (decode prefill).
     """
     # Discretize: a [B,S,E,N], b [B,S,E,N].
     a = jnp.exp(dt[..., None] * A[None, None])            # [B,S,E,N]
@@ -101,11 +102,14 @@ def _selective_scan(u, dt, A, B, C, D):
 
     _, h = jax.lax.associative_scan(combine, (a, b), axis=1)
     y = jnp.einsum("bsen,bsn->bse", h, C)
-    return y + u * D[None, None]
+    y = y + u * D[None, None]
+    return (y, h[:, -1]) if return_h else y
 
 
-def mamba_mixer_forward(p, x, cfg: TransformerConfig, mcfg: MambaConfig):
-    """x [B,S,H] → [B,S,H]."""
+def mamba_mixer_forward(p, x, cfg: TransformerConfig, mcfg: MambaConfig,
+                        return_state: bool = False):
+    """x [B,S,H] → [B,S,H] (+ (conv_tail [B,k-1,E], h_last [B,E,N]) when
+    return_state — the decode cache seeded by prefill)."""
     b, s, h = x.shape
     e = mcfg.expand * h
     n = mcfg.state_dim
@@ -113,14 +117,14 @@ def mamba_mixer_forward(p, x, cfg: TransformerConfig, mcfg: MambaConfig):
     dt_f32 = jnp.float32
     xz = x.astype(cfg.compute_dtype) @ p["in_kernel"].astype(
         cfg.compute_dtype)
-    u, z = jnp.split(xz, 2, axis=-1)
+    u_raw, z = jnp.split(xz, 2, axis=-1)
 
     # Causal depthwise conv along seq.
     k = mcfg.conv_kernel
-    u_pad = jnp.pad(u, ((0, 0), (k - 1, 0), (0, 0)))
+    u_pad = jnp.pad(u_raw, ((0, 0), (k - 1, 0), (0, 0)))
     windows = jnp.stack([u_pad[:, i:i + s] for i in range(k)], axis=0)
     u = jnp.einsum("kbse,ke->bse", windows,
-                   p["conv_kernel"].astype(u.dtype))
+                   p["conv_kernel"].astype(u_raw.dtype))
     u = u + p["conv_bias"].astype(u.dtype)
     u = jax.nn.silu(u)
 
@@ -131,9 +135,57 @@ def mamba_mixer_forward(p, x, cfg: TransformerConfig, mcfg: MambaConfig):
         + p["dt_bias"].astype(dt_f32))
     A = -jnp.exp(p["A_log"].astype(dt_f32))
     y = _selective_scan(u.astype(dt_f32), dt, A, B_.astype(dt_f32),
-                        C_.astype(dt_f32), p["D"].astype(dt_f32))
+                        C_.astype(dt_f32), p["D"].astype(dt_f32),
+                        return_h=return_state)
+    if return_state:
+        y, h_last = y
     y = y.astype(cfg.compute_dtype) * jax.nn.silu(z)
-    return y @ p["out_kernel"].astype(cfg.compute_dtype)
+    out = y @ p["out_kernel"].astype(cfg.compute_dtype)
+    if not return_state:
+        return out
+    # conv cache = last k-1 PRE-conv inputs (pad with zeros for short
+    # prompts, matching the forward's zero padding).
+    conv_tail = u_pad[:, s: s + k - 1]
+    return out, (conv_tail, h_last)
+
+
+def mamba_mixer_step(p, conv_buf, ssm_h, x, cfg: TransformerConfig,
+                     mcfg: MambaConfig):
+    """One-token recurrent mixer step (the reference decodes Mamba with
+    Triton selective_state_update; here plain jnp — the per-token work is
+    a handful of small matmuls).
+
+    conv_buf [B,k-1,E] (pre-conv inputs), ssm_h [B,E,N], x [B,H] →
+    (y [B,H], (conv_buf', ssm_h')).
+    """
+    h = x.shape[-1]
+    n = mcfg.state_dim
+    dt_rank = mcfg.dt_rank or max(h // 16, 1)
+    dt_f32 = jnp.float32
+    xz = x.astype(cfg.compute_dtype) @ p["in_kernel"].astype(
+        cfg.compute_dtype)
+    u_raw, z = jnp.split(xz, 2, axis=-1)              # [B,E]
+
+    window = jnp.concatenate([conv_buf, u_raw[:, None]], axis=1)  # [B,k,E]
+    u = jnp.einsum("bke,ke->be", window,
+                   p["conv_kernel"].astype(u_raw.dtype))
+    u = jax.nn.silu(u + p["conv_bias"].astype(u.dtype))
+
+    proj = u @ p["x_proj"].astype(u.dtype)
+    dt_r, B_, C_ = jnp.split(proj, [dt_rank, dt_rank + n], axis=-1)
+    dt = jax.nn.softplus(
+        dt_r.astype(dt_f32) @ p["dt_proj"].astype(dt_f32)
+        + p["dt_bias"].astype(dt_f32))                # [B,E]
+    A = -jnp.exp(p["A_log"].astype(dt_f32))           # [E,N]
+    a = jnp.exp(dt[..., None] * A[None])              # [B,E,N]
+    b = dt[..., None] * B_.astype(dt_f32)[:, None, :] \
+        * u.astype(dt_f32)[..., None]
+    ssm_h = a * ssm_h + b
+    y = jnp.einsum("ben,bn->be", ssm_h, C_.astype(dt_f32))
+    y = y + u.astype(dt_f32) * p["D"].astype(dt_f32)[None]
+    y = y.astype(cfg.compute_dtype) * jax.nn.silu(z)
+    out = y @ p["out_kernel"].astype(cfg.compute_dtype)
+    return out, (window[:, 1:], ssm_h)
 
 
 def init_mamba_params(rng, cfg: TransformerConfig, mcfg: MambaConfig):
@@ -220,3 +272,90 @@ def mamba_loss(p, tokens, targets, loss_mask, cfg: TransformerConfig,
     logits = mamba_forward(p, tokens, cfg, mcfg, ctx=ctx)
     loss, _ = cross_entropy_loss(logits, targets, loss_mask)
     return loss, {"lm_loss": loss}
+
+
+# ---------------------------------------------------------------------------
+# Recurrent generation (reference: core/inference mamba support +
+# tools mamba text-generation server). Pure-M stacks only: hybrid
+# patterns would additionally need the attention KV cache.
+
+def mamba_prefill(p, tokens, cfg: TransformerConfig, mcfg: MambaConfig):
+    """Parallel-scan prefill: logits for the prompt AND the per-layer
+    decode caches (conv tails + final SSM states), stacked [L, ...]."""
+    if mcfg.hybrid_pattern and set(mcfg.hybrid_pattern) != {"M"}:
+        raise NotImplementedError(
+            "mamba generation supports pure-M stacks (hybrid layers "
+            "need an attention KV cache)")
+    h = jnp.take(p["embedding"]["word"], tokens, axis=0).astype(
+        cfg.compute_dtype)
+
+    def body(x, layer_p):
+        y = rms_norm(x, layer_p["ln_scale"], cfg.layernorm_epsilon)
+        out, state = mamba_mixer_forward(layer_p["mixer"], y, cfg, mcfg,
+                                         return_state=True)
+        return x + out.astype(x.dtype), state
+
+    h, states = jax.lax.scan(body, h, p["layers"])
+    h = rms_norm(h, p["final_ln_scale"], cfg.layernorm_epsilon)
+    dt = cfg.compute_dtype
+    logits = h.astype(dt) @ p["embedding"]["word"].T.astype(dt)
+    return logits.astype(jnp.float32), states
+
+
+def mamba_decode_step(p, states, token, cfg: TransformerConfig,
+                      mcfg: MambaConfig):
+    """token [B] + stacked states → (logits [B,V], new states)."""
+    x = jnp.take(p["embedding"]["word"], token, axis=0).astype(
+        cfg.compute_dtype)
+
+    def body(carry, inp):
+        x = carry
+        layer_p, (conv_buf, ssm_h) = inp
+        y = rms_norm(x, layer_p["ln_scale"], cfg.layernorm_epsilon)
+        out, new_state = mamba_mixer_step(layer_p["mixer"], conv_buf,
+                                          ssm_h, y, cfg, mcfg)
+        return x + out.astype(x.dtype), new_state
+
+    x, new_states = jax.lax.scan(body, x, (p["layers"], states))
+    x = rms_norm(x, p["final_ln_scale"], cfg.layernorm_epsilon)
+    dt = cfg.compute_dtype
+    logits = x.astype(dt) @ p["embedding"]["word"].T.astype(dt)
+    return logits.astype(jnp.float32), new_states
+
+
+def mamba_generate(p, prompt_tokens, cfg: TransformerConfig,
+                   mcfg: MambaConfig, *, max_new_tokens: int = 32,
+                   greedy: bool = True, temperature: float = 1.0,
+                   seed: int = 0, token_callback=None):
+    """Convenience one-shot generation: parallel prefill then jitted
+    recurrent decode (state donated). prompt_tokens [B,S] →
+    [B, S+max_new]. For serving (sampling params, eod stop, compile
+    caching) use inference.engine.MambaInferenceEngine."""
+    import numpy as np
+
+    from megatronapp_tpu.inference.engine import mask_padded_vocab
+
+    prefill = jax.jit(
+        lambda p, t: mamba_prefill(p, t, cfg, mcfg))
+    step = jax.jit(
+        lambda p, s, t: mamba_decode_step(p, s, t, cfg, mcfg),
+        donate_argnums=(1,))
+
+    logits, states = prefill(p, prompt_tokens)
+    out = [np.asarray(prompt_tokens)]
+    rng = jax.random.PRNGKey(seed)
+    next_logits = mask_padded_vocab(logits[:, -1], cfg)
+    for i in range(max_new_tokens):
+        if greedy:
+            token = jnp.argmax(next_logits, axis=-1)
+        else:
+            rng, k = jax.random.split(rng)
+            token = jax.random.categorical(
+                k, next_logits / max(temperature, 1e-6), axis=-1)
+        token = token.astype(prompt_tokens.dtype)
+        out.append(np.asarray(token)[:, None])
+        if token_callback is not None:
+            token_callback(np.asarray(token))
+        next_logits, states = step(p, states, token)
+        next_logits = mask_padded_vocab(next_logits, cfg)
+    return np.concatenate(out, axis=1)

@@ -80,9 +80,19 @@ def t5_base(**kw) -> TransformerConfig:
     return t5_config(**d)
 
 
+def mamba_130m(**kw) -> TransformerConfig:
+    """state-spaces/mamba-130m-class dims (24 layers, d_model 768)."""
+    d = dict(num_layers=24, hidden_size=768, num_attention_heads=12,
+             vocab_size=50280, max_position_embeddings=2048,
+             normalization=NormKind.rmsnorm)
+    d.update(kw)
+    return TransformerConfig(**d)
+
+
 PRESETS = {
     "gpt2-125m": gpt2_125m,
     "gpt3-2.7b": gpt3_2p7b,
+    "mamba-130m": mamba_130m,
     "gpt-16l-2048h": gpt_16l_2048h,
     "llama3-8b": llama3_8b,
     "mixtral-8x7b": mixtral_8x7b,

@@ -129,14 +129,9 @@ def main(argv=None):
         import jax
 
         from megatronapp_tpu.models.vision import init_vit_params
-        from megatronapp_tpu.training.checkpointing import CheckpointManager
+        from tasks.common import restore_params
         tmpl, _ = init_vit_params(jax.random.PRNGKey(0), cfg, spec)
-        mngr = CheckpointManager(args.load_dir)
-        restored = mngr.restore({"step": 0, "params": tmpl,
-                                 "opt_state": {}})
-        mngr.close()
-        if restored is not None:
-            pretrained = restored["params"]
+        pretrained = restore_params(args.load_dir, tmpl)
 
     _, best = finetune_vision(
         np.asarray(train["images"], np.float32), np.asarray(

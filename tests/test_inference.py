@@ -243,3 +243,35 @@ class TestDynamicEngine:
         while eng.has_work:
             seen_finished += eng.step()["finished"]
         assert seen_finished == [a, b]
+
+
+class TestMambaEngine:
+    def test_generate_text_roundtrip(self):
+        """MambaInferenceEngine serves the server-facing surface:
+        tokenize → recurrent generate → detokenize."""
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.data.tokenizers import NullTokenizer
+        from megatronapp_tpu.inference.engine import (
+            MambaInferenceEngine, SamplingParams,
+        )
+        from megatronapp_tpu.models.mamba import (
+            MambaConfig, init_mamba_params,
+        )
+        cfg = TransformerConfig(
+            num_layers=2, hidden_size=32, num_attention_heads=4,
+            vocab_size=64, max_position_embeddings=64,
+            compute_dtype=jnp.float32, remat_policy="none")
+        mcfg = MambaConfig(state_dim=8)
+        p, _ = init_mamba_params(jax.random.PRNGKey(0), cfg, mcfg)
+        tok = NullTokenizer(64)
+        eng = MambaInferenceEngine(p, cfg, mcfg, tokenizer=tok)
+        tokens_seen = []
+        texts = eng.generate_text(
+            ["5 6 7"], 4, SamplingParams(greedy=True),
+            token_callback=lambda s, t, l: tokens_seen.append(int(t[0])))
+        assert len(texts) == 1
+        out_ids = [int(x) for x in texts[0].split()]
+        assert out_ids == tokens_seen[:len(out_ids)]
+        assert len(tokens_seen) == 4

@@ -40,16 +40,33 @@ def main():
     ap.add_argument("--port", type=int, default=5000)
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--max-seq-len", type=int, default=None)
-    ap.add_argument("--engine", choices=["static", "dynamic"],
-                    default="static")
+    ap.add_argument("--engine", choices=["static", "dynamic", "mamba"],
+                    default="static",
+                    help="mamba = recurrent-state decode for pure-M "
+                         "presets (reference mamba server tool)")
     ap.add_argument("--max-batch", type=int, default=4)
     args = ap.parse_args()
 
     cfg = PRESETS[args.preset]()
-    params, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    mcfg = None
+    if args.engine == "mamba":
+        from megatronapp_tpu.models.mamba import (
+            MambaConfig, init_mamba_params,
+        )
+        mcfg = MambaConfig()
+        params, _ = init_mamba_params(jax.random.PRNGKey(0), cfg, mcfg)
+    else:
+        params, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
     if args.load_quantized:
         from tools.checkpoint.quantize import load_quantized_params
-        params = load_quantized_params(args.load_quantized)
+        loaded = load_quantized_params(args.load_quantized)
+        expect = "layers" if args.engine == "mamba" else "block"
+        if expect not in loaded:
+            raise SystemExit(
+                f"--load-quantized artifact does not look like a "
+                f"{args.engine} checkpoint (missing '{expect}'); "
+                f"top-level keys: {sorted(loaded)[:8]}")
+        params = loaded
         print(f"loaded int8-quantized params from {args.load_quantized}")
     elif args.load_dir:
         mngr = CheckpointManager(args.load_dir)
@@ -60,6 +77,12 @@ def main():
         mngr.close()
     tok = build_tokenizer(args.tokenizer_type, args.tokenizer_name_or_path,
                           vocab_size=cfg.vocab_size)
+    if args.engine == "mamba":
+        from megatronapp_tpu.inference.engine import MambaInferenceEngine
+        engine = MambaInferenceEngine(params, cfg, mcfg, tokenizer=tok)
+        print(f"serving mamba on {args.host}:{args.port}")
+        TextGenerationServer(engine, args.host, args.port).run()
+        return
     if getattr(args, "engine", "static") == "dynamic":
         engine = DynamicInferenceEngine(
             params, cfg, tokenizer=tok, max_batch=args.max_batch,
