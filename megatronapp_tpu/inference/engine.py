@@ -40,6 +40,57 @@ class SamplingParams:
     seed: int = 0
 
 
+def _decode_loop(cfg, prompt_tokens, raw_logits_last, step_fn,
+                 max_new_tokens, sampling, eod_id, token_callback):
+    """Shared autoregressive sampling loop (one copy for the static,
+    mamba, and convenience paths): sampling, padded-vocab masking, eod
+    early stop, MegaScope per-token callback. step_fn(next_tok [B]) →
+    raw logits [B, V] for the next position."""
+    sampling = sampling or SamplingParams()
+    b = prompt_tokens.shape[0]
+    rng = jax.random.PRNGKey(sampling.seed)
+    logits_last = mask_padded_vocab(raw_logits_last, cfg)
+    out = [prompt_tokens]
+    finished = np.zeros((b,), bool)
+    for step in range(max_new_tokens):
+        rng, krng = jax.random.split(rng)
+        next_tok = sample_logits(logits_last, krng, sampling)
+        next_tok = next_tok.astype(jnp.int32)
+        tok_host = np.asarray(jax.device_get(next_tok))
+        if token_callback is not None:
+            token_callback(step, tok_host,
+                           np.asarray(jax.device_get(logits_last)))
+        if eod_id is not None:
+            finished |= tok_host == eod_id
+        out.append(next_tok[:, None])
+        if eod_id is not None and finished.all():
+            break
+        if step == max_new_tokens - 1:
+            break
+        logits_last = mask_padded_vocab(step_fn(next_tok), cfg)
+    return np.asarray(jax.device_get(jnp.concatenate(out, axis=1)))
+
+
+def _generate_text(engine, prompts, max_new_tokens, sampling,
+                   token_callback):
+    """Shared string-level API (api.py generate_and_post_process parity).
+
+    Prompts of different lengths run as separate batches (no padding
+    leaks into causal attention / recurrent state)."""
+    assert engine.tokenizer is not None, "tokenizer required"
+    eod = getattr(engine.tokenizer, "eod", None)
+    texts = []
+    for prompt in prompts:
+        ids = np.asarray([engine.tokenizer.tokenize(prompt)], np.int32)
+        out = engine.generate(ids, max_new_tokens, sampling, eod_id=eod,
+                              token_callback=token_callback)
+        new_ids = out[0, ids.shape[1]:].tolist()
+        if eod is not None and eod in new_ids:
+            new_ids = new_ids[: new_ids.index(eod)]
+        texts.append(engine.tokenizer.detokenize(new_ids))
+    return texts
+
+
 def mask_padded_vocab(logits: jnp.ndarray, cfg: TransformerConfig
                       ) -> jnp.ndarray:
     """Mask logits for vocab rows beyond the tokenizer's true vocab to -inf.
@@ -148,7 +199,6 @@ class StaticInferenceEngine:
                  eod_id: Optional[int] = None,
                  token_callback: Optional[Callable] = None) -> np.ndarray:
         """prompt_tokens [B, S_prompt] int32 → [B, S_prompt+max_new]."""
-        sampling = sampling or SamplingParams()
         prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
         b, s_prompt = prompt_tokens.shape
         total = s_prompt + max_new_tokens
@@ -156,55 +206,25 @@ class StaticInferenceEngine:
             raise ValueError(f"prompt+new ({total}) exceeds max_seq_len "
                              f"({self.max_seq_len})")
         cache = init_kv_cache(self.cfg, b, self.max_seq_len)
-        rng = jax.random.PRNGKey(sampling.seed)
-
         logits, cache = self._prefill(self.params, prompt_tokens, cache, 0)
-        # MegaScope per-token logits hook (tik_result parity).
-        logits_last = mask_padded_vocab(logits[:, -1], self.cfg)
-        out = [prompt_tokens]
-        finished = np.zeros((b,), bool)
-        pos = s_prompt
-        for step in range(max_new_tokens):
-            rng, krng = jax.random.split(rng)
-            next_tok = sample_logits(logits_last, krng, sampling)
-            next_tok = next_tok.astype(jnp.int32)
-            tok_host = np.asarray(jax.device_get(next_tok))
-            if token_callback is not None:
-                token_callback(step, tok_host,
-                               np.asarray(jax.device_get(logits_last)))
-            if eod_id is not None:
-                finished |= tok_host == eod_id
-            out.append(next_tok[:, None])
-            if eod_id is not None and finished.all():
-                break
-            if step == max_new_tokens - 1:
-                break
-            logits, cache = self._decode(self.params, next_tok[:, None],
-                                         cache, pos)
-            logits_last = mask_padded_vocab(logits[:, -1], self.cfg)
-            pos += 1
-        return np.asarray(jax.device_get(jnp.concatenate(out, axis=1)))
+        state = {"cache": cache, "pos": s_prompt}
+
+        def step_fn(next_tok):
+            logits, state["cache"] = self._decode(
+                self.params, next_tok[:, None], state["cache"],
+                state["pos"])
+            state["pos"] += 1
+            return logits[:, -1]
+
+        return _decode_loop(self.cfg, prompt_tokens, logits[:, -1],
+                            step_fn, max_new_tokens, sampling, eod_id,
+                            token_callback)
 
     def generate_text(self, prompts, max_new_tokens: int,
                       sampling: Optional[SamplingParams] = None,
                       token_callback: Optional[Callable] = None):
-        """String-level API (api.py generate_and_post_process parity).
-
-        Prompts of different lengths run as separate batches (no padding
-        leaks into causal attention); equal-length prompts could be batched
-        by the caller via generate()."""
-        assert self.tokenizer is not None, "tokenizer required"
-        eod = getattr(self.tokenizer, "eod", None)
-        texts = []
-        for prompt in prompts:
-            ids = np.asarray([self.tokenizer.tokenize(prompt)], np.int32)
-            out = self.generate(ids, max_new_tokens, sampling, eod_id=eod,
-                                token_callback=token_callback)
-            new_ids = out[0, ids.shape[1]:].tolist()
-            if eod is not None and eod in new_ids:
-                new_ids = new_ids[: new_ids.index(eod)]
-            texts.append(self.tokenizer.detokenize(new_ids))
-        return texts
+        return _generate_text(self, prompts, max_new_tokens, sampling,
+                              token_callback)
 
 
 class MambaInferenceEngine:
@@ -215,7 +235,8 @@ class MambaInferenceEngine:
     Exposes the same generate/generate_text surface the
     TextGenerationServer drives on StaticInferenceEngine."""
 
-    def __init__(self, params, cfg, mcfg, tokenizer=None):
+    def __init__(self, params, cfg, mcfg, tokenizer=None,
+                 max_seq_len: Optional[int] = None):
         from megatronapp_tpu.models.mamba import (
             mamba_decode_step, mamba_prefill,
         )
@@ -223,7 +244,9 @@ class MambaInferenceEngine:
         self.cfg = cfg
         self.mcfg = mcfg
         self.tokenizer = tokenizer
-        self.max_seq_len = cfg.max_position_embeddings
+        # Mamba has no positional embeddings — an operator may serve
+        # beyond the training context via --max-seq-len.
+        self.max_seq_len = max_seq_len or cfg.max_position_embeddings
         # jit once per engine — per-request lambdas would re-trace and
         # recompile every call.
         self._prefill = jax.jit(
@@ -239,52 +262,29 @@ class MambaInferenceEngine:
         """Same contract as StaticInferenceEngine.generate: full sampling
         (greedy/temperature/top-k/top-p), padded-vocab masking, eod early
         stop, max_seq_len bound."""
-        sampling = sampling or SamplingParams()
         prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
-        b, s_prompt = prompt_tokens.shape
+        s_prompt = prompt_tokens.shape[1]
         if s_prompt + max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f"prompt+new ({s_prompt + max_new_tokens}) exceeds "
                 f"max_seq_len ({self.max_seq_len})")
-        rng = jax.random.PRNGKey(sampling.seed)
         logits, states = self._prefill(self.params, prompt_tokens)
-        logits_last = mask_padded_vocab(logits[:, -1], self.cfg)
-        out = [prompt_tokens]
-        finished = np.zeros((b,), bool)
-        for step in range(max_new_tokens):
-            rng, krng = jax.random.split(rng)
-            next_tok = sample_logits(logits_last, krng, sampling)
-            next_tok = next_tok.astype(jnp.int32)
-            tok_host = np.asarray(jax.device_get(next_tok))
-            if token_callback is not None:
-                token_callback(step, tok_host,
-                               np.asarray(jax.device_get(logits_last)))
-            if eod_id is not None:
-                finished |= tok_host == eod_id
-            out.append(next_tok[:, None])
-            if eod_id is not None and finished.all():
-                break
-            if step == max_new_tokens - 1:
-                break
-            logits_last, states = self._step(self.params, states, next_tok)
-            logits_last = mask_padded_vocab(logits_last, self.cfg)
-        return np.asarray(jax.device_get(jnp.concatenate(out, axis=1)))
+        box = {"states": states}
+
+        def step_fn(next_tok):
+            logits_last, box["states"] = self._step(
+                self.params, box["states"], next_tok)
+            return logits_last
+
+        return _decode_loop(self.cfg, prompt_tokens, logits[:, -1],
+                            step_fn, max_new_tokens, sampling, eod_id,
+                            token_callback)
 
     def generate_text(self, prompts, max_new_tokens: int,
                       sampling: Optional[SamplingParams] = None,
                       token_callback: Optional[Callable] = None):
-        assert self.tokenizer is not None, "tokenizer required"
-        eod = getattr(self.tokenizer, "eod", None)
-        texts = []
-        for prompt in prompts:
-            ids = np.asarray([self.tokenizer.tokenize(prompt)], np.int32)
-            out = self.generate(ids, max_new_tokens, sampling,
-                                eod_id=eod, token_callback=token_callback)
-            new_ids = out[0, ids.shape[1]:].tolist()
-            if eod is not None and eod in new_ids:
-                new_ids = new_ids[: new_ids.index(eod)]
-            texts.append(self.tokenizer.detokenize(new_ids))
-        return texts
+        return _generate_text(self, prompts, max_new_tokens, sampling,
+                              token_callback)
 
 
 def beam_search(engine: StaticInferenceEngine, prompt_tokens: np.ndarray,

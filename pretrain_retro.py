@@ -28,6 +28,10 @@ def main(argv=None):
     ap.add_argument("--retro-num-neighbors", type=int, default=2)
     ap.add_argument("--retro-retrieved-length", type=int, default=128)
     ap.add_argument("--retro-encoder-layers", type=int, default=2)
+    ap.add_argument("--retro-data", type=str, default=None,
+                    help=".npz from tools/retro_preprocess.py "
+                         "(samples + neighbors); synthetic stream if "
+                         "absent")
     args = ap.parse_args(argv)
     cfg, parallel, training, opt_cfg = configs_from_args(args)
     spec = RetroSpec(chunk_length=args.retro_chunk_length,
@@ -59,22 +63,50 @@ def main(argv=None):
     num_micro = training.num_microbatches(ctx.dp * ctx.ep)
     n_chunks = training.seq_length // spec.chunk_length
 
+    retro_data = None
+    if args.retro_data:
+        retro_data = np.load(args.retro_data)
+        samples, neigh = retro_data["samples"], retro_data["neighbors"]
+        if samples.shape[1] != training.seq_length:
+            raise SystemExit(
+                f"--retro-data samples are length {samples.shape[1]} but "
+                f"--seq-length is {training.seq_length}")
+        if neigh.shape[1:] != (n_chunks, spec.num_neighbors,
+                               spec.retrieved_length):
+            raise SystemExit(
+                f"--retro-data neighbors {neigh.shape[1:]} mismatch the "
+                f"retro spec {(n_chunks, spec.num_neighbors, spec.retrieved_length)}")
+        print(f"retro corpus: {len(samples)} samples from "
+              f"{args.retro_data}")
+
     rng = np.random.default_rng(training.seed)
     losses = []
     t0 = time.perf_counter()
     with ctx.mesh:
         for it in range(training.train_iters):
-            toks = rng.integers(0, cfg.vocab_size, (
-                training.global_batch_size, training.seq_length)
-            ).astype(np.int32)
-            batch = reshape_global_batch({
-                "tokens": toks,
-                "neighbors": rng.integers(0, cfg.vocab_size, (
+            if retro_data is not None:
+                idx = (np.arange(training.global_batch_size)
+                       + it * training.global_batch_size) % len(samples)
+                toks = samples[idx]
+                nb = neigh[idx]
+            else:
+                toks = rng.integers(0, cfg.vocab_size, (
+                    training.global_batch_size, training.seq_length)
+                ).astype(np.int32)
+                nb = rng.integers(0, cfg.vocab_size, (
                     training.global_batch_size, n_chunks,
                     spec.num_neighbors, spec.retrieved_length)
-                ).astype(np.int32),
+                ).astype(np.int32)
+            # The rolled label at the final position wraps to the
+            # sample's own first token — mask it out (harmless on the
+            # synthetic stream, a wrong signal on real corpus samples).
+            loss_mask = np.ones_like(toks, np.float32)
+            loss_mask[:, -1] = 0.0
+            batch = reshape_global_batch({
+                "tokens": toks,
+                "neighbors": nb,
                 "labels": np.roll(toks, -1, axis=1),
-                "loss_mask": np.ones_like(toks, np.float32),
+                "loss_mask": loss_mask,
             }, num_micro)
             state, metrics = step_fn(state, batch)
             if (it + 1) % training.log_interval == 0 or \

@@ -1,0 +1,86 @@
+"""Retro retrieval-database pipeline tests (tools/retro_preprocess.py —
+reference tools/retro build_db + query)."""
+
+import os
+
+import jax
+import numpy as np
+
+from megatronapp_tpu.data.indexed_dataset import (
+    IndexedDataset, IndexedDatasetWriter,
+)
+from megatronapp_tpu.models.bert import bert_config, init_bert_params
+from tools.bert_embedding import embed_token_chunks, knn_neighbors
+from tools.retro_preprocess import build_chunk_db, build_retro_dataset
+
+
+def write_corpus(tmp_path, n_docs=10, seed=0):
+    rng = np.random.default_rng(seed)
+    prefix = os.path.join(str(tmp_path), "c")
+    with IndexedDatasetWriter(prefix, np.int32) as w:
+        for _ in range(n_docs):
+            w.add_document(rng.integers(5, 90,
+                                        int(rng.integers(40, 120))))
+    return IndexedDataset(prefix)
+
+
+class TestChunkDb:
+    def test_chunking_covers_corpus(self, tmp_path):
+        ds = write_corpus(tmp_path)
+        chunks, doc_ids = build_chunk_db(ds, 16)
+        assert chunks.shape[1] == 16
+        assert len(chunks) == len(doc_ids)
+        # every document contributes ceil(len/16) chunks
+        docs = np.asarray(ds.document_indices)
+        total = 0
+        for d in range(len(docs) - 1):
+            n_tok = sum(len(ds[i]) for i in range(int(docs[d]),
+                                                  int(docs[d + 1])))
+            total += -(-n_tok // 16)
+        assert len(chunks) == total
+        # chunk content round-trips the corpus (first doc, first chunk)
+        first = np.concatenate([np.asarray(ds[i]) for i in
+                                range(int(docs[0]), int(docs[1]))])
+        np.testing.assert_array_equal(chunks[0], first[:16])
+
+    def test_knn_excludes_same_document(self, tmp_path):
+        ds = write_corpus(tmp_path)
+        chunks, doc_ids = build_chunk_db(ds, 16)
+        cfg = bert_config(num_layers=1, hidden_size=32,
+                          num_attention_heads=4, vocab_size=128,
+                          max_position_embeddings=32,
+                          attention_impl="reference")
+        p, _ = init_bert_params(jax.random.PRNGKey(0), cfg,
+                                add_binary_head=False)
+        emb = embed_token_chunks(p, cfg, chunks, batch_size=32)
+        assert emb.shape == (len(chunks), 32)
+        nbrs = knn_neighbors(emb, 2, group_ids=doc_ids)
+        for i in range(len(chunks)):
+            for j in nbrs[i]:
+                assert doc_ids[j] != doc_ids[i], (i, j)
+
+
+class TestRetroDataset:
+    def test_shapes_and_retrieved_continuation(self, tmp_path):
+        ds = write_corpus(tmp_path)
+        cfg = bert_config(num_layers=1, hidden_size=32,
+                          num_attention_heads=4, vocab_size=128,
+                          max_position_embeddings=32,
+                          attention_impl="reference")
+        p, _ = init_bert_params(jax.random.PRNGKey(0), cfg,
+                                add_binary_head=False)
+        samples, neigh = build_retro_dataset(
+            ds, p, cfg, chunk_length=16, chunks_per_sample=3,
+            num_neighbors=2, log_fn=lambda s: None)
+        chunks, doc_ids = build_chunk_db(ds, 16)
+        n = len(chunks) // 3
+        assert samples.shape == (n, 48)
+        assert neigh.shape == (n, 3, 2, 32)
+        # samples are the chunk stream in order
+        np.testing.assert_array_equal(samples[0, :16], chunks[0])
+        np.testing.assert_array_equal(samples[0, 16:32], chunks[1])
+        # each retrieved row starts with an actual db chunk
+        flat = neigh.reshape(-1, 32)
+        chunk_set = {chunks[i].tobytes() for i in range(len(chunks))}
+        for row in flat[:20]:
+            assert row[:16].tobytes() in chunk_set

@@ -48,16 +48,66 @@ def embed_texts(params, cfg, tokenizer, ids, texts, seq_length=128,
     return np.concatenate(out, axis=0)
 
 
+def embed_token_chunks(params, cfg, chunks: np.ndarray, pad_id: int = 0,
+                       batch_size: int = 64) -> np.ndarray:
+    """Mean-pooled embeddings for pre-tokenized chunks [N, m] → [N, H]
+    (the retro chunk-DB embedding step; chunks carry no CLS/SEP framing,
+    pad ids are masked out of the mean)."""
+    import jax
+    import jax.numpy as jnp
+
+    from megatronapp_tpu.models.bert import bert_encode
+
+    @jax.jit
+    def encode(tokens, mask):
+        h = bert_encode(params, tokens, cfg, padding_mask=mask)
+        h = h.astype(jnp.float32) * mask[..., None]
+        return jnp.sum(h, axis=1) / jnp.maximum(
+            jnp.sum(mask, axis=1, keepdims=True), 1.0)
+
+    out = []
+    n = len(chunks)
+    for s in range(0, n, batch_size):
+        part = np.asarray(chunks[s: s + batch_size], np.int32)
+        pad = batch_size - len(part)
+        if pad:  # keep one compiled shape
+            part = np.concatenate([part, np.zeros_like(
+                part[:1]).repeat(pad, axis=0)])
+        mask = (part != pad_id).astype(np.float32)
+        emb = np.asarray(jax.device_get(
+            encode(jnp.asarray(part), jnp.asarray(mask))))
+        out.append(emb[: batch_size - pad] if pad else emb)
+    return np.concatenate(out, axis=0)
+
+
 def knn_neighbors(embeddings: np.ndarray, k: int,
-                  exclude_self: bool = True) -> np.ndarray:
+                  exclude_self: bool = True,
+                  group_ids: np.ndarray = None) -> np.ndarray:
     """Brute-force cosine kNN → [N, k] neighbor indices (the retrieval
-    step of the reference retro pipeline; faiss-free)."""
+    step of the reference retro pipeline; faiss-free).
+
+    group_ids: optional [N] — candidates sharing the query's group (its
+    source document) are excluded, the reference retro rule that stops a
+    chunk retrieving itself/its own article."""
     x = embeddings / np.maximum(
         np.linalg.norm(embeddings, axis=1, keepdims=True), 1e-9)
     sim = x @ x.T
     if exclude_self:
         np.fill_diagonal(sim, -np.inf)
-    return np.argsort(-sim, axis=1)[:, :k]
+    if group_ids is not None:
+        g = np.asarray(group_ids)
+        sim[g[:, None] == g[None, :]] = -np.inf
+    out = np.argsort(-sim, axis=1)[:, :k]
+    # argsort happily "ranks" the -inf exclusions — never let an excluded
+    # candidate (self / same document) through silently.
+    picked = np.take_along_axis(sim, out, axis=1)
+    if np.isneginf(picked).any():
+        short = int(np.isneginf(picked).any(axis=1).sum())
+        raise ValueError(
+            f"{short} rows have fewer than k={k} valid neighbor "
+            "candidates after exclusions (corpus has too few "
+            "documents?)")
+    return out
 
 
 def main(argv=None):

@@ -1,0 +1,149 @@
+"""Retro retrieval-database preprocessing.
+
+Parity with /root/reference/tools/retro/ (build_db + query pipeline,
+cli/preprocess): chunk a tokenized .bin/.idx corpus into fixed-length
+chunks, embed each chunk with a BERT encoder (tools/bert_embedding), find
+k nearest neighbors per chunk (cosine, same-document candidates
+excluded), and materialize training samples — token sequences of C
+chunks plus, per chunk, its neighbors' retrieved content (neighbor chunk
++ that chunk's continuation, the reference retrieved_length = 2×chunk
+convention).
+
+Output .npz:
+  samples    [N, C*m]      training token sequences
+  neighbors  [N, C, K, R]  retrieved neighbor tokens per chunk
+consumed by `pretrain_retro.py --retro-data PATH`.
+
+Usage:
+  python tools/retro_preprocess.py --data-path corpus --output retro.npz \
+      --chunk-length 64 --num-neighbors 2 [--load-dir bert_ckpt ...]
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
+
+
+def build_chunk_db(indexed, chunk_length: int, pad_id: int = 0):
+    """Corpus → (chunks [N_chunks, m], doc_ids [N_chunks]).
+
+    Documents are split into m-length chunks; the trailing partial chunk
+    is zero-padded (reference chunk-db construction pads the tail)."""
+    chunks, doc_ids = [], []
+    docs = np.asarray(indexed.document_indices)
+    for d in range(len(docs) - 1):
+        toks = np.concatenate([np.asarray(indexed[i], np.int32)
+                               for i in range(int(docs[d]),
+                                              int(docs[d + 1]))])
+        for s in range(0, len(toks), chunk_length):
+            part = toks[s: s + chunk_length]
+            if len(part) < chunk_length:
+                part = np.pad(part, (0, chunk_length - len(part)),
+                              constant_values=pad_id)
+            chunks.append(part)
+            doc_ids.append(d)
+    return np.stack(chunks), np.asarray(doc_ids)
+
+
+def build_retro_dataset(indexed, params, cfg, *, chunk_length: int = 64,
+                        chunks_per_sample: int = 4, num_neighbors: int = 2,
+                        retrieved_length: int = None, pad_id: int = 0,
+                        batch_size: int = 64, log_fn=print):
+    """Full pipeline → (samples [N, C*m], neighbor_tokens [N, C, K, R])."""
+    from tools.bert_embedding import embed_token_chunks, knn_neighbors
+
+    retrieved_length = retrieved_length or 2 * chunk_length
+    if retrieved_length > 2 * chunk_length:
+        raise ValueError(
+            f"retrieved_length ({retrieved_length}) exceeds the "
+            f"neighbor+continuation content (2*chunk_length = "
+            f"{2 * chunk_length})")
+    chunks, doc_ids = build_chunk_db(indexed, chunk_length, pad_id)
+    n_chunks = len(chunks)
+    log_fn(f"chunk db: {n_chunks} chunks of {chunk_length} from "
+           f"{doc_ids.max() + 1 if n_chunks else 0} docs")
+    emb = embed_token_chunks(params, cfg, chunks, pad_id=pad_id,
+                             batch_size=batch_size)
+    nbrs = knn_neighbors(emb, num_neighbors, group_ids=doc_ids)
+    log_fn(f"kNN done: {nbrs.shape}")
+
+    # Retrieved content for neighbor j: chunk_j ++ continuation chunk
+    # (same doc next chunk, zero-padded at doc end).
+    def retrieved(j: int) -> np.ndarray:
+        cont = (chunks[j + 1] if j + 1 < n_chunks and
+                doc_ids[j + 1] == doc_ids[j]
+                else np.full(chunk_length, pad_id, np.int32))
+        return np.concatenate([chunks[j], cont])[:retrieved_length]
+
+    c = chunks_per_sample
+    n_samples = n_chunks // c
+    samples = np.zeros((n_samples, c * chunk_length), np.int32)
+    neigh = np.zeros((n_samples, c, num_neighbors, retrieved_length),
+                     np.int32)
+    for i in range(n_samples):
+        for ci in range(c):
+            gi = i * c + ci
+            samples[i, ci * chunk_length:(ci + 1) * chunk_length] = \
+                chunks[gi]
+            for k in range(num_neighbors):
+                neigh[i, ci, k] = retrieved(int(nbrs[gi, k]))
+    return samples, neigh
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(__doc__)
+    ap.add_argument("--data-path", required=True,
+                    help=".bin/.idx corpus prefix")
+    ap.add_argument("--output", required=True, help="output .npz")
+    ap.add_argument("--chunk-length", type=int, default=64)
+    ap.add_argument("--chunks-per-sample", type=int, default=4)
+    ap.add_argument("--num-neighbors", type=int, default=2)
+    ap.add_argument("--retrieved-length", type=int, default=None)
+    ap.add_argument("--num-layers", type=int, default=4)
+    ap.add_argument("--hidden-size", type=int, default=256)
+    ap.add_argument("--num-attention-heads", type=int, default=8)
+    ap.add_argument("--vocab-size", type=int, default=30592)
+    ap.add_argument("--seq-length", type=int, default=128)
+    ap.add_argument("--load-dir", default=None,
+                    help="BERT encoder checkpoint for embeddings")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from megatronapp_tpu.data.indexed_dataset import IndexedDataset
+    from megatronapp_tpu.models.bert import bert_config, init_bert_params
+    from tasks.common import restore_params
+
+    cfg = bert_config(num_layers=args.num_layers,
+                      hidden_size=args.hidden_size,
+                      num_attention_heads=args.num_attention_heads,
+                      vocab_size=args.vocab_size,
+                      max_position_embeddings=max(args.seq_length,
+                                                  args.chunk_length))
+    params, _ = init_bert_params(jax.random.PRNGKey(0), cfg,
+                                 add_binary_head=False)
+    loaded = restore_params(args.load_dir, params)
+    if loaded is not None:
+        params = loaded
+    elif args.load_dir:
+        print("warning: checkpoint restore failed; random encoder")
+    elif not args.load_dir:
+        print("warning: no --load-dir; embeddings from a random encoder "
+              "(pipeline check only)")
+
+    samples, neigh = build_retro_dataset(
+        IndexedDataset(args.data_path), params, cfg,
+        chunk_length=args.chunk_length,
+        chunks_per_sample=args.chunks_per_sample,
+        num_neighbors=args.num_neighbors,
+        retrieved_length=args.retrieved_length)
+    np.savez_compressed(args.output, samples=samples, neighbors=neigh)
+    print(f"retro dataset → {args.output}: samples {samples.shape}, "
+          f"neighbors {neigh.shape}")
+
+
+if __name__ == "__main__":
+    main()

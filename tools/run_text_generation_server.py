@@ -79,7 +79,8 @@ def main():
                           vocab_size=cfg.vocab_size)
     if args.engine == "mamba":
         from megatronapp_tpu.inference.engine import MambaInferenceEngine
-        engine = MambaInferenceEngine(params, cfg, mcfg, tokenizer=tok)
+        engine = MambaInferenceEngine(params, cfg, mcfg, tokenizer=tok,
+                                      max_seq_len=args.max_seq_len)
         print(f"serving mamba on {args.host}:{args.port}")
         TextGenerationServer(engine, args.host, args.port).run()
         return
