@@ -67,6 +67,11 @@ def main(argv=None):
     if args.retro_data:
         retro_data = np.load(args.retro_data)
         samples, neigh = retro_data["samples"], retro_data["neighbors"]
+        sample_mask = (retro_data["mask"] if "mask" in retro_data.files
+                       else None)
+        if len(samples) == 0:
+            raise SystemExit(f"--retro-data {args.retro_data} contains "
+                             "no samples")
         if samples.shape[1] != training.seq_length:
             raise SystemExit(
                 f"--retro-data samples are length {samples.shape[1]} but "
@@ -89,7 +94,10 @@ def main(argv=None):
                        + it * training.global_batch_size) % len(samples)
                 toks = samples[idx]
                 nb = neigh[idx]
+                mask_rows = (sample_mask[idx] if sample_mask is not None
+                             else None)
             else:
+                mask_rows = None
                 toks = rng.integers(0, cfg.vocab_size, (
                     training.global_batch_size, training.seq_length)
                 ).astype(np.int32)
@@ -100,7 +108,12 @@ def main(argv=None):
             # The rolled label at the final position wraps to the
             # sample's own first token — mask it out (harmless on the
             # synthetic stream, a wrong signal on real corpus samples).
+            # Real data also masks document-tail chunk padding: the label
+            # at position t is toks[t+1], so drop positions whose TARGET
+            # is padding (shifted mask) as well as padded positions.
             loss_mask = np.ones_like(toks, np.float32)
+            if mask_rows is not None:
+                loss_mask = mask_rows * np.roll(mask_rows, -1, axis=1)
             loss_mask[:, -1] = 0.0
             batch = reshape_global_batch({
                 "tokens": toks,

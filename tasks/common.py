@@ -27,6 +27,23 @@ def build_tok_and_ids(tokenizer_type, tokenizer_name_or_path, vocab_size):
     return tok, ids
 
 
+def padded_batches(arrays, batch_size):
+    """Yield (padded_chunk_tuple, real_count): fixed-size batches over
+    parallel arrays with the ragged tail zero-padded — keeps one
+    compiled shape for jitted eval loops."""
+    import numpy as np
+    n = len(arrays[0])
+    for s in range(0, n, batch_size):
+        chunks = [a[s: s + batch_size] for a in arrays]
+        real = len(chunks[0])
+        if real < batch_size:
+            chunks = [np.concatenate(
+                [c, np.zeros_like(c[:1]).repeat(batch_size - real,
+                                                axis=0)])
+                for c in chunks]
+        yield tuple(chunks), real
+
+
 def restore_params(load_dir, template_params, log_fn=print):
     """Orbax-restore `params` from a training checkpoint dir, or None."""
     if not load_dir:

@@ -21,28 +21,30 @@ sys.path.insert(0, __file__.rsplit("/tasks/", 1)[0])
 import numpy as np
 
 
-def evaluate_accuracy(params, cfg, spec, images, labels,
-                      batch_size=64):
-    """Top-1 accuracy over an array dataset (reference
-    accuracy_func_provider/calculate_correct_answers)."""
+def make_classify_fwd(cfg, spec):
+    """Jit the eval forward ONCE; pass the result to evaluate_accuracy
+    from loops (a fresh jit per call would recompile every epoch)."""
     import jax
 
     from megatronapp_tpu.models.vision import vit_classify
+    return jax.jit(lambda p, x: vit_classify(p, x, cfg, spec))
 
-    fwd = jax.jit(lambda p, x: vit_classify(p, x, cfg, spec))
+
+def evaluate_accuracy(params, cfg, spec, images, labels,
+                      batch_size=64, fwd=None):
+    """Top-1 accuracy over an array dataset (reference
+    accuracy_func_provider/calculate_correct_answers)."""
+    from tasks.common import padded_batches
+
+    fwd = fwd or make_classify_fwd(cfg, spec)
     correct = 0
-    n = len(images)
-    # pad the tail chunk to a full batch to keep one compiled shape
-    for s in range(0, n, batch_size):
-        chunk = images[s: s + batch_size]
-        pad = batch_size - len(chunk)
-        if pad:
-            chunk = np.concatenate([chunk, np.zeros_like(
-                chunk[:1]).repeat(pad, axis=0)])
+    done = 0
+    for (chunk,), real in padded_batches([images], batch_size):
         logits = np.asarray(fwd(params, chunk))
-        pred = logits.argmax(-1)[: batch_size - pad]
-        correct += int((pred == labels[s: s + len(pred)]).sum())
-    return correct / max(n, 1)
+        pred = logits.argmax(-1)[:real]
+        correct += int((pred == labels[done: done + real]).sum())
+        done += real
+    return correct / max(len(images), 1)
 
 
 def finetune_vision(train_images, train_labels, valid_images,
@@ -78,6 +80,7 @@ def finetune_vision(train_images, train_labels, valid_images,
         updates, opt_state = opt.update(g, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    eval_fwd = make_classify_fwd(cfg, spec)
     rng = np.random.default_rng(seed)
     steps_per_epoch = max(len(train_images) // batch_size, 1)
     best = 0.0
@@ -89,7 +92,7 @@ def finetune_vision(train_images, train_labels, valid_images,
             params, opt_state, loss = step(
                 params, opt_state, train_images[idx], train_labels[idx])
         acc = evaluate_accuracy(params, cfg, spec, valid_images,
-                                valid_labels, batch_size)
+                                valid_labels, batch_size, fwd=eval_fwd)
         best = max(best, acc)
         log_fn(f"epoch {epoch+1}/{epochs} | train loss "
                f"{float(loss):.4f} | dev acc {acc:.4f}")

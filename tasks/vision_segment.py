@@ -1,0 +1,215 @@
+"""Semantic segmentation finetune + mIoU evaluation (SETR-style head).
+
+Parity with /root/reference/tasks/vision/segmentation/ (seg_heads.py
+SetrSegmentationHead: per-patch features → class logits → upsample to
+pixel resolution; metrics.py mean_iou over a class confusion matrix;
+finetune_setr.py epoch loop). Data interface: .npz with `images`
+[N,H,W,C] float and `masks` [N,H,W] int class ids (255 = ignore), the
+cityscapes loading of the reference reduced to arrays.
+
+Usage:
+  python tasks/vision_segment.py --train-data train.npz \
+      --valid-data val.npz --num-classes 19 --img-size 128 --patch-dim 16
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tasks/", 1)[0])
+
+import numpy as np
+
+IGNORE_INDEX = 255
+
+
+def init_seg_head(rng, cfg, num_classes):
+    """Linear per-patch classifier (SetrSegmentationHead's conv1x1 on
+    patch features is exactly a per-patch linear)."""
+    import jax
+    import jax.numpy as jnp
+    std = cfg.init_method_std
+    return {
+        "kernel": jax.random.normal(
+            rng, (cfg.hidden_size, num_classes), jnp.float32) * std,
+        "bias": jnp.zeros((num_classes,), jnp.float32),
+    }
+
+
+def segment_logits(params, images, cfg, spec, num_classes, ctx=None):
+    """[B,H,W,C] → per-pixel class logits [B,H,W,num_classes]: backbone
+    patch tokens (CLS dropped) → per-patch linear → bilinear upsample
+    (seg_heads.py to_2D + interpolate)."""
+    import jax
+    import jax.numpy as jnp
+
+    from megatronapp_tpu.models.vision import vit_backbone
+    b, h, w, _ = images.shape
+    grid = spec.image_size // spec.patch_size
+    enc = vit_backbone(params, images, cfg, spec, ctx=ctx)[:, 1:]
+    sh = params["seg_head"]
+    logits = enc.astype(jnp.float32) @ sh["kernel"] + sh["bias"]
+    logits = logits.reshape(b, grid, grid, num_classes)
+    return jax.image.resize(logits, (b, h, w, num_classes), "bilinear")
+
+
+def segmentation_loss(params, images, masks, cfg, spec, num_classes,
+                      ctx=None):
+    """Per-pixel CE with ignore-index masking + pixel accuracy."""
+    import jax.numpy as jnp
+
+    from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
+    logits = segment_logits(params, images, cfg, spec, num_classes,
+                            ctx=ctx)
+    b, h, w, c = logits.shape
+    valid = (masks != IGNORE_INDEX).astype(jnp.float32)
+    safe = jnp.where(masks == IGNORE_INDEX, 0, masks)
+    loss, _ = cross_entropy_loss(
+        logits.reshape(b, h * w, c), safe.reshape(b, h * w),
+        valid.reshape(b, h * w))
+    pred = jnp.argmax(logits, -1)
+    acc = jnp.sum((pred == masks) * valid) / jnp.maximum(valid.sum(), 1.0)
+    return loss, {"lm_loss": loss, "pixel_accuracy": acc}
+
+
+def confusion_matrix(pred: np.ndarray, target: np.ndarray,
+                     num_classes: int) -> np.ndarray:
+    """[num_classes, num_classes] counts (rows = target, cols = pred),
+    ignore-index pixels dropped (metrics.py hist semantics)."""
+    valid = target != IGNORE_INDEX
+    t = target[valid].astype(np.int64)
+    p = pred[valid].astype(np.int64)
+    idx = t * num_classes + p
+    return np.bincount(idx, minlength=num_classes ** 2).reshape(
+        num_classes, num_classes)
+
+
+def mean_iou(conf: np.ndarray):
+    """(mIoU over classes present, per-class IoU array with NaN for
+    absent classes) — reference mean_iou."""
+    inter = np.diag(conf).astype(np.float64)
+    union = conf.sum(1) + conf.sum(0) - inter
+    with np.errstate(divide="ignore", invalid="ignore"):
+        iou = inter / union
+    return float(np.nanmean(np.where(union > 0, iou, np.nan))), iou
+
+
+def make_segment_fwd(cfg, spec, num_classes):
+    """Jit the eval forward ONCE; pass to evaluate_miou from loops."""
+    import jax
+    return jax.jit(lambda p, x: segment_logits(p, x, cfg, spec,
+                                               num_classes))
+
+
+def evaluate_miou(params, cfg, spec, images, masks, num_classes,
+                  batch_size=16, fwd=None):
+    from tasks.common import padded_batches
+    fwd = fwd or make_segment_fwd(cfg, spec, num_classes)
+    conf = np.zeros((num_classes, num_classes), np.int64)
+    done = 0
+    for (chunk,), real in padded_batches([images], batch_size):
+        pred = np.asarray(fwd(params, chunk)).argmax(-1)[:real]
+        conf += confusion_matrix(pred, masks[done: done + real],
+                                 num_classes)
+        done += real
+    return mean_iou(conf)
+
+
+def finetune_segmentation(train_images, train_masks, valid_images,
+                          valid_masks, cfg, spec, num_classes, *,
+                          epochs=3, batch_size=16, lr=1e-3, seed=0,
+                          pretrained_params=None, log_fn=print):
+    """Epoch loop; returns (params, best mIoU)."""
+    import jax
+    import optax
+
+    from megatronapp_tpu.models.vision import init_vit_params
+
+    params, _ = init_vit_params(jax.random.PRNGKey(seed), cfg, spec)
+    if pretrained_params is not None:
+        for key in pretrained_params:
+            if key in params and key != "seg_head":
+                params[key] = pretrained_params[key]
+    params["seg_head"] = init_seg_head(jax.random.PRNGKey(seed + 1), cfg,
+                                       num_classes)
+
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, images, masks):
+        (loss, metrics), g = jax.value_and_grad(
+            lambda p: segmentation_loss(p, images, masks, cfg, spec,
+                                        num_classes), has_aux=True)(params)
+        updates, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    eval_fwd = make_segment_fwd(cfg, spec, num_classes)
+    rng = np.random.default_rng(seed)
+    steps_per_epoch = max(len(train_images) // batch_size, 1)
+    best = 0.0
+    for epoch in range(epochs):
+        order = rng.permutation(len(train_images))
+        loss = None
+        for s in range(steps_per_epoch):
+            idx = order[s * batch_size: (s + 1) * batch_size]
+            params, opt_state, loss = step(
+                params, opt_state, train_images[idx], train_masks[idx])
+        miou, _ = evaluate_miou(params, cfg, spec, valid_images,
+                                valid_masks, num_classes, batch_size,
+                                fwd=eval_fwd)
+        best = max(best, miou)
+        log_fn(f"epoch {epoch+1}/{epochs} | train loss "
+               f"{float(loss):.4f} | mIoU {miou:.4f}")
+    return params, best
+
+
+def main(argv=None):
+    from megatronapp_tpu.models.vision import VitSpec, vit_config
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--train-data", required=True,
+                    help=".npz with images/masks")
+    ap.add_argument("--valid-data", required=True)
+    ap.add_argument("--num-classes", type=int, required=True)
+    ap.add_argument("--img-size", type=int, default=128)
+    ap.add_argument("--patch-dim", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--num-layers", type=int, default=12)
+    ap.add_argument("--hidden-size", type=int, default=768)
+    ap.add_argument("--num-attention-heads", type=int, default=12)
+    ap.add_argument("--load-dir", default=None)
+    args = ap.parse_args(argv)
+
+    cfg = vit_config(num_layers=args.num_layers,
+                     hidden_size=args.hidden_size,
+                     num_attention_heads=args.num_attention_heads,
+                     max_position_embeddings=(args.img_size //
+                                              args.patch_dim) ** 2 + 1)
+    spec = VitSpec(image_size=args.img_size, patch_size=args.patch_dim,
+                   num_classes=args.num_classes)
+    train = np.load(args.train_data)
+    valid = np.load(args.valid_data)
+    pretrained = None
+    if args.load_dir:
+        import jax
+
+        from megatronapp_tpu.models.vision import init_vit_params
+        from tasks.common import restore_params
+        tmpl, _ = init_vit_params(jax.random.PRNGKey(0), cfg, spec)
+        pretrained = restore_params(args.load_dir, tmpl)
+
+    _, best = finetune_segmentation(
+        np.asarray(train["images"], np.float32),
+        np.asarray(train["masks"], np.int32),
+        np.asarray(valid["images"], np.float32),
+        np.asarray(valid["masks"], np.int32),
+        cfg, spec, args.num_classes, epochs=args.epochs,
+        batch_size=args.batch_size, lr=args.lr,
+        pretrained_params=pretrained)
+    print(f"best mIoU: {best:.4f}")
+
+
+if __name__ == "__main__":
+    main()

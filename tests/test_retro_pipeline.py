@@ -27,7 +27,7 @@ def write_corpus(tmp_path, n_docs=10, seed=0):
 class TestChunkDb:
     def test_chunking_covers_corpus(self, tmp_path):
         ds = write_corpus(tmp_path)
-        chunks, doc_ids = build_chunk_db(ds, 16)
+        chunks, doc_ids, lengths = build_chunk_db(ds, 16)
         assert chunks.shape[1] == 16
         assert len(chunks) == len(doc_ids)
         # every document contributes ceil(len/16) chunks
@@ -45,14 +45,15 @@ class TestChunkDb:
 
     def test_knn_excludes_same_document(self, tmp_path):
         ds = write_corpus(tmp_path)
-        chunks, doc_ids = build_chunk_db(ds, 16)
+        chunks, doc_ids, lengths = build_chunk_db(ds, 16)
         cfg = bert_config(num_layers=1, hidden_size=32,
                           num_attention_heads=4, vocab_size=128,
                           max_position_embeddings=32,
                           attention_impl="reference")
         p, _ = init_bert_params(jax.random.PRNGKey(0), cfg,
                                 add_binary_head=False)
-        emb = embed_token_chunks(p, cfg, chunks, batch_size=32)
+        emb = embed_token_chunks(p, cfg, chunks, lengths=lengths,
+                                 batch_size=32)
         assert emb.shape == (len(chunks), 32)
         nbrs = knn_neighbors(emb, 2, group_ids=doc_ids)
         for i in range(len(chunks)):
@@ -69,12 +70,19 @@ class TestRetroDataset:
                           attention_impl="reference")
         p, _ = init_bert_params(jax.random.PRNGKey(0), cfg,
                                 add_binary_head=False)
-        samples, neigh = build_retro_dataset(
+        samples, neigh, sample_mask = build_retro_dataset(
             ds, p, cfg, chunk_length=16, chunks_per_sample=3,
             num_neighbors=2, log_fn=lambda s: None)
-        chunks, doc_ids = build_chunk_db(ds, 16)
+        chunks, doc_ids, lengths = build_chunk_db(ds, 16)
         n = len(chunks) // 3
         assert samples.shape == (n, 48)
+        assert sample_mask.shape == (n, 48)
+        # document-tail padded positions are masked out
+        for i in range(n):
+            for ci in range(3):
+                gi = i * 3 + ci
+                sl = sample_mask[i, ci * 16:(ci + 1) * 16]
+                assert sl.sum() == lengths[gi]
         assert neigh.shape == (n, 3, 2, 32)
         # samples are the chunk stream in order
         np.testing.assert_array_equal(samples[0, :16], chunks[0])

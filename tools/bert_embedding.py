@@ -18,9 +18,8 @@ sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
 import numpy as np
 
 
-def embed_texts(params, cfg, tokenizer, ids, texts, seq_length=128,
-                batch_size=32):
-    """Mean-pooled (over real tokens) final hidden states [N, H]."""
+def _mean_pool_encoder(params, cfg):
+    """One jitted mean-pool BERT encoder: (tokens, mask) → [B, H]."""
     import jax
     import jax.numpy as jnp
 
@@ -33,6 +32,16 @@ def embed_texts(params, cfg, tokenizer, ids, texts, seq_length=128,
         return jnp.sum(h, axis=1) / jnp.maximum(
             jnp.sum(mask, axis=1, keepdims=True), 1.0)
 
+    return encode
+
+
+def embed_texts(params, cfg, tokenizer, ids, texts, seq_length=128,
+                batch_size=32):
+    """Mean-pooled (over real tokens) final hidden states [N, H]."""
+    import jax
+    import jax.numpy as jnp
+
+    encode = _mean_pool_encoder(params, cfg)
     out = []
     for s in range(0, len(texts), batch_size):
         chunk = texts[s: s + batch_size]
@@ -48,32 +57,33 @@ def embed_texts(params, cfg, tokenizer, ids, texts, seq_length=128,
     return np.concatenate(out, axis=0)
 
 
-def embed_token_chunks(params, cfg, chunks: np.ndarray, pad_id: int = 0,
+def embed_token_chunks(params, cfg, chunks: np.ndarray,
+                       lengths: np.ndarray = None,
                        batch_size: int = 64) -> np.ndarray:
     """Mean-pooled embeddings for pre-tokenized chunks [N, m] → [N, H]
-    (the retro chunk-DB embedding step; chunks carry no CLS/SEP framing,
-    pad ids are masked out of the mean)."""
+    (the retro chunk-DB embedding step; chunks carry no CLS/SEP framing).
+
+    lengths [N]: true token count per chunk — the attention/mean mask is
+    positional, NOT value-based (token id == pad id is a legitimate
+    corpus token). Defaults to full-length chunks."""
     import jax
     import jax.numpy as jnp
 
-    from megatronapp_tpu.models.bert import bert_encode
-
-    @jax.jit
-    def encode(tokens, mask):
-        h = bert_encode(params, tokens, cfg, padding_mask=mask)
-        h = h.astype(jnp.float32) * mask[..., None]
-        return jnp.sum(h, axis=1) / jnp.maximum(
-            jnp.sum(mask, axis=1, keepdims=True), 1.0)
-
+    encode = _mean_pool_encoder(params, cfg)
+    n, m = chunks.shape
+    if lengths is None:
+        lengths = np.full(n, m, np.int32)
     out = []
-    n = len(chunks)
+    pos = np.arange(m)
     for s in range(0, n, batch_size):
         part = np.asarray(chunks[s: s + batch_size], np.int32)
+        lens = np.asarray(lengths[s: s + batch_size], np.int32)
         pad = batch_size - len(part)
         if pad:  # keep one compiled shape
             part = np.concatenate([part, np.zeros_like(
                 part[:1]).repeat(pad, axis=0)])
-        mask = (part != pad_id).astype(np.float32)
+            lens = np.concatenate([lens, np.ones(pad, np.int32)])
+        mask = (pos[None, :] < lens[:, None]).astype(np.float32)
         emb = np.asarray(jax.device_get(
             encode(jnp.asarray(part), jnp.asarray(mask))))
         out.append(emb[: batch_size - pad] if pad else emb)

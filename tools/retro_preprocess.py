@@ -28,11 +28,14 @@ sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
 
 
 def build_chunk_db(indexed, chunk_length: int, pad_id: int = 0):
-    """Corpus → (chunks [N_chunks, m], doc_ids [N_chunks]).
+    """Corpus → (chunks [N_chunks, m], doc_ids [N_chunks],
+    lengths [N_chunks]).
 
     Documents are split into m-length chunks; the trailing partial chunk
-    is zero-padded (reference chunk-db construction pads the tail)."""
-    chunks, doc_ids = [], []
+    is zero-padded (reference chunk-db construction pads the tail) and
+    its true length recorded — padding is tracked positionally, never by
+    token value."""
+    chunks, doc_ids, lengths = [], [], []
     docs = np.asarray(indexed.document_indices)
     for d in range(len(docs) - 1):
         toks = np.concatenate([np.asarray(indexed[i], np.int32)
@@ -40,19 +43,22 @@ def build_chunk_db(indexed, chunk_length: int, pad_id: int = 0):
                                               int(docs[d + 1]))])
         for s in range(0, len(toks), chunk_length):
             part = toks[s: s + chunk_length]
+            lengths.append(len(part))
             if len(part) < chunk_length:
                 part = np.pad(part, (0, chunk_length - len(part)),
                               constant_values=pad_id)
             chunks.append(part)
             doc_ids.append(d)
-    return np.stack(chunks), np.asarray(doc_ids)
+    return np.stack(chunks), np.asarray(doc_ids), np.asarray(
+        lengths, np.int32)
 
 
 def build_retro_dataset(indexed, params, cfg, *, chunk_length: int = 64,
                         chunks_per_sample: int = 4, num_neighbors: int = 2,
                         retrieved_length: int = None, pad_id: int = 0,
                         batch_size: int = 64, log_fn=print):
-    """Full pipeline → (samples [N, C*m], neighbor_tokens [N, C, K, R])."""
+    """Full pipeline → (samples [N, C*m], neighbor_tokens [N, C, K, R],
+    sample_mask [N, C*m] — 0 on document-tail padding)."""
     from tools.bert_embedding import embed_token_chunks, knn_neighbors
 
     retrieved_length = retrieved_length or 2 * chunk_length
@@ -61,11 +67,16 @@ def build_retro_dataset(indexed, params, cfg, *, chunk_length: int = 64,
             f"retrieved_length ({retrieved_length}) exceeds the "
             f"neighbor+continuation content (2*chunk_length = "
             f"{2 * chunk_length})")
-    chunks, doc_ids = build_chunk_db(indexed, chunk_length, pad_id)
+    chunks, doc_ids, lengths = build_chunk_db(indexed, chunk_length,
+                                              pad_id)
     n_chunks = len(chunks)
+    if n_chunks < chunks_per_sample:
+        raise ValueError(
+            f"corpus yields only {n_chunks} chunks — fewer than "
+            f"chunks_per_sample ({chunks_per_sample}); no samples")
     log_fn(f"chunk db: {n_chunks} chunks of {chunk_length} from "
            f"{doc_ids.max() + 1 if n_chunks else 0} docs")
-    emb = embed_token_chunks(params, cfg, chunks, pad_id=pad_id,
+    emb = embed_token_chunks(params, cfg, chunks, lengths=lengths,
                              batch_size=batch_size)
     nbrs = knn_neighbors(emb, num_neighbors, group_ids=doc_ids)
     log_fn(f"kNN done: {nbrs.shape}")
@@ -81,16 +92,18 @@ def build_retro_dataset(indexed, params, cfg, *, chunk_length: int = 64,
     c = chunks_per_sample
     n_samples = n_chunks // c
     samples = np.zeros((n_samples, c * chunk_length), np.int32)
+    sample_mask = np.zeros((n_samples, c * chunk_length), np.float32)
     neigh = np.zeros((n_samples, c, num_neighbors, retrieved_length),
                      np.int32)
     for i in range(n_samples):
         for ci in range(c):
             gi = i * c + ci
-            samples[i, ci * chunk_length:(ci + 1) * chunk_length] = \
-                chunks[gi]
+            sl = slice(ci * chunk_length, (ci + 1) * chunk_length)
+            samples[i, sl] = chunks[gi]
+            sample_mask[i, sl][: lengths[gi]] = 1.0
             for k in range(num_neighbors):
                 neigh[i, ci, k] = retrieved(int(nbrs[gi, k]))
-    return samples, neigh
+    return samples, neigh, sample_mask
 
 
 def main(argv=None):
@@ -134,13 +147,14 @@ def main(argv=None):
         print("warning: no --load-dir; embeddings from a random encoder "
               "(pipeline check only)")
 
-    samples, neigh = build_retro_dataset(
+    samples, neigh, mask = build_retro_dataset(
         IndexedDataset(args.data_path), params, cfg,
         chunk_length=args.chunk_length,
         chunks_per_sample=args.chunks_per_sample,
         num_neighbors=args.num_neighbors,
         retrieved_length=args.retrieved_length)
-    np.savez_compressed(args.output, samples=samples, neighbors=neigh)
+    np.savez_compressed(args.output, samples=samples, neighbors=neigh,
+                        mask=mask)
     print(f"retro dataset → {args.output}: samples {samples.shape}, "
           f"neighbors {neigh.shape}")
 
