@@ -1,0 +1,62 @@
+"""Unified downstream-task dispatcher.
+
+Parity with /root/reference/tasks/main.py (one entry, --task routes to
+the family-specific harness). Task names follow the reference's
+(RACE, MNLI/QQP-style classify, WIKITEXT103, LAMBADA) plus the
+families this build adds explicit entries for.
+
+  python tasks/main.py --task RACE --train-data r.jsonl --valid-data d.jsonl ...
+  python tasks/main.py --task CLASSIFY --num-classes 2 ...
+  python tasks/main.py --task WIKITEXT103 --data-path wiki.txt ...
+  python tasks/main.py --task LAMBADA --data-path lambada.jsonl ...
+  python tasks/main.py --task ORQA --data-path blocks --queries q.jsonl ...
+  python tasks/main.py --task MSDP-EVAL --guess-file g --answer-file a
+  python tasks/main.py --task VISION-CLASSIFY --train-data t.npz ...
+  python tasks/main.py --task VISION-SEGMENT --train-data t.npz ...
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tasks/", 1)[0])
+
+
+def main():
+    if "--task" not in sys.argv:
+        raise SystemExit(__doc__)
+    i = sys.argv.index("--task")
+    task = sys.argv[i + 1].upper()
+    rest = sys.argv[1:i] + sys.argv[i + 2:]
+
+    if task in ("RACE", "MULTICHOICE"):
+        from tasks.finetune import main as m
+        m(["--task", "multichoice", *rest])
+    elif task in ("CLASSIFY", "MNLI", "QQP"):
+        from tasks.finetune import main as m
+        m(["--task", "classify", *rest])
+    elif task in ("WIKITEXT103", "WIKITEXT"):
+        from tasks.zeroshot_gpt import main as m
+        m(["--task", "wikitext", *rest])
+    elif task == "LAMBADA":
+        from tasks.zeroshot_gpt import main as m
+        m(["--task", "lambada", *rest])
+    elif task == "ORQA":
+        from tasks.orqa_eval import main as m
+        m(rest)
+    elif task in ("MSDP-EVAL", "MSDP"):
+        from tasks.msdp import main as m
+        m(rest)
+    elif task == "VISION-CLASSIFY":
+        from tasks.vision_classify import main as m
+        m(rest)
+    elif task == "VISION-SEGMENT":
+        from tasks.vision_segment import main as m
+        m(rest)
+    else:
+        raise SystemExit(
+            f"unknown --task {task}; known: RACE, CLASSIFY (MNLI/QQP), "
+            "WIKITEXT103, LAMBADA, ORQA, MSDP-EVAL, VISION-CLASSIFY, "
+            "VISION-SEGMENT")
+
+
+if __name__ == "__main__":
+    main()
