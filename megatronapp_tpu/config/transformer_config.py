@@ -153,6 +153,11 @@ class TransformerConfig:
     flash_block_q: int = 512
     flash_block_kv: int = 512
 
+    # lax.scan unroll factor for the layer stack (PERF.md lever #3:
+    # unrolling lets XLA software-pipeline across layer boundaries at
+    # the cost of code size/compile time). Must divide num_layers.
+    scan_unroll: int = 1
+
     # Heterogeneous per-layer structure (reference
     # heterogeneous_config.py HeterogeneousTransformerConfig): the HF
     # Nemotron "block_configs" JSON (encoded string). When set, layers

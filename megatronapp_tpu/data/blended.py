@@ -4,22 +4,43 @@ Parity with /root/reference/megatron/core/datasets/blended_dataset.py:25
 (BlendedDataset): samples are drawn from constituent datasets in proportion
 to weights using the deficit-tracking index built by the C++ helper
 (build_blending_indices), deterministic and stable across runs.
+weights=None activates the exhaustive mode (reference
+build_exhaustive_blending_indices, used when blends give sizes instead of
+weights): every constituent is consumed exactly once, interleaved
+size-proportionally.
 """
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
-from megatronapp_tpu.data.helpers import build_blending_indices
+from megatronapp_tpu.data.helpers import (
+    build_blending_indices, build_exhaustive_blending_indices,
+)
 
 
 class BlendedDataset:
-    def __init__(self, datasets: Sequence, weights: Sequence[float],
-                 num_samples: int):
+    def __init__(self, datasets: Sequence,
+                 weights: Optional[Sequence[float]],
+                 num_samples: Optional[int] = None):
+        if weights is None:
+            # Exhaustive: draw exactly len(d) samples from each d.
+            self.datasets = list(datasets)
+            sizes = np.asarray([len(d) for d in datasets], np.int64)
+            self.dataset_index, self.dataset_sample_index = \
+                build_exhaustive_blending_indices(sizes)
+            self.num_samples = int(sizes.sum())
+            if num_samples is not None and num_samples != self.num_samples:
+                raise ValueError(
+                    f"exhaustive blend yields {self.num_samples} samples; "
+                    f"num_samples={num_samples} conflicts")
+            return
         if len(datasets) != len(weights):
             raise ValueError("datasets and weights length mismatch")
+        if num_samples is None:
+            raise ValueError("num_samples required with explicit weights")
         self.datasets = list(datasets)
         self.num_samples = num_samples
         self.dataset_index, self.dataset_sample_index = \

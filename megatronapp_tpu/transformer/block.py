@@ -212,7 +212,8 @@ def block_forward(stacked_p, x: jnp.ndarray, cfg: TransformerConfig,
 
         body = _remat_wrap(body, cfg.remat_policy)
         (x, _), aux = jax.lax.scan(
-            body, (x, jnp.int32(layer_offset)), stacked_p)
+            body, (x, jnp.int32(layer_offset)), stacked_p,
+            unroll=cfg.scan_unroll)
         return x, jnp.sum(aux)
 
     freq = cfg.moe_layer_freq

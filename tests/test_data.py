@@ -177,3 +177,32 @@ class TestBlended:
         # Undersized constituent is rejected up front.
         with pytest.raises(ValueError):
             BlendedDataset([b, a], [0.9, 0.1], 50)
+
+
+def test_blended_exhaustive_mode(tmp_path):
+    """weights=None consumes every constituent exactly once (reference
+    build_exhaustive_blending_indices semantics)."""
+
+    class _Fake:
+        def __init__(self, tag, n):
+            self.tag, self.n = tag, n
+            self.seq_length = 8
+
+        def __len__(self):
+            return self.n
+
+        def __getitem__(self, i):
+            return (self.tag, i)
+
+    a, b, c = _Fake("a", 5), _Fake("b", 3), _Fake("c", 9)
+    blend = BlendedDataset([a, b, c], None)
+    assert len(blend) == 17
+    got = [blend[i] for i in range(len(blend))]
+    for tag, n in (("a", 5), ("b", 3), ("c", 9)):
+        mine = sorted(i for t, i in got if t == tag)
+        assert mine == list(range(n))
+    import pytest as _p
+    with _p.raises(ValueError):
+        BlendedDataset([a, b], None, num_samples=3)
+    with _p.raises(ValueError):
+        BlendedDataset([a, b], [0.5, 0.5])  # weights need num_samples
