@@ -228,10 +228,12 @@ def block_forward(stacked_p, x: jnp.ndarray, cfg: TransformerConfig,
             return (hh, l + 1), a
 
         (h, _), aux_dense = jax.lax.scan(
-            dense_body, (h, lid + 1), group_p["dense"])
+            dense_body, (h, lid + 1), group_p["dense"],
+            unroll=cfg.scan_unroll)
         return (h, lid + freq), aux_moe + jnp.sum(aux_dense)
 
     group_body = _remat_wrap(group_body, cfg.remat_policy)
     (x, _), aux = jax.lax.scan(
-        group_body, (x, jnp.int32(layer_offset)), stacked_p)
+        group_body, (x, jnp.int32(layer_offset)), stacked_p,
+        unroll=cfg.scan_unroll)
     return x, jnp.sum(aux)

@@ -1,0 +1,58 @@
+"""Ensemble classification predictions from multiple finetune runs.
+
+Parity with /root/reference/tasks/ensemble_classifier.py: load per-run
+prediction files, sum the class scores per example (uid-aligned), argmax
+the ensemble, report per-dataset and overall accuracy.
+
+Prediction file format: .npz with `logits` [N, C], `labels` [N],
+`uid` [N] (written by tasks/finetune.py --save-predictions).
+
+Usage:
+  python tasks/ensemble_classifier.py run1/preds.npz run2/preds.npz ...
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/tasks/", 1)[0])
+
+
+def ensemble(paths):
+    """Sum uid-aligned scores across runs → (pred [N], labels [N])."""
+    total = None
+    labels = None
+    uid = None
+    for path in paths:
+        data = np.load(path)
+        if total is None:
+            total = np.asarray(data["logits"], np.float64).copy()
+            labels = np.asarray(data["labels"])
+            uid = np.asarray(data["uid"])
+        else:
+            if not np.array_equal(uid, data["uid"]):
+                raise ValueError(f"{path}: uid mismatch with the first "
+                                 "run — predictions are not aligned")
+            if not np.array_equal(labels, data["labels"]):
+                raise ValueError(f"{path}: labels disagree with the "
+                                 "first run on the same uids")
+            total += np.asarray(data["logits"], np.float64)
+    if total is None:
+        raise ValueError("no prediction files")
+    return total.argmax(axis=1), labels
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="+", help="prediction .npz files")
+    args = ap.parse_args(argv)
+    pred, labels = ensemble(args.paths)
+    acc = float((pred == labels).mean())
+    print(f"ensemble of {len(args.paths)} runs: accuracy {acc:.4f} "
+          f"({len(pred)} examples)")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

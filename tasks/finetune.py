@@ -194,7 +194,7 @@ def finetune_classification(train_rows, valid_rows, tokenizer, ids, cfg,
                             num_classes, *, epochs=3, batch_size=16,
                             lr=2e-5, seq_length=128, seed=0,
                             pretrained_params=None, log_fn=print,
-                            multichoice=False):
+                            multichoice=False, save_predictions=None):
     """Epoch loop (reference finetune_utils.finetune): train on train_rows,
     report dev accuracy each epoch. Returns (params, best_accuracy).
 
@@ -281,6 +281,29 @@ def finetune_classification(train_rows, valid_rows, tokenizer, ids, cfg,
         best = max(best, acc)
         log_fn(f"epoch {epoch+1}/{epochs} | train loss "
                f"{float(loss):.4f} | dev acc {acc:.4f}")
+    if save_predictions:
+        # Final dev-set class scores for tasks/ensemble_classifier.py
+        # (reference finetune_utils saves (predictions, labels, uid)).
+        import hashlib
+        logits_fn = jax.jit(lambda p, b: _pooled_logits(p, b, cfg))
+        rows_logits = []
+        for s in range(0, len(valid_rows), batch_size):
+            rows = valid_rows[s: s + batch_size]
+            scores = np.asarray(logits_fn(params, build(rows)))
+            if multichoice:
+                scores = scores.reshape(-1, num_choices)
+            rows_logits.append(scores)
+        # Content-derived uid: runs over DIFFERENT dev files must not
+        # pass the ensemble's alignment check by length coincidence.
+        uid = np.asarray([
+            int.from_bytes(hashlib.sha1(
+                repr(r[1:]).encode()).digest()[:8], "little")
+            for r in valid_rows], np.uint64)
+        np.savez(save_predictions,
+                 logits=np.concatenate(rows_logits),
+                 labels=np.asarray([r[0] for r in valid_rows], np.int32),
+                 uid=uid)
+        log_fn(f"predictions → {save_predictions}")
     return params, best
 
 
@@ -308,6 +331,9 @@ def main(argv=None):
     ap.add_argument("--tokenizer-type", default="BertWordPieceTokenizer")
     ap.add_argument("--tokenizer-name-or-path", default=None)
     ap.add_argument("--load-dir", default=None)
+    ap.add_argument("--save-predictions", default=None,
+                    help=".npz of final dev-set scores for "
+                         "tasks/ensemble_classifier.py")
     args = ap.parse_args(argv)
 
     from tasks.common import build_tok_and_ids, restore_params
@@ -336,7 +362,8 @@ def main(argv=None):
         cfg, args.num_classes, epochs=args.epochs,
         batch_size=args.batch_size, lr=args.lr,
         seq_length=args.seq_length, pretrained_params=pretrained,
-        multichoice=args.task == "multichoice")
+        multichoice=args.task == "multichoice",
+        save_predictions=args.save_predictions)
     print(f"best dev accuracy: {best:.4f}")
 
 

@@ -13,6 +13,7 @@ families this build adds explicit entries for.
   python tasks/main.py --task MSDP-EVAL --guess-file g --answer-file a
   python tasks/main.py --task VISION-CLASSIFY --train-data t.npz ...
   python tasks/main.py --task VISION-SEGMENT --train-data t.npz ...
+  python tasks/main.py --task ENSEMBLE run1/p.npz run2/p.npz
 """
 
 import sys
@@ -51,11 +52,14 @@ def main():
     elif task == "VISION-SEGMENT":
         from tasks.vision_segment import main as m
         m(rest)
+    elif task == "ENSEMBLE":
+        from tasks.ensemble_classifier import main as m
+        m(rest)
     else:
         raise SystemExit(
             f"unknown --task {task}; known: RACE, CLASSIFY (MNLI/QQP), "
             "WIKITEXT103, LAMBADA, ORQA, MSDP-EVAL, VISION-CLASSIFY, "
-            "VISION-SEGMENT")
+            "VISION-SEGMENT, ENSEMBLE")
 
 
 if __name__ == "__main__":

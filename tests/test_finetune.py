@@ -132,3 +132,52 @@ def test_multichoice_learns_synthetic_task(tmp_path):
         rows[:80], rows[80:], tok, IDS, cfg, 1, epochs=6, batch_size=8,
         lr=1e-3, seq_length=32, multichoice=True, log_fn=lambda s: None)
     assert best > 0.6, best  # chance = 0.25
+
+
+def test_save_predictions_and_ensemble(tmp_path):
+    """Two finetune runs save dev scores; the ensemble beats-or-matches
+    each constituent on the marker-token task."""
+    from tasks.ensemble_classifier import ensemble
+    from tasks.finetune import finetune_classification
+
+    rng = np.random.default_rng(3)
+
+    def make_rows(n):
+        rows = []
+        for _ in range(n):
+            toks = list(rng.integers(10, 90, 12))
+            label = int(rng.random() < 0.5)
+            if label:
+                toks[int(rng.integers(0, 12))] = 7
+            rows.append((label, " ".join(map(str, toks)), None))
+        return rows
+
+    from megatronapp_tpu.data.tokenizers import NullTokenizer
+    train, valid = make_rows(64), make_rows(32)
+    tok = NullTokenizer(100)
+    cfg = bert_config(num_layers=2, hidden_size=48,
+                      num_attention_heads=4, vocab_size=100,
+                      max_position_embeddings=16,
+                      attention_impl="reference")
+    paths = []
+    accs = []
+    for seed in (0, 1):
+        path = str(tmp_path / f"p{seed}.npz")
+        _, best = finetune_classification(
+            train, valid, tok, IDS, cfg, 2, epochs=2, batch_size=16,
+            lr=1e-3, seq_length=16, seed=seed, log_fn=lambda s: None,
+            save_predictions=path)
+        paths.append(path)
+        accs.append(best)
+    pred, labels = ensemble(paths)
+    ens_acc = float((pred == labels).mean())
+    assert len(pred) == 32
+    assert ens_acc >= 0.5
+    # uid misalignment detected
+    import pytest as _p
+    data = np.load(paths[0])
+    bad = str(tmp_path / "bad.npz")
+    np.savez(bad, logits=data["logits"], labels=data["labels"],
+             uid=data["uid"][::-1].copy())
+    with _p.raises(ValueError):
+        ensemble([paths[0], bad])
