@@ -1,0 +1,16 @@
+#!/bin/bash
+# Text-generation serving: static KV-cache engine, continuous-batching
+# dynamic engine, or recurrent-state mamba engine; REST /api + WS /ws
+# (reference: tools/run_text_generation_server.py + examples/inference).
+set -e
+# HF GPT-2 -> our checkpoint:
+python tools/checkpoint/convert.py --model-type gpt2 \
+    --hf-path gpt2 --save-dir ckpt_gpt2
+
+python tools/run_text_generation_server.py --load-dir ckpt_gpt2 \
+    --preset gpt2-125m --tokenizer-type GPT2BPETokenizer \
+    --engine dynamic --port 5000 &
+sleep 10
+curl -s -X PUT localhost:5000/api -H 'Content-Type: application/json' \
+    -d '{"prompts": ["The capital of France is"], "tokens_to_generate": 16, "top_k": 40}'
+kill %1

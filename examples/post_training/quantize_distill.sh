@@ -1,0 +1,14 @@
+#!/bin/bash
+# Post-training: int8 PTQ export + serving, and distillation
+# (reference: megatron/post_training — ModelOpt quantize/distill flows).
+set -e
+python tools/checkpoint/quantize.py --load-dir ckpt_gpt2 \
+    --save gpt2_int8.npz
+python tools/run_text_generation_server.py \
+    --load-quantized gpt2_int8.npz --preset gpt2-125m --port 5001 &
+sleep 10
+curl -s -X PUT localhost:5001/api -H 'Content-Type: application/json' \
+    -d '{"prompts": ["Hello"], "tokens_to_generate": 8}'
+kill %1
+# Distillation: teacher ckpt -> smaller student (see
+# megatronapp_tpu/training/distillation.py, pretrain_gpt --distill-*).

@@ -1,0 +1,21 @@
+#!/bin/bash
+# ICT biencoder pretraining + ORQA-style retrieval eval
+# (reference: pretrain_ict.py + tasks/orqa — examples analogue).
+# Corpus: sentence-split .bin/.idx (tools/preprocess_data.py
+# --split-sentences) + one-title-per-document companion.
+set -e
+DATA=${DATA:-data/blocks}
+TITLES=${TITLES:-data/titles}
+
+python pretrain_ict.py \
+    --num-layers 12 --hidden-size 768 --num-attention-heads 12 \
+    --seq-length 256 --micro-batch-size 32 --global-batch-size 128 \
+    --train-iters 10000 --lr 1e-4 \
+    --data-path "$DATA" --titles-data-path "$TITLES" \
+    --query-in-block-prob 0.1 --retriever-score-scaling \
+    --save-dir ckpt_ict
+
+python tasks/orqa_eval.py \
+    --data-path "$DATA" --titles-data-path "$TITLES" \
+    --queries qa_dev.jsonl --load-dir ckpt_ict \
+    --report-topk-accuracies 1 5 20

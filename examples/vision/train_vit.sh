@@ -1,0 +1,17 @@
+#!/bin/bash
+# ViT classification pretraining, then downstream finetune + segmentation
+# (reference: pretrain_vision_classify.py + tasks/vision).
+set -e
+python pretrain_vision_classify.py \
+    --num-layers 12 --hidden-size 768 --num-attention-heads 12 \
+    --img-size 224 --patch-dim 16 --num-classes 1000 \
+    --micro-batch-size 32 --global-batch-size 256 --train-iters 10000 \
+    --save-dir ckpt_vit
+
+python tasks/main.py --task VISION-CLASSIFY \
+    --train-data cifar_train.npz --valid-data cifar_val.npz \
+    --num-classes 10 --img-size 32 --patch-dim 4 --load-dir ckpt_vit
+
+python tasks/main.py --task VISION-SEGMENT \
+    --train-data seg_train.npz --valid-data seg_val.npz \
+    --num-classes 19 --img-size 128 --patch-dim 16 --load-dir ckpt_vit
