@@ -187,7 +187,17 @@ def parse_args(ap: argparse.ArgumentParser, argv=None):
     Resolution order (lowest → highest precedence): parser defaults →
     --config-yaml values → --use-checkpoint-args stored values → explicit
     CLI flags. Use this instead of ap.parse_args in entry points."""
+    import os
     import sys
+
+    # Honor JAX_PLATFORMS explicitly: some site configurations (e.g. the
+    # tunneled-TPU image) programmatically force jax_platforms AFTER env
+    # processing, which silently overrides the operator's choice and can
+    # hang every entry point when the tunnel is down. Applying the env var
+    # through jax.config restores the standard JAX contract.
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     argv = list(sys.argv[1:] if argv is None else argv)
     pre, _ = ap.parse_known_args(argv)
     defaults = {}

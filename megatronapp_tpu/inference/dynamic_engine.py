@@ -7,10 +7,13 @@ enter a waiting queue; the engine admits them into free cache slots
 finished requests — new requests join mid-flight without draining the batch.
 
 TPU-first: all shapes static. The shared cache is [L, max_batch, S_max,
-Hkv, D]; per-slot sequence lengths live in a [max_batch] int32 array; the
-decode step is ONE jit for all slots (per-row rope positions + per-row
-causal masks), and prefill runs through length-bucketed jits (a handful of
-compilations instead of one per prompt length).
+Hkv, D] K/V for standard attention, or the compressed MLA pair
+(latent [L, max_batch, S_max, kv_lora_rank] + shared roped key
+[L, max_batch, S_max, dpe]); per-slot sequence lengths live in a
+[max_batch] int32 array; the decode step is ONE jit for all slots
+(per-row rope positions + per-row causal masks), and prefill runs
+through length-bucketed jits (a handful of compilations instead of one
+per prompt length).
 """
 
 from __future__ import annotations
@@ -103,10 +106,6 @@ class DynamicInferenceEngine:
     def __init__(self, params, cfg: TransformerConfig, tokenizer=None,
                  max_batch: int = 4, max_seq_len: Optional[int] = None,
                  prefill_buckets: Tuple[int, ...] = (32, 128, 512)):
-        if cfg.multi_latent_attention:
-            raise NotImplementedError(
-                "dynamic batching currently supports standard attention "
-                "caches (MLA serves through the static engine)")
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer

@@ -228,9 +228,10 @@ class StaticInferenceEngine:
 
 
 class MambaInferenceEngine:
-    """Server-compatible generation engine for pure-Mamba models
-    (reference: the mamba text-generation server under tools/; decode is
-    O(1)-state recurrent instead of KV-cached attention).
+    """Server-compatible generation engine for Mamba models — pure-M
+    stacks decode with O(1) recurrent state; hybrid (M/attention) stacks
+    additionally carry a KV cache sized max_seq_len for the '*' layers
+    (reference: the mamba text-generation server under tools/).
 
     Exposes the same generate/generate_text surface the
     TextGenerationServer drives on StaticInferenceEngine."""
@@ -245,14 +246,17 @@ class MambaInferenceEngine:
         self.mcfg = mcfg
         self.tokenizer = tokenizer
         # Mamba has no positional embeddings — an operator may serve
-        # beyond the training context via --max-seq-len.
+        # beyond the training context via --max-seq-len. (Hybrid stacks
+        # with rope attention layers stay within rope table range.)
         self.max_seq_len = max_seq_len or cfg.max_position_embeddings
         # jit once per engine — per-request lambdas would re-trace and
         # recompile every call.
         self._prefill = jax.jit(
-            lambda p, t: mamba_prefill(p, t, cfg, mcfg))
+            lambda p, t: mamba_prefill(p, t, cfg, mcfg,
+                                       max_len=self.max_seq_len))
         self._step = jax.jit(
-            lambda p, s, t: mamba_decode_step(p, s, t, cfg, mcfg),
+            lambda p, s, t, i: mamba_decode_step(p, s, t, cfg, mcfg,
+                                                 cache_index=i),
             donate_argnums=(1,))
 
     def generate(self, prompt_tokens: np.ndarray, max_new_tokens: int,
@@ -269,11 +273,13 @@ class MambaInferenceEngine:
                 f"prompt+new ({s_prompt + max_new_tokens}) exceeds "
                 f"max_seq_len ({self.max_seq_len})")
         logits, states = self._prefill(self.params, prompt_tokens)
-        box = {"states": states}
+        box = {"states": states, "pos": s_prompt}
 
         def step_fn(next_tok):
             logits_last, box["states"] = self._step(
-                self.params, box["states"], next_tok)
+                self.params, box["states"], next_tok,
+                jnp.int32(box["pos"]))
+            box["pos"] += 1
             return logits_last
 
         return _decode_loop(self.cfg, prompt_tokens, logits[:, -1],

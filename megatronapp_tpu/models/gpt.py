@@ -78,10 +78,11 @@ def init_gpt_params(rng, cfg: TransformerConfig, pp: int = 1, vpp: int = 1):
             k_out, (cfg.hidden_size, cfg.vocab_size), cfg.params_dtype) * std
         ax["output"] = ("embed", "vocab")
     if cfg.mtp_num_layers:
-        if pp > 1:
-            raise NotImplementedError(
-                "multi-token prediction under pipeline parallelism is not "
-                "supported yet (reference places MTP on the last stage)")
+        # MTP depth modules are NOT part of the pipelined stack: like the
+        # embedding/head they run compiler-sharded on the last-stage
+        # output (the reference places MTP on the last pp stage —
+        # multi_token_prediction.py; here "outside the pipeline" is the
+        # same placement expressed SPMD-style).
         from megatronapp_tpu.transformer.mtp import init_mtp_params
         p["mtp"], ax["mtp"] = init_mtp_params(k_out, cfg)
     return p, ax
@@ -237,6 +238,12 @@ def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
             raise NotImplementedError(
                 "multi token prediction + sequence packing is not "
                 "supported (reference multi_token_prediction.py assert)")
+        if zigzag_active(cfg, ctx):
+            raise NotImplementedError(
+                "multi token prediction + zigzag context parallelism is "
+                "not supported (the depth modules' future-token rolls "
+                "assume contiguous sequence order); use cp_comm_type "
+                "'a2a'/'allgather' or mtp_num_layers=0")
         from megatronapp_tpu.transformer.mtp import mtp_loss as _mtp_loss
         logits, aux, hid, (cos, sin) = gpt_forward(
             p, tokens, cfg, ctx=ctx, zigzag_keep=True, return_hidden=True)
@@ -355,9 +362,31 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
     # normalize to per-microbatch scale to match the non-pipelined path.
     aux = aux / m
 
+    mtp_metrics = {}
+    mtp_scaled_term = jnp.zeros((), jnp.float32)
+    if cfg.mtp_num_layers:
+        # MTP runs on the last-stage output, outside the pp body, like the
+        # head (reference last-stage placement, multi_token_prediction.py).
+        if positions is not None:
+            raise NotImplementedError(
+                "multi token prediction + zigzag context parallelism is "
+                "not supported (future-token rolls assume contiguous "
+                "sequence order)")
+        from megatronapp_tpu.transformer.mtp import mtp_loss as _mtp_loss
+        mtp_scaled_term, mtp_mean, mtp_layer_aux = _mtp_loss(
+            p["mtp"], out_mb.reshape(m * mb, s, -1),
+            lambda t: gpt_embed(p, t, cfg),
+            lambda hh: gpt_head(p, hh, cfg),
+            tokens_mb.reshape(m * mb, s), targets_mb.reshape(m * mb, s),
+            loss_mask_mb.reshape(m * mb, s), cfg, cos, sin, ctx=ctx)
+        aux = aux + mtp_layer_aux
+        mtp_metrics["mtp_loss"] = mtp_mean
+
     logits = gpt_head(p, out_mb, cfg)
     loss, _ = cross_entropy_loss(logits, targets_mb, loss_mask_mb)
-    return loss + aux, {"lm_loss": loss, "moe_aux_loss": aux}
+    return loss + aux + mtp_scaled_term, {"lm_loss": loss,
+                                          "moe_aux_loss": aux,
+                                          **mtp_metrics}
 
 
 def _gpt_pipeline_loss_packed(p, tokens_mb, targets_mb, loss_mask_mb,
@@ -368,6 +397,10 @@ def _gpt_pipeline_loss_packed(p, tokens_mb, targets_mb, loss_mask_mb,
     segment mask inside the pipeline body (reference packed/THD under pp)."""
     from megatronapp_tpu.parallel.pipeline import spmd_pipeline
 
+    if cfg.mtp_num_layers:
+        raise NotImplementedError(
+            "multi token prediction + sequence packing is not "
+            "supported (reference multi_token_prediction.py assert)")
     m, mb, s = tokens_mb.shape
     flat_segs = segment_ids_mb.reshape(m * mb, s)
     packed_pos = packed_position_ids(flat_segs)                # [M*mb, S]

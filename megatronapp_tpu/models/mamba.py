@@ -276,26 +276,51 @@ def mamba_loss(p, tokens, targets, loss_mask, cfg: TransformerConfig,
 
 # ---------------------------------------------------------------------------
 # Recurrent generation (reference: core/inference mamba support +
-# tools mamba text-generation server). Pure-M stacks only: hybrid
-# patterns would additionally need the attention KV cache.
+# tools mamba text-generation server). Pure-M stacks carry stacked
+# (conv_tail, ssm_h) states through a scan; hybrid stacks additionally
+# carry a per-'*'-layer attention KV cache (reference hybrid allocation
+# serves through the same inference context as attention models).
 
-def mamba_prefill(p, tokens, cfg: TransformerConfig, mcfg: MambaConfig):
+def mamba_prefill(p, tokens, cfg: TransformerConfig, mcfg: MambaConfig,
+                  max_len: Optional[int] = None):
     """Parallel-scan prefill: logits for the prompt AND the per-layer
-    decode caches (conv tails + final SSM states), stacked [L, ...]."""
-    if mcfg.hybrid_pattern and set(mcfg.hybrid_pattern) != {"M"}:
-        raise NotImplementedError(
-            "mamba generation supports pure-M stacks (hybrid layers "
-            "need an attention KV cache)")
+    decode caches. Pure-M stacks: states stacked [L, ...]. Hybrid stacks:
+    a per-layer list of ('M' conv tail + SSM state) or ('*' K/V cache of
+    length ``max_len``, which must cover prompt + generated tokens)."""
+    pattern = mcfg.hybrid_pattern or "M" * cfg.num_layers
     h = jnp.take(p["embedding"]["word"], tokens, axis=0).astype(
         cfg.compute_dtype)
 
-    def body(x, layer_p):
-        y = rms_norm(x, layer_p["ln_scale"], cfg.layernorm_epsilon)
-        out, state = mamba_mixer_forward(layer_p["mixer"], y, cfg, mcfg,
-                                         return_state=True)
-        return x + out.astype(x.dtype), state
+    if set(pattern) == {"M"}:
+        def body(x, layer_p):
+            y = rms_norm(x, layer_p["ln_scale"], cfg.layernorm_epsilon)
+            out, state = mamba_mixer_forward(layer_p["mixer"], y, cfg, mcfg,
+                                             return_state=True)
+            return x + out.astype(x.dtype), state
 
-    h, states = jax.lax.scan(body, h, p["layers"])
+        h, states = jax.lax.scan(body, h, p["layers"])
+    else:
+        from megatronapp_tpu.models.gpt import gpt_rope_tables
+        b, s = tokens.shape
+        max_len = max_len or s
+        cos_full, sin_full = gpt_rope_tables(cfg, max_len)
+        cos = None if cos_full is None else cos_full[:s]
+        sin = None if sin_full is None else sin_full[:s]
+        states = []
+        for kind, layer_p in zip(pattern, p["layers"]):
+            if kind == "M":
+                y = rms_norm(h, layer_p["ln_scale"], cfg.layernorm_epsilon)
+                out, state = mamba_mixer_forward(layer_p["mixer"], y, cfg,
+                                                 mcfg, return_state=True)
+                h = h + out.astype(h.dtype)
+            else:
+                kv = (jnp.zeros((b, max_len, cfg.num_query_groups,
+                                 cfg.head_dim), cfg.compute_dtype),
+                      jnp.zeros((b, max_len, cfg.num_query_groups,
+                                 cfg.head_dim), cfg.compute_dtype))
+                (h, state), _ = layer_forward(
+                    layer_p, h, cfg, cos, sin, kv_cache=kv, cache_index=0)
+            states.append(state)
     h = rms_norm(h, p["final_ln_scale"], cfg.layernorm_epsilon)
     dt = cfg.compute_dtype
     logits = h.astype(dt) @ p["embedding"]["word"].T.astype(dt)
@@ -303,20 +328,52 @@ def mamba_prefill(p, tokens, cfg: TransformerConfig, mcfg: MambaConfig):
 
 
 def mamba_decode_step(p, states, token, cfg: TransformerConfig,
-                      mcfg: MambaConfig):
-    """token [B] + stacked states → (logits [B,V], new states)."""
+                      mcfg: MambaConfig, cache_index=None):
+    """token [B] + per-layer states → (logits [B,V], new states).
+
+    ``cache_index`` (scalar int32, the absolute position of ``token``) is
+    required for hybrid stacks — attention layers write their KV cache and
+    select rope angles at that position; pure-M stacks ignore it."""
+    pattern = mcfg.hybrid_pattern or "M" * cfg.num_layers
     x = jnp.take(p["embedding"]["word"], token, axis=0).astype(
         cfg.compute_dtype)
 
-    def body(carry, inp):
-        x = carry
-        layer_p, (conv_buf, ssm_h) = inp
-        y = rms_norm(x, layer_p["ln_scale"], cfg.layernorm_epsilon)
-        out, new_state = mamba_mixer_step(layer_p["mixer"], conv_buf,
-                                          ssm_h, y, cfg, mcfg)
-        return x + out.astype(x.dtype), new_state
+    if set(pattern) == {"M"}:
+        def body(carry, inp):
+            x = carry
+            layer_p, (conv_buf, ssm_h) = inp
+            y = rms_norm(x, layer_p["ln_scale"], cfg.layernorm_epsilon)
+            out, new_state = mamba_mixer_step(layer_p["mixer"], conv_buf,
+                                              ssm_h, y, cfg, mcfg)
+            return x + out.astype(x.dtype), new_state
 
-    x, new_states = jax.lax.scan(body, x, (p["layers"], states))
+        x, new_states = jax.lax.scan(body, x, (p["layers"], states))
+    else:
+        from megatronapp_tpu.models.gpt import gpt_rope_tables
+        if cache_index is None:
+            raise ValueError("hybrid mamba decode requires cache_index")
+        max_len = next(s[0].shape[1] for kind, s in zip(pattern, states)
+                       if kind == "*")
+        cos_full, sin_full = gpt_rope_tables(cfg, max_len)
+        cos = None if cos_full is None else jax.lax.dynamic_slice_in_dim(
+            cos_full, cache_index, 1)
+        sin = None if sin_full is None else jax.lax.dynamic_slice_in_dim(
+            sin_full, cache_index, 1)
+        h = x[:, None]  # [B,1,H]
+        new_states = []
+        for kind, layer_p, state in zip(pattern, p["layers"], states):
+            if kind == "M":
+                y = rms_norm(h[:, 0], layer_p["ln_scale"],
+                             cfg.layernorm_epsilon)
+                out, new_state = mamba_mixer_step(
+                    layer_p["mixer"], state[0], state[1], y, cfg, mcfg)
+                h = h + out[:, None].astype(h.dtype)
+            else:
+                (h, new_state), _ = layer_forward(
+                    layer_p, h, cfg, cos, sin, kv_cache=state,
+                    cache_index=cache_index)
+            new_states.append(new_state)
+        x = h[:, 0]
     x = rms_norm(x, p["final_ln_scale"], cfg.layernorm_epsilon)
     dt = cfg.compute_dtype
     logits = x.astype(dt) @ p["embedding"]["word"].T.astype(dt)
@@ -335,10 +392,13 @@ def mamba_generate(p, prompt_tokens, cfg: TransformerConfig,
 
     from megatronapp_tpu.inference.engine import mask_padded_vocab
 
+    prompt_len = prompt_tokens.shape[1]
+    max_len = prompt_len + max_new_tokens
     prefill = jax.jit(
-        lambda p, t: mamba_prefill(p, t, cfg, mcfg))
+        lambda p, t: mamba_prefill(p, t, cfg, mcfg, max_len=max_len))
     step = jax.jit(
-        lambda p, s, t: mamba_decode_step(p, s, t, cfg, mcfg),
+        lambda p, s, t, i: mamba_decode_step(p, s, t, cfg, mcfg,
+                                             cache_index=i),
         donate_argnums=(1,))
 
     logits, states = prefill(p, prompt_tokens)
@@ -356,6 +416,7 @@ def mamba_generate(p, prompt_tokens, cfg: TransformerConfig,
         out.append(np.asarray(token)[:, None])
         if token_callback is not None:
             token_callback(np.asarray(token))
-        next_logits, states = step(p, states, token)
+        next_logits, states = step(p, states, token,
+                                   jnp.int32(prompt_len + i))
         next_logits = mask_padded_vocab(next_logits, cfg)
     return np.concatenate(out, axis=1)

@@ -43,12 +43,62 @@ def _mask_rows(x, start, limit):
     idx = start + jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0)
     return jnp.where(idx < limit, x, jnp.zeros_like(x))
 
+def _valid_mask(q_start, k_start, block_q, block_kv, seq_q, seq_kv,
+                causal, bounded, qs_ref, ks_ref):
+    """[bq, bkv] validity mask with only the statically-needed terms:
+    bounds checks when the sequence doesn't divide the block, the causal
+    triangle, and packed-segment equality."""
+    rows = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 0)
+    cols = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1)
+    if bounded:
+        valid = (rows < seq_q) & (cols < seq_kv)
+        if causal:
+            valid = valid & (rows >= cols)
+    else:
+        valid = rows >= cols if causal else jnp.ones(
+            (block_q, block_kv), jnp.bool_)
+    if qs_ref is not None:
+        # Packed sequences: attend within-segment only (segment ids
+        # [bq,1] vs [1,bkv] broadcast to the score block).
+        valid = valid & (qs_ref[0] == ks_ref[0])
+    return valid
+
+
+def _dispatch_tiles(compute, causal, edge_mask, q_start, k_start,
+                    block_q, block_kv):
+    """Shared tile dispatch for all three kernels: skip tiles entirely
+    above the causal diagonal, and route interior tiles (strictly below
+    the diagonal, in-bounds, no segment ids) to compute(masked=False) —
+    skipping the iota/compare/select chain on [bq, bkv] is the kernels'
+    main VPU saving."""
+    if causal:
+        if edge_mask:
+            @pl.when(q_start + block_q - 1 >= k_start)
+            def _():
+                compute(True)
+        else:
+            interior = q_start >= k_start + block_kv
+
+            @pl.when(interior)
+            def _():
+                compute(False)
+
+            @pl.when(jnp.logical_not(interior)
+                     & (q_start + block_q - 1 >= k_start))
+            def _():
+                compute(True)
+    else:
+        compute(edge_mask)
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(*refs, scale, causal, block_q, block_kv,
-                num_kv, seq_q, seq_kv, has_segs):
+                num_kv, seq_q, seq_kv, has_segs, bounded):
     if has_segs:
         (q_ref, k_ref, v_ref, qs_ref, ks_ref,
          o_ref, lse_ref, acc, m_scr, l_scr) = refs
@@ -67,48 +117,40 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_kv,
     q_start = iq * block_q
     k_start = ik * block_kv
 
-    def compute():
-        q = _mask_rows(q_ref[0, 0].astype(jnp.float32) * scale,
-                       q_start, seq_q)                # [bq, D]
-        k = _mask_rows(k_ref[0, 0], k_start, seq_kv)  # [bkv, D]
+    def compute(masked):
+        q = q_ref[0, 0].astype(jnp.float32) * scale   # [bq, D]
+        k = k_ref[0, 0]                               # [bkv, D]
+        v = v_ref[0, 0]                               # [bkv, D]
+        if bounded:
+            q = _mask_rows(q, q_start, seq_q)
+            k = _mask_rows(k, k_start, seq_kv)
+            v = _mask_rows(v, k_start, seq_kv)
         s = jax.lax.dot_general(
             q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bq, bkv]
-        rows = q_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 0)
-        cols = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 1)
-        valid = (rows < seq_q) & (cols < seq_kv)
-        if causal:
-            valid = valid & (rows >= cols)
-        if qs_ref is not None:
-            # Packed sequences: attend within-segment only (segment ids
-            # [bq,1] vs [1,bkv] broadcast to the score block).
-            valid = valid & (qs_ref[0] == ks_ref[0])
-        s = jnp.where(valid, s, _NEG_INF)
+        if masked:
+            valid = _valid_mask(q_start, k_start, block_q, block_kv,
+                                seq_q, seq_kv, causal, bounded,
+                                qs_ref, ks_ref)
+            s = jnp.where(valid, s, _NEG_INF)
 
         m_prev = m_scr[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
         m_safe = jnp.maximum(m_new, _NEG_INF / 2)
         p = jnp.exp(s - m_safe[:, None])
-        p = jnp.where(valid, p, 0.0)
+        if masked:
+            p = jnp.where(valid, p, 0.0)
         corr = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
         corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
         l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=1)
-        v = _mask_rows(v_ref[0, 0], k_start, seq_kv)  # [bkv, D]
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         acc[:] = acc[:] * corr[:, None] + pv
         m_scr[:, 0] = m_new
 
-    if causal:
-        # Skip tiles entirely above the diagonal.
-        @pl.when(q_start + block_q - 1 >= k_start)
-        def _():
-            compute()
-    else:
-        compute()
+    _dispatch_tiles(compute, causal, bounded or has_segs, q_start, k_start,
+                    block_q, block_kv)
 
     @pl.when(ik == num_kv - 1)
     def _finalize():
@@ -134,7 +176,8 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_kv, segs=None):
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_kv=block_kv, num_kv=nk, seq_q=sq, seq_kv=skv,
-        has_segs=segs is not None)
+        has_segs=segs is not None,
+        bounded=(sq % block_q != 0) or (skv % block_kv != 0))
 
     in_specs = [
             pl.BlockSpec((1, 1, block_q, d),
@@ -184,7 +227,7 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_kv, segs=None):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(*refs, scale, causal, block_q, block_kv, num_kv,
-                   seq_q, seq_kv, has_segs):
+                   seq_q, seq_kv, has_segs, bounded):
     if has_segs:
         (q_ref, k_ref, v_ref, qs_ref, ks_ref, do_ref, lse_ref, delta_ref,
          dq_ref, dq_acc) = refs
@@ -202,41 +245,38 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_kv, num_kv,
     q_start = iq * block_q
     k_start = ik * block_kv
 
-    def compute():
+    def compute(masked):
         q = q_ref[0, 0].astype(jnp.float32) * scale
-        k = _mask_rows(k_ref[0, 0], k_start, seq_kv)
-        v = _mask_rows(v_ref[0, 0], k_start, seq_kv)
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        if bounded:
+            k = _mask_rows(k, k_start, seq_kv)
+            v = _mask_rows(v, k_start, seq_kv)
         do = do_ref[0, 0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, 0]
         delta = delta_ref[0, 0][:, 0]
 
         s = jax.lax.dot_general(q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        rows = q_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 0)
-        cols = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 1)
-        valid = (rows < seq_q) & (cols < seq_kv)
-        if causal:
-            valid = valid & (rows >= cols)
-        if qs_ref is not None:
-            valid = valid & (qs_ref[0] == ks_ref[0])
-        s = jnp.where(valid, s, _NEG_INF)
-        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(do.astype(v.dtype), v,
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = jnp.where(valid, p * (dp - delta[:, None]), 0.0)
+        if masked:
+            valid = _valid_mask(q_start, k_start, block_q, block_kv,
+                                seq_q, seq_kv, causal, bounded,
+                                qs_ref, ks_ref)
+            s = jnp.where(valid, s, _NEG_INF)
+            p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+            ds = jnp.where(valid, p * (dp - delta[:, None]), 0.0)
+        else:
+            p = jnp.exp(s - lse[:, None])
+            ds = p * (dp - delta[:, None])
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    if causal:
-        @pl.when(q_start + block_q - 1 >= k_start)
-        def _():
-            compute()
-    else:
-        compute()
+    _dispatch_tiles(compute, causal, bounded or has_segs, q_start, k_start,
+                    block_q, block_kv)
 
     @pl.when(ik == num_kv - 1)
     def _finalize():
@@ -244,7 +284,8 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_kv, num_kv,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal,
-                    block_q, block_kv, num_q, seq_q, seq_kv, has_segs):
+                    block_q, block_kv, num_q, seq_q, seq_kv, has_segs,
+                    bounded):
     if has_segs:
         (q_ref, k_ref, v_ref, qs_ref, ks_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
@@ -263,47 +304,45 @@ def _bwd_dkv_kernel(*refs, scale, causal,
     q_start = iq * block_q
     k_start = ik * block_kv
 
-    def compute():
-        q = _mask_rows(q_ref[0, 0].astype(jnp.float32) * scale,
-                       q_start, seq_q)
-        k = _mask_rows(k_ref[0, 0], k_start, seq_kv)
-        v = _mask_rows(v_ref[0, 0], k_start, seq_kv)
-        do = _mask_rows(do_ref[0, 0].astype(jnp.float32), q_start, seq_q)
+    def compute(masked):
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        if bounded:
+            q = _mask_rows(q, q_start, seq_q)
+            k = _mask_rows(k, k_start, seq_kv)
+            v = _mask_rows(v, k_start, seq_kv)
+            do = _mask_rows(do, q_start, seq_q)
         lse = lse_ref[0, 0][:, 0]
         delta = delta_ref[0, 0][:, 0]
 
         s = jax.lax.dot_general(q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        rows = q_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 0)
-        cols = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_kv), 1)
-        valid = (rows < seq_q) & (cols < seq_kv)
-        if causal:
-            valid = valid & (rows >= cols)
-        if qs_ref is not None:
-            valid = valid & (qs_ref[0] == ks_ref[0])
-        s = jnp.where(valid, s, _NEG_INF)
-        p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)  # [bq, bkv]
+        dp = jax.lax.dot_general(do.astype(v.dtype), v,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if masked:
+            valid = _valid_mask(q_start, k_start, block_q, block_kv,
+                                seq_q, seq_kv, causal, bounded,
+                                qs_ref, ks_ref)
+            s = jnp.where(valid, s, _NEG_INF)
+            p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+            ds = jnp.where(valid, p * (dp - delta[:, None]), 0.0)
+        else:
+            p = jnp.exp(s - lse[:, None])          # [bq, bkv]
+            ds = p * (dp - delta[:, None])         # [bq, bkv]
         # dv += p^T @ do
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do.astype(v.dtype), v,
-                                 (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = jnp.where(valid, p * (dp - delta[:, None]), 0.0)  # [bq, bkv]
         # dk += ds^T @ q * scale (q already has scale folded in → use raw q)
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(q_start + block_q - 1 >= k_start)
-        def _():
-            compute()
-    else:
-        compute()
+    _dispatch_tiles(compute, causal, bounded or has_segs, q_start, k_start,
+                    block_q, block_kv)
 
     @pl.when(iq == num_q - 1)
     def _finalize():
@@ -320,6 +359,7 @@ def _flash_backward(res, g, scale, causal, block_q, block_kv, segs=None):
     block_kv = min(block_kv, skv)
     nq = _cdiv(sq, block_q)
     nk = _cdiv(skv, block_kv)
+    bounded = (sq % block_q != 0) or (skv % block_kv != 0)
 
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)  # [B,H,Sq]
@@ -356,7 +396,8 @@ def _flash_backward(res, g, scale, causal, block_q, block_kv, segs=None):
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_kv=block_kv, num_kv=nk,
-                          seq_q=sq, seq_kv=skv, has_segs=segs is not None),
+                          seq_q=sq, seq_kv=skv, has_segs=segs is not None,
+                          bounded=bounded),
         grid=(b, h, nq, nk),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d),
@@ -399,7 +440,8 @@ def _flash_backward(res, g, scale, causal, block_q, block_kv, segs=None):
     dk_full, dv_full = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_kv=block_kv, num_q=nq,
-                          seq_q=sq, seq_kv=skv, has_segs=segs is not None),
+                          seq_q=sq, seq_kv=skv, has_segs=segs is not None,
+                          bounded=bounded),
         grid=(b, h, nk, nq),
         in_specs=dkv_in_specs,
         out_specs=[

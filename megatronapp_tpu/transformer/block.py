@@ -86,7 +86,7 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
             attn_out, new_cache = mla_forward(
                 p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
                 layer_id=layer_id, ctx=ctx, kv_cache=kv_cache,
-                cache_index=cache_index)
+                cache_index=cache_index, cache_positions=cache_positions)
         else:
             attn_out = mla_forward(
                 p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,

@@ -70,11 +70,17 @@ def init_mla_params(rng, cfg: TransformerConfig, out_std: float):
 def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                 rope_cos=None, rope_sin=None,
                 attention_mask: Optional[jnp.ndarray] = None,
-                layer_id=None, ctx=None, kv_cache=None, cache_index=None):
+                layer_id=None, ctx=None, kv_cache=None, cache_index=None,
+                cache_positions=None):
     """kv_cache: optional (latent_cache [B, Smax, kv_lora_rank],
     kpe_cache [B, Smax, dpe]) — the COMPRESSED decode cache (the latent +
     shared roped key; reference MLA's defining cache shape). Returns
     (out, new_cache) when caching, else out.
+
+    cache_positions: optional [B] int32 per-row write positions for
+    continuous-batching decode (dynamic_context.py analogue) — each row
+    appends its latent/k_pe at ITS OWN position; causality must then come
+    from the caller's per-row attention_mask.
 
     Decode recomputes k_nope/v from the cached latent via kv_up each step
     (the storage-optimal variant; weight absorption into q is a further
@@ -109,20 +115,33 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
         k_pe = rotary.apply_rope(k_pe[:, :, None, :], rope_cos,
                                  rope_sin)[:, :, 0]
 
+    from megatronapp_tpu.config.transformer_config import AttnMaskType
     new_cache = None
     s_kv = s
+    mask_type = cfg.attn_mask_type
+    q_offset = 0
     if kv_cache is not None:
         if ctx is not None and ctx.cp > 1:
             raise NotImplementedError(
                 "MLA decode with a KV cache under context parallelism is "
                 "not supported (each shard would attend only local KV)")
-        # Append the normed latent + roped shared key at cache_index; the
-        # whole cached history reconstitutes k_nope/v below.
         c_lat, c_pe = kv_cache
-        c_lat = jax.lax.dynamic_update_slice_in_dim(
-            c_lat, latent.astype(c_lat.dtype), cache_index, axis=1)
-        c_pe = jax.lax.dynamic_update_slice_in_dim(
-            c_pe, k_pe.astype(c_pe.dtype), cache_index, axis=1)
+        if cache_positions is not None:
+            # Continuous-batching decode: per-row append positions;
+            # causality comes from the caller's per-row mask.
+            c_lat = c_lat.at[jnp.arange(b), cache_positions].set(
+                latent[:, 0].astype(c_lat.dtype))
+            c_pe = c_pe.at[jnp.arange(b), cache_positions].set(
+                k_pe[:, 0].astype(c_pe.dtype))
+            mask_type = AttnMaskType.bidirectional
+        else:
+            # Append the normed latent + roped shared key at cache_index;
+            # the whole cached history reconstitutes k_nope/v below.
+            c_lat = jax.lax.dynamic_update_slice_in_dim(
+                c_lat, latent.astype(c_lat.dtype), cache_index, axis=1)
+            c_pe = jax.lax.dynamic_update_slice_in_dim(
+                c_pe, k_pe.astype(c_pe.dtype), cache_index, axis=1)
+            q_offset = cache_index
         new_cache = (c_lat, c_pe)
         latent, k_pe = c_lat.astype(dt), c_pe.astype(dt)
         s_kv = latent.shape[1]
@@ -167,10 +186,10 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
             a2a_size=cfg.hierarchical_cp_a2a_size)
     else:
         out = dot_product_attention(
-            q_full, k_full, v, mask_type=cfg.attn_mask_type,
+            q_full, k_full, v, mask_type=mask_type,
             attention_mask=attention_mask, softmax_scale=scale,
             softmax_in_fp32=cfg.attention_softmax_in_fp32,
-            q_offset=0 if cache_index is None else cache_index)
+            q_offset=q_offset)
     out = scope_capture("context", out, layer_id)
     out = out.reshape(b, s, nq * dv) @ _dist.apply(
         "weight", p["out_kernel"], layer_id).astype(dt)

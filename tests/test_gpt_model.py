@@ -338,13 +338,39 @@ class TestMTP:
     def test_mtp_guards(self):
         import pytest as _pytest
         cfg = self.cfg()
-        with _pytest.raises(NotImplementedError):
-            init_gpt_params(jax.random.PRNGKey(0), cfg, pp=2)
         p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
         toks = jnp.zeros((1, 16), jnp.int32)
         seg = jnp.zeros((1, 16), jnp.int32)
         with _pytest.raises(NotImplementedError):
             gpt_loss(p, toks, toks, None, cfg, segment_ids=seg)
+
+    def test_mtp_under_pp_matches_dense(self, devices8):
+        """MTP under pipeline parallelism (round-1 raise lifted): the depth
+        modules run on the last-stage output outside the pp body, like the
+        head — total loss bit-matches the single-mesh MTP run."""
+        from megatronapp_tpu.config.parallel_config import ParallelConfig
+        from megatronapp_tpu.models.gpt import gpt_pipeline_loss
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        cfg = self.cfg()
+        rng = np.random.default_rng(0)
+        M, mb, s = 2, 2, 16
+        tokens = jnp.asarray(rng.integers(0, 128, (M, mb, s)), jnp.int32)
+        labels = jnp.asarray(np.roll(np.asarray(tokens), -1, 2))
+        mask = jnp.ones((M, mb, s), jnp.float32)
+        p_flat, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+        per_mb = [gpt_loss(p_flat, tokens[i], labels[i], mask[i], cfg)
+                  for i in range(M)]
+        ref = float(np.mean([float(l) for l, _ in per_mb]))
+        ref_mtp = float(np.mean([float(m["mtp_loss"]) for _, m in per_mb]))
+        par = ParallelConfig(pipeline_parallel=2)
+        ctx = build_mesh(par, devices=devices8[:2])
+        p_pipe, _ = init_gpt_params(jax.random.PRNGKey(0), cfg, pp=2)
+        with ctx.mesh:
+            loss, m = jax.jit(lambda q: gpt_pipeline_loss(
+                q, tokens, labels, mask, cfg, ctx))(p_pipe)
+        np.testing.assert_allclose(float(loss), ref, atol=5e-5)
+        np.testing.assert_allclose(float(m["mtp_loss"]), ref_mtp,
+                                   atol=5e-5)
 
 
 class TestMoELayerFreqPipeline:

@@ -220,6 +220,42 @@ class TestDynamicEngine:
                 toks = np.concatenate([toks, [[nxt]]], axis=1)
             assert res[rid].tolist() == toks[0].tolist()
 
+    def test_mla_dynamic_batching_matches_oracle(self):
+        """MLA under continuous batching (round-1 guard lifted): per-row
+        compressed-latent cache appends reproduce per-request greedy
+        oracles across interleaved mixed-length requests."""
+        from megatronapp_tpu.inference.dynamic_engine import (
+            DynamicInferenceEngine,
+        )
+        from megatronapp_tpu.inference.engine import SamplingParams
+        from megatronapp_tpu.models.gpt import gpt_forward, init_gpt_params
+
+        cfg = TransformerConfig(
+            num_layers=2, hidden_size=64, num_attention_heads=4,
+            vocab_size=128, max_position_embeddings=64,
+            multi_latent_attention=True, kv_lora_rank=32, qk_head_dim=16,
+            qk_pos_emb_head_dim=8, v_head_dim=16,
+            compute_dtype=jnp.float32, remat_policy="none")
+        params, _ = init_gpt_params(jax.random.PRNGKey(7), cfg)
+        eng = DynamicInferenceEngine(params, cfg, max_batch=2,
+                                     max_seq_len=48,
+                                     prefill_buckets=(16,))
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, 128, n).astype(np.int32)
+                   for n in (5, 9, 3)]
+        ids = [eng.add_request(p, max_new_tokens=5,
+                               sampling=SamplingParams(greedy=True))
+               for p in prompts]
+        res = eng.run_to_completion()
+        assert set(res) == set(ids)
+        for p, rid in zip(prompts, ids):
+            toks = p[None].copy()
+            for _ in range(5):
+                logits, _ = gpt_forward(params, jnp.asarray(toks), cfg)
+                nxt = int(jnp.argmax(logits[0, -1]))
+                toks = np.concatenate([toks, [[nxt]]], axis=1)
+            assert res[rid].tolist() == toks[0].tolist()
+
     def test_admission_interleaves_midflight(self):
         """A request added while others are decoding joins as soon as a
         slot frees, without draining the batch."""

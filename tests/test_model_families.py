@@ -510,11 +510,41 @@ class TestMambaGeneration:
         out2 = mamba_generate(p, prompt, cfg, mcfg, max_new_tokens=5)
         np.testing.assert_array_equal(out, out2)
 
-    def test_hybrid_pattern_raises(self):
-        import pytest as _pytest
+    def test_hybrid_decode_matches_forward(self):
+        """Hybrid (mamba + attention) stack: recurrent decode with the
+        attention KV cache must reproduce the full forward."""
+        from megatronapp_tpu.models.mamba import (
+            mamba_decode_step, mamba_prefill,
+        )
+        cfg = TransformerConfig(
+            num_layers=3, hidden_size=32, num_attention_heads=4,
+            vocab_size=64, max_position_embeddings=32,
+            compute_dtype=jnp.float32, remat_policy="none")
+        mcfg = MambaConfig(state_dim=8, hybrid_pattern="M*M")
+        p, _ = init_mamba_params(jax.random.PRNGKey(5), cfg, mcfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 9), 0, 64)
+        full = np.asarray(mamba_forward(p, tokens, cfg, mcfg))
+        logits, states = mamba_prefill(p, tokens[:, :5], cfg, mcfg,
+                                       max_len=9)
+        np.testing.assert_allclose(np.asarray(logits), full[:, :5],
+                                   rtol=2e-4, atol=2e-4)
+        for pos in range(5, 9):
+            step_logits, states = mamba_decode_step(
+                p, states, tokens[:, pos], cfg, mcfg,
+                cache_index=jnp.int32(pos))
+            np.testing.assert_allclose(
+                np.asarray(step_logits), full[:, pos],
+                rtol=2e-4, atol=2e-4, err_msg=f"pos {pos}")
 
-        from megatronapp_tpu.models.mamba import mamba_prefill
-        cfg, mcfg, p = self._setup()
-        mcfg2 = MambaConfig(state_dim=8, hybrid_pattern="M*")
-        with _pytest.raises(NotImplementedError):
-            mamba_prefill(p, jnp.zeros((1, 4), jnp.int32), cfg, mcfg2)
+    def test_hybrid_generate_api(self):
+        from megatronapp_tpu.models.mamba import mamba_generate
+        cfg = TransformerConfig(
+            num_layers=2, hidden_size=32, num_attention_heads=4,
+            vocab_size=64, max_position_embeddings=32,
+            compute_dtype=jnp.float32, remat_policy="none")
+        mcfg = MambaConfig(state_dim=8, hybrid_pattern="M*")
+        p, _ = init_mamba_params(jax.random.PRNGKey(6), cfg, mcfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0, 64)
+        out = mamba_generate(p, prompt, cfg, mcfg, max_new_tokens=5)
+        assert out.shape == (2, 9)
+        np.testing.assert_array_equal(out[:, :4], np.asarray(prompt))
