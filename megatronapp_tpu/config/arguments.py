@@ -102,6 +102,16 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     g.add_argument("--forward-backward-disaggregating", action="store_true")
     g.add_argument("--use-dpp", action="store_true",
                    help="breadth-first-chunk pipeline order (MegaDPP)")
+    # Multi-host runtime (reference torchrun MASTER_ADDR/RANK/WORLD_SIZE →
+    # jax.distributed; auto-detected on TPU pods).
+    g.add_argument("--multi-host", action="store_true",
+                   help="join the jax.distributed multi-host runtime "
+                        "before building the mesh (auto-detects "
+                        "coordinator on TPU pods)")
+    g.add_argument("--coordinator-address", default=None,
+                   help="host:port of process 0 (manual launches)")
+    g.add_argument("--num-processes", type=int, default=None)
+    g.add_argument("--process-id", type=int, default=None)
 
     g = ap.add_argument_group("training")  # _add_training_args parity
     g.add_argument("--micro-batch-size", type=int, default=1)
@@ -218,7 +228,16 @@ def parse_args(ap: argparse.ArgumentParser, argv=None):
         if unknown:
             raise ValueError(f"unknown config keys: {unknown}")
         ap.set_defaults(**defaults)
-    return ap.parse_args(argv)
+    args = ap.parse_args(argv)
+    if getattr(args, "multi_host", False):
+        # Join the multi-host runtime before anything touches the backend
+        # (parse_args itself never does). Checked on the FINAL namespace so
+        # --multi-host works from the CLI, --config-yaml, and
+        # --use-checkpoint-args restores alike.
+        from megatronapp_tpu.parallel.mesh import initialize_multi_host
+        initialize_multi_host(args.coordinator_address,
+                              args.num_processes, args.process_id)
+    return args
 
 
 def _flags_from_yaml(path: str) -> dict:

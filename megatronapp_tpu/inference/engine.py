@@ -246,9 +246,17 @@ class MambaInferenceEngine:
         self.mcfg = mcfg
         self.tokenizer = tokenizer
         # Mamba has no positional embeddings — an operator may serve
-        # beyond the training context via --max-seq-len. (Hybrid stacks
-        # with rope attention layers stay within rope table range.)
+        # beyond the training context via --max-seq-len. Hybrid stacks
+        # contain rope attention layers, so there the trained position
+        # range is a hard bound.
         self.max_seq_len = max_seq_len or cfg.max_position_embeddings
+        pattern = mcfg.hybrid_pattern or ""
+        if set(pattern) - {"M"} and (
+                self.max_seq_len > cfg.max_position_embeddings):
+            raise ValueError(
+                f"hybrid mamba stack: max_seq_len ({self.max_seq_len}) "
+                "exceeds the attention layers' trained position range "
+                f"({cfg.max_position_embeddings})")
         # jit once per engine — per-request lambdas would re-trace and
         # recompile every call.
         self._prefill = jax.jit(

@@ -94,6 +94,43 @@ class MeshContext:
             yield self
 
 
+def initialize_multi_host(coordinator_address: Optional[str] = None,
+                          num_processes: Optional[int] = None,
+                          process_id: Optional[int] = None) -> None:
+    """Join the multi-host runtime (reference
+    torch.distributed.init_process_group, training/initialize.py:330-335;
+    here ``jax.distributed.initialize`` — the JAX runtime then exposes one
+    global ``jax.devices()`` list spanning all hosts, and XLA routes
+    inter-slice collectives over DCN).
+
+    On TPU pods (GKE/queued resources) all three arguments auto-detect from
+    the metadata server; pass them explicitly for manual launches
+    (reference MASTER_ADDR/RANK/WORLD_SIZE env). Safe to call once per
+    process, before any other jax API touches the backend."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def _dcn_slice_axis(shape: Sequence[int], n_slices: int) -> int:
+    """Pick the mesh axis to split across DCN slices: the OUTERMOST of
+    pp/dp/ep whose degree n_slices divides (axis order pp, dp, ep, cp, tp
+    — pipeline stages or data-parallel replicas span slices; cp/tp
+    collectives are latency-critical and must stay on intra-slice ICI,
+    the reference's NCCL-topology preference)."""
+    for i, extent in enumerate(shape[:3]):  # pp, dp, ep only
+        if extent > 1 and extent % n_slices == 0:
+            return i
+    raise ValueError(
+        f"no pp/dp/ep mesh axis in {tuple(shape)} divisible by {n_slices} "
+        "DCN slices; choose pp/dp degrees that factor across slices")
+
+
 def build_mesh(parallel: ParallelConfig,
                devices: Optional[Sequence[jax.Device]] = None) -> MeshContext:
     """Build the mesh with axis order pp, dp, ep, cp, tp (outer→inner).
@@ -102,11 +139,37 @@ def build_mesh(parallel: ParallelConfig,
     links; PP outermost lets pipeline stages span slices over DCN — the
     reference encodes the same locality preference via RankGenerator order
     tp-cp-ep-dp-pp (parallel_state.py).
-    """
+
+    On real TPU the device array is laid out topology-aware: within one
+    slice via ``mesh_utils.create_device_mesh`` (ICI torus assignment), and
+    across slices via ``create_hybrid_device_mesh`` with the slice count on
+    the outermost divisible axis (DCN traffic rides pp/dp, never tp).
+    Virtual/CPU devices keep the plain deterministic reshape (tests)."""
     if devices is None:
         devices = jax.devices()
     shape = parallel.mesh_shape(len(devices))
-    dev_array = np.asarray(devices).reshape(shape)
+    if getattr(devices[0], "platform", None) == "tpu":
+        from jax.experimental import mesh_utils
+        slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+        if len(slice_ids) > 1:
+            # Raises (with a config suggestion) when no pp/dp/ep axis
+            # factors across the slices — a misconfigured multi-slice job
+            # must fail loudly, not silently put tp/cp on DCN.
+            dcn = [1] * len(shape)
+            dcn[_dcn_slice_axis(shape, len(slice_ids))] = len(slice_ids)
+            per_slice = [s // d for s, d in zip(shape, dcn)]
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                per_slice, dcn, devices=devices)
+        else:
+            try:
+                dev_array = mesh_utils.create_device_mesh(
+                    shape, devices=devices)
+            except (ValueError, NotImplementedError):
+                # Unusual topologies (e.g. subset meshes) — fall back to
+                # the enumeration order, which jax topology-sorts.
+                dev_array = np.asarray(devices).reshape(shape)
+    else:
+        dev_array = np.asarray(devices).reshape(shape)
     mesh = Mesh(dev_array, MESH_AXES)
     return MeshContext(mesh=mesh, parallel=parallel)
 

@@ -432,3 +432,19 @@ class TestChipRTTProbe:
                               rtts[0]["rtt_ms"] * 100}]
         assert any(r["device"] == "slow"
                    for r in detect_slow_chips(rigged, 2.0))
+
+
+class TestDCNMeshLayout:
+    def test_slice_axis_prefers_outermost_divisible(self):
+        """DCN slices split the outermost divisible axis (pp first, then
+        dp) so tp/cp collectives never cross slices."""
+        from megatronapp_tpu.parallel.mesh import _dcn_slice_axis
+        # (pp, dp, ep, cp, tp)
+        assert _dcn_slice_axis((4, 2, 1, 1, 8), 2) == 0   # pp spans DCN
+        assert _dcn_slice_axis((1, 8, 1, 1, 4), 2) == 1   # dp spans DCN
+        assert _dcn_slice_axis((2, 4, 1, 1, 1), 4) == 1   # pp=2 not /4 → dp
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            _dcn_slice_axis((1, 3, 1, 1, 4), 2)           # tp never splits?
+        with _pytest.raises(ValueError):
+            _dcn_slice_axis((1, 1, 1, 1, 1), 2)
