@@ -377,11 +377,12 @@ def hierarchical_attention(q, k, v, axis_name: str = CP_AXIS,
     ring rotates K/V spans with group-granular causal skipping (diagonal
     span gets the within-span causal mask, earlier spans are fully
     visible). Requires heads % a2a_size == 0 and contiguous cp sharding.
+
+    segment_ids (packed/THD): the local [B, S/cp] ids are all-gathered to
+    the inner group's span (positions, not heads, so no head scatter) and
+    the K/V spans' ids ride the outer ring with them; the within-segment
+    equality mask composes with the group-granular causal mask per block.
     """
-    if segment_ids is not None:
-        raise NotImplementedError(
-            "packed sequences under hierarchical (a2a+p2p) cp are not "
-            "supported; use 'p2p' or 'a2a'")
     cp = jax.lax.axis_size(axis_name)
     assert cp % a2a_size == 0, (cp, a2a_size)
     ring_size = cp // a2a_size
@@ -402,6 +403,13 @@ def hierarchical_attention(q, k, v, axis_name: str = CP_AXIS,
                                   axis_index_groups=inner_groups)
 
     q, k, v = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    segs = None
+    if segment_ids is not None:
+        # Segment ids are per-position: gather the inner group's span
+        # ([B, S/cp] → [B, S/ring]) instead of head-scattering.
+        segs = jax.lax.all_gather(segment_ids, axis_name, axis=1,
+                                  tiled=True,
+                                  axis_index_groups=inner_groups)
     b, sq, h, d = q.shape
     dv = v.shape[-1]
     if softmax_scale is None:
@@ -410,8 +418,9 @@ def hierarchical_attention(q, k, v, axis_name: str = CP_AXIS,
     # inner position, next group) — each hop moves one sequence span.
     perm = [(r, (r + a2a_size) % cp) for r in range(cp)]
 
-    def block_update(o, m, l, k_blk, v_blk, src_group):
+    def block_update(o, m, l, k_blk, v_blk, src_group, kv_segs_blk):
         s_ = _block_scores(q, repeat_kv(k_blk, h), softmax_scale)
+        blk_mask = None                      # [sq, skv] or [B, sq, skv]
         if causal:
             q_pos = jnp.arange(sq)
             kv_pos = jnp.arange(k_blk.shape[1])
@@ -419,12 +428,18 @@ def hierarchical_attention(q, k, v, axis_name: str = CP_AXIS,
             blk_mask = jnp.where(
                 src_group == my_group, within,
                 jnp.broadcast_to(src_group < my_group, within.shape))
-            s_ = jnp.where(blk_mask[None, None], s_, _NEG_INF)
+        if kv_segs_blk is not None:
+            seg_m = segs[:, :, None] == kv_segs_blk[:, None, :]
+            blk_mask = (seg_m if blk_mask is None
+                        else seg_m & blk_mask[None])
+        if blk_mask is not None:
+            mask_b = blk_mask if blk_mask.ndim == 3 else blk_mask[None]
+            s_ = jnp.where(mask_b[:, None], s_, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s_, axis=-1))
         m_safe = jnp.maximum(m_new, _NEG_INF / 2)
         pr = jnp.exp(s_ - m_safe[..., None])
-        if causal:
-            pr = jnp.where(blk_mask[None, None], pr, 0.0)
+        if blk_mask is not None:
+            pr = jnp.where(mask_b[:, None], pr, 0.0)
         corr = jnp.exp(jnp.minimum(m - m_new, 0.0))
         corr = jnp.where(m <= _NEG_INF / 2, 0.0, corr)
         l = l * corr + jnp.sum(pr, axis=-1)
@@ -440,19 +455,30 @@ def hierarchical_attention(q, k, v, axis_name: str = CP_AXIS,
     o = zeros_like_vma((b, h, sq, dv), jnp.float32, q)
     m = full_like_vma((b, h, sq), _NEG_INF, jnp.float32, q)
     l = zeros_like_vma((b, h, sq), jnp.float32, q)
-    o, m, l = block_update(o, m, l, k, v, my_group)
+    o, m, l = block_update(o, m, l, k, v, my_group, segs)
 
     def body(carry, step):
-        o, m, l, k_blk, v_blk = carry
+        if segs is None:
+            o, m, l, k_blk, v_blk = carry
+            kv_segs_blk = None
+        else:
+            o, m, l, k_blk, v_blk, kv_segs_blk = carry
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        if kv_segs_blk is not None:
+            kv_segs_blk = jax.lax.ppermute(kv_segs_blk, axis_name, perm)
         src_group = (my_group - step) % ring_size
-        o, m, l = block_update(o, m, l, k_blk, v_blk, src_group)
-        return (o, m, l, k_blk, v_blk), None
+        o, m, l = block_update(o, m, l, k_blk, v_blk, src_group,
+                               kv_segs_blk)
+        new_carry = ((o, m, l, k_blk, v_blk) if segs is None
+                     else (o, m, l, k_blk, v_blk, kv_segs_blk))
+        return new_carry, None
 
     if ring_size > 1:
-        (o, m, l, _, _), _ = jax.lax.scan(body, (o, m, l, k, v),
-                                          jnp.arange(1, ring_size))
+        init = ((o, m, l, k, v) if segs is None
+                else (o, m, l, k, v, segs))
+        carry, _ = jax.lax.scan(body, init, jnp.arange(1, ring_size))
+        o, m, l = carry[0], carry[1], carry[2]
     out = o / jnp.maximum(l, 1e-20)[..., None]
     out = jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
     return gather_heads(out)

@@ -213,6 +213,43 @@ class TestHierarchicalCP:
         np.testing.assert_allclose(np.asarray(f(q, k, v)),
                                    np.asarray(ref), atol=3e-5)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_packed_matches_dense(self, devices8, causal):
+        """Packed sequences under a2a+p2p (round-1 guard lifted): segment
+        ids gather to the inner-group span and ride the outer ring; output
+        matches the dense segment-masked oracle."""
+        from jax.sharding import PartitionSpec as P
+        from megatronapp_tpu.config.transformer_config import AttnMaskType
+        from megatronapp_tpu.ops.context_parallel import (
+            hierarchical_attention,
+        )
+        cp, a2a_size = 8, 2
+        mesh = jax.sharding.Mesh(np.array(devices8[:cp]), ("cp",))
+        b, s, h, d = 2, 8 * cp, 8, 16
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, s, h, d))
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d))
+        rng = np.random.default_rng(3)
+        segs = np.zeros((b, s), np.int32)
+        for i in range(b):
+            bounds = np.sort(rng.choice(np.arange(4, s - 2), 3,
+                                        replace=False))
+            segs[i] = np.searchsorted(bounds, np.arange(s), side="right")
+        segs = jnp.asarray(segs)
+        seg_mask = (segs[:, None, :, None] == segs[:, None, None, :])
+        ref = dot_product_attention(
+            q, k, v, mask_type=(AttnMaskType.causal if causal
+                                else AttnMaskType.bidirectional),
+            attention_mask=seg_mask)
+        f = jax.jit(jax.shard_map(
+            lambda a, b_, c, sg: hierarchical_attention(
+                a, b_, c, axis_name="cp", causal=causal,
+                a2a_size=a2a_size, segment_ids=sg),
+            mesh=mesh, in_specs=(P(None, "cp"),) * 3 + (P(None, "cp"),),
+            out_specs=P(None, "cp"), axis_names={"cp"}))
+        np.testing.assert_allclose(np.asarray(f(q, k, v, segs)),
+                                   np.asarray(ref), atol=3e-5)
+
     def test_model_level_training(self, devices8):
         """GPT trains with cp_comm_type='a2a+p2p' and tracks the cp=1 run."""
         import dataclasses
