@@ -8,9 +8,15 @@ allgather/all-to-all collectives; TPU-first, we build GShard-style dispatch/
 combine einsums against experts stacked on an 'experts'-sharded leading axis —
 XLA lowers the token exchange to a ragged all-to-all over the 'ep' mesh axis.
 
-Capacity-factor dispatch (tokens beyond capacity dropped, prob-weighted
-combine) matches the reference's --moe-expert-capacity-factor path; the
-GroupedMLP becomes one batched einsum over the expert axis (MXU-friendly).
+Two dispatch modes, matching the reference's semantics:
+- moe_capacity_factor=None (the reference DEFAULT): exact dropless —
+  token copies are sorted by expert and run through ``lax.ragged_dot``
+  grouped GEMMs (static shapes, no capacity buffer, no token dropping;
+  the reference's allgather/a2a dispatchers with no capacity).
+- moe_capacity_factor=F: GShard capacity dispatch (tokens beyond
+  F*T*k/E per expert dropped, prob-weighted combine) — the reference's
+  --moe-expert-capacity-factor path; the GroupedMLP becomes one batched
+  einsum over the expert axis (MXU-friendly).
 """
 
 from __future__ import annotations
@@ -95,6 +101,38 @@ def _expert_ffn(p, x: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
     return jnp.einsum("ecf,efh->ech", y, p["fc2_kernel"].astype(dt))
 
 
+def _dropless_experts(p, x_flat, topk_idx, topk_probs,
+                      cfg: TransformerConfig) -> jnp.ndarray:
+    """Exact dropless dispatch: sort the T*k token copies by expert id and
+    run grouped GEMMs (``lax.ragged_dot``) over the contiguous per-expert
+    row groups — static shapes, no capacity buffer, zero drops. This is
+    the reference's default behavior (no --moe-expert-capacity-factor ⇒
+    dispatchers never drop; experts.py GroupedMLP runs ragged groups)."""
+    t, h = x_flat.shape
+    k = cfg.moe_router_topk
+    e = cfg.num_moe_experts
+    dt = cfg.compute_dtype
+    flat_expert = topk_idx.reshape(t * k)
+    order = jnp.argsort(flat_expert)
+    token_of = order // k
+    group_sizes = jnp.bincount(flat_expert, length=e).astype(jnp.int32)
+
+    x_sorted = jnp.take(x_flat.astype(dt), token_of, axis=0)
+    y = jax.lax.ragged_dot(x_sorted, p["fc1_kernel"].astype(dt),
+                           group_sizes)
+    if is_gated(cfg.activation):
+        gate, val = jnp.split(y, 2, axis=-1)
+        y = apply_activation(cfg.activation, val, gate)
+    else:
+        y = apply_activation(cfg.activation, y)
+    y = jax.lax.ragged_dot(y, p["fc2_kernel"].astype(dt), group_sizes)
+
+    w_sorted = jnp.take(topk_probs.reshape(t * k), order).astype(
+        jnp.float32)
+    return jnp.zeros((t, h), jnp.float32).at[token_of].add(
+        y.astype(jnp.float32) * w_sorted[:, None])
+
+
 def moe_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None
                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """x: [B,S,H] → ([B,S,H], aux_loss scalar)."""
@@ -106,8 +144,27 @@ def moe_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None
 
     topk_idx, topk_probs, aux = _router(p, x_flat, cfg)
 
-    cap_factor = cfg.moe_capacity_factor or 1.25
-    capacity = max(int(cap_factor * t * k / e), 1)
+    if cfg.moe_capacity_factor is None:
+        out = _dropless_experts(p, x_flat, topk_idx, topk_probs, cfg)
+    else:
+        out = _capacity_experts(p, x_flat, topk_idx, topk_probs, cfg)
+    return _with_shared(p, x_flat, out, cfg).reshape(
+        b, s, h).astype(x.dtype), aux
+
+
+def _capacity_experts(p, x_flat, topk_idx, topk_probs,
+                      cfg: TransformerConfig) -> jnp.ndarray:
+    """GShard capacity dispatch (reference --moe-expert-capacity-factor
+    path): tokens beyond F*T*k/E per expert are dropped."""
+    t, _h = x_flat.shape
+    e = cfg.num_moe_experts
+    k = cfg.moe_router_topk
+    if cfg.moe_capacity_factor <= 0:
+        raise ValueError(
+            f"moe_capacity_factor must be > 0 (got "
+            f"{cfg.moe_capacity_factor}); omit it (None) for dropless "
+            "dispatch")
+    capacity = max(int(cfg.moe_capacity_factor * t * k / e), 1)
 
     # Position of each (token, k) assignment within its expert's buffer.
     onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.int32)  # [T,K,E]
@@ -127,17 +184,19 @@ def moe_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None
     expert_in = jnp.einsum("tec,th->ech", dispatch,
                            x_flat.astype(cfg.compute_dtype))
     expert_out = _expert_ffn(p, expert_in, cfg)
-    out = jnp.einsum("tec,ech->th", combine.astype(jnp.float32),
-                     expert_out.astype(jnp.float32))
+    return jnp.einsum("tec,ech->th", combine.astype(jnp.float32),
+                      expert_out.astype(jnp.float32))
 
-    if "shared_fc1" in p:
-        dt = cfg.compute_dtype
-        y = x_flat.astype(dt) @ p["shared_fc1"].astype(dt)
-        if is_gated(cfg.activation):
-            gate, val = jnp.split(y, 2, axis=-1)
-            y = apply_activation(cfg.activation, val, gate)
-        else:
-            y = apply_activation(cfg.activation, y)
-        out = out + (y @ p["shared_fc2"].astype(dt)).astype(jnp.float32)
 
-    return out.reshape(b, s, h).astype(x.dtype), aux
+def _with_shared(p, x_flat, out, cfg: TransformerConfig):
+    """Add the always-on shared expert(s) (reference shared_experts.py)."""
+    if "shared_fc1" not in p:
+        return out
+    dt = cfg.compute_dtype
+    y = x_flat.astype(dt) @ p["shared_fc1"].astype(dt)
+    if is_gated(cfg.activation):
+        gate, val = jnp.split(y, 2, axis=-1)
+        y = apply_activation(cfg.activation, val, gate)
+    else:
+        y = apply_activation(cfg.activation, y)
+    return out + (y @ p["shared_fc2"].astype(dt)).astype(jnp.float32)
