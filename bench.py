@@ -67,12 +67,17 @@ def parent_main():
             return
         history.append(f"attempt {attempt+1} bench: {res.get('error')}")
     # All attempts failed: emit a diagnostic record in the standard schema.
+    # `history` carries the per-attempt errors (probe timeouts indicate a
+    # tunnel hang; rc!=0 lines carry the real traceback tail) — see PERF.md
+    # for the last measured numbers.
     print(json.dumps({
         "metric": "gpt2_125m_tokens_per_sec_per_chip",
         "value": 0.0,
         "unit": "tokens/s",
         "vs_baseline": 0.0,
-        "extra": {"error": "benchmark could not run", "history": history},
+        "extra": {"error": "benchmark could not run; see history and "
+                           "PERF.md for last measured numbers",
+                  "history": history},
     }))
 
 

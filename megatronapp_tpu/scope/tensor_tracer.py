@@ -148,6 +148,10 @@ class TensorTracer:
             if not self.mlp2_records:
                 return None
             data = np.concatenate(self.mlp2_records, axis=0)
+        if data.shape[0] < 2 or data.shape[1] < n_components:
+            # Too few samples/features for a 2-component plane (sklearn
+            # raises; the SVD fallback would emit degenerate points).
+            return None
         # StandardScaler + PCA (sklearn when present, numpy SVD otherwise).
         mean = data.mean(0)
         std = data.std(0)

@@ -123,6 +123,17 @@ class TrainingScopeSession:
             # captures are dropped / race the payload list.
             jax.effects_barrier()
             tt.deactivate()
+            # PCA of this step's accumulated MLP2 records (reference
+            # tik_end, tensor_tracer.py:212-223 → frontend PCAPlot). Never
+            # let a PCA failure turn a completed step into an error payload
+            # — the optimizer state has already advanced.
+            try:
+                pca = tt.pca_mlp2()
+            except Exception:
+                pca = None
+            if pca is not None:
+                payloads.append({"type": "pca",
+                                 "points": pca.tolist()})
             tt.clear_records()
             self.iteration += 1
             payloads.append({

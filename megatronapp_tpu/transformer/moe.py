@@ -89,16 +89,21 @@ def _router(p, x_flat: jnp.ndarray, cfg: TransformerConfig):
     return topk_idx, topk_probs, aux
 
 
+def _apply_act(cfg: TransformerConfig, y: jnp.ndarray) -> jnp.ndarray:
+    """Apply the configured activation, splitting gate‖value for gated
+    kinds (the fc1 kernels emit 2F columns when gated)."""
+    if is_gated(cfg.activation):
+        gate, val = jnp.split(y, 2, axis=-1)
+        return apply_activation(cfg.activation, val, gate)
+    return apply_activation(cfg.activation, y)
+
+
 def _expert_ffn(p, x: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
     """Batched expert MLP: x [E, C, H] → [E, C, H] (GroupedMLP analogue)."""
     dt = cfg.compute_dtype
     y = jnp.einsum("ech,ehf->ecf", x.astype(dt), p["fc1_kernel"].astype(dt))
-    if is_gated(cfg.activation):
-        gate, val = jnp.split(y, 2, axis=-1)
-        y = apply_activation(cfg.activation, val, gate)
-    else:
-        y = apply_activation(cfg.activation, y)
-    return jnp.einsum("ecf,efh->ech", y, p["fc2_kernel"].astype(dt))
+    return jnp.einsum("ecf,efh->ech", _apply_act(cfg, y),
+                      p["fc2_kernel"].astype(dt))
 
 
 def _dropless_experts(p, x_flat, topk_idx, topk_probs,
@@ -120,12 +125,8 @@ def _dropless_experts(p, x_flat, topk_idx, topk_probs,
     x_sorted = jnp.take(x_flat.astype(dt), token_of, axis=0)
     y = jax.lax.ragged_dot(x_sorted, p["fc1_kernel"].astype(dt),
                            group_sizes)
-    if is_gated(cfg.activation):
-        gate, val = jnp.split(y, 2, axis=-1)
-        y = apply_activation(cfg.activation, val, gate)
-    else:
-        y = apply_activation(cfg.activation, y)
-    y = jax.lax.ragged_dot(y, p["fc2_kernel"].astype(dt), group_sizes)
+    y = jax.lax.ragged_dot(_apply_act(cfg, y), p["fc2_kernel"].astype(dt),
+                           group_sizes)
 
     w_sorted = jnp.take(topk_probs.reshape(t * k), order).astype(
         jnp.float32)
@@ -193,10 +194,5 @@ def _with_shared(p, x_flat, out, cfg: TransformerConfig):
     if "shared_fc1" not in p:
         return out
     dt = cfg.compute_dtype
-    y = x_flat.astype(dt) @ p["shared_fc1"].astype(dt)
-    if is_gated(cfg.activation):
-        gate, val = jnp.split(y, 2, axis=-1)
-        y = apply_activation(cfg.activation, val, gate)
-    else:
-        y = apply_activation(cfg.activation, y)
+    y = _apply_act(cfg, x_flat.astype(dt) @ p["shared_fc1"].astype(dt))
     return out + (y @ p["shared_fc2"].astype(dt)).astype(jnp.float32)

@@ -147,22 +147,28 @@ class TestTrainingScopeServer:
             # Step 1: with visualization + disturbance.
             await ws.send_json({
                 "type": "run_training_step",
-                "visualization": {"MLP1": [0, 1], "QKV_mat_mul": [0]},
+                "visualization": {"MLP1": [0, 1], "MLP2": [0, 1],
+                                  "QKV_mat_mul": [0]},
                 "disturbance": {"system": {"kind": "noise1",
                                            "scale": 0.01}},
                 "compressor": {"pixels": 4, "method": "mean"},
             })
-            captures, done = [], None
+            captures, pca, done = [], None, None
             while done is None:
                 msg = await ws.receive_json(timeout=120)
                 if msg.get("type") == "step_done":
                     done = msg
+                elif msg.get("type") == "pca":
+                    pca = msg
                 elif msg.get("type") == "error":
                     raise AssertionError(msg)
                 else:
                     captures.append(msg)
             assert done["iteration"] == 1
             assert np.isfinite(done["loss"])
+            # MLP2 captures accumulate → a PCA payload follows (reference
+            # tik_end → PCAPlot).
+            assert pca is not None and len(pca["points"][0]) == 2
             sites = {c["site"] for c in captures}
             assert "mlp1" in sites
             mlp1 = next(c for c in captures if c["site"] == "mlp1")

@@ -18,7 +18,7 @@ sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
 
 def main(argv=None):
     from megatronapp_tpu.config.arguments import (
-        build_parser, configs_from_args,
+        build_parser, configs_from_args, parse_args,
     )
     from megatronapp_tpu.scope.ws_server import (
         TrainingScopeServer, TrainingScopeSession,
@@ -27,7 +27,7 @@ def main(argv=None):
     ap = build_parser("MegaScope training server (megatronapp-tpu)")
     ap.add_argument("--ws-host", default="0.0.0.0")
     ap.add_argument("--ws-port", type=int, default=5656)
-    args = ap.parse_args(argv)
+    args = parse_args(ap, argv)  # honors JAX_PLATFORMS / YAML defaults
     model, parallel, training, opt = configs_from_args(args)
 
     session = TrainingScopeSession(model, parallel, training, opt)
