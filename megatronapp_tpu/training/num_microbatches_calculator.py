@@ -28,6 +28,12 @@ class ConstantCalculator:
         denom = self.micro_batch_size * self.data_parallel
         return self.global_batch_size, self.global_batch_size // denom
 
+    def stages(self):
+        """All distinct (global_batch_size, num_microbatches) pairs the
+        schedule will ever produce — for fail-fast validation against
+        schedule constraints (e.g. interleaved pipeline M % pp)."""
+        return [self.get(0)]
+
 
 @dataclasses.dataclass
 class RampupCalculator:
@@ -72,6 +78,15 @@ class RampupCalculator:
                      self.global_batch_size)
         denom = self.micro_batch_size * self.data_parallel
         return bs, bs // denom
+
+    def stages(self):
+        """All distinct (global_batch_size, num_microbatches) pairs over
+        the ramp, start → final (see ConstantCalculator.stages)."""
+        denom = self.micro_batch_size * self.data_parallel
+        return [(bs, bs // denom)
+                for bs in range(self.start_batch_size,
+                                self.global_batch_size + 1,
+                                self.batch_size_increment)]
 
 
 def build_calculator(global_batch_size: int, micro_batch_size: int,

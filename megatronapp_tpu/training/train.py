@@ -51,6 +51,22 @@ def reshape_global_batch(batch: Dict[str, np.ndarray], num_micro: int
     return {k: r(v) for k, v in batch.items()}
 
 
+def _validate_schedule_stages(batch_calc, pp: int, vpp: int,
+                              order_policy: str) -> None:
+    """Fail at startup (not hours into a ramp) when any batch-size stage
+    produces a microbatch count the interleaved pipeline can't schedule
+    (spmd_pipeline requires M % pp == 0 for vpp>1 'dfc')."""
+    if pp > 1 and vpp > 1 and order_policy == "dfc":
+        for gbs_i, m_i in batch_calc.stages():
+            if m_i % pp:
+                raise ValueError(
+                    f"batch size {gbs_i} in the schedule gives {m_i} "
+                    f"microbatches, not divisible by pipeline_parallel="
+                    f"{pp} as the interleaved (dfc) pipeline requires; "
+                    "adjust the rampup schedule or use order_policy "
+                    "'bfc'")
+
+
 class _RowBuffer:
     """Takes exactly-n sample rows from a fixed-size batch stream without
     dropping any (batch-size rampup consumes fewer rows than the stream's
@@ -110,10 +126,12 @@ def pretrain_gpt(
     batch_calc = build_calculator(
         train_cfg.global_batch_size, train_cfg.micro_batch_size, dp_total,
         train_cfg.rampup_batch_size)
+    vpp = parallel_cfg.virtual_pipeline_parallel
+    _validate_schedule_stages(batch_calc, ctx.pp, vpp,
+                              parallel_cfg.pipeline_order_policy)
 
     optimizer = get_optimizer(opt_cfg, train_cfg.train_iters)
     rng = jax.random.PRNGKey(train_cfg.seed)
-    vpp = parallel_cfg.virtual_pipeline_parallel
 
     def params_and_axes(rng):
         return init_gpt_params(rng, model_cfg, pp=ctx.pp, vpp=vpp)
@@ -402,16 +420,22 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
     tp/pp/cp (each half-mesh runs the same loss_fn as the main path,
     including the SPMD pipeline)."""
     from megatronapp_tpu.parallel.fbd import FBDExecutor, split_fbd_meshes
+    from megatronapp_tpu.training.num_microbatches_calculator import (
+        build_calculator,
+    )
 
-    if train_cfg.rampup_batch_size:
-        raise NotImplementedError(
-            "rampup_batch_size is not supported under "
-            "forward_backward_disaggregating yet")
     fwd_ctx, bwd_ctx = split_fbd_meshes(parallel_cfg)
     log_fn(f"FBD: forward mesh {dict(fwd_ctx.mesh.shape)} | backward mesh "
            f"{dict(bwd_ctx.mesh.shape)}")
-    num_micro = train_cfg.num_microbatches(bwd_ctx.dp * bwd_ctx.ep)
+    # Batch-size rampup composes: the executor's microbatch loop takes any
+    # M (non-pipelined — no recompiles; pipelined — one compile per ramp
+    # stage, same bound as the main path).
+    batch_calc = build_calculator(
+        train_cfg.global_batch_size, train_cfg.micro_batch_size,
+        bwd_ctx.dp * bwd_ctx.ep, train_cfg.rampup_batch_size)
     vpp = parallel_cfg.virtual_pipeline_parallel
+    _validate_schedule_stages(batch_calc, bwd_ctx.pp, vpp,
+                              parallel_cfg.pipeline_order_policy)
 
     optimizer = get_optimizer(opt_cfg, train_cfg.train_iters)
     rng = jax.random.PRNGKey(train_cfg.seed)
@@ -461,10 +485,12 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
             loader.close()
 
     # Fast-forward the data stream past consumed samples on resume (same
-    # bookkeeping as the main path; FBD has no rampup, so consumed is
-    # step-linear).
+    # bookkeeping as the main path — rampup makes consumed step-nonlinear,
+    # so replay the schedule).
+    consumed = 0
+    for _ in range(start_step):
+        consumed += batch_calc.get(consumed)[0]
     if batch_iter is None:
-        consumed = start_step * train_cfg.global_batch_size
         if batch_iter_factory is not None:
             batch_iter = batch_iter_factory(consumed)
         else:
@@ -494,9 +520,13 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
 
     losses = []
     t0 = time.perf_counter()
+    rows = _RowBuffer(batch_iter)
+    start_consumed = consumed
     for it in range(start_step, train_cfg.train_iters):
         tracer.iteration_begin(it)
-        batch = reshape_global_batch(next(batch_iter), num_micro)
+        cur_gbs, cur_micro = batch_calc.get(consumed)
+        batch = reshape_global_batch(rows.take(cur_gbs), cur_micro)
+        consumed += cur_gbs
         with tracer.scope("train-step"):
             out = executor.step(batch)
         if (it + 1) % train_cfg.log_interval == 0 or \
@@ -527,8 +557,7 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
     if train_cfg.trace:
         tracer.finalize()
     metrics_logger.close()
-    tokens = (train_cfg.train_iters - start_step) * \
-        train_cfg.global_batch_size * train_cfg.seq_length
+    tokens = (consumed - start_consumed) * train_cfg.seq_length
     return TrainResult(state=executor.state, losses=losses,
                        tokens_per_sec=tokens / max(dt, 1e-9),
                        step_time_ms=dt / max(

@@ -448,3 +448,37 @@ class TestDCNMeshLayout:
             _dcn_slice_axis((1, 3, 1, 1, 4), 2)           # tp never splits?
         with _pytest.raises(ValueError):
             _dcn_slice_axis((1, 1, 1, 1, 1), 2)
+
+
+class TestRampupPipelineValidation:
+    def test_incompatible_ramp_stage_fails_at_startup(self, devices8):
+        """A rampup stage whose microbatch count violates the interleaved
+        pipeline's M % pp constraint is rejected at startup, not hours
+        into the run (fail-fast for both the main and FBD paths)."""
+        import pytest as _pytest
+
+        from megatronapp_tpu.config.parallel_config import ParallelConfig
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.training.train import pretrain_gpt
+        model = TransformerConfig(num_layers=4, hidden_size=64,
+                                  num_attention_heads=4, vocab_size=128,
+                                  max_position_embeddings=64)
+        # pp=2 vpp=2 dfc, dp=1, mbs=1: ramp stage gbs=2 → M=2 ok, but
+        # gbs=6 → M=6... use mbs=1 ramp (1,1,8) → stages M=1..4; M=1,3
+        # violate M%2.
+        par = ParallelConfig(pipeline_parallel=2,
+                             virtual_pipeline_parallel=2)
+        ctx = build_mesh(par, devices=devices8[:2])
+        train = TrainingConfig(micro_batch_size=1, global_batch_size=4,
+                               seq_length=32, train_iters=4,
+                               log_interval=2,
+                               rampup_batch_size=(1, 1, 8))
+        with _pytest.raises(ValueError, match="dfc"):
+            pretrain_gpt(model, par, train, OptimizerConfig(lr=1e-3),
+                         ctx=ctx)

@@ -70,6 +70,23 @@ class TestFBD:
                                    atol=1e-3)
         assert res_fbd.losses[-1] < res_fbd.losses[0]
 
+    def test_fbd_with_rampup(self, devices8):
+        """Batch-size rampup composes with FBD (round-1 raise lifted): the
+        microbatch count grows over the ramp and the run converges."""
+        from tests.test_training import learnable_batches
+
+        model = tiny(compute_dtype=jnp.float32)
+        # bwd mesh dp=4 → ramp 8→16 in steps of 8 over 24 samples.
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=16,
+                               seq_length=32, train_iters=8, log_interval=2,
+                               rampup_batch_size=(8, 8, 24))
+        opt = OptimizerConfig(lr=1e-3, lr_decay_iters=8, clip_grad=0.0)
+        par = ParallelConfig(forward_backward_disaggregating=True)
+        res = pretrain_gpt(model, par, train, opt,
+                           batch_iter=learnable_batches(32, 128, 16))
+        assert np.isfinite(res.losses[-1])
+        assert res.losses[-1] < res.losses[0]
+
     @pytest.mark.parametrize("compose", ["pp", "cp"])
     def test_fbd_composes_with_pp_cp(self, devices8, compose):
         """FBD + pipeline / context parallelism: each half-mesh runs the
