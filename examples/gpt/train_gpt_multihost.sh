@@ -1,0 +1,26 @@
+#!/bin/bash
+# Multi-host launch (reference run_master_*.sh / run_worker_*.sh +
+# torchrun MASTER_ADDR/RANK/WORLD_SIZE semantics → jax.distributed).
+#
+# On TPU pods (GKE / queued resources) coordinator/world auto-detect —
+# every host runs the SAME command:
+#   ./train_gpt_multihost.sh
+#
+# For manual launches, pass the rendezvous explicitly; process 0's host
+# serves as coordinator:
+#   host0$ ./train_gpt_multihost.sh --coordinator-address host0:1234 \
+#              --num-processes 2 --process-id 0
+#   host1$ ./train_gpt_multihost.sh --coordinator-address host0:1234 \
+#              --num-processes 2 --process-id 1
+#
+# The mesh lays DCN across pp/dp (never tp/cp): with pp=2 over 2 slices,
+# each pipeline stage lives on one slice and stage hand-offs ride DCN
+# (parallel/mesh.py _dcn_slice_axis).
+python pretrain_gpt.py \
+    --multi-host \
+    --num-layers 16 --hidden-size 2048 --num-attention-heads 32 \
+    --seq-length 2048 --max-position-embeddings 2048 \
+    --micro-batch-size 2 --global-batch-size 32 \
+    --tensor-model-parallel-size 4 --pipeline-model-parallel-size 2 \
+    --train-iters 100 --lr 1e-4 --lr-warmup-iters 10 \
+    "$@"
