@@ -131,7 +131,7 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
                    help="selective recompute (default policy already "
                         "selective; use --recompute-granularity)")
     g.add_argument("--recompute-granularity", default="selective",
-                   choices=["none", "selective", "full"])
+                   choices=["none", "selective", "selective_attn", "full"])
     g.add_argument("--bf16", action="store_true", default=True)
     g.add_argument("--fp32", action="store_true",
                    help="disable bf16 compute")

@@ -127,9 +127,12 @@ class TransformerConfig:
     params_dtype: jnp.dtype = jnp.float32
     compute_dtype: jnp.dtype = jnp.bfloat16
 
-    # Rematerialization policy for the layer scan: 'none' | 'full' | 'selective'.
+    # Rematerialization policy for the layer scan:
+    # 'none' | 'full' | 'selective' | 'selective_attn'.
     # 'selective' checkpoints only attention internals (reference
-    # --recompute-activations semantics, arguments.py recompute group).
+    # --recompute-activations semantics, arguments.py recompute group);
+    # 'selective_attn' additionally saves the attention outputs so the
+    # flash kernel forward is not re-executed in the backward pass.
     remat_policy: str = "selective"
 
     # Context-parallel attention mode (reference cp_comm_type,

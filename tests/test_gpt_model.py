@@ -93,14 +93,14 @@ class TestGPTModel:
     def test_remat_matches_no_remat(self):
         tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 128)
         losses = {}
-        for policy in ("none", "full", "selective"):
+        for policy in ("none", "full", "selective", "selective_attn"):
             cfg = small_cfg(remat_policy=policy)
             p, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
             loss, _ = gpt_loss(p, tokens, tokens, None, cfg)
             g = jax.grad(lambda p: gpt_loss(p, tokens, tokens, None, cfg)[0])(p)
             losses[policy] = (float(loss),
                               float(jnp.sum(jnp.abs(g["block"]["ln1_scale"]))))
-        for policy in ("full", "selective"):
+        for policy in ("full", "selective", "selective_attn"):
             np.testing.assert_allclose(losses[policy], losses["none"],
                                        rtol=1e-5)
 
