@@ -121,6 +121,10 @@ class DynamicInferenceEngine:
         self.slots: List[Optional[Request]] = [None] * max_batch
         self.waiting: deque = deque()
         self._ids = itertools.count()
+        self._build_jits()
+
+    def _build_jits(self):
+        cfg = self.cfg
         self._decode = jax.jit(
             lambda p, t, c, l, a: _decode_step(p, t, c, l, a, cfg))
         # Prefill reuses the static engine's whole-prompt forward on a
@@ -130,6 +134,11 @@ class DynamicInferenceEngine:
         from megatronapp_tpu.inference.engine import _forward_with_cache
         self._prefill = jax.jit(
             functools.partial(_forward_with_cache, cfg=cfg))
+
+    def reset_compilation(self):
+        """Re-trace on next call (after MegaScope hook toggles — see
+        StaticInferenceEngine.reset_compilation)."""
+        self._build_jits()
 
     # ---- request lifecycle ------------------------------------------------
     def add_request(self, prompt_tokens, max_new_tokens: int,

@@ -187,12 +187,21 @@ class StaticInferenceEngine:
         self.tokenizer = tokenizer
         self.max_seq_len = max_seq_len or cfg.max_position_embeddings
 
+        self._build_jits()
+
+    def _build_jits(self):
         self._prefill = jax.jit(
-            functools.partial(_forward_with_cache, cfg=cfg),
+            functools.partial(_forward_with_cache, cfg=self.cfg),
             static_argnames=(), donate_argnums=(2,))
         self._decode = jax.jit(
-            functools.partial(_forward_with_cache, cfg=cfg),
+            functools.partial(_forward_with_cache, cfg=self.cfg),
             donate_argnums=(2,))
+
+    def reset_compilation(self):
+        """Drop the jitted prefill/decode so the next call re-traces —
+        required after toggling MegaScope capture hooks, whose enablement
+        is baked in at trace time (scope/hooks.py NOTE)."""
+        self._build_jits()
 
     def generate(self, prompt_tokens: np.ndarray, max_new_tokens: int,
                  sampling: Optional[SamplingParams] = None,
@@ -259,6 +268,13 @@ class MambaInferenceEngine:
                 f"({cfg.max_position_embeddings})")
         # jit once per engine — per-request lambdas would re-trace and
         # recompile every call.
+        self._build_jits()
+
+    def _build_jits(self):
+        from megatronapp_tpu.models.mamba import (
+            mamba_decode_step, mamba_prefill,
+        )
+        cfg, mcfg = self.cfg, self.mcfg
         self._prefill = jax.jit(
             lambda p, t: mamba_prefill(p, t, cfg, mcfg,
                                        max_len=self.max_seq_len))
@@ -266,6 +282,11 @@ class MambaInferenceEngine:
             lambda p, s, t, i: mamba_decode_step(p, s, t, cfg, mcfg,
                                                  cache_index=i),
             donate_argnums=(1,))
+
+    def reset_compilation(self):
+        """Re-trace on next call (after MegaScope hook toggles — see
+        StaticInferenceEngine.reset_compilation)."""
+        self._build_jits()
 
     def generate(self, prompt_tokens: np.ndarray, max_new_tokens: int,
                  sampling: Optional[SamplingParams] = None,

@@ -12,6 +12,15 @@ REST:  PUT /api  {"prompts": [...], "tokens_to_generate": N,
 WS:    /ws — client sends the same JSON; server streams
        {"type": "token", "step": i, "token": id, "text": str} per token
        then {"type": "done", "text": full}.
+
+MegaScope inference mode (reference InferenceWSServer/InferenceGenerate,
+text_generation_server.py:211-239): a WS request may add
+"visualization" (FlagType→layers map), "compressor" {pixels, method} and
+"disturbance" configs — the server then also streams per-token capture
+payloads {update_type, site, layer_id, result} (same wire contract as
+training mode) and attaches the top-20 candidate list (tik_result) to
+each token message. Toggling captures re-traces the engine's jits —
+the documented cost of dynamic reconfiguration under jit.
 """
 
 from __future__ import annotations
@@ -41,6 +50,13 @@ class TextGenerationServer:
         self.engine = engine
         self.host = host
         self.port = port
+        # One generation at a time: the engine, capture hooks, and
+        # disturbance are process-global, and viz requests re-trace the
+        # engine's jits — concurrent generations would cross-contaminate
+        # (the reference server serializes with a lock too,
+        # text_generation_server.py MegatronServer).
+        import threading
+        self._gen_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     async def handle_api(self, request):
@@ -51,9 +67,12 @@ class TextGenerationServer:
             n = int(req.get("tokens_to_generate", 64))
             sampling = _sampling_from_request(req)
             loop = asyncio.get_running_loop()
-            texts = await loop.run_in_executor(
-                None, lambda: self.engine.generate_text(prompts, n,
-                                                        sampling))
+
+            def run_api():
+                with self._gen_lock:
+                    return self.engine.generate_text(prompts, n, sampling)
+
+            texts = await loop.run_in_executor(None, run_api)
             return web.json_response({
                 "text": [p + t for p, t in zip(prompts, texts)],
                 "segments": texts,
@@ -73,37 +92,99 @@ class TextGenerationServer:
             prompts = req.get("prompts") or [req.get("prompt", "")]
             n = int(req.get("tokens_to_generate", 64))
             sampling = _sampling_from_request(req)
+            viz = req.get("visualization")
             queue: asyncio.Queue = asyncio.Queue()
 
             def cb(step, tokens, logits):
-                text = self.engine.tokenizer.detokenize(
-                    [int(tokens[0])]) if self.engine.tokenizer else ""
-                loop.call_soon_threadsafe(queue.put_nowait, {
+                payload = {
                     "type": "token", "step": int(step),
-                    "token": int(tokens[0]), "text": text,
-                })
+                    "token": int(tokens[0]),
+                    "text": (self.engine.tokenizer.detokenize(
+                        [int(tokens[0])]) if self.engine.tokenizer
+                        else ""),
+                }
+                if viz and logits is not None:
+                    # Reference tik_result: sampled token + top-20
+                    # candidates with decoded text.
+                    from megatronapp_tpu.scope.tensor_tracer import (
+                        get_tensor_tracer,
+                    )
+                    payload["candidates"] = get_tensor_tracer(
+                    ).report_result(logits[0], int(tokens[0]),
+                                    self.engine.tokenizer)["candidates"]
+                loop.call_soon_threadsafe(queue.put_nowait, payload)
 
-            fut = loop.run_in_executor(
-                None, lambda: self.engine.generate_text(
-                    prompts[:1], n, sampling, token_callback=cb))
-            done = False
-            while not done:
-                get = asyncio.create_task(queue.get())
-                await asyncio.wait({get, fut},
-                                   return_when=asyncio.FIRST_COMPLETED)
-                while not queue.empty() or get.done():
-                    payload = (get.result() if get.done()
-                               else queue.get_nowait())
-                    await ws.send_json(payload)
-                    if queue.empty():
-                        break
-                    get = asyncio.create_task(queue.get())
-                if fut.done() and queue.empty():
-                    if not get.done():
-                        get.cancel()
-                    texts = fut.result()
-                    await ws.send_json({"type": "done", "text": texts[0]})
-                    done = True
+            def run_generation():
+                # Capture hooks are thread-local and baked in at trace
+                # time: activate in THIS worker thread and re-trace the
+                # engine around the toggle. The lock serializes against
+                # every other generation (shared engine/global hooks).
+                with self._gen_lock:
+                    if not viz:
+                        return self.engine.generate_text(
+                            prompts[:1], n, sampling, token_callback=cb)
+                    import jax
+
+                    from megatronapp_tpu.scope.disturbance import (
+                        get_disturbance,
+                    )
+                    from megatronapp_tpu.scope.hooks import (
+                        capture_payload,
+                    )
+                    from megatronapp_tpu.scope.tensor_tracer import (
+                        get_tensor_tracer,
+                    )
+                    comp = req.get("compressor") or {}
+                    tt = get_tensor_tracer()
+
+                    def report(site, layer_id, arr):
+                        loop.call_soon_threadsafe(
+                            queue.put_nowait,
+                            capture_payload(site, layer_id, arr))
+
+                    # Config application sits INSIDE the try: a malformed
+                    # client config must not leave hooks globally active.
+                    try:
+                        tt.set_flags_from_config(viz)
+                        tt.activate(report,
+                                    pixels=int(comp.get("pixels", 16)),
+                                    method=comp.get("method", "mean"))
+                        if req.get("disturbance") is not None:
+                            get_disturbance().configure(
+                                req["disturbance"],
+                                seed=int(req.get("random_seed", 0)))
+                        self.engine.reset_compilation()
+                        return self.engine.generate_text(
+                            prompts[:1], n, sampling, token_callback=cb)
+                    finally:
+                        jax.effects_barrier()
+                        tt.deactivate()
+                        tt.clear_records()
+                        get_disturbance().clear()
+                        self.engine.reset_compilation()
+
+            fut = loop.run_in_executor(None, run_generation)
+            # Sentinel-terminated drain: per-token callbacks enqueue via
+            # call_soon_threadsafe BEFORE the executor job finishes, and
+            # the done-callback fires on the loop after those are
+            # scheduled, so FIFO order guarantees every payload precedes
+            # the sentinel (no racy cancel of an in-flight queue.get).
+            _DONE = object()
+            fut.add_done_callback(lambda _: queue.put_nowait(_DONE))
+            while True:
+                payload = await queue.get()
+                if payload is _DONE:
+                    break
+                await ws.send_json(payload)
+            try:
+                texts = fut.result()
+            except Exception as e:
+                # Client-input-driven failures (bad flag names, malformed
+                # disturbance configs) surface as an error frame, matching
+                # the REST handler's 400-with-message behavior.
+                await ws.send_json({"type": "error", "message": str(e)})
+                continue
+            await ws.send_json({"type": "done", "text": texts[0]})
         return ws
 
     # ------------------------------------------------------------------

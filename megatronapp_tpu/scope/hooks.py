@@ -85,6 +85,21 @@ def _compress(x: jnp.ndarray, pixels: int) -> jnp.ndarray:
     return trimmed.reshape(*x.shape[:-1], pixels, chunk).mean(-1)
 
 
+def capture_payload(site: str, layer_id, arr) -> dict:
+    """The capture wire payload (reference training_wsserver.py:46-52
+    contract: update_type = FlagType value, layer_id, result) — shared by
+    the training WS server and the inference server so the frontend
+    contract lives in ONE place."""
+    import numpy as np
+    flag = _SITE_TO_FLAG.get(site)
+    return {
+        "update_type": int(flag) if flag is not None else -1,
+        "site": site,
+        "layer_id": int(layer_id) if layer_id is not None else -1,
+        "result": np.asarray(arr, np.float64).tolist(),
+    }
+
+
 def scope_capture(site: str, x: jnp.ndarray, layer_id=None) -> jnp.ndarray:
     """Identity passthrough that optionally mirrors a compressed copy of x to
     the host sink. Safe to call inside jit/scan."""

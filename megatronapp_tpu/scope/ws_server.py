@@ -27,7 +27,7 @@ import jax
 import numpy as np
 
 from megatronapp_tpu.scope.disturbance import get_disturbance
-from megatronapp_tpu.scope.hooks import _SITE_TO_FLAG
+from megatronapp_tpu.scope.hooks import _SITE_TO_FLAG, capture_payload
 from megatronapp_tpu.scope.tensor_tracer import get_tensor_tracer
 
 
@@ -90,13 +90,7 @@ class TrainingScopeSession:
             tt = get_tensor_tracer()
 
             def report(site, layer_id, arr):
-                flag = _SITE_TO_FLAG.get(site)
-                payloads.append({
-                    "update_type": int(flag) if flag is not None else -1,
-                    "site": site,
-                    "layer_id": int(layer_id) if layer_id is not None else -1,
-                    "result": np.asarray(arr, np.float64).tolist(),
-                })
+                payloads.append(capture_payload(site, layer_id, arr))
 
             comp = compressor or {}
             if visualization:

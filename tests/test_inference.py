@@ -151,6 +151,73 @@ class TestServer:
         asyncio.run(run())
 
 
+class TestInferenceScope:
+    def test_ws_streams_captures_and_candidates(self, engine):
+        """MegaScope inference mode (reference InferenceWSServer): a WS
+        request with a visualization config streams per-token capture
+        payloads and top-20 candidate lists alongside the tokens."""
+        from aiohttp.test_utils import TestClient, TestServer as ATestServer
+        from megatronapp_tpu.inference.server import TextGenerationServer
+
+        srv = TextGenerationServer(engine)
+
+        async def run():
+            client = TestClient(ATestServer(srv.build_app()))
+            await client.start_server()
+            ws = await client.ws_connect("/ws")
+            await ws.send_json({
+                "prompt": "1 2 3", "tokens_to_generate": 2,
+                "greedy": True,
+                "visualization": {"MLP1": [0], "QKV_mat_mul": [0]},
+                "compressor": {"pixels": 4, "method": "mean"}})
+            tokens, captures = [], []
+            while True:
+                msg = await ws.receive_json(timeout=120)
+                if msg.get("type") == "token":
+                    tokens.append(msg)
+                elif msg.get("type") == "done":
+                    break
+                elif "site" in msg:
+                    captures.append(msg)
+            assert len(tokens) == 2
+            for t in tokens:
+                cands = t["candidates"]
+                assert len(cands) == 20
+                assert cands[0]["prob"] >= cands[-1]["prob"]
+            sites = {c["site"] for c in captures}
+            assert "mlp1" in sites
+            # Plain request afterwards: no captures, no candidates (the
+            # engine re-traced back to hook-free jits).
+            await ws.send_json({"prompt": "1 2", "tokens_to_generate": 1,
+                                "greedy": True})
+            plain = []
+            while True:
+                msg = await ws.receive_json(timeout=120)
+                if msg.get("type") == "done":
+                    break
+                plain.append(msg)
+            assert all("site" not in m for m in plain)
+            assert all("candidates" not in m for m in plain
+                       if m.get("type") == "token")
+            # Bad flag name → error frame (not a dropped socket), and the
+            # hooks are left deactivated (next request streams cleanly).
+            await ws.send_json({"prompt": "1", "tokens_to_generate": 1,
+                                "visualization": {"NOT_A_FLAG": [0]}})
+            while True:
+                msg = await ws.receive_json(timeout=120)
+                if msg.get("type") in ("error", "done"):
+                    break
+            assert msg["type"] == "error"
+            from megatronapp_tpu.scope.tensor_tracer import (
+                get_tensor_tracer,
+            )
+            assert not get_tensor_tracer().enabled
+            await ws.close()
+            await client.close()
+
+        asyncio.run(run())
+
+
 class TestMLADecode:
     def test_mla_cached_decode_matches_full_forward(self):
         """MLA serves: the compressed-latent decode cache reproduces the
