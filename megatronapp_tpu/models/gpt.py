@@ -238,15 +238,21 @@ def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
             raise NotImplementedError(
                 "multi token prediction + sequence packing is not "
                 "supported (reference multi_token_prediction.py assert)")
-        if zigzag_active(cfg, ctx):
-            raise NotImplementedError(
-                "multi token prediction + zigzag context parallelism is "
-                "not supported (the depth modules' future-token rolls "
-                "assume contiguous sequence order); use cp_comm_type "
-                "'a2a'/'allgather' or mtp_num_layers=0")
         from megatronapp_tpu.transformer.mtp import mtp_loss as _mtp_loss
         logits, aux, hid, (cos, sin) = gpt_forward(
             p, tokens, cfg, ctx=ctx, zigzag_keep=True, return_hidden=True)
+        if zigzag_active(cfg, ctx):
+            # The depth modules' future-token rolls need contiguous
+            # order: un-permute the main-stack output and run MTP with
+            # plain rope tables — its attention then takes the contiguous
+            # (non-zigzag) ring, which is correct under cp.
+            from megatronapp_tpu.ops.context_parallel import (
+                zigzag_inverse_indices,
+            )
+            inv = jnp.asarray(zigzag_inverse_indices(tokens.shape[1],
+                                                     ctx.cp))
+            hid = jnp.take(hid, inv, axis=1)
+            cos, sin = gpt_rope_tables(cfg, tokens.shape[1])
         mtp_scaled, mtp_mean, mtp_layer_aux = _mtp_loss(
             p["mtp"], hid, lambda t: gpt_embed(p, t, cfg),
             lambda hh: gpt_head(p, hh, cfg), tokens, targets, loss_mask,
