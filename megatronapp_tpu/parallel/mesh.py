@@ -105,8 +105,12 @@ def initialize_multi_host(coordinator_address: Optional[str] = None,
 
     On TPU pods (GKE/queued resources) all three arguments auto-detect from
     the metadata server; pass them explicitly for manual launches
-    (reference MASTER_ADDR/RANK/WORLD_SIZE env). Safe to call once per
-    process, before any other jax API touches the backend."""
+    (reference MASTER_ADDR/RANK/WORLD_SIZE env). Idempotent: a second call
+    in the same process (repeated parse_args in tests/notebooks) is a
+    no-op instead of a double-initialize error."""
+    client = getattr(jax.distributed, "global_state", None)
+    if client is not None and getattr(client, "client", None) is not None:
+        return
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
