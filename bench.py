@@ -88,7 +88,9 @@ def parent_main():
             history.append(f"attempt {attempt+1} probe: {probe.get('error')}")
             continue
         res = _run_child("--bench", BENCH_TIMEOUT_S)
-        if res.get("metric") and res.get("value"):
+        # Presence check, not truthiness: a measured value of 0.0 is a
+        # (pathological but) completed run, not a failed attempt.
+        if res.get("metric") and res.get("value") is not None:
             res.setdefault("extra", {})["probe_s"] = probe.get("elapsed")
             print(json.dumps(_save_last_good(res)))
             return
@@ -98,10 +100,15 @@ def parent_main():
     # carries the per-attempt errors for diagnosis.
     last = _load_last_good()
     if last is not None:
+        # Top-level `stale` so the consumer can verifiably distinguish this
+        # from a live measurement (the value itself is the persisted
+        # last-good number, kept at top level per the driver contract).
+        last["stale"] = True
         last.setdefault("extra", {})["stale"] = True
         last["extra"]["stale_reason"] = ("live benchmark could not run this "
                                          "invocation; value is the persisted "
-                                         "last-good measurement")
+                                         "last-good measurement from "
+                                         "extra.measured_at")
         last["extra"]["history"] = history
         print(json.dumps(last))
         return

@@ -450,6 +450,37 @@ class TestDCNMeshLayout:
             _dcn_slice_axis((1, 1, 1, 1, 1), 2)
 
 
+class TestMultiHostInitIdempotent:
+    def test_second_call_is_noop(self, monkeypatch):
+        """jax.distributed raises on re-entry ('should only be called
+        once'); initialize_multi_host must swallow exactly that (repeated
+        parse_args in tests/notebooks) and re-raise anything else."""
+        import jax
+
+        from megatronapp_tpu.parallel import mesh as mesh_mod
+
+        calls = []
+
+        def fake_init(**kw):
+            calls.append(kw)
+            if len(calls) > 1:
+                raise RuntimeError(
+                    "jax.distributed.initialize should only be called once.")
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        mesh_mod.initialize_multi_host()
+        mesh_mod.initialize_multi_host()   # must not raise
+        assert len(calls) == 2
+
+        def other_err(**kw):
+            raise RuntimeError("coordinator unreachable")
+
+        monkeypatch.setattr(jax.distributed, "initialize", other_err)
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError, match="unreachable"):
+            mesh_mod.initialize_multi_host()
+
+
 class TestRampupPipelineValidation:
     def test_incompatible_ramp_stage_fails_at_startup(self, devices8):
         """A rampup stage whose microbatch count violates the interleaved
