@@ -1,0 +1,364 @@
+"""DINO self-distillation pretraining (student/teacher ViT).
+
+Parity with /root/reference/megatron/legacy/model/vision/dino.py
+(DINOLoss :23, DINOHead :82, MultiCropWrapper :118, DINOPretrainModel :219,
+cosine_scheduler :159) and pretrain_vision_dino.py. TPU-first re-design:
+the reference's stateful torch modules (EMA teacher, center buffer,
+momentum/temp schedules indexed by epoch) become one pure jitted train
+step over an explicit state pytree {student params, opt state, teacher
+params, center} — the EMA update, the center momentum update, and the
+last-layer gradient freeze are all traced-in `lax`-friendly arithmetic,
+and the cross-replica center mean falls out of jnp.mean over the
+dp-sharded batch axis (the reference's hand-written all_reduce,
+dino.py:73-80).
+
+Multi-crop: 2 global + N local views. Local crops run the same backbone
+with the patch-grid position table bilinearly resized (the reference
+interpolates pos embeddings inside VitBackbone for mismatched input
+sizes); both resolutions batch over the leading axis so the MXU sees two
+large matmul streams instead of ncrops small ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.models.vision import (
+    VitSpec, init_vit_params, vit_backbone,
+)
+
+
+@dataclasses.dataclass
+class DinoSpec:
+    """DINO hyperparameters (reference args: --dino-* flags,
+    arguments.py _add_vision_args)."""
+    out_dim: int = 65536              # prototype count (dino.py out_dim)
+    head_hidden: int = 2048           # --dino-head-hidden-size
+    bottleneck: int = 256             # --dino-bottleneck-size
+    head_nlayers: int = 3
+    norm_last_layer: bool = True      # --dino-norm-last-layer
+    n_local_crops: int = 2            # --dino-local-crops-number
+    local_crop_size: int = 96         # --dino-local-img-size
+    student_temp: float = 0.1
+    warmup_teacher_temp: float = 0.04  # --dino-warmup-teacher-temp
+    teacher_temp: float = 0.07         # --dino-teacher-temp
+    warmup_teacher_temp_iters: int = 0
+    center_momentum: float = 0.9
+    momentum_teacher: float = 0.996    # --dino-momentum-teacher
+    freeze_last_layer_iters: int = 0   # --dino-freeze-last-layer (in iters)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+
+
+def init_dino_head_params(rng, in_dim: int, spec: DinoSpec, std: float):
+    """MLP (nlayers, GELU) → L2-normalize → weight-normed linear
+    (reference DINOHead, dino.py:82-116)."""
+    n = max(spec.head_nlayers, 1)
+    keys = jax.random.split(rng, n + 1)
+    p: Dict[str, Any] = {}
+    ax: Dict[str, Any] = {}
+    dims = ([in_dim, spec.bottleneck] if n == 1 else
+            [in_dim] + [spec.head_hidden] * (n - 1) + [spec.bottleneck])
+    for i in range(n):
+        p[f"mlp{i}_kernel"] = jax.random.normal(
+            keys[i], (dims[i], dims[i + 1]), jnp.float32) * std
+        p[f"mlp{i}_bias"] = jnp.zeros((dims[i + 1],), jnp.float32)
+        ax[f"mlp{i}_kernel"] = (None, None)
+        ax[f"mlp{i}_bias"] = (None,)
+    # Weight-norm direction v; magnitude g is fixed at 1 when
+    # norm_last_layer (reference weight_g.requires_grad=False).
+    p["last_v"] = jax.random.normal(
+        keys[n], (spec.bottleneck, spec.out_dim), jnp.float32) * std
+    ax["last_v"] = (None, None)
+    if not spec.norm_last_layer:
+        p["last_g"] = jnp.ones((spec.out_dim,), jnp.float32)
+        ax["last_g"] = (None,)
+    return p, ax
+
+
+def dino_head_forward(p, x: jnp.ndarray, spec: DinoSpec) -> jnp.ndarray:
+    """[B, H] features → [B, out_dim] prototype scores."""
+    x = x.astype(jnp.float32)
+    n = max(spec.head_nlayers, 1)
+    for i in range(n):
+        x = x @ p[f"mlp{i}_kernel"] + p[f"mlp{i}_bias"]
+        if i < n - 1:
+            x = jax.nn.gelu(x)
+    x = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-12)
+    v = p["last_v"]
+    w = v / (jnp.linalg.norm(v, axis=0, keepdims=True) + 1e-12)
+    if "last_g" in p:
+        w = w * p["last_g"][None, :]
+    return x @ w
+
+
+def init_dino_params(rng, cfg: TransformerConfig, vit_spec: VitSpec,
+                     spec: DinoSpec):
+    """Student params + logical axes. The teacher is a structural copy
+    made by the caller (same pytree), never differentiated."""
+    kb, kh = jax.random.split(rng)
+    backbone, bb_ax = init_vit_params(kb, cfg, vit_spec, with_head=False)
+    head, head_ax = init_dino_head_params(kh, cfg.hidden_size, spec,
+                                          cfg.init_method_std)
+    return ({"backbone": backbone, "head": head},
+            {"backbone": bb_ax, "head": head_ax})
+
+
+# ---------------------------------------------------------------------------
+# Multi-crop forward
+
+
+def _adapt_pos(pos: jnp.ndarray, from_grid: int, to_grid: int) -> jnp.ndarray:
+    """Bilinearly resize the patch-grid part of a [1+P, H] position table
+    to a different crop resolution (reference VitBackbone interpolates for
+    mismatched img sizes; DINO local crops are smaller than global)."""
+    if from_grid == to_grid:
+        return pos
+    cls_pos, grid = pos[:1], pos[1:]
+    h = grid.shape[-1]
+    grid = grid.reshape(from_grid, from_grid, h)
+    grid = jax.image.resize(grid, (to_grid, to_grid, h), method="bilinear")
+    return jnp.concatenate([cls_pos, grid.reshape(to_grid * to_grid, h)], 0)
+
+
+def dino_branch_forward(p, images: jnp.ndarray, cfg: TransformerConfig,
+                        vit_spec: VitSpec, spec: DinoSpec,
+                        ctx=None) -> jnp.ndarray:
+    """One branch (student or teacher) over a stack of same-size crops:
+    [B, S, S, C] → [B, out_dim]. Handles local-crop sizes by resizing the
+    position table to the crop's patch grid."""
+    crop = images.shape[1]
+    from_grid = vit_spec.image_size // vit_spec.patch_size
+    to_grid = crop // vit_spec.patch_size
+    bb = p["backbone"]
+    if to_grid != from_grid:
+        bb = dict(bb, pos=_adapt_pos(bb["pos"], from_grid, to_grid))
+    local_spec = dataclasses.replace(vit_spec, image_size=crop)
+    enc = vit_backbone(bb, images, cfg, local_spec, ctx=ctx)
+    return dino_head_forward(p["head"], enc[:, 0], spec)
+
+
+def dino_forward(student, teacher, global_crops: jnp.ndarray,
+                 local_crops: Optional[jnp.ndarray],
+                 cfg: TransformerConfig, vit_spec: VitSpec, spec: DinoSpec,
+                 ctx=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """global_crops [B, 2, S, S, C]; local_crops [B, n, s, s, C] or None.
+
+    Returns (student_out [(2+n)*B, out_dim] view-major, teacher_out
+    [2*B, out_dim]) — the reference MultiCropWrapper's chunk layout."""
+    b = global_crops.shape[0]
+    # View-major ordering: crops of one view are contiguous (torch.chunk
+    # semantics in DINOLoss), so [B,2,...] must transpose to [2,B,...].
+    flat_g = global_crops.transpose(1, 0, 2, 3, 4).reshape(
+        (2 * b,) + global_crops.shape[2:])
+    s_global = dino_branch_forward(student, flat_g, cfg, vit_spec, spec,
+                                   ctx=ctx)
+    t_out = dino_branch_forward(teacher, flat_g, cfg, vit_spec, spec,
+                                ctx=ctx)
+    if local_crops is not None and local_crops.shape[1] > 0:
+        n = local_crops.shape[1]
+        flat_l = local_crops.transpose(1, 0, 2, 3, 4).reshape(
+            (n * b,) + local_crops.shape[2:])
+        s_local = dino_branch_forward(student, flat_l, cfg, vit_spec, spec,
+                                      ctx=ctx)
+        s_out = jnp.concatenate([s_global, s_local], axis=0)
+    else:
+        s_out = s_global
+    return s_out, jax.lax.stop_gradient(t_out)
+
+
+# ---------------------------------------------------------------------------
+# Loss + schedules
+
+
+def teacher_temp_at(step, spec: DinoSpec):
+    """Linear warmup warmup_teacher_temp → teacher_temp (reference
+    teacher_temp_schedule, dino.py:34-39, per-iter instead of per-epoch)."""
+    w = max(spec.warmup_teacher_temp_iters, 1)
+    frac = jnp.clip(step.astype(jnp.float32) / w, 0.0, 1.0)
+    warm = spec.warmup_teacher_temp + frac * (
+        spec.teacher_temp - spec.warmup_teacher_temp)
+    return jnp.where(step >= spec.warmup_teacher_temp_iters,
+                     spec.teacher_temp, warm)
+
+
+def teacher_momentum_at(step, train_iters: int, spec: DinoSpec):
+    """Cosine ramp momentum_teacher → 1.0 (reference cosine_scheduler,
+    dino.py:159, applied to the EMA momentum in update_momentum :286)."""
+    frac = jnp.clip(step.astype(jnp.float32) / max(train_iters, 1), 0., 1.)
+    return 1.0 - (1.0 - spec.momentum_teacher) * (
+        jnp.cos(jnp.pi * frac) + 1.0) / 2.0
+
+
+def dino_loss(student_out: jnp.ndarray, teacher_out: jnp.ndarray,
+              center: jnp.ndarray, step, spec: DinoSpec,
+              batch_size: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Cross-entropy between teacher and student softmaxes across views,
+    skipping same-view pairs (reference DINOLoss.forward, dino.py:41-71).
+
+    Returns (loss, new_center). The center update (momentum mean of
+    teacher outputs, dino.py:73-80) is global across data-parallel
+    replicas for free: under jit the batch axis is dp-sharded and
+    jnp.mean reduces globally.
+    """
+    temp = teacher_temp_at(step, spec)
+    t = jax.nn.softmax((teacher_out - center) / temp, axis=-1)
+    t = jax.lax.stop_gradient(t)
+    s_views = student_out.reshape(-1, batch_size, spec.out_dim)
+    t_views = t.reshape(2, batch_size, spec.out_dim)
+    s_logp = jax.nn.log_softmax(s_views / spec.student_temp, axis=-1)
+
+    total = jnp.zeros((), jnp.float32)
+    n_terms = 0
+    for iq in range(2):
+        for v in range(s_views.shape[0]):
+            if v == iq:
+                continue  # skip same-view pairs (dino.py:63)
+            total += jnp.mean(jnp.sum(-t_views[iq] * s_logp[v], axis=-1))
+            n_terms += 1
+    loss = total / max(n_terms, 1)
+
+    batch_center = jnp.mean(teacher_out, axis=0, keepdims=True)
+    new_center = (center * spec.center_momentum +
+                  batch_center * (1.0 - spec.center_momentum))
+    return loss, jax.lax.stop_gradient(new_center)
+
+
+# ---------------------------------------------------------------------------
+# Train step (student grads → optimizer → EMA teacher → center)
+
+
+def setup_dino_train_state(rng, cfg: TransformerConfig, vit_spec: VitSpec,
+                           spec: DinoSpec, optimizer, ctx):
+    """State pytree {'step','params','opt_state','teacher','center'},
+    jit-initialized into shardings (teacher mirrors the student's axes;
+    the reference clones the student into the teacher at startup,
+    dino.py:242-252)."""
+    from megatronapp_tpu.parallel.sharding import tree_logical_to_sharding
+    from megatronapp_tpu.training.train_state import (
+        pick_rules, state_logical_axes,
+    )
+
+    captured = {}
+
+    def _shapes_only(r):
+        p, ax = init_dino_params(r, cfg, vit_spec, spec)
+        captured["axes"] = ax
+        return p
+
+    jax.eval_shape(_shapes_only, rng)
+    params_axes = captured["axes"]
+
+    def _init(r):
+        params, _ = init_dino_params(r, cfg, vit_spec, spec)
+        return {"step": jnp.zeros((), jnp.int32), "params": params,
+                "opt_state": optimizer.init(params),
+                "teacher": jax.tree.map(jnp.copy, params),
+                "center": jnp.zeros((1, spec.out_dim), jnp.float32)}
+
+    struct = jax.eval_shape(_init, rng)
+    axes = state_logical_axes(params_axes, struct["opt_state"])
+    axes["teacher"] = params_axes
+    axes["center"] = (None, None)
+    shardings = tree_logical_to_sharding(axes, ctx.mesh, pick_rules(ctx))
+    with ctx.mesh:
+        state = jax.jit(_init, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def make_dino_train_step(cfg: TransformerConfig, vit_spec: VitSpec,
+                         spec: DinoSpec, optimizer, opt_cfg, ctx,
+                         state_shardings, train_iters: int):
+    """One jitted step: student grad + update, teacher EMA, center update
+    (reference pretrain loop: loss_func + update_momentum +
+    cancel_gradients_last_layer, dino.py:266-293)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from megatronapp_tpu.training.optimizer import (
+        global_grad_norm, lr_schedule,
+    )
+    sched = lr_schedule(opt_cfg, train_iters)
+
+    def step(state, batch):
+        params, teacher = state["params"], state["teacher"]
+        b = batch["global_crops"].shape[0]
+
+        def loss_fn(p):
+            s_out, t_out = dino_forward(
+                p, teacher, batch["global_crops"],
+                batch.get("local_crops"), cfg, vit_spec, spec, ctx=ctx)
+            loss, new_center = dino_loss(s_out, t_out, state["center"],
+                                         state["step"], spec, b)
+            return loss, (new_center, t_out)
+
+        (loss, (new_center, t_out)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+
+        # Freeze the last prototype layer for the first K iters
+        # (reference cancel_gradients_last_layer, dino.py:278-284).
+        if spec.freeze_last_layer_iters > 0:
+            gate = (state["step"] >=
+                    spec.freeze_last_layer_iters).astype(jnp.float32)
+            grads["head"]["last_v"] = grads["head"]["last_v"] * gate
+            if "last_g" in grads["head"]:
+                grads["head"]["last_g"] = grads["head"]["last_g"] * gate
+
+        grad_norm = global_grad_norm(grads)
+        updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                            params)
+        new_params = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
+                                  params, updates)
+
+        m = teacher_momentum_at(state["step"], train_iters, spec)
+        new_teacher = jax.tree.map(
+            lambda t, s: t * m + s.astype(t.dtype) * (1.0 - m),
+            teacher, new_params)
+
+        new_state = {"step": state["step"] + 1, "params": new_params,
+                     "opt_state": new_opt, "teacher": new_teacher,
+                     "center": new_center}
+        metrics = {"loss": loss, "grad_norm": grad_norm,
+                   "lr": sched(state["step"]), "teacher_momentum": m}
+        return new_state, metrics
+
+    b_sh = NamedSharding(ctx.mesh, P(ctx.batch_spec()[0]))
+    return jax.jit(step, in_shardings=(state_shardings, b_sh),
+                   out_shardings=(state_shardings, None),
+                   donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# KNN monitor (reference knn_monitor.py knn_predict / feature bank)
+
+
+def knn_predict(feature: jnp.ndarray, feature_bank: jnp.ndarray,
+                feature_labels: jnp.ndarray, classes: int, knn_k: int,
+                knn_t: float) -> jnp.ndarray:
+    """Weighted-KNN class prediction (reference knn_monitor.knn_predict:
+    cosine sim → top-k → exp(sim/T) weights → one-hot score sum).
+
+    feature [B, D] (L2-normalized), feature_bank [D, N],
+    feature_labels [N] → predicted labels [B, classes-ranked]."""
+    sim = feature @ feature_bank                       # [B, N]
+    sim_w, idx = jax.lax.top_k(sim, knn_k)             # [B, K]
+    labels = feature_labels[idx]                       # [B, K]
+    w = jnp.exp(sim_w / knn_t)
+    one_hot = jax.nn.one_hot(labels, classes, dtype=w.dtype)  # [B, K, C]
+    scores = jnp.sum(one_hot * w[..., None], axis=1)   # [B, C]
+    return jnp.argsort(-scores, axis=-1)
+
+
+def compute_features(teacher, images: jnp.ndarray, cfg: TransformerConfig,
+                     vit_spec: VitSpec, ctx=None) -> jnp.ndarray:
+    """L2-normalized teacher CLS features for the bank
+    (knn_monitor.compute_feature_bank)."""
+    enc = vit_backbone(teacher["backbone"], images, cfg, vit_spec, ctx=ctx)
+    f = enc[:, 0].astype(jnp.float32)
+    return f / (jnp.linalg.norm(f, axis=-1, keepdims=True) + 1e-12)

@@ -1,0 +1,137 @@
+"""ViT inpainting pretraining (masked-region reconstruction).
+
+Parity with /root/reference/megatron/legacy/model/vision/inpainting.py
+(VitInpaintingModel :19 — ViT backbone + zero-init linear patch decoder →
+rearrange back to an image) and pretrain_vision_inpaint.py (masked-MSE
+loss normalized by mask count + PSNR/SSIM metrics,
+tasks/vision/segmentation/metrics.py:414-505). TPU-first: patch decode is
+one [B,P,H]×[H,patch_dim] matmul and the un-patchify is a
+reshape/transpose (inverse of models/vision.patchify — no einops/conv);
+SSIM's per-channel gaussian filtering is a depthwise
+lax.conv_general_dilated that XLA fuses.
+
+Design note: the reference builds the backbone with class_token=False;
+here the shared ViT keeps its CLS token and the decoder reads the patch
+tokens enc[:, 1:] — same reconstruction capacity, one backbone
+implementation for classify/DINO/inpaint.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.models.vision import (
+    VitSpec, init_vit_params, vit_backbone,
+)
+
+
+def init_inpaint_params(rng, cfg: TransformerConfig, spec: VitSpec):
+    kb, _ = jax.random.split(rng)
+    p, ax = init_vit_params(kb, cfg, spec, with_head=False)
+    # Zero-init decoder (reference get_linear_layer(..., init.zeros_),
+    # inpainting.py:42-46).
+    p["decoder_kernel"] = jnp.zeros((cfg.hidden_size, spec.patch_dim),
+                                    jnp.float32)
+    p["decoder_bias"] = jnp.zeros((spec.patch_dim,), jnp.float32)
+    ax["decoder_kernel"] = ("embed", None)
+    ax["decoder_bias"] = (None,)
+    return p, ax
+
+
+def unpatchify(patches: jnp.ndarray, patch: int, image_size: int,
+               channels: int) -> jnp.ndarray:
+    """[B, P, p*p*C] → [B, H, W, C] (inverse of vision.patchify; the
+    reference's einops rearrange, inpainting.py:58-65)."""
+    b = patches.shape[0]
+    g = image_size // patch
+    x = patches.reshape(b, g, g, patch, patch, channels)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(b, image_size, image_size, channels)
+
+
+def inpaint_forward(p, images: jnp.ndarray, cfg: TransformerConfig,
+                    spec: VitSpec, ctx=None) -> jnp.ndarray:
+    """Masked image [B, H, W, C] → reconstruction [B, H, W, C]."""
+    enc = vit_backbone(p, images, cfg, spec, ctx=ctx)
+    decoded = enc[:, 1:].astype(jnp.float32) @ p["decoder_kernel"] \
+        + p["decoder_bias"]
+    return unpatchify(decoded, spec.patch_size, spec.image_size,
+                      spec.num_channels)
+
+
+def psnr(pred: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    """10·log10(1/mse) on [0,1]-range images (reference PSNR,
+    metrics.py:414-432)."""
+    mse = jnp.mean((pred - target) ** 2)
+    return 10.0 * jnp.log10(1.0 / jnp.maximum(mse, 1e-10))
+
+
+def _gaussian_window(size: int, sigma: float) -> jnp.ndarray:
+    x = jnp.arange(size, dtype=jnp.float32) - size // 2
+    g = jnp.exp(-(x ** 2) / (2.0 * sigma ** 2))
+    g = g / jnp.sum(g)
+    return jnp.outer(g, g)
+
+
+def ssim(pred: jnp.ndarray, target: jnp.ndarray, window_size: int = 11,
+         sigma: float = 1.5) -> jnp.ndarray:
+    """Structural similarity on [B, H, W, C] images (reference SSIM,
+    metrics.py:435-505: 11×11 gaussian σ=1.5, C1=0.01², C2=0.03²).
+    Depthwise gaussian filtering via feature-grouped convolution."""
+    c = pred.shape[-1]
+    win = _gaussian_window(window_size, sigma)
+    # [H, W, in_per_group=1, out=C] depthwise kernel.
+    kernel = jnp.tile(win[:, :, None, None], (1, 1, 1, c))
+
+    def filt(x):
+        return jax.lax.conv_general_dilated(
+            x.astype(jnp.float32), kernel, window_strides=(1, 1),
+            padding="VALID", feature_group_count=c,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    mu_p, mu_t = filt(pred), filt(target)
+    mu_pp, mu_tt, mu_pt = mu_p * mu_p, mu_t * mu_t, mu_p * mu_t
+    sig_p = filt(pred * pred) - mu_pp
+    sig_t = filt(target * target) - mu_tt
+    sig_pt = filt(pred * target) - mu_pt
+    c1, c2 = 0.01 ** 2, 0.03 ** 2
+    ssim_map = ((2 * mu_pt + c1) * (2 * sig_pt + c2)) / (
+        (mu_pp + mu_tt + c1) * (sig_p + sig_t + c2))
+    return jnp.mean(ssim_map)
+
+
+def inpaint_loss(p, images: jnp.ndarray, masks: jnp.ndarray,
+                 cfg: TransformerConfig, spec: VitSpec,
+                 ctx=None) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Masked-region MSE + PSNR/SSIM metrics (reference loss_func,
+    pretrain_vision_inpaint.py:47-74: outputs and images both masked to
+    the hole, sum-MSE / count_nonzero(mask)).
+
+    images [B,H,W,C] original; masks [B,H,W,1] with 1 = hole to fill.
+    The model sees the image with holes zeroed.
+    """
+    masked_input = images * (1.0 - masks)
+    out = inpaint_forward(p, masked_input, cfg, spec, ctx=ctx)
+    hole_out = out * masks
+    hole_img = images.astype(jnp.float32) * masks
+    mask_count = jnp.maximum(jnp.sum(masks) * spec.num_channels, 1.0)
+    loss = jnp.sum((hole_out - hole_img) ** 2) / mask_count
+    return loss, {"loss_mse": loss, "psnr": psnr(hole_out, hole_img),
+                  "ssim": ssim(hole_out, hole_img)}
+
+
+def random_patch_masks(rng: jnp.ndarray, batch: int, spec: VitSpec,
+                       mask_ratio: float = 0.25) -> jnp.ndarray:
+    """Patch-aligned random hole masks [B, H, W, 1] (the reference's
+    RandomMaskingGenerator in the vit dataset transform): each patch is
+    masked i.i.d. with probability mask_ratio."""
+    g = spec.image_size // spec.patch_size
+    bits = (jax.random.uniform(rng, (batch, g, g)) <
+            mask_ratio).astype(jnp.float32)
+    m = jnp.repeat(jnp.repeat(bits, spec.patch_size, axis=1),
+                   spec.patch_size, axis=2)
+    return m[..., None]

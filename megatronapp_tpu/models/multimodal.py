@@ -29,11 +29,16 @@ from megatronapp_tpu.transformer.block import block_forward
 
 
 def init_vlm_params(rng, lm_cfg: TransformerConfig,
-                    vis_cfg: TransformerConfig, spec: VitSpec):
-    """{'vision', 'projector', 'lm'} param tree + logical axes."""
+                    vis_cfg: TransformerConfig, spec: VitSpec,
+                    clip_tower: bool = False):
+    """{'vision', 'projector', 'lm'} param tree + logical axes.
+
+    clip_tower=True uses the CLIP-structured vision params (pre-LN, no
+    final norm) matching converted HF LLaVA checkpoints."""
     k_vis, k_proj1, k_proj2, k_lm = jax.random.split(rng, 4)
     std = lm_cfg.init_method_std
-    vis_p, vis_ax = init_vit_params(k_vis, vis_cfg, spec, with_head=False)
+    vis_p, vis_ax = init_vit_params(k_vis, vis_cfg, spec, with_head=False,
+                                    clip_variant=clip_tower)
     lm_p, lm_ax = init_gpt_params(k_lm, lm_cfg)
     p = {
         "vision": vis_p,

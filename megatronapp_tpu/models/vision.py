@@ -65,7 +65,11 @@ def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
 
 
 def init_vit_params(rng, cfg: TransformerConfig, spec: VitSpec,
-                    with_head: bool = True):
+                    with_head: bool = True, clip_variant: bool = False):
+    """clip_variant=True produces the CLIP-tower structure (pre-encoder
+    layernorm, no final norm) that tools/checkpoint/convert.py's LLaVA
+    loader emits, so converted checkpoints restore against this init's
+    pytree template."""
     keys = jax.random.split(rng, 5)
     std = cfg.init_method_std
     h = cfg.hidden_size
@@ -77,14 +81,21 @@ def init_vit_params(rng, cfg: TransformerConfig, spec: VitSpec,
             keys[1], (1, 1, h), cfg.params_dtype) * std,
         "pos": jax.random.normal(
             keys[2], (1 + spec.num_patches, h), cfg.params_dtype) * std,
-        "final_ln_scale": jnp.ones((h,), cfg.params_dtype),
-        "final_ln_bias": jnp.zeros((h,), cfg.params_dtype),
     }
     ax = {
         "patch_proj": (None, "embed"), "patch_bias": ("embed",),
         "cls_token": (None, None, "embed"), "pos": ("pos", "embed"),
-        "final_ln_scale": ("embed",), "final_ln_bias": ("embed",),
     }
+    if clip_variant:
+        p["pre_ln_scale"] = jnp.ones((h,), cfg.params_dtype)
+        p["pre_ln_bias"] = jnp.zeros((h,), cfg.params_dtype)
+        ax["pre_ln_scale"] = ("embed",)
+        ax["pre_ln_bias"] = ("embed",)
+    else:
+        p["final_ln_scale"] = jnp.ones((h,), cfg.params_dtype)
+        p["final_ln_bias"] = jnp.zeros((h,), cfg.params_dtype)
+        ax["final_ln_scale"] = ("embed",)
+        ax["final_ln_bias"] = ("embed",)
     p["block"], ax["block"] = init_block_params(keys[3], cfg)
     if with_head:
         p["head_kernel"] = jax.random.normal(
@@ -97,7 +108,13 @@ def init_vit_params(rng, cfg: TransformerConfig, spec: VitSpec,
 
 def vit_backbone(p, images: jnp.ndarray, cfg: TransformerConfig,
                  spec: VitSpec, ctx=None) -> jnp.ndarray:
-    """[B, H, W, C] images → [B, 1+P, H] encoded tokens (CLS first)."""
+    """[B, H, W, C] images → [B, 1+P, H] encoded tokens (CLS first).
+
+    Optional param-presence-gated variants (used by converted CLIP towers,
+    tools/checkpoint/convert.py llava path): a 'pre_ln_*' layernorm after
+    the position add (CLIP pre_layrnorm), and omitting 'final_ln_scale'
+    skips the output norm (LLaVA consumes an intermediate feature layer
+    that is never post-normalized)."""
     b = images.shape[0]
     x = patchify(images.astype(cfg.compute_dtype), spec.patch_size)
     x = x @ p["patch_proj"].astype(cfg.compute_dtype) \
@@ -106,7 +123,12 @@ def vit_backbone(p, images: jnp.ndarray, cfg: TransformerConfig,
                            (b, 1, cfg.hidden_size))
     x = jnp.concatenate([cls, x], axis=1)
     x = x + p["pos"].astype(cfg.compute_dtype)[None]
+    if "pre_ln_scale" in p:
+        x = apply_norm(NormKind.layernorm, x, p["pre_ln_scale"],
+                       p.get("pre_ln_bias"), cfg.layernorm_epsilon)
     x, _ = block_forward(p["block"], x, cfg, None, None, None, ctx=ctx)
+    if "final_ln_scale" not in p:
+        return x
     return apply_norm(NormKind.layernorm, x, p["final_ln_scale"],
                       p["final_ln_bias"], cfg.layernorm_epsilon)
 

@@ -1,0 +1,265 @@
+"""MegaDPP dynamic runtime: readiness-driven transfer ordering.
+
+Parity with the reference's dynamic half of MegaDPP (paper §5.2): the
+static schedules in parallel/pipeline.py pick a *compile-time* send order
+(dfc/bfc); the reference additionally runs background sender threads that
+scan a pool of finished tensors and ship whichever (chunk, microbatch) is
+ready first in DFC/BFC priority order
+(/root/reference/megatron/shm_tensor_new_rdma/shm_tensor_new_rdma.cpp:1478-1646
+forward_send/backward_send traversal), through a pre-allocated bounded
+buffer pool with ready/expired queues
+(/root/reference/megatron/shm_tensor_new_rdma_pre_alloc/shm_tensor_new_rdma_pre_alloc.cpp:126-205
+NUM_GPU_BUFFERS=4 + ready_buffers/expired_buffers + condition variables).
+
+TPU-first reinterpretation: per-(stage, chunk) computations are separate
+XLA executables dispatched asynchronously per stage device; the host
+runtime watches completion (readiness) and *initiates inter-stage
+transfers in priority order among the tensors that are actually ready*,
+holding a slot from a bounded TransferPool for the duration of each
+transfer. The transfer itself is one `jax.device_put` — PJRT DMA (ICI on
+a pod, host staging on the tunneled chip) — so the runtime only
+*sequences* transfers; Python threads are fine because dispatch,
+block_until_ready and device_put all release the GIL. The static baseline
+(`dynamic=False`) ships strictly in schedule order, blocking on each
+index in turn even when later tensors are already finished — exactly the
+stall DPP exists to remove.
+
+The backward direction of the reference (backward_send, mirrored
+priority) is symmetric; the FBD executor (parallel/fbd.py) already ships
+vjp residuals fwd→bwd, so this runtime exposes the forward direction and
+the generic scheduler both halves share.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+__all__ = [
+    "send_priority", "static_order", "TransferPool", "DppPipelineRunner",
+]
+
+
+def send_priority(chunk: int, mb: int, pp: int, vpp: int,
+                  policy: str = "dfc") -> Tuple[int, ...]:
+    """Priority key for a finished (chunk, microbatch) activation — lower
+    ships first. Mirrors the reference forward_send traversal of the
+    (chunk, microbatch) matrix (shm_tensor_new_rdma.cpp:1487-1510):
+
+    - 'dfc' (depth-first-chunk): rounds of pp microbatches; within a
+      round, all chunks before the next round — the interleaved-schedule
+      order (round, chunk, position).
+    - 'bfc' (breadth-first-chunk): all microbatches of chunk c before
+      chunk c+1 (chunk, mb).
+    """
+    if policy == "dfc":
+        return (mb // pp, chunk, mb % pp)
+    if policy == "bfc":
+        return (chunk, mb)
+    raise ValueError(f"unknown DPP order policy {policy!r}")
+
+
+def static_order(pp: int, vpp: int, num_microbatches: int,
+                 policy: str = "dfc") -> List[Tuple[int, int]]:
+    """The full (chunk, mb) send order a static scheduler commits to."""
+    items = [(c, m) for c in range(vpp) for m in range(num_microbatches)]
+    items.sort(key=lambda cm: send_priority(cm[0], cm[1], pp, vpp, policy))
+    return items
+
+
+class TransferPool:
+    """Bounded pool of transfer slots (the reference's NUM_GPU_BUFFERS
+    pre-allocated staging buffers with ready/expired queues,
+    shm_tensor_new_rdma_pre_alloc.cpp:126-205). A sender must hold a slot
+    for the duration of a transfer; acquisition stall time is recorded —
+    it is the backpressure signal the dynamic scheduler reacts to."""
+
+    def __init__(self, n_buffers: int = 4):
+        self._sem = threading.Semaphore(n_buffers)
+        self._lock = threading.Lock()
+        self.stall_s = 0.0
+        self.acquisitions = 0
+
+    def acquire(self) -> None:
+        t0 = time.perf_counter()
+        self._sem.acquire()
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.stall_s += dt
+            self.acquisitions += 1
+
+    def release(self) -> None:
+        self._sem.release()
+
+
+class _Mailbox:
+    """Arrival table keyed by (chunk, mb) with blocking pop."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items: Dict[Tuple[int, int], Any] = {}
+
+    def put(self, key: Tuple[int, int], value: Any) -> None:
+        with self._cv:
+            self._items[key] = value
+            self._cv.notify_all()
+
+    def pop(self, key: Tuple[int, int], timeout: float = 120.0) -> Any:
+        with self._cv:
+            ok = self._cv.wait_for(lambda: key in self._items, timeout)
+            if not ok:
+                raise TimeoutError(f"activation {key} never arrived")
+            return self._items.pop(key)
+
+    def pop_best(self, keyfn, timeout: float = 120.0) -> Tuple[Tuple[int, int], Any]:
+        """Pop the minimum-priority available item (dynamic readiness
+        scan, reference forward_send:1487-1520)."""
+        with self._cv:
+            ok = self._cv.wait_for(lambda: bool(self._items), timeout)
+            if not ok:
+                raise TimeoutError("no activation became ready")
+            key = min(self._items, key=keyfn)
+            return key, self._items.pop(key)
+
+
+class DppPipelineRunner:
+    """Host-driven interleaved pipeline with dynamic send ordering.
+
+    chunk_fn(stage, chunk, h, mb) -> h' runs one model chunk of one
+    microbatch (typically a jitted function closed over that stage's
+    params, placed on ``devices[stage]``). The runner executes the full
+    vpp-interleaved forward: (stage s, chunk c) feeds (s+1, c) or wraps
+    (pp-1, c) → (0, c+1); chunk vpp-1 leaving stage pp-1 is an output.
+
+    Per stage, a compute thread consumes arrivals and a sender thread
+    ships finished activations — in readiness-first priority order
+    (``dynamic=True``) or strict static order — through a bounded
+    TransferPool per link. Metrics collected per run:
+      transfer_order[stage]  — (chunk, mb) in actual ship order
+      sender_stall_s[stage]  — time the sender spent waiting for work
+      pool_stall_s[stage]    — time blocked on the bounded buffer pool
+      compute_wait_s[stage]  — time the compute loop starved for inputs
+                               (the downstream stall DPP reordering cuts)
+    """
+
+    def __init__(self, chunk_fn: Callable[[int, int, Any, int], Any],
+                 devices: Sequence[Any], pp: int, vpp: int,
+                 num_microbatches: int, policy: str = "dfc",
+                 dynamic: bool = True, n_buffers: int = 4):
+        if len(devices) < pp:
+            raise ValueError(f"need {pp} devices, got {len(devices)}")
+        self.chunk_fn = chunk_fn
+        self.devices = list(devices[:pp])
+        self.pp, self.vpp, self.M = pp, vpp, num_microbatches
+        self.policy, self.dynamic = policy, dynamic
+        self.n_buffers = n_buffers
+        # Per-run state (populated by run()).
+        self.transfer_order: List[List[Tuple[int, int]]] = []
+        self.sender_stall_s: List[float] = []
+        self.pool_stall_s: List[float] = []
+
+    # -- topology -----------------------------------------------------
+
+    def _next_hop(self, stage: int, chunk: int
+                  ) -> Optional[Tuple[int, int]]:
+        """(stage, chunk) an activation flows to next, or None if it is a
+        pipeline output."""
+        if stage < self.pp - 1:
+            return stage + 1, chunk
+        if chunk < self.vpp - 1:
+            return 0, chunk + 1
+        return None
+
+    # -- execution ----------------------------------------------------
+
+    def run(self, microbatch_inputs: Sequence[Any]) -> List[Any]:
+        """Execute the forward pipeline over all microbatches. Returns
+        outputs indexed by microbatch."""
+        if len(microbatch_inputs) != self.M:
+            raise ValueError("need one input per microbatch")
+        pp, vpp, M = self.pp, self.vpp, self.M
+        inboxes = [_Mailbox() for _ in range(pp)]       # compute inputs
+        finished = [_Mailbox() for _ in range(pp)]      # awaiting send
+        pools = [TransferPool(self.n_buffers) for _ in range(pp)]
+        outputs: Dict[int, Any] = {}
+        out_lock = threading.Lock()
+        errors: List[BaseException] = []
+        sender_stall = [0.0] * pp
+        compute_wait = [0.0] * pp
+        order_log: List[List[Tuple[int, int]]] = [[] for _ in range(pp)]
+
+        # Seed stage 0 with chunk-0 inputs.
+        for m, h in enumerate(microbatch_inputs):
+            inboxes[0].put((0, m), jax.device_put(h, self.devices[0]))
+
+        def keyfn(cm):
+            return send_priority(cm[0], cm[1], pp, vpp, self.policy)
+
+        def compute_loop(stage: int):
+            try:
+                n_items = vpp * M
+                for _ in range(n_items):
+                    # Compute follows readiness in priority order too (the
+                    # schedule order when nothing is late).
+                    t0 = time.perf_counter()
+                    (c, m), h = inboxes[stage].pop_best(keyfn)
+                    compute_wait[stage] += time.perf_counter() - t0
+                    h = self.chunk_fn(stage, c, h, m)
+                    jax.block_until_ready(h)
+                    finished[stage].put((c, m), h)
+            except BaseException as e:  # noqa: BLE001 — surfaced in run()
+                errors.append(e)
+
+        def sender_loop(stage: int):
+            try:
+                plan = static_order(pp, vpp, M, self.policy)
+                for i in range(len(plan)):
+                    t0 = time.perf_counter()
+                    if self.dynamic:
+                        (c, m), h = finished[stage].pop_best(keyfn)
+                    else:
+                        c, m = plan[i]           # strict static order:
+                        h = finished[stage].pop((c, m))  # block on it
+                    sender_stall[stage] += time.perf_counter() - t0
+                    order_log[stage].append((c, m))
+                    hop = self._next_hop(stage, c)
+                    if hop is None:
+                        with out_lock:
+                            outputs[m] = h
+                        continue
+                    nxt_stage, nxt_chunk = hop
+                    pools[stage].acquire()
+                    try:
+                        h = jax.device_put(h, self.devices[nxt_stage])
+                        jax.block_until_ready(h)
+                    finally:
+                        pools[stage].release()
+                    inboxes[nxt_stage].put((nxt_chunk, m), h)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = []
+        for s in range(pp):
+            threads.append(threading.Thread(target=compute_loop, args=(s,),
+                                            daemon=True))
+            threads.append(threading.Thread(target=sender_loop, args=(s,),
+                                            daemon=True))
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+        self.wall_s = time.perf_counter() - t_start
+        if errors:
+            raise errors[0]
+        if len(outputs) != M:
+            raise RuntimeError(f"pipeline produced {len(outputs)}/{M} "
+                               "outputs (thread timeout?)")
+        self.transfer_order = order_log
+        self.sender_stall_s = sender_stall
+        self.compute_wait_s = compute_wait
+        self.pool_stall_s = [p.stall_s for p in pools]
+        return [outputs[m] for m in range(M)]

@@ -13,7 +13,7 @@ import time
 import jax
 import numpy as np
 
-from megatronapp_tpu.config.arguments import build_parser, configs_from_args
+from megatronapp_tpu.config.arguments import build_parser, configs_from_args, parse_args
 from megatronapp_tpu.models.bert import (
     bert_config, bert_loss, init_bert_params, mock_bert_batch,
 )
@@ -29,7 +29,7 @@ def main(argv=None):
     ap.add_argument("--mask-prob", type=float, default=0.15)
     ap.add_argument("--short-seq-prob", type=float, default=0.1)
     ap.add_argument("--bert-no-binary-head", action="store_true")
-    args = ap.parse_args(argv)
+    args = parse_args(ap, argv)
     gpt_cfg, parallel, training, opt_cfg = configs_from_args(args)
     # Re-flavor the architecture config for BERT (bidirectional, learned
     # positions) keeping all sizes.

@@ -12,7 +12,7 @@ import time
 
 import jax
 
-from megatronapp_tpu.config.arguments import build_parser, configs_from_args
+from megatronapp_tpu.config.arguments import build_parser, configs_from_args, parse_args
 from megatronapp_tpu.models.bert import bert_config
 from megatronapp_tpu.models.biencoder import ict_loss, init_biencoder_params
 from megatronapp_tpu.parallel.mesh import build_mesh
@@ -30,7 +30,7 @@ def main(argv=None):
     ap.add_argument("--retriever-score-scaling", action="store_true")
     ap.add_argument("--biencoder-shared-query-context-model",
                     action="store_true")
-    args = ap.parse_args(argv)
+    args = parse_args(ap, argv)
     gpt_cfg, parallel, training, opt_cfg = configs_from_args(args)
     import dataclasses
     cfg = bert_config(**{f.name: getattr(gpt_cfg, f.name)

@@ -1,0 +1,86 @@
+"""Mamba / hybrid-SSM pretraining entry point.
+
+Parity with /root/reference/pretrain_mamba.py (MambaModel provider :44,
+GPT-style get_batch/loss_func over the same .bin/.idx data). Model is
+megatronapp_tpu/models/mamba.py: associative-scan selective SSM with
+optional hybrid attention layers (--hybrid-pattern 'MMM*'), trained with
+the shared microbatch-accumulating train step.
+"""
+
+import time
+
+import jax
+import numpy as np
+
+from megatronapp_tpu.config.arguments import (
+    parse_args,
+    build_parser, configs_from_args, make_batch_iter_factory,
+)
+from megatronapp_tpu.models.mamba import (
+    MambaConfig, init_mamba_params, mamba_loss,
+)
+from megatronapp_tpu.parallel.mesh import build_mesh
+from megatronapp_tpu.training.optimizer import get_optimizer
+from megatronapp_tpu.data.mock import mock_batches
+from megatronapp_tpu.training.train import reshape_global_batch
+from megatronapp_tpu.training.train_state import setup_train_state
+from megatronapp_tpu.training.train_step import make_train_step
+
+
+def main(argv=None):
+    ap = build_parser("pretrain_mamba (megatronapp-tpu)")
+    # Reference mamba flags (arguments.py --mamba-state-dim etc.).
+    ap.add_argument("--mamba-state-dim", type=int, default=16)
+    ap.add_argument("--mamba-conv-kernel", type=int, default=4)
+    ap.add_argument("--mamba-expand", type=int, default=2)
+    ap.add_argument("--hybrid-pattern", type=str, default=None,
+                    help="per-layer allocation, e.g. 'MMM*' (M=mamba, "
+                         "*=attention); default all-M")
+    args = parse_args(ap, argv)
+    cfg, parallel, training, opt_cfg = configs_from_args(args)
+    mcfg = MambaConfig(state_dim=args.mamba_state_dim,
+                       conv_kernel=args.mamba_conv_kernel,
+                       expand=args.mamba_expand,
+                       hybrid_pattern=args.hybrid_pattern)
+
+    ctx = build_mesh(parallel)
+    optimizer = get_optimizer(opt_cfg, training.train_iters)
+    state, shardings, _ = setup_train_state(
+        jax.random.PRNGKey(training.seed),
+        lambda k: init_mamba_params(k, cfg, mcfg), optimizer, ctx)
+
+    def loss_fn(params, micro):
+        return mamba_loss(params, micro["tokens"], micro["labels"],
+                          micro["loss_mask"], cfg, mcfg, ctx=ctx)
+
+    step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
+                              training.train_iters)
+    num_micro = training.num_microbatches(ctx.dp * ctx.ep)
+
+    factory = make_batch_iter_factory(args, training, cfg)
+    batch_iter = factory(0) if factory is not None else mock_batches(
+        training.seq_length, cfg.vocab_size, training.global_batch_size,
+        seed=training.seed)
+
+    losses = []
+    t0 = time.perf_counter()
+    with ctx.mesh:
+        for it in range(training.train_iters):
+            batch = reshape_global_batch(next(batch_iter), num_micro)
+            state, metrics = step_fn(state, batch)
+            if (it + 1) % training.log_interval == 0 or \
+                    it + 1 == training.train_iters:
+                metrics = jax.device_get(metrics)
+                losses.append(float(metrics["loss"]))
+                print(f"iter {it+1:6d}/{training.train_iters} | "
+                      f"loss {float(metrics['loss']):.4f} | "
+                      f"grad_norm {float(metrics['grad_norm']):.3f}")
+    dt = time.perf_counter() - t0
+    tokens = training.train_iters * training.global_batch_size * \
+        training.seq_length
+    print(f"done: final loss {losses[-1]:.4f}, {tokens/dt:,.0f} tok/s")
+    return losses
+
+
+if __name__ == "__main__":
+    main()

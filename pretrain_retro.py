@@ -11,7 +11,7 @@ import time
 import jax
 import numpy as np
 
-from megatronapp_tpu.config.arguments import build_parser, configs_from_args
+from megatronapp_tpu.config.arguments import build_parser, configs_from_args, parse_args
 from megatronapp_tpu.models.retro import (
     RetroSpec, init_retro_params, retro_loss,
 )
@@ -32,7 +32,7 @@ def main(argv=None):
                     help=".npz from tools/retro_preprocess.py "
                          "(samples + neighbors); synthetic stream if "
                          "absent")
-    args = ap.parse_args(argv)
+    args = parse_args(ap, argv)
     cfg, parallel, training, opt_cfg = configs_from_args(args)
     spec = RetroSpec(chunk_length=args.retro_chunk_length,
                      num_neighbors=args.retro_num_neighbors,

@@ -12,7 +12,7 @@ import time
 import jax
 import numpy as np
 
-from megatronapp_tpu.config.arguments import build_parser, configs_from_args
+from megatronapp_tpu.config.arguments import build_parser, configs_from_args, parse_args
 from megatronapp_tpu.models.t5 import init_t5_params, t5_config, t5_loss
 from megatronapp_tpu.parallel.mesh import build_mesh
 from megatronapp_tpu.training.optimizer import get_optimizer
@@ -41,7 +41,7 @@ def main(argv=None):
     ap.add_argument("--mask-prob", type=float, default=0.15)
     ap.add_argument("--short-seq-prob", type=float, default=0.1)
     ap.add_argument("--decoder-seq-length", type=int, default=None)
-    args = ap.parse_args(argv)
+    args = parse_args(ap, argv)
     gpt_cfg, parallel, training, opt_cfg = configs_from_args(args)
     import dataclasses
     cfg = t5_config(**{f.name: getattr(gpt_cfg, f.name)

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from megatronapp_tpu.config.arguments import build_parser, configs_from_args
+from megatronapp_tpu.config.arguments import build_parser, configs_from_args, parse_args
 from megatronapp_tpu.models.vision import (
     VitSpec, init_vit_params, vit_classification_loss, vit_config,
 )
@@ -27,7 +27,7 @@ def main(argv=None):
     ap.add_argument("--img-size", type=int, default=224)
     ap.add_argument("--patch-dim", type=int, default=16)
     ap.add_argument("--num-classes", type=int, default=1000)
-    args = ap.parse_args(argv)
+    args = parse_args(ap, argv)
     gpt_cfg, parallel, training, opt_cfg = configs_from_args(args)
     spec = VitSpec(image_size=args.img_size, patch_size=args.patch_dim,
                    num_classes=args.num_classes)

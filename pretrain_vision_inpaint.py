@@ -1,17 +1,18 @@
-"""Vision-language (LLaVA-style) pretraining entry point.
+"""ViT inpainting pretraining entry point.
 
-Parity with /root/reference/pretrain_vlm.py: ViT encoder → MLP projector →
-GPT decoder over [visual ‖ text], loss on text positions (synthetic
-image/caption stream unless a loader is wired in).
+Parity with /root/reference/pretrain_vision_inpaint.py (VitInpaintingModel
++ masked-MSE loss + PSNR/SSIM metrics). Synthetic image stream with
+patch-aligned random hole masks unless an image loader is wired in.
 """
 
+import dataclasses
 import time
 
 import jax
 import numpy as np
 
 from megatronapp_tpu.config.arguments import build_parser, configs_from_args, parse_args
-from megatronapp_tpu.models.multimodal import init_vlm_params, vlm_loss
+from megatronapp_tpu.models.inpaint import init_inpaint_params, inpaint_loss
 from megatronapp_tpu.models.vision import VitSpec, vit_config
 from megatronapp_tpu.parallel.mesh import build_mesh
 from megatronapp_tpu.training.optimizer import get_optimizer
@@ -21,58 +22,52 @@ from megatronapp_tpu.training.train_step import make_train_step
 
 
 def main(argv=None):
-    ap = build_parser("pretrain_vlm (megatronapp-tpu)")
+    ap = build_parser("pretrain_vision_inpaint (megatronapp-tpu)")
     ap.add_argument("--img-size", type=int, default=224)
     ap.add_argument("--patch-dim", type=int, default=16)
-    ap.add_argument("--vision-num-layers", type=int, default=2)
-    ap.add_argument("--vision-hidden-size", type=int, default=None)
-    ap.add_argument("--clip-vision-tower", action="store_true",
-                    help="CLIP-structured tower (pre-LN, no final norm) "
-                         "matching converted HF LLaVA checkpoints")
+    ap.add_argument("--mask-factor", type=float, default=0.25,
+                    help="fraction of patches masked per image")
     args = parse_args(ap, argv)
-    lm_cfg, parallel, training, opt_cfg = configs_from_args(args)
+    gpt_cfg, parallel, training, opt_cfg = configs_from_args(args)
     spec = VitSpec(image_size=args.img_size, patch_size=args.patch_dim)
-    vis_cfg = vit_config(
-        num_layers=args.vision_num_layers,
-        hidden_size=args.vision_hidden_size or lm_cfg.hidden_size // 2,
-        num_attention_heads=max(lm_cfg.num_attention_heads // 2, 1),
-        vocab_size=1, max_position_embeddings=1 + spec.num_patches,
-        compute_dtype=lm_cfg.compute_dtype)
+    cfg = vit_config(**{f.name: getattr(gpt_cfg, f.name)
+                        for f in dataclasses.fields(gpt_cfg)
+                        if f.name not in ("position_embedding",
+                                          "attn_mask_type",
+                                          "add_qkv_bias",
+                                          "max_position_embeddings")},
+                     max_position_embeddings=1 + spec.num_patches)
 
     ctx = build_mesh(parallel)
     optimizer = get_optimizer(opt_cfg, training.train_iters)
     state, shardings, _ = setup_train_state(
         jax.random.PRNGKey(training.seed),
-        lambda k: init_vlm_params(k, lm_cfg, vis_cfg, spec,
-                                  clip_tower=args.clip_vision_tower),
-        optimizer,
-        ctx)
+        lambda k: init_inpaint_params(k, cfg, spec), optimizer, ctx)
 
     def loss_fn(p, micro):
-        return vlm_loss(p, micro["images"], micro["tokens"],
-                        micro["labels"], micro["loss_mask"], lm_cfg,
-                        vis_cfg, spec, ctx=ctx)
+        return inpaint_loss(p, micro["images"], micro["masks"], cfg, spec,
+                            ctx=ctx)
 
     step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
                               training.train_iters)
     num_micro = training.num_microbatches(ctx.dp * ctx.ep)
 
     rng = np.random.default_rng(training.seed)
+    g = spec.image_size // spec.patch_size
     losses = []
     t0 = time.perf_counter()
     with ctx.mesh:
         for it in range(training.train_iters):
-            toks = rng.integers(0, lm_cfg.vocab_size, (
-                training.global_batch_size, training.seq_length)
-            ).astype(np.int32)
+            bits = (rng.random((training.global_batch_size, g, g)) <
+                    args.mask_factor).astype(np.float32)
+            masks = np.repeat(np.repeat(bits, spec.patch_size, axis=1),
+                              spec.patch_size, axis=2)[..., None]
             batch = reshape_global_batch({
                 "images": rng.normal(size=(
                     training.global_batch_size, spec.image_size,
                     spec.image_size, spec.num_channels)
                 ).astype(np.float32),
-                "tokens": toks,
-                "labels": np.roll(toks, -1, axis=1),
-                "loss_mask": np.ones_like(toks, np.float32),
+                "masks": masks,
             }, num_micro)
             state, metrics = step_fn(state, batch)
             if (it + 1) % training.log_interval == 0 or \
@@ -80,11 +75,14 @@ def main(argv=None):
                 metrics = jax.device_get(metrics)
                 losses.append(float(metrics["loss"]))
                 print(f"iter {it+1:6d}/{training.train_iters} | "
-                      f"loss {float(metrics['loss']):.4f}")
+                      f"loss {float(metrics['loss']):.4f} | "
+                      f"psnr {float(metrics['psnr']):.2f} | "
+                      f"ssim {float(metrics['ssim']):.3f}")
     dt = time.perf_counter() - t0
-    tokens = training.train_iters * training.global_batch_size * \
-        training.seq_length
-    print(f"done: final loss {losses[-1]:.4f}, {tokens/dt:,.0f} tok/s")
+    print(f"done: final loss {losses[-1]:.4f}, "
+          f"{training.train_iters * training.global_batch_size / dt:.1f} "
+          f"img/s")
+    return losses
 
 
 if __name__ == "__main__":

@@ -19,6 +19,7 @@ import sys
 sys.path.insert(0, __file__.rsplit("/tasks/", 1)[0])
 
 import numpy as np
+from megatronapp_tpu.config.arguments import parse_args
 
 
 def read_tsv(path):
@@ -334,7 +335,7 @@ def main(argv=None):
     ap.add_argument("--save-predictions", default=None,
                     help=".npz of final dev-set scores for "
                          "tasks/ensemble_classifier.py")
-    args = ap.parse_args(argv)
+    args = parse_args(ap, argv)
 
     from tasks.common import build_tok_and_ids, restore_params
     tok, ids = build_tok_and_ids(args.tokenizer_type,

@@ -23,6 +23,7 @@ import sys
 sys.path.insert(0, __file__.rsplit("/tasks/", 1)[0])
 
 import numpy as np
+from megatronapp_tpu.config.arguments import parse_args
 
 
 def _pad_batch(seqs, seq_length, pad):
@@ -146,7 +147,7 @@ def main(argv=None):
     ap.add_argument("--tokenizer-name-or-path", default=None)
     ap.add_argument("--report-topk-accuracies", type=int, nargs="+",
                     default=[1, 5, 20])
-    args = ap.parse_args(argv)
+    args = parse_args(ap, argv)
 
     import jax
 

@@ -19,6 +19,7 @@ import sys
 sys.path.insert(0, __file__.rsplit("/tasks/", 1)[0])
 
 import numpy as np
+from megatronapp_tpu.config.arguments import parse_args
 
 
 def make_classify_fwd(cfg, spec):
@@ -115,7 +116,7 @@ def main(argv=None):
     ap.add_argument("--hidden-size", type=int, default=768)
     ap.add_argument("--num-attention-heads", type=int, default=12)
     ap.add_argument("--load-dir", default=None)
-    args = ap.parse_args(argv)
+    args = parse_args(ap, argv)
 
     cfg = vit_config(num_layers=args.num_layers,
                      hidden_size=args.hidden_size,

@@ -25,6 +25,7 @@ import sys
 sys.path.insert(0, __file__.rsplit("/tasks/", 1)[0])
 
 import numpy as np
+from megatronapp_tpu.config.arguments import parse_args
 
 
 def lm_nll(params, cfg, token_ids: np.ndarray, seq_length: int,
@@ -155,7 +156,7 @@ def main(argv=None):
     ap.add_argument("--tokenizer-name-or-path", default=None)
     ap.add_argument("--seq-length", type=int, default=1024)
     ap.add_argument("--overlapping-eval", type=int, default=0)
-    args = ap.parse_args(argv)
+    args = parse_args(ap, argv)
 
     import jax
 

@@ -29,3 +29,34 @@ def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs[:8]
+
+
+def pytest_collection_modifyitems(config, items):
+    """Apply the 'slow' marker from tests/slow_manifest.txt (measured
+    >10s tests; reference pytest.ini's internal/flaky gating). The fast
+    iteration lane is `pytest -m "not slow"` (~7 min); the full suite
+    remains the default so `pytest tests/` still covers everything."""
+    manifest = os.path.join(os.path.dirname(__file__), "slow_manifest.txt")
+    try:
+        with open(manifest) as f:
+            slow = {ln.strip() for ln in f
+                    if ln.strip() and not ln.startswith("#")}
+    except OSError:
+        return
+    matched = set()
+    for item in items:
+        nodeid = item.nodeid.replace("\\", "/")
+        if not nodeid.startswith("tests/"):
+            nodeid = "tests/" + nodeid
+        if nodeid in slow:
+            item.add_marker(pytest.mark.slow)
+            matched.add(nodeid)
+    stale = slow - matched
+    if stale and len(items) > len(slow):
+        # Renamed/re-parameterized slow tests would silently drift into
+        # the fast lane; surface manifest staleness at collection time.
+        import warnings
+        warnings.warn(
+            f"tests/slow_manifest.txt has {len(stale)} entries matching "
+            f"no collected test (e.g. {sorted(stale)[0]}); regenerate "
+            "with tools/update_slow_manifest.py", stacklevel=1)

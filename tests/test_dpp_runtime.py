@@ -1,0 +1,140 @@
+"""MegaDPP dynamic runtime: readiness-driven send ordering (runtime/dpp.py).
+
+Reference semantics: background senders ship whichever (chunk, microbatch)
+is ready first in DFC/BFC priority order through a bounded buffer pool
+(shm_tensor_new_rdma.cpp:1478-1646, shm_tensor_new_rdma_pre_alloc.cpp:
+126-205); a static scheduler commits to the compile-time order and
+head-of-line blocks when a stage runs late — the stall DPP removes.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatronapp_tpu.runtime.dpp import (
+    DppPipelineRunner, TransferPool, send_priority, static_order,
+)
+
+
+class TestPriorityOrder:
+    def test_dfc_matches_reference_traversal(self):
+        """DFC: rounds of pp microbatches, all chunks within a round
+        before the next round (forward_send loop nest :1487-1510)."""
+        order = static_order(pp=2, vpp=2, num_microbatches=4, policy="dfc")
+        assert order == [(0, 0), (0, 1), (1, 0), (1, 1),
+                         (0, 2), (0, 3), (1, 2), (1, 3)]
+
+    def test_bfc_all_mbs_before_next_chunk(self):
+        order = static_order(pp=2, vpp=2, num_microbatches=3, policy="bfc")
+        assert order == [(0, 0), (0, 1), (0, 2), (1, 0), (1, 1), (1, 2)]
+
+    def test_unknown_policy(self):
+        with pytest.raises(ValueError):
+            send_priority(0, 0, 2, 2, "zigzag")
+
+
+class TestTransferPool:
+    def test_bounded_and_stall_accounting(self):
+        pool = TransferPool(n_buffers=1)
+        pool.acquire()
+        t0 = time.perf_counter()
+        import threading
+        threading.Timer(0.1, pool.release).start()
+        pool.acquire()   # must wait for the release
+        assert time.perf_counter() - t0 >= 0.09
+        assert pool.stall_s >= 0.09
+        assert pool.acquisitions == 2
+        pool.release()
+
+
+def _make_runner(devices, pp=2, vpp=2, M=4, slow=None, **kw):
+    """Chunk = (h * 1.01 + stage + chunk) elementwise; `slow` maps
+    (stage, chunk) -> seconds of injected compute jitter."""
+    slow = slow or {}
+
+    fns = {}
+    for s in range(pp):
+        for c in range(vpp):
+            # The runner device_puts inputs onto the stage device; jit
+            # follows the operand placement.
+            fns[(s, c)] = jax.jit(lambda h, s=s, c=c: h * 1.01 + (s + c))
+
+    def chunk_fn(stage, chunk, h, mb):
+        if (stage, chunk) in slow:
+            time.sleep(slow[(stage, chunk)])
+        return fns[(stage, chunk)](h)
+
+    return DppPipelineRunner(chunk_fn, devices, pp=pp, vpp=vpp,
+                             num_microbatches=M, **kw)
+
+
+def _expected(h, pp, vpp):
+    for c in range(vpp):
+        for s in range(pp):
+            h = h * 1.01 + (s + c)
+    return h
+
+
+class TestDppPipelineRunner:
+    @pytest.mark.parametrize("dynamic", [True, False])
+    @pytest.mark.parametrize("policy", ["dfc", "bfc"])
+    def test_outputs_match_sequential(self, devices8, dynamic, policy):
+        pp, vpp, M = 2, 2, 4
+        runner = _make_runner(devices8, pp, vpp, M, dynamic=dynamic,
+                              policy=policy)
+        ins = [jnp.full((8, 8), float(m)) for m in range(M)]
+        outs = runner.run(ins)
+        for m, (i, o) in enumerate(zip(ins, outs)):
+            np.testing.assert_allclose(np.asarray(o),
+                                       np.asarray(_expected(i, pp, vpp)),
+                                       rtol=1e-6)
+        # Every stage shipped every (chunk, mb) exactly once.
+        for log in runner.transfer_order:
+            assert sorted(log) == sorted(
+                (c, m) for c in range(vpp) for m in range(M))
+
+    def test_slow_stage_changes_transfer_order(self, devices8):
+        """The DPP property (paper §5.2): with stage 1 late, the dynamic
+        stage-0 sender ships already-finished chunk-0 microbatches instead
+        of head-of-line blocking on the (1, 0) round trip the static DFC
+        plan demands."""
+        pp, vpp, M = 2, 2, 4
+        slow = {(1, 0): 0.15}   # stage 1 is the laggard
+        ins = [jnp.full((4, 4), float(m)) for m in range(M)]
+
+        dyn = _make_runner(devices8, pp, vpp, M, slow=slow, dynamic=True)
+        dyn_out = dyn.run(ins)
+        sta = _make_runner(devices8, pp, vpp, M, slow=slow, dynamic=False)
+        sta_out = sta.run(ins)
+        for a, b in zip(dyn_out, sta_out):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+        plan = static_order(pp, vpp, M, "dfc")
+        assert sta.transfer_order[0] == plan  # static = committed order
+        d0 = dyn.transfer_order[0]
+        assert d0 != plan                     # readiness reordered sends
+        # Specifically: (0,2)/(0,3) (ready immediately) ship before the
+        # (1,0) wrap-around that static order blocks on.
+        assert d0.index((0, 2)) < d0.index((1, 0))
+        assert d0.index((0, 3)) < d0.index((1, 0))
+
+    def test_dynamic_reduces_sender_stall(self, devices8):
+        """Head-of-line blocking shows up as sender stall time; the
+        readiness scan removes it (numbers recorded in PERF.md)."""
+        pp, vpp, M = 2, 2, 6
+        slow = {(1, 0): 0.08}
+        ins = [jnp.full((4, 4), float(m)) for m in range(M)]
+        dyn = _make_runner(devices8, pp, vpp, M, slow=slow, dynamic=True)
+        dyn.run(ins)
+        sta = _make_runner(devices8, pp, vpp, M, slow=slow, dynamic=False)
+        sta.run(ins)
+        # Stage-0 sender: static waits through every slow round trip.
+        assert sta.sender_stall_s[0] > dyn.sender_stall_s[0]
+
+    def test_input_count_validation(self, devices8):
+        runner = _make_runner(devices8, 2, 1, 3)
+        with pytest.raises(ValueError, match="one input per microbatch"):
+            runner.run([jnp.zeros((2, 2))])

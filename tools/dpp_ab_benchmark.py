@@ -1,0 +1,68 @@
+"""A/B the MegaDPP dynamic runtime against the static send schedule.
+
+Injected stage jitter (a slow pipeline stage) + real inter-device
+transfers on the virtual CPU mesh; reports transfer order, sender stall,
+and wall time for dynamic vs static ordering. Numbers land in PERF.md
+(VERDICT round-3 task 3).
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/dpp_ab_benchmark.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from megatronapp_tpu.runtime.dpp import DppPipelineRunner  # noqa: E402
+
+
+def run_ab(pp=2, vpp=2, M=8, slow_stage=1, slow_chunk=0, jitter_s=0.05,
+           size=(512, 512), repeats=3):
+    devices = jax.devices()[:pp]
+    fns = {(s, c): jax.jit(lambda h, s=s, c=c: h * 1.01 + (s + c))
+           for s in range(pp) for c in range(vpp)}
+
+    def chunk_fn(stage, chunk, h, mb):
+        if stage == slow_stage and chunk == slow_chunk:
+            time.sleep(jitter_s)
+        return fns[(stage, chunk)](h)
+
+    ins = [jnp.full(size, float(m)) for m in range(M)]
+    out = {}
+    for dynamic in (True, False):
+        walls, stalls = [], []
+        order0 = None
+        for _ in range(repeats):
+            r = DppPipelineRunner(chunk_fn, devices, pp=pp, vpp=vpp,
+                                  num_microbatches=M, dynamic=dynamic)
+            r.run(ins)
+            walls.append(r.wall_s)
+            stalls.append(sum(r.sender_stall_s))
+            order0 = r.transfer_order[0]
+        key = "dynamic" if dynamic else "static"
+        out[key] = {"wall_s": round(min(walls), 4),
+                    "sender_stall_s": round(min(stalls), 4),
+                    "stage0_order_head": order0[:6]}
+    out["config"] = {"pp": pp, "vpp": vpp, "M": M, "jitter_s": jitter_s,
+                     "slow": [slow_stage, slow_chunk], "size": list(size)}
+    return out
+
+
+if __name__ == "__main__":
+    res = run_ab()
+    print(json.dumps(res, default=str, indent=1))

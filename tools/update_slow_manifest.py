@@ -1,0 +1,22 @@
+"""Regenerate tests/slow_manifest.txt from a pytest --durations=0 log.
+
+  python -m pytest tests/ -q --durations=0 > /tmp/suite.txt
+  python tools/update_slow_manifest.py /tmp/suite.txt [threshold_s]
+"""
+
+import re
+import sys
+
+log = sys.argv[1]
+threshold = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
+slow = sorted({m.group(2) for ln in open(log)
+               for m in [re.match(r"(\d+\.\d+)s call\s+(\S+)", ln)]
+               if m and float(m.group(1)) > threshold})
+out = "tests/slow_manifest.txt"
+with open(out, "w") as f:
+    f.write("# Tests marked @slow (measured >%gs on the 8-virtual-device\n"
+            "# CPU mesh; tools/update_slow_manifest.py regenerates from a\n"
+            "# pytest --durations=0 log). Fast lane: pytest -m 'not slow'.\n"
+            % threshold)
+    f.writelines(t + "\n" for t in slow)
+print(f"{len(slow)} slow tests → {out}")
