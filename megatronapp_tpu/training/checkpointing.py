@@ -19,6 +19,40 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 
+def _relayout_leaf(x: np.ndarray, target_shape: tuple) -> np.ndarray:
+    """Re-layout one stacked-layer leaf between pipeline layouts.
+
+    Layouts are [L, *rest] (pp=1) or [pp, vpp, L/(pp*vpp), *rest]
+    (parallel/pipeline.py reshape_params_for_pipeline: chunk-major
+    reshape + stage/chunk swap). The saved and target layouts are both
+    inferred from shapes: `rest` is the longest common suffix, the
+    leading dims factor the same layer count L. Mirrors the reference's
+    resharding.py PP-change path."""
+    if tuple(x.shape) == target_shape:
+        return x
+    # A layer-stack leaf leads with [L] or [pp, vpp, Lc]; enumerate the
+    # split (a greedy common-suffix match would eat an equal Lc).
+    for ls in (1, 3):
+        for lt in (1, 3):
+            lead_s, rest_s = x.shape[:ls], x.shape[ls:]
+            lead_t, rest_t = target_shape[:lt], target_shape[lt:]
+            if (x.ndim - ls == len(target_shape) - lt and
+                    tuple(rest_s) == tuple(rest_t) and
+                    len(lead_s) == ls and len(lead_t) == lt and
+                    int(np.prod(lead_s)) == int(np.prod(lead_t))):
+                L = int(np.prod(lead_s))
+                if ls == 3:                   # [pp, vpp, Lc] → [L]
+                    x = np.swapaxes(x, 0, 1).reshape((L,) + tuple(rest_s))
+                if lt == 3:                   # [L] → [pp, vpp, Lc]
+                    pp, vpp, lc = lead_t
+                    x = np.swapaxes(
+                        x.reshape((vpp, pp, lc) + tuple(rest_s)), 0, 1)
+                return np.ascontiguousarray(x)
+    raise ValueError(
+        f"cannot relayout checkpoint leaf {x.shape} -> {target_shape}: "
+        "not a pipeline layout change (model geometry differs?)")
+
+
 class CheckpointManager:
     """Thin wrapper over ocp.CheckpointManager.
 
@@ -42,8 +76,17 @@ class CheckpointManager:
             step, args=ocp.args.StandardSave(state), force=force)
 
     def restore(self, state_struct: Any, step: Optional[int] = None) -> Any:
-        """Restore into the shardings of `state_struct` (abstract arrays with
-        shardings → resharding on layout change comes free)."""
+        """Restore into the shardings of `state_struct`.
+
+        Mesh-only layout changes (tp/dp/fsdp degree) reshard natively:
+        arrays keep their shapes and Orbax redistributes into the new
+        shardings. Pipeline layout changes (pp/vpp degree) additionally
+        change the stacked-layer leaf SHAPES ([L, ...] ↔ [pp, vpp, Lc,
+        ...], models/gpt.py init layout) — the reference's
+        dist_checkpointing/strategies/resharding.py TP/PP-change path.
+        When shapes mismatch, leaves are restored in their saved shapes,
+        relayouted host-side (shape-driven, see _relayout_leaf), and
+        device_put into the target shardings."""
         if step is None:
             step = self._mngr.latest_step()
         if step is None:
@@ -52,8 +95,65 @@ class CheckpointManager:
             lambda x: (ocp.utils.to_shape_dtype_struct(x)
                        if hasattr(x, "dtype") else x),
             state_struct)
-        return self._mngr.restore(
-            step, args=ocp.args.StandardRestore(abstract))
+        meta = self._mngr.item_metadata(step)
+        if meta is None:
+            # A manager that has not saved in this process does not know
+            # the item handler yet; read the tree metadata directly.
+            with ocp.StandardCheckpointer() as ck:
+                meta = ck.metadata(os.path.join(
+                    self._mngr.directory, str(step), "default"
+                )).item_metadata
+        # The metadata tree flattens containers differently (optax
+        # namedtuples become lists), but leaf ORDER is isomorphic to the
+        # target structure — compare/rebuild leaf-wise on the target
+        # treedef.
+        target_leaves, treedef = jax.tree.flatten(abstract)
+        saved_leaves = jax.tree.leaves(meta.tree)
+        if len(saved_leaves) != len(target_leaves):
+            # Structural change (different model/optimizer): let the
+            # plain restore produce its descriptive error.
+            return self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        mismatch = any(
+            hasattr(t, "shape") and tuple(s.shape) != tuple(t.shape)
+            for s, t in zip(saved_leaves, target_leaves))
+        if not mismatch:
+            return self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        # Cross-pipeline-layout restore. Shape-matching leaves restore
+        # straight into their target shardings (native parallel
+        # resharding); only mismatched leaves take the host relayout
+        # path, restored REPLICATED on the target mesh (explicit
+        # sharding — file-derived shardings are unsafe on a different
+        # topology, and replicated arrays stay fully addressable under
+        # multi-host).
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = next(t.sharding.mesh for t in target_leaves
+                    if isinstance(getattr(t, "sharding", None),
+                                  NamedSharding))
+        replicated = NamedSharding(mesh, PartitionSpec())
+
+        def _mismatched(s, t):
+            return (hasattr(t, "shape") and
+                    tuple(s.shape) != tuple(t.shape))
+
+        saved_abstract = jax.tree.unflatten(treedef, [
+            (jax.ShapeDtypeStruct(tuple(s.shape), t.dtype,
+                                  sharding=replicated)
+             if _mismatched(s, t) else t)
+            for s, t in zip(saved_leaves, target_leaves)])
+        restored = self._mngr.restore(
+            step, args=ocp.args.StandardRestore(saved_abstract))
+        out_leaves = []
+        for s, t, r in zip(saved_leaves, target_leaves,
+                           jax.tree.leaves(restored)):
+            if _mismatched(s, t):
+                r = jax.device_put(
+                    _relayout_leaf(np.asarray(jax.device_get(r)),
+                                   tuple(t.shape)),
+                    t.sharding)
+            out_leaves.append(r)
+        return jax.tree.unflatten(treedef, out_leaves)
 
     @property
     def latest_step(self) -> Optional[int]:
