@@ -74,7 +74,11 @@ def aggregate_benchmark_data(per_process: Dict[int, List[dict]]
                 continue
             rr = dict(r)
             rr["ts"] = r["ts"] + offsets[it]
-            rr["pid"] = pid
+            # Records carry their own pid (host records: the process
+            # index; profiler-derived collectives: process*1000+device,
+            # tracer.add_collective_records); fall back to the file's
+            # process id for legacy traces.
+            rr["pid"] = r.get("pid", pid)
             out.append(rr)
     out.sort(key=lambda r: (r["ts"], r["pid"]))
     return out
@@ -118,6 +122,22 @@ def transform_to_complete_events(records: List[dict]) -> List[dict]:
                 "pid": r["pid"], "tid": r.get("tid", 0), "s": "t",
                 "args": {**r.get("args", {}),
                          "iteration": r.get("iteration", -1), "id": eid},
+            })
+        elif r["ph"] == "X":
+            # Pre-formed complete events (profiler-derived collectives,
+            # trace/profiler_collectives.py) pass through. Ids are ALWAYS
+            # reassigned here: producer ids restart per capture window
+            # and per process, so keeping them would collide with span
+            # ids and with each other, corrupting every id-keyed lookup
+            # (dependency related-sets, detect stage 2, amend_p2p).
+            eid += 1
+            args = {**r.get("args", {})}
+            args.setdefault("iteration", r.get("iteration", -1))
+            args["id"] = eid
+            out.append({
+                "name": r["name"], "ph": "X", "ts": r["ts"],
+                "dur": r.get("dur", 0.001), "pid": r["pid"],
+                "tid": r.get("tid", 0), "args": args,
             })
     out.sort(key=lambda r: (r["ts"], r["pid"]))
     return out

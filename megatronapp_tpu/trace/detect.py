@@ -80,10 +80,21 @@ def detect_stage1(events: List[dict]) -> Dict[int, int]:
     return dict(suspects)
 
 
+def _owner(e: dict):
+    """Process a collective event belongs to: profiler-derived per-device
+    records carry args['process'] (trace/profiler_collectives.py); plain
+    tracer records are owned by their pid."""
+    return e.get("args", {}).get("process", e["pid"])
+
+
 def detect_stage2(events: List[dict], related: Dict[int, Set[int]],
                   pid: int) -> bool:
     """Within collectives, is `pid` the earliest finisher in >40% of its
-    related-op sets (reference detect_in_data_parallelism_group)?"""
+    related-op sets (reference detect_in_data_parallelism_group)?
+
+    Membership is by owning PROCESS: profiler-derived collective events
+    have per-device pids, so a set's events attribute back to the
+    process stage 1 escalated."""
     by_id = {e["args"]["id"]: e for e in events
              if "id" in e.get("args", {})}
     total = 0
@@ -94,7 +105,7 @@ def detect_stage2(events: List[dict], related: Dict[int, Set[int]],
             continue
         seen.update(ids)
         evs = [by_id[i] for i in ids if i in by_id]
-        if not any(e["pid"] == pid for e in evs):
+        if not any(_owner(e) == pid for e in evs):
             continue
         # Events in a related set share a name by construction
         # (dependency matching key), but tolerate heterogeneous sets from
@@ -102,11 +113,12 @@ def detect_stage2(events: List[dict], related: Dict[int, Set[int]],
         if not any(e["name"].startswith(p) for e in evs
                    for p in COLLECTIVE_PREFIXES):
             continue
+        mine = [e for e in evs if _owner(e) == pid]
+        others = [e for e in evs if _owner(e) != pid]
+        if not others:
+            continue
         total += 1
-        mine = [e for e in evs if e["pid"] == pid]
-        others = [e for e in evs if e["pid"] != pid]
-        if mine and others and all(
-                _end(mine[0]) < _end(o) for o in others):
+        if min(_end(m) for m in mine) < min(_end(o) for o in others):
             slow_cnt += 1
     return total > 0 and slow_cnt > STAGE2_FRACTION * total
 

@@ -1,0 +1,233 @@
+"""Per-collective trace events synthesized from the XLA profiler.
+
+Parity with the reference's hand-instrumented TP collectives
+(/root/reference/megatron/core/tensor_parallel/mappings.py:27-60 records
+group + bytes per op; /root/reference/megatron/training/trace.py:371-380
+derives per-op Gbps) — but TPU-first: XLA inserts the collectives during
+SPMD partitioning, so host code never sees them. Instead we
+
+1. statically read the compiled HLO for every collective instruction
+   (kind, output bytes, replica groups → mesh axes), and
+2. capture one profiled execution (``jax.profiler.trace`` emits a Chrome
+   trace with per-device X events carrying ``args.hlo_op``), then
+
+join the two on the HLO op name into tracer-contract event dicts
+({pid, name, ts, dur, args:{id, group, bytes, bandwidth_gbps,
+iteration}}) that flow through trace/dependency.py ``build_dependencies``
+and trace/detect.py stage 2 unchanged. This also restores collective
+visibility on backends without host callbacks (the tunneled axon chip —
+trace/tracer.py ``callbacks_supported``): the profiler path needs no
+in-graph instrumentation at all.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import tempfile
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "f16": 2, "bf16": 2, "s32": 4,
+                "u32": 4, "s64": 8, "u64": 8, "s16": 2, "u16": 2,
+                "s8": 1, "u8": 1, "pred": 1, "f8e4m3fn": 1, "f8e5m2": 1}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+_INSTR_RE = re.compile(
+    r"%?([\w.-]+)\s*=\s*(\([^)]*\)|\S+)\s+"
+    r"(all-reduce-start|all-gather-start|reduce-scatter|all-reduce|"
+    r"all-gather|collective-permute-start|collective-permute|all-to-all)"
+    r"\(")
+_GROUPS_RE = re.compile(r"replica_groups=(\{\{[\d,{}\s]*\}\}|\[[^\]]*\]"
+                        r"<=\[[^\]]*\](?:T\([\d,]*\))?)")
+_SRC_TGT_RE = re.compile(r"source_target_pairs=\{([\d,{}\s]*)\}")
+
+
+def _shape_bytes(shape_text: str, result_only: bool = False) -> int:
+    """'f32[32,64]{1,0}' or '(f32[8], f32[8])' → payload bytes.
+
+    result_only: async '-start' ops have tuple shapes holding (operands,
+    results); count only the result half so bytes are not double-counted
+    (e.g. all-reduce-start's (in, out) pair)."""
+    shapes = _SHAPE_RE.findall(shape_text)
+    if result_only and len(shapes) > 1:
+        shapes = shapes[len(shapes) // 2:]
+    total = 0
+    for dtype, dims in shapes:
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def _parse_groups(text: str) -> List[List[int]]:
+    """Decode replica_groups: explicit '{{0,1},{2,3}}' or iota
+    '[2,2]<=[4]' / '[2,2]<=[2,2]T(1,0)'."""
+    if text.startswith("{{"):
+        return [[int(x) for x in g.split(",") if x.strip()]
+                for g in re.findall(r"\{([\d,\s]*)\}", text[1:-1])]
+    m = re.match(r"\[([\d,]*)\]<=\[([\d,]*)\](?:T\(([\d,]*)\))?", text)
+    if not m:
+        return []
+    gshape = [int(x) for x in m.group(1).split(",")]
+    dims = [int(x) for x in m.group(2).split(",")]
+    ids = np.arange(int(np.prod(dims))).reshape(dims)
+    if m.group(3):
+        ids = ids.transpose([int(x) for x in m.group(3).split(",")])
+    return ids.reshape(gshape).tolist()
+
+
+def _axes_of_groups(groups: List[List[int]], mesh) -> str:
+    """Mesh axes a collective spans: axes whose coordinate varies within
+    a participant group (e.g. tp for the TP all-reduce)."""
+    if mesh is None or not groups or len(groups[0]) < 2:
+        return ""
+    coord_of = {}
+    it = np.nditer(np.asarray(mesh.devices, dtype=object),
+                   flags=["multi_index", "refs_ok"])
+    for dev in it:
+        coord_of[dev.item().id] = it.multi_index
+    g = [coord_of.get(d) for d in groups[0]]
+    if any(c is None for c in g):
+        return ""
+    varying = [mesh.axis_names[i] for i in range(len(mesh.axis_names))
+               if len({c[i] for c in g}) > 1]
+    return "x".join(varying)
+
+
+def extract_hlo_collectives(hlo_text: str, mesh=None) -> Dict[str, dict]:
+    """Map HLO op name → {kind, bytes, groups, axes} for every collective
+    in a compiled module (the static half of the join)."""
+    out: Dict[str, dict] = {}
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.search(line)
+        if not m:
+            continue
+        name, shape_text, kind = m.groups()
+        is_async = kind.endswith("-start")
+        kind = kind.replace("-start", "")
+        info = {"kind": kind,
+                "bytes": _shape_bytes(shape_text, result_only=is_async)}
+        gm = _GROUPS_RE.search(line)
+        groups = _parse_groups(gm.group(1)) if gm else []
+        if not groups and kind == "collective-permute":
+            pm = _SRC_TGT_RE.search(line)
+            if pm:
+                pairs = re.findall(r"\{(\d+),(\d+)\}", "{" + pm.group(1) + "}")
+                members = sorted({int(a) for p in pairs for a in p})
+                groups = [members]
+        info["groups"] = groups
+        info["axes"] = _axes_of_groups(groups, mesh)
+        out[name] = info
+    return out
+
+
+def parse_profile_dir(trace_dir: str, cleanup: bool = False) -> List[dict]:
+    """Read a jax.profiler output directory → the raw per-device
+    Chrome-trace X events that carry an hlo_op."""
+    paths = sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True))
+    events: List[dict] = []
+    if paths:
+        with gzip.open(paths[-1]) as f:
+            payload = json.load(f)
+        events = [e for e in payload.get("traceEvents", [])
+                  if e.get("ph") == "X" and "hlo_op" in e.get("args", {})]
+    if cleanup:
+        import shutil
+        shutil.rmtree(trace_dir, ignore_errors=True)
+    return events
+
+
+def profile_run(run: Callable[[], Any],
+                trace_dir: Optional[str] = None) -> List[dict]:
+    """Execute ``run`` under jax.profiler and return the raw per-device
+    Chrome-trace X events that carry an hlo_op.
+
+    The fence is a device_get of the smallest output leaf, not
+    block_until_ready: on the tunneled axon backend block_until_ready
+    does not wait, and the profiler would stop before the step ran."""
+    import jax
+
+    own = trace_dir is None
+    trace_dir = trace_dir or tempfile.mkdtemp(prefix="jax_prof_")
+    with jax.profiler.trace(trace_dir):
+        out = run()
+        leaves = [l for l in jax.tree.leaves(out) if hasattr(l, "size")]
+        if leaves:
+            jax.device_get(min(leaves, key=lambda l: l.size))
+        jax.block_until_ready(out)
+    return parse_profile_dir(trace_dir, cleanup=own)
+
+
+def collective_events(raw_events: Sequence[dict],
+                      hlo_info: Dict[str, dict],
+                      iteration: int = 0,
+                      id_base: int = 0,
+                      process_index: Optional[int] = None,
+                      local_device_count: Optional[int] = None
+                      ) -> List[dict]:
+    """Join profiler events with HLO metadata into tracer-contract
+    records (trace/dependency.py: args carries id/group/bytes;
+    trace/detect.py stage 2 keys on the collective name prefixes).
+
+    Each local device gets its own timeline (the reference's per-GPU
+    process granularity): pid = 1000*(process+1) + local ordinal — a
+    range disjoint from process pids so device rows never collide with
+    the host-side schedule records. The profiler reports LOCAL ordinals;
+    replica groups contain GLOBAL device ids, so membership is checked
+    against process*local_count + ordinal. args carries 'process' (owner,
+    for detector stage-2 attribution) and 'device' (global id)."""
+    import jax
+
+    if process_index is None:
+        process_index = jax.process_index()
+    if local_device_count is None:
+        local_device_count = jax.local_device_count()
+    out: List[dict] = []
+    next_id = id_base
+    for e in sorted(raw_events, key=lambda x: (x.get("ts", 0.0))):
+        op = e["args"]["hlo_op"]
+        base = op.split(".")[0]
+        info = hlo_info.get(op) or hlo_info.get(base)
+        if info is None or info["kind"] not in COLLECTIVE_KINDS:
+            continue
+        ordinal = int(e["args"].get("device_ordinal", e.get("pid", 0)))
+        dev = process_index * local_device_count + ordinal
+        group = next((g for g in info["groups"] if dev in g),
+                     info["groups"][0] if info["groups"] else [])
+        dur_us = float(e.get("dur", 0.0))
+        gbps = (info["bytes"] * 8e-3 / dur_us) if dur_us > 0 else 0.0
+        out.append({
+            "ph": "X", "pid": 1000 * (process_index + 1) + ordinal,
+            "tid": e.get("tid", 0),
+            "name": info["kind"], "ts": float(e["ts"]), "dur": dur_us,
+            "args": {"id": next_id, "hlo_op": op, "group": group,
+                     "bytes": info["bytes"], "axes": info["axes"],
+                     "bandwidth_gbps": round(gbps, 3),
+                     "process": process_index, "device": dev,
+                     "iteration": iteration},
+        })
+        next_id += 1
+    return out
+
+
+def profile_step_collectives(compiled, run: Callable[[], Any], mesh=None,
+                             iteration: int = 0) -> List[dict]:
+    """One-call convenience: HLO metadata from ``compiled`` (a
+    jax.stages.Compiled) + one profiled execution of ``run`` → joined
+    collective event records."""
+    info = extract_hlo_collectives(compiled.as_text(), mesh)
+    raw = profile_run(run)
+    return collective_events(raw, info, iteration=iteration)

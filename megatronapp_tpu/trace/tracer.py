@@ -222,6 +222,41 @@ class Tracer:
         with self._lock:
             self._records.append(rec)
 
+    def now_in_iteration_us(self) -> float:
+        """Current offset inside the open iteration window (µs)."""
+        return (_now_ns() - self._iter_t0) / 1e3
+
+    def add_collective_records(self, events: List[Dict[str, Any]],
+                               offset_us: Optional[float] = None):
+        """Merge profiler-derived collective events
+        (trace/profiler_collectives.py; per-device pids already disjoint
+        from process pids) into this iteration's records.
+
+        offset_us anchors the capture inside the iteration window — pass
+        the value of now_in_iteration_us() taken BEFORE the profiled
+        execution, so events land where the collectives ran rather than
+        after the (per-process, variable) profile parsing delay that
+        would skew cross-process stage-2 comparisons."""
+        if not (self.enabled and self.active and events):
+            return
+        base = min(e["ts"] for e in events)
+        if offset_us is None:
+            offset_us = self.now_in_iteration_us()
+        recs = []
+        for e in events:
+            recs.append({
+                "name": e["name"], "ph": "X",
+                "ts": e["ts"] - base + offset_us,
+                "dur": e.get("dur", 0.0),
+                "pid": e["pid"],
+                "tid": e.get("tid", 0),
+                "iteration": self._iteration,
+                "args": dict(e.get("args", {}),
+                             iteration=self._iteration),
+            })
+        with self._lock:
+            self._records.extend(recs)
+
     def drain(self) -> List[Dict[str, Any]]:
         with self._lock:
             recs, self._records = self._records, []

@@ -236,6 +236,58 @@ def pretrain_gpt(
             log_fn("trace: backend lacks host callbacks; schedule-phase "
                    "spans disabled (host-side scopes only)")
 
+    # Per-collective events via the XLA profiler (reference
+    # mappings.py:27-60 group+bytes instrumentation; here synthesized
+    # post-hoc since SPMD inserts the collectives — see
+    # trace/profiler_collectives.py). One profiled iteration per trace
+    # window keeps the profiler overhead off the steady state.
+    _coll = {"hlo": {}, "window": -1}
+
+    def run_step_maybe_profiled(active_fn, state, batch, it):
+        if (not tracer.active or
+                train_cfg.trace_granularity not in ("full", "collective")):
+            return active_fn(state, batch)
+        window = it // tracer.interval
+        if window == _coll["window"]:
+            return active_fn(state, batch)
+        _coll["window"] = window
+        from megatronapp_tpu.trace.profiler_collectives import (
+            collective_events, extract_hlo_collectives, profile_run,
+        )
+        key = id(active_fn)
+        if key not in _coll["hlo"]:
+            try:
+                compiled = active_fn.lower(state, batch).compile()
+                _coll["hlo"][key] = extract_hlo_collectives(
+                    compiled.as_text(), ctx.mesh)
+            except Exception as e:  # pragma: no cover — backend-specific
+                log_fn(f"trace: collective HLO extraction failed ({e}); "
+                       "profiler collectives disabled")
+                _coll["hlo"][key] = None
+        info = _coll["hlo"][key]
+        if not info:
+            return active_fn(state, batch)
+        result = {}
+
+        def run():
+            result["out"] = active_fn(state, batch)
+            return result["out"]
+
+        # Anchor BEFORE the capture so events land where the collectives
+        # ran, not after the profile-parse delay (which varies per host
+        # and would skew cross-process stage-2 comparisons).
+        offset_us = tracer.now_in_iteration_us()
+        try:
+            raw = profile_run(run)
+            tracer.add_collective_records(
+                collective_events(raw, info, iteration=it),
+                offset_us=offset_us)
+        except Exception as e:  # pragma: no cover — profiler optional
+            log_fn(f"trace: profiler capture failed ({e})")
+            if "out" not in result:  # failed before the step ran
+                result["out"] = active_fn(state, batch)
+        return result["out"]
+
     from megatronapp_tpu.training.rerun_state_machine import (
         get_rerun_state_machine,
     )
@@ -285,7 +337,8 @@ def pretrain_gpt(
             straggler.start()
             with tracer.scope("train-step"):
                 active_fn = traced_step_fn if tracer.active else step_fn
-                state, metrics = active_fn(state, batch)
+                state, metrics = run_step_maybe_profiled(
+                    active_fn, state, batch, it)
                 # Block for accurate per-step timing only when tracing or
                 # logging this step; otherwise let steps pipeline.
                 should_log = ((it + 1) % train_cfg.log_interval == 0 or

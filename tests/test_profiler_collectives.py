@@ -1,0 +1,254 @@
+"""Profiler-derived per-collective trace events (trace/profiler_collectives).
+
+Reference behavior being matched: per-collective records carrying group +
+bytes + bandwidth (core/tensor_parallel/mappings.py:27-60,
+training/trace.py:371-380) feeding slow-chip detection stage 2. Here the
+records are synthesized from the XLA profiler + compiled HLO since SPMD
+inserts the collectives below host visibility.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from megatronapp_tpu.trace.dependency import build_dependencies
+from megatronapp_tpu.trace.detect import detect_stage2, try_detect
+from megatronapp_tpu.trace.profiler_collectives import (
+    _parse_groups, _shape_bytes, collective_events,
+    extract_hlo_collectives, profile_run, profile_step_collectives,
+)
+
+
+class TestHloParsing:
+    def test_parse_explicit_groups(self):
+        assert _parse_groups("{{0,1},{2,3}}") == [[0, 1], [2, 3]]
+
+    def test_parse_iota_groups(self):
+        assert _parse_groups("[2,2]<=[4]") == [[0, 1], [2, 3]]
+        # transposed iota: [2,2]<=[2,2]T(1,0) → column-major pairing
+        assert _parse_groups("[2,2]<=[2,2]T(1,0)") == [[0, 2], [1, 3]]
+
+    def test_shape_bytes(self):
+        assert _shape_bytes("f32[32,64]{1,0}") == 32 * 64 * 4
+        assert _shape_bytes("bf16[8]") == 16
+        assert _shape_bytes("(f32[8], f32[4])") == 48
+        assert _shape_bytes("f32[]") == 4
+        # Async '-start' tuples hold (operands, results): count results
+        # only, so bytes/bandwidth are not double-counted.
+        assert _shape_bytes("(f32[8]{0}, f32[16]{0})",
+                            result_only=True) == 64
+        assert _shape_bytes("(f32[8], f32[8], f32[8], f32[16])",
+                            result_only=True) == 32 + 64
+
+    def test_extract_from_real_hlo(self, devices8):
+        mesh = Mesh(np.array(devices8[:4]).reshape(2, 2), ("dp", "tp"))
+
+        def fn(x, w):
+            return jnp.sum(x @ w)
+
+        x = jax.device_put(jnp.ones((64, 64)),
+                           NamedSharding(mesh, P("dp", "tp")))
+        w = jax.device_put(jnp.ones((64, 64)),
+                           NamedSharding(mesh, P("tp", None)))
+        compiled = jax.jit(fn, out_shardings=NamedSharding(mesh, P())
+                           ).lower(x, w).compile()
+        info = extract_hlo_collectives(compiled.as_text(), mesh)
+        kinds = {v["kind"] for v in info.values()}
+        assert "all-reduce" in kinds
+        # The contraction all-reduce spans tp and carries the partial
+        # matmul's bytes; every op got byte + axes attribution.
+        tp_ops = [v for v in info.values()
+                  if v["axes"] == "tp" and v["kind"] == "all-reduce"]
+        assert tp_ops and all(v["bytes"] > 0 for v in tp_ops)
+
+
+class TestProfiledCollectives:
+    @pytest.fixture(scope="class")
+    def tp_run(self, devices8):
+        mesh = Mesh(np.array(devices8[:4]).reshape(2, 2), ("dp", "tp"))
+
+        def fn(x, w):
+            return jnp.sum(x @ w)
+
+        x = jax.device_put(jnp.ones((128, 128)),
+                           NamedSharding(mesh, P("dp", "tp")))
+        w = jax.device_put(jnp.ones((128, 128)),
+                           NamedSharding(mesh, P("tp", None)))
+        compiled = jax.jit(fn, out_shardings=NamedSharding(mesh, P())
+                           ).lower(x, w).compile()
+        compiled(x, w).block_until_ready()  # warmup outside the profile
+        return mesh, compiled, (x, w)
+
+    def test_events_join_and_attribute(self, tp_run):
+        mesh, compiled, args = tp_run
+        events = profile_step_collectives(
+            compiled, lambda: compiled(*args), mesh, iteration=3)
+        assert events, "no collective events captured from the profiler"
+        # Per-device events: the tp all-reduce appears on all 4 devices,
+        # with pids in the device range (1000*(process+1)+ordinal).
+        ar = [e for e in events if e["name"] == "all-reduce"]
+        assert {e["pid"] for e in ar} == {1000, 1001, 1002, 1003}
+        for e in ar:
+            a = e["args"]
+            assert a["bytes"] > 0
+            assert a["device"] in a["group"]   # global id ∈ replica group
+            assert a["process"] == 0
+            assert a["iteration"] == 3
+            assert e["dur"] >= 0
+        # Bandwidth computed when the profiler measured a duration.
+        assert any(e["args"]["bandwidth_gbps"] > 0 for e in ar
+                   if e["dur"] > 0)
+
+    def test_flows_through_dependency_and_detector(self, tp_run):
+        """The synthesized records satisfy the dependency/detector
+        contracts: related sets form across devices and stage 2 executes
+        on them (VERDICT round-3 missing #2 'no emission site')."""
+        mesh, compiled, args = tp_run
+        events = profile_step_collectives(
+            compiled, lambda: compiled(*args), mesh)
+        related = build_dependencies(events)
+        assert related, "no related collective sets formed"
+        some = next(iter(related.values()))
+        assert len(some) >= 2  # one logical op across >=2 devices
+        for pid in {e["pid"] for e in events}:
+            assert detect_stage2(events, related, pid) in (True, False)
+        assert isinstance(try_detect(events, related), list)
+
+    def test_model_train_step_emits_collectives(self, devices8):
+        """A real tp=2 GPT train step profiles into all-reduce records —
+        the detector's stage-2 input now exists for real runs."""
+        from megatronapp_tpu.config.parallel_config import ParallelConfig
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.data.mock import mock_batches
+        from megatronapp_tpu.models.gpt import gpt_loss, init_gpt_params
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.training.optimizer import get_optimizer
+        from megatronapp_tpu.training.train import reshape_global_batch
+        from megatronapp_tpu.training.train_state import setup_train_state
+        from megatronapp_tpu.training.train_step import make_train_step
+
+        cfg = TransformerConfig(num_layers=2, hidden_size=64,
+                                num_attention_heads=4, vocab_size=128,
+                                max_position_embeddings=32)
+        par = ParallelConfig(tensor_parallel=2, data_parallel=2)
+        ctx = build_mesh(par, devices=devices8[:4])
+        opt_cfg = OptimizerConfig(lr=1e-3)
+        optimizer = get_optimizer(opt_cfg, 2)
+        state, shardings, _ = setup_train_state(
+            jax.random.PRNGKey(0), lambda k: init_gpt_params(k, cfg),
+            optimizer, ctx)
+
+        def loss_fn(p, micro):
+            return gpt_loss(p, micro["tokens"], micro["labels"],
+                            micro["loss_mask"], cfg, ctx=ctx)
+
+        step = make_train_step(loss_fn, optimizer, opt_cfg, ctx,
+                               shardings, 2, donate=False)
+        batch = reshape_global_batch(
+            next(mock_batches(32, 128, 4, seed=0)), 1)
+        with ctx.mesh:
+            compiled = step.lower(state, batch).compile()
+            state2, _ = compiled(state, batch)   # warmup
+            jax.block_until_ready(state2)
+            events = profile_step_collectives(
+                compiled, lambda: compiled(state, batch), ctx.mesh)
+        assert events
+        kinds = {e["name"] for e in events}
+        assert "all-reduce" in kinds
+        axes = {e["args"]["axes"] for e in events}
+        assert any("tp" in a for a in axes)
+        related = build_dependencies(events)
+        assert related
+
+
+class TestEndToEndTracedRun:
+    def test_traced_training_run_emits_collectives(self, devices8,
+                                                   tmp_path):
+        """A real traced tp=2 pretrain_gpt run lands per-collective
+        records in the trace files; aggregation preserves them and the
+        detector's stage 2 executes on the resulting related sets
+        (VERDICT round-3 task 4's done-criterion)."""
+        import os
+
+        from megatronapp_tpu.config.parallel_config import ParallelConfig
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.trace.aggregate import aggregate_dir
+        from megatronapp_tpu.training.train import pretrain_gpt
+
+        model = TransformerConfig(num_layers=2, hidden_size=64,
+                                  num_attention_heads=4, vocab_size=128,
+                                  max_position_embeddings=32)
+        par = ParallelConfig(tensor_parallel=2, data_parallel=2)
+        ctx = build_mesh(par, devices=devices8[:4])
+        trace_dir = str(tmp_path / "trace")
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=4,
+                               seq_length=32, train_iters=3,
+                               log_interval=1, trace=True,
+                               trace_dir=trace_dir, trace_interval=2,
+                               continuous_trace_iterations=1)
+        pretrain_gpt(model, par, train, OptimizerConfig(lr=1e-3), ctx=ctx)
+
+        trace = aggregate_dir(trace_dir,
+                              os.path.join(trace_dir, "agg.json"))
+        coll = [e for e in trace["traceEvents"]
+                if e.get("name") == "all-reduce" and e.get("ph") == "X"]
+        assert coll, "traced run produced no collective events"
+        # Per-device pids disjoint from process pids, full attribution.
+        assert len({e["pid"] for e in coll}) >= 2
+        assert all(e["pid"] >= 1000 for e in coll)
+        assert all(e["args"]["bytes"] > 0 for e in coll)
+        assert any(e["args"].get("group") for e in coll)
+        # Ids are globally unique after aggregation (multi-window capture
+        # must not collide id-keyed lookups).
+        ids = [e["args"]["id"] for e in trace["traceEvents"]
+               if "id" in e.get("args", {})]
+        assert len(ids) == len(set(ids))
+
+        related = build_dependencies(trace["traceEvents"])
+        assert any(len(ids) >= 2 for ids in related.values())
+        # Stage 2 attributes device events to their owning PROCESS — the
+        # pid stage 1 escalates.
+        owner = {e["args"]["process"] for e in coll}
+        assert owner == {0}
+        assert detect_stage2(trace["traceEvents"], related,
+                             0) in (True, False)
+
+    def test_stage2_attributes_device_events_to_process(self):
+        """Synthetic 2-process trace: process 1's devices always finish
+        their collectives earliest → stage 2 flags pid 1, not the device
+        pids (the round-4 review's cross-pid attribution bug)."""
+        events = []
+        eid = 0
+        for occ in range(5):
+            for proc in (0, 1):
+                for local in range(2):
+                    dev = proc * 2 + local
+                    eid += 1
+                    # process 1 finishes early (slow chip waits less)
+                    end_shift = 0.0 if proc else 50.0
+                    events.append({
+                        "ph": "X", "name": "all-reduce",
+                        "ts": occ * 1000.0 + end_shift,
+                        "dur": 10.0,
+                        "pid": 1000 * (proc + 1) + local, "tid": 0,
+                        "args": {"id": eid, "group": [0, 1, 2, 3],
+                                 "bytes": 64, "process": proc,
+                                 "device": dev, "iteration": 0},
+                    })
+        related = build_dependencies(events)
+        assert related
+        assert detect_stage2(events, related, 1) is True
+        assert detect_stage2(events, related, 0) is False
