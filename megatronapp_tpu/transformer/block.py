@@ -108,7 +108,8 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                    cfg.layernorm_epsilon)
     aux = None
     if "moe" in p:
-        mlp_out, aux = moe_forward(p["moe"], h, cfg, layer_id=layer_id)
+        mlp_out, aux = moe_forward(p["moe"], h, cfg, layer_id=layer_id,
+                                   ctx=ctx)
     else:
         mlp_out = mlp_forward(p["mlp"], h, cfg, layer_id=layer_id)
     x = residual + mlp_out.astype(residual.dtype)

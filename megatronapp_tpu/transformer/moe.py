@@ -134,15 +134,29 @@ def _dropless_experts(p, x_flat, topk_idx, topk_probs,
         y.astype(jnp.float32) * w_sorted[:, None])
 
 
-def moe_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None
-                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """x: [B,S,H] → ([B,S,H], aux_loss scalar)."""
+def moe_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
+                ctx=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x: [B,S,H] → ([B,S,H], aux_loss scalar).
+
+    ctx with ep > 1 selects the explicit all-to-all dispatch
+    (_a2a_expert_forward): expert weights stay home on their ep shard and
+    token activations travel, the reference MoEAlltoAllTokenDispatcher
+    (core/transformer/moe/token_dispatcher.py). Without it, XLA's SPMD
+    partitioner faces token-sharded ⇄ expert-sharded layout transitions
+    it can only solve by full rematerialization (replicate + repartition
+    — the 'Involuntary full rematerialization' warnings)."""
     b, s, h = x.shape
     t = b * s
     e = cfg.num_moe_experts
     k = cfg.moe_router_topk
-    x_flat = x.reshape(t, h)
 
+    if ctx is not None and getattr(ctx, "ep", 1) > 1:
+        out, aux = _a2a_expert_forward(p, x, cfg, ctx)
+        x_flat = x.reshape(t, h)
+        return _with_shared(p, x_flat, out.reshape(t, h), cfg).reshape(
+            b, s, h).astype(x.dtype), aux
+
+    x_flat = x.reshape(t, h)
     topk_idx, topk_probs, aux = _router(p, x_flat, cfg)
 
     if cfg.moe_capacity_factor is None:
@@ -151,6 +165,103 @@ def moe_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None
         out = _capacity_experts(p, x_flat, topk_idx, topk_probs, cfg)
     return _with_shared(p, x_flat, out, cfg).reshape(
         b, s, h).astype(x.dtype), aux
+
+
+def _a2a_expert_forward(p, x: jnp.ndarray, cfg: TransformerConfig, ctx
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-parallel dispatch as explicit ICI all-to-alls.
+
+    shard_map manual over the ep axis ONLY (dp/tp/cp stay under compiler
+    control — the gated fc1 split and the fc2 contraction reshard
+    automatically): each ep shard routes its own tokens, packs per-expert
+    capacity buffers, all-to-alls them to the experts' home shards, runs
+    the local expert FFNs, and all-to-alls results back — the reference's
+    a2a dispatcher made of two lax.all_to_all collectives instead of
+    torch.distributed.all_to_all.
+
+    Capacity: moe_capacity_factor when set (GShard drop semantics);
+    otherwise T_local*k — every copy provably fits, keeping the default
+    dropless-exact semantics at the cost of a fatter buffer (the
+    reference pads to capacity on this path too,
+    --moe-pad-expert-input-to-capacity).
+    """
+    from megatronapp_tpu.config.parallel_config import EP_AXIS
+
+    e = cfg.num_moe_experts
+    k = cfg.moe_router_topk
+    ep = ctx.ep
+    e_loc = e // ep
+    dt = cfg.compute_dtype
+    if cfg.moe_capacity_factor is not None and cfg.moe_capacity_factor <= 0:
+        raise ValueError(
+            f"moe_capacity_factor must be > 0 (got "
+            f"{cfg.moe_capacity_factor}); omit it (None) for dropless "
+            "dispatch")
+
+    def body(router_kernel, fc1, fc2, x_loc):
+        bl, sl, h = x_loc.shape
+        t_loc = bl * sl
+        xf = x_loc.reshape(t_loc, h)
+        topk_idx, topk_probs, aux = _router(
+            {"router_kernel": router_kernel}, xf, cfg)
+        # Aux stats are per-ep-shard token means; average across shards
+        # (the dp-sharded token dim is auto, so its mean is already
+        # global over dp).
+        aux = jax.lax.pmean(aux, EP_AXIS)
+
+        if cfg.moe_capacity_factor is not None:
+            cap = max(int(cfg.moe_capacity_factor * t_loc * k / e), 1)
+        else:
+            # top_k indices are distinct per token, so an expert receives
+            # at most one copy per token: cap = t_loc is provably
+            # dropless.
+            cap = t_loc
+        flat_e = topk_idx.reshape(t_loc * k)
+        pos = _position_in_expert(flat_e, e)                  # [T*k]
+        valid = pos < cap
+        idx_e = jnp.where(valid, flat_e, 0)
+        idx_p = jnp.where(valid, pos, 0)
+        token_of = jnp.arange(t_loc * k) // k
+
+        vals = (xf[token_of].astype(dt) *
+                valid[:, None].astype(dt))                    # [T*k, H]
+        send = jnp.zeros((e, cap, h), dt).at[idx_e, idx_p].add(vals)
+
+        # tokens → expert home shards (experts live contiguously:
+        # shard i holds [i*e_loc, (i+1)*e_loc), the fc1/fc2 'experts'
+        # axis sharding).
+        send = send.reshape(ep, e_loc, cap, h)
+        recv = jax.lax.all_to_all(send, EP_AXIS, split_axis=0,
+                                  concat_axis=0)              # [ep_src,...]
+        xin = recv.transpose(1, 0, 2, 3).reshape(e_loc, ep * cap, h)
+        y = _expert_ffn({"fc1_kernel": fc1, "fc2_kernel": fc2}, xin, cfg)
+        y = y.reshape(e_loc, ep, cap, h).transpose(1, 0, 2, 3)
+        y = jax.lax.all_to_all(y, EP_AXIS, split_axis=0,
+                               concat_axis=0)                 # back home
+        y = y.reshape(e, cap, h)
+
+        w = (topk_probs.reshape(t_loc * k) *
+             valid.astype(topk_probs.dtype))
+        contrib = y[idx_e, idx_p].astype(jnp.float32) * w[:, None]
+        out = contrib.reshape(t_loc, k, h).sum(axis=1)        # [T_loc, H]
+        return out.reshape(bl, sl, h), aux
+
+    from jax.sharding import PartitionSpec as P
+    sm = jax.shard_map(
+        body, mesh=ctx.shard_map_mesh,
+        in_specs=(P(), P(EP_AXIS), P(EP_AXIS), P(EP_AXIS)),
+        out_specs=(P(EP_AXIS), P()),
+        axis_names={EP_AXIS})
+    return sm(p["router_kernel"], p["fc1_kernel"], p["fc2_kernel"], x)
+
+
+def _position_in_expert(flat_expert: jnp.ndarray, e: int) -> jnp.ndarray:
+    """Arrival-order slot of each (token, choice) copy within its
+    expert's capacity buffer (GShard position accounting, shared by the
+    capacity and a2a dispatchers). flat_expert: [T*k] → pos [T*k]."""
+    onehot = jax.nn.one_hot(flat_expert, e, dtype=jnp.int32)  # [T*k, E]
+    before = jnp.cumsum(onehot, axis=0) - onehot
+    return jnp.sum(before * onehot, axis=1)
 
 
 def _capacity_experts(p, x_flat, topk_idx, topk_probs,
@@ -167,11 +278,8 @@ def _capacity_experts(p, x_flat, topk_idx, topk_probs,
             "dispatch")
     capacity = max(int(cfg.moe_capacity_factor * t * k / e), 1)
 
-    # Position of each (token, k) assignment within its expert's buffer.
     onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.int32)  # [T,K,E]
-    flat_onehot = onehot.reshape(t * k, e)
-    pos_in_expert = jnp.cumsum(flat_onehot, axis=0) * flat_onehot - 1  # [T*K,E]
-    pos = jnp.max(pos_in_expert, axis=-1).reshape(t, k)  # [T,K]
+    pos = _position_in_expert(topk_idx.reshape(t * k), e).reshape(t, k)
     keep = pos < capacity
 
     # Dispatch tensor [T, E, C] (GShard combine/dispatch einsum pattern).
