@@ -132,6 +132,11 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
                         "selective; use --recompute-granularity)")
     g.add_argument("--recompute-granularity", default="selective",
                    choices=["none", "selective", "selective_attn", "full"])
+    g.add_argument("--attention-impl", default="auto",
+                   choices=["auto", "pallas", "reference"],
+                   help="auto = flash above --flash-min-seq, dense below")
+    g.add_argument("--flash-min-seq", type=int, default=2048,
+                   help="flash/dense crossover sequence length (PERF.md)")
     g.add_argument("--bf16", action="store_true", default=True)
     g.add_argument("--fp32", action="store_true",
                    help="disable bf16 compute")
@@ -376,6 +381,8 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
                 args.hierarchical_context_parallel_sizes[0]
                 if args.hierarchical_context_parallel_sizes else 2),
             remat_policy=args.recompute_granularity,
+            attention_impl=args.attention_impl,
+            flash_min_seq=args.flash_min_seq,
             compute_dtype=jnp.float32 if args.fp32 else jnp.bfloat16,
             heterogeneous_layers_config_json=_hetero_json(args),
         )

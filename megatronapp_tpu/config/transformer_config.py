@@ -149,8 +149,17 @@ class TransformerConfig:
 
     # Kernel implementation selection (spec_utils.py ModuleSpec analogue):
     # 'reference' = pure jnp; 'pallas' = fused Pallas flash attention;
-    # 'auto' = pallas on TPU, reference elsewhere.
+    # 'auto' = on TPU, pallas for sequences >= flash_min_seq and the
+    # XLA dense path below it, reference elsewhere.
     attention_impl: str = "auto"
+
+    # Flash/dense crossover for 'auto' (PERF.md lever #2): at short
+    # sequences the O(S^2) dense backward is FASTER on this chip than
+    # the flash backward kernels at D=64 (measured 8x at S=1024 —
+    # half-empty MXU lanes + recompute overhead dominate below the
+    # memory-capacity regime flash exists for). 'pallas' forces flash
+    # regardless.
+    flash_min_seq: int = 2048
 
     # Fused dot-product attention blockwise kernel sizes (Pallas).
     flash_block_q: int = 512

@@ -316,3 +316,20 @@ def t5_pipeline_loss(p, batch_mb, enc_cfg: TransformerConfig, ctx,
     loss, _ = cross_entropy_loss(logits, batch_mb["labels"],
                                  batch_mb.get("loss_mask"))
     return loss, {"lm_loss": loss}
+
+
+def mock_t5_batch(seed, batch_size, enc_len, dec_len, vocab_size):
+    """Synthetic span-corruption-shaped batch (pretrain_t5.py mock
+    stream; mirrors models/bert.py mock_bert_batch placement)."""
+    import numpy as np
+    r = np.random.default_rng(seed)
+    enc = r.integers(3, vocab_size, size=(batch_size, enc_len))
+    dec = r.integers(3, vocab_size, size=(batch_size, dec_len))
+    labels = np.concatenate([dec[:, 1:], dec[:, :1]], axis=1)
+    return {
+        "text_enc": enc.astype(np.int32),
+        "text_dec": dec.astype(np.int32),
+        "labels": labels.astype(np.int32),
+        "loss_mask": np.ones((batch_size, dec_len), np.float32),
+        "enc_mask": np.ones((batch_size, enc_len), np.float32),
+    }

@@ -39,11 +39,14 @@ def dot_product_attention(
     softmax_scale: Optional[float] = None,
     softmax_in_fp32: bool = True,
     q_offset: int = 0,
+    layer_id=None,
 ) -> jnp.ndarray:
     """Returns context [B, Sq, H, D].
 
     q_offset: absolute position of q[0] relative to k[0] (used for decode
     steps and for ring-attention block offsets).
+    layer_id: MegaScope capture attribution for the 'attention_probs'
+    site (reference RawAttentionScore flag).
     """
     b, sq, h, d = q.shape
     skv = k.shape[1]
@@ -69,5 +72,8 @@ def dot_product_attention(
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     else:
         probs = jax.nn.softmax(scores, axis=-1)
+    # MegaScope RawAttentionScore site ([B,H,Sq,Skv] probabilities).
+    from megatronapp_tpu.scope.hooks import scope_capture
+    probs = scope_capture("attention_probs", probs, layer_id)
     probs = probs.astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -164,7 +164,19 @@ def attention_forward(
 
         impl = cfg.attention_impl
         if impl == "auto":
-            impl = "pallas" if jax.default_backend() == "tpu" else "reference"
+            # Crossover: dense XLA attention below flash_min_seq (the
+            # flash bwd kernels lose to the fused dense backward at
+            # short S with D=64 — PERF.md), flash above it. The dense
+            # fallback is memory-guarded: it materializes fp32
+            # [B, H, S, S] scores+probs, so configs whose score tensors
+            # exceed ~1 GB per device keep the O(S)-memory flash kernel
+            # regardless of S.
+            dense_bytes = 2 * 4 * b * nq * s * s
+            if ctx is not None and ctx.num_devices > 1:
+                dense_bytes //= ctx.num_devices
+            impl = ("pallas" if jax.default_backend() == "tpu"
+                    and (s >= cfg.flash_min_seq or dense_bytes > 1 << 30)
+                    else "reference")
         # GSPMD cannot partition a pallas_call (it would replicate full
         # attention on every device), so the kernel must be placed
         # explicitly: on a multi-device mesh we shard_map it manually over
@@ -237,7 +249,7 @@ def attention_forward(
                 q, k, v, mask_type=mask_type,
                 attention_mask=attention_mask, softmax_scale=None,
                 softmax_in_fp32=cfg.attention_softmax_in_fp32,
-                q_offset=q_offset)
+                q_offset=q_offset, layer_id=layer_id)
     attn_out = scope_capture("context", attn_out, layer_id)
 
     out_kernel = _dist.apply("weight", p["out_kernel"], layer_id)

@@ -13,27 +13,14 @@ import jax
 import numpy as np
 
 from megatronapp_tpu.config.arguments import build_parser, configs_from_args, parse_args
-from megatronapp_tpu.models.t5 import init_t5_params, t5_config, t5_loss
+from megatronapp_tpu.models.t5 import (
+    init_t5_params, mock_t5_batch, t5_config, t5_loss,
+)
 from megatronapp_tpu.parallel.mesh import build_mesh
 from megatronapp_tpu.training.optimizer import get_optimizer
 from megatronapp_tpu.training.train import reshape_global_batch
 from megatronapp_tpu.training.train_state import setup_train_state
 from megatronapp_tpu.training.train_step import make_train_step
-
-
-def mock_t5_batch(seed, batch_size, enc_len, dec_len, vocab_size):
-    """Synthetic span-corruption-shaped batch."""
-    r = np.random.default_rng(seed)
-    enc = r.integers(3, vocab_size, size=(batch_size, enc_len))
-    dec = r.integers(3, vocab_size, size=(batch_size, dec_len))
-    labels = np.concatenate([dec[:, 1:], dec[:, :1]], axis=1)
-    return {
-        "text_enc": enc.astype(np.int32),
-        "text_dec": dec.astype(np.int32),
-        "labels": labels.astype(np.int32),
-        "loss_mask": np.ones((batch_size, dec_len), np.float32),
-        "enc_mask": np.ones((batch_size, enc_len), np.float32),
-    }
 
 
 def main(argv=None):

@@ -110,6 +110,19 @@ def main():
         q, q, q).astype(jnp.float32).sum()))
     entry("dense_attn_fwd_bwd", _time_fn(dnb, q), attn_flops * 3.5)
 
+    # 4b. flash-vs-dense crossover sweep over sequence length (PERF.md
+    # lever #2: locates the auto-select threshold flash_min_seq).
+    for s_len in (1024, 2048, 4096):
+        qs = jnp.ones((max(B * S // s_len, 1), s_len, NH, D), jnp.bfloat16)
+        fl_s = jax.jit(jax.grad(lambda q: flash_attention(
+            q, q, q, causal=True).astype(jnp.float32).sum()))
+        dn_s = jax.jit(jax.grad(lambda q: dot_product_attention(
+            q, q, q).astype(jnp.float32).sum()))
+        sweep_flops = (2 * 2 * qs.shape[0] * NH * s_len * s_len * D / 2
+                       * 3.5)
+        entry(f"flash_fwd_bwd_S{s_len}", _time_fn(fl_s, qs), sweep_flops)
+        entry(f"dense_fwd_bwd_S{s_len}", _time_fn(dn_s, qs), sweep_flops)
+
     # 5. one layer fwd+bwd (both attention impls)
     import dataclasses
 
