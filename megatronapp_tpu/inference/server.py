@@ -27,11 +27,17 @@ from __future__ import annotations
 
 import asyncio
 import json
+import threading
 from typing import Optional
-
 from megatronapp_tpu.inference.engine import (
     SamplingParams, StaticInferenceEngine,
 )
+
+
+class _ClientGone(Exception):
+    """Raised inside the generation worker when the WS client vanished
+    mid-stream (cooperative cancellation via the token callback)."""
+
 
 
 def _sampling_from_request(req: dict) -> SamplingParams:
@@ -55,7 +61,6 @@ class TextGenerationServer:
         # engine's jits — concurrent generations would cross-contaminate
         # (the reference server serializes with a lock too,
         # text_generation_server.py MegatronServer).
-        import threading
         self._gen_lock = threading.Lock()
 
     # ------------------------------------------------------------------
@@ -85,17 +90,40 @@ class TextGenerationServer:
         ws = web.WebSocketResponse()
         await ws.prepare(request)
         loop = asyncio.get_running_loop()
-        async for msg in ws:
-            if msg.type != 1:  # TEXT
-                continue
+        # One persistent receive task doubles as the mid-generation
+        # disconnect watcher: cancelling a ws.receive() mid-flight can
+        # drop frames, so the SAME pending task is awaited between
+        # requests and select()-ed against the payload queue during one.
+        # TEXT frames that arrive mid-generation are buffered in
+        # `pending` and served in order once the current one finishes
+        # (sequential pipelining, matching the old async-for semantics).
+        import collections
+        pending: collections.deque = collections.deque()
+        recv_task = asyncio.ensure_future(ws.receive())
+        while True:
+            if pending:
+                msg = pending.popleft()
+            else:
+                msg = await recv_task
+                if msg.type == 1:
+                    recv_task = asyncio.ensure_future(ws.receive())
+            if msg.type != 1:  # not TEXT → close/closing/error: done
+                break
             req = json.loads(msg.data)
             prompts = req.get("prompts") or [req.get("prompt", "")]
             n = int(req.get("tokens_to_generate", 64))
             sampling = _sampling_from_request(req)
             viz = req.get("visualization")
             queue: asyncio.Queue = asyncio.Queue()
+            # Client-gone cancellation: a disconnect mid-stream must not
+            # leave the generation running to completion while holding
+            # _gen_lock (round-2 advisor finding) — the per-token
+            # callback aborts the executor job at the next token.
+            cancel = threading.Event()
 
             def cb(step, tokens, logits):
+                if cancel.is_set():
+                    raise _ClientGone()
                 payload = {
                     "type": "token", "step": int(step),
                     "token": int(tokens[0]),
@@ -171,13 +199,56 @@ class TextGenerationServer:
             # the sentinel (no racy cancel of an in-flight queue.get).
             _DONE = object()
             fut.add_done_callback(lambda _: queue.put_nowait(_DONE))
-            while True:
-                payload = await queue.get()
-                if payload is _DONE:
-                    break
-                await ws.send_json(payload)
+            # Drain payloads while WATCHING the socket: a close frame (or
+            # any mid-stream client traffic) must abort the in-flight
+            # generation — the token callback raises _ClientGone at the
+            # next token, releasing _gen_lock instead of running to
+            # completion (round-2 advisor finding). A bare queue.get()
+            # would never see the disconnect. recv_task is the
+            # persistent watcher; on a mid-stream fire it stays
+            # completed and the top of the outer loop consumes it.
+            completed = False
+            get_task = asyncio.ensure_future(queue.get())
+            try:
+                while True:
+                    done, _ = await asyncio.wait(
+                        {get_task, recv_task},
+                        return_when=asyncio.FIRST_COMPLETED)
+                    if recv_task in done:
+                        m = recv_task.result()
+                        if m.type == 1:
+                            # Pipelined request: buffer it, keep
+                            # streaming the current generation.
+                            pending.append(m)
+                            recv_task = asyncio.ensure_future(
+                                ws.receive())
+                            continue
+                        break           # disconnect → abort
+                    payload = get_task.result()
+                    if payload is _DONE:
+                        completed = True
+                        break
+                    await ws.send_json(payload)
+                    get_task = asyncio.ensure_future(queue.get())
+            except (ConnectionResetError, RuntimeError):
+                pass                    # TCP reset mid-send → abort
+            finally:
+                if not completed:
+                    cancel.set()
+                if not get_task.done():
+                    get_task.cancel()   # queue.get cancel is loss-free
+            if not completed:
+                try:
+                    await fut      # worker aborts at the next token
+                except _ClientGone:
+                    pass
+                except Exception:  # noqa: BLE001 — client already gone
+                    pass
+                continue           # outer loop handles the fired recv
             try:
                 texts = fut.result()
+            except _ClientGone:
+                continue
             except Exception as e:
                 # Client-input-driven failures (bad flag names, malformed
                 # disturbance configs) surface as an error frame, matching
@@ -185,6 +256,8 @@ class TextGenerationServer:
                 await ws.send_json({"type": "error", "message": str(e)})
                 continue
             await ws.send_json({"type": "done", "text": texts[0]})
+        if not recv_task.done():
+            recv_task.cancel()     # connection is closing anyway
         return ws
 
     # ------------------------------------------------------------------

@@ -73,7 +73,12 @@ def dot_product_attention(
     else:
         probs = jax.nn.softmax(scores, axis=-1)
     # MegaScope RawAttentionScore site ([B,H,Sq,Skv] probabilities).
-    from megatronapp_tpu.scope.hooks import scope_capture
-    probs = scope_capture("attention_probs", probs, layer_id)
+    # Gated on layer_id: only the transformer self-attention path
+    # threads it, so context-parallel per-block partial softmaxes, T5
+    # cross-attention, retro, and MLA callers (layer_id=None here) do
+    # not emit misattributed payloads into the site.
+    if layer_id is not None:
+        from megatronapp_tpu.scope.hooks import scope_capture
+        probs = scope_capture("attention_probs", probs, layer_id)
     probs = probs.astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

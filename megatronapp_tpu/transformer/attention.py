@@ -122,8 +122,15 @@ def attention_forward(
         ck, cv = kv_cache
         if cache_positions is not None:
             # Continuous-batching decode (dynamic_context.py analogue):
-            # each row appends at ITS OWN position; causality comes from
-            # the caller's per-row attention_mask, not a scalar offset.
+            # each row appends at ITS OWN position; causality MUST come
+            # from the caller's per-row attention_mask — fail fast if it
+            # is missing rather than silently attending to stale/future
+            # cache slots (round-2 advisor finding).
+            if attention_mask is None:
+                raise ValueError(
+                    "per-row decode (cache_positions) requires an "
+                    "explicit per-row attention_mask; see "
+                    "inference/dynamic_engine.py's attend mask")
             ck = ck.at[jnp.arange(b), cache_positions].set(k[:, 0])
             cv = cv.at[jnp.arange(b), cache_positions].set(v[:, 0])
             mask_type = AttnMaskType.bidirectional

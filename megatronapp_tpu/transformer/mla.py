@@ -127,8 +127,16 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                 "not supported (each shard would attend only local KV)")
         c_lat, c_pe = kv_cache
         if cache_positions is not None:
-            # Continuous-batching decode: per-row append positions;
-            # causality comes from the caller's per-row mask.
+            # Continuous-batching decode: per-row append positions.
+            # Causality MUST come from the caller's per-row mask — the
+            # scalar-offset causal mask cannot express per-row history
+            # lengths, so an absent mask would silently attend to stale/
+            # future cache slots (round-2 advisor finding).
+            if attention_mask is None:
+                raise ValueError(
+                    "per-row decode (cache_positions) requires an "
+                    "explicit per-row attention_mask; see "
+                    "inference/dynamic_engine.py's attend mask")
             c_lat = c_lat.at[jnp.arange(b), cache_positions].set(
                 latent[:, 0].astype(c_lat.dtype))
             c_pe = c_pe.at[jnp.arange(b), cache_positions].set(

@@ -10,7 +10,6 @@ data/t5_dataset.py (sentinel span corruption).
 import time
 
 import jax
-import numpy as np
 
 from megatronapp_tpu.config.arguments import build_parser, configs_from_args, parse_args
 from megatronapp_tpu.models.t5 import (

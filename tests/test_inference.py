@@ -378,3 +378,52 @@ class TestMambaEngine:
         out_ids = [int(x) for x in texts[0].split()]
         assert out_ids == tokens_seen[:len(out_ids)]
         assert len(tokens_seen) == 4
+
+
+class TestWsDisconnectCancellation:
+    def test_disconnect_aborts_generation_and_releases_lock(self, engine):
+        """A client vanishing mid-stream must abort the in-flight
+        generation at the next token instead of holding _gen_lock to
+        completion (round-2 advisor finding; server streams via the
+        token callback, which raises _ClientGone once cancelled)."""
+        import time as _time
+
+        from aiohttp.test_utils import TestClient, TestServer as ATestServer
+        from megatronapp_tpu.inference.server import TextGenerationServer
+
+        srv = TextGenerationServer(engine)
+        # Slow the stream so an un-cancelled run would take ~10s.
+        orig = engine.generate_text
+
+        def slow_generate(prompts, n, sampling, token_callback=None):
+            def slow_cb(step, tokens, logits):
+                _time.sleep(0.2)
+                if token_callback:
+                    token_callback(step, tokens, logits)
+            return orig(prompts, n, sampling, token_callback=slow_cb)
+
+        engine.generate_text = slow_generate
+        try:
+            async def run():
+                client = TestClient(ATestServer(srv.build_app()))
+                await client.start_server()
+                ws = await client.ws_connect("/ws")
+                await ws.send_json({"prompt": "1 2 3",
+                                    "tokens_to_generate": 50,
+                                    "greedy": True})
+                msg = await ws.receive_json(timeout=60)
+                assert msg["type"] == "token"
+                await ws.close()        # client gone mid-stream
+                await client.close()
+
+            t0 = _time.perf_counter()
+            asyncio.run(run())
+            # The worker must release the generation lock well before the
+            # 50*0.2s=10s a full run would take.
+            acquired = srv._gen_lock.acquire(timeout=5.0)
+            elapsed = _time.perf_counter() - t0
+            assert acquired, "generation still holds _gen_lock"
+            srv._gen_lock.release()
+            assert elapsed < 8.0, f"generation ran on for {elapsed:.1f}s"
+        finally:
+            engine.generate_text = orig
