@@ -322,6 +322,15 @@ def pretrain_gpt(
     step_time_ms = 0.0
     tokens_per_sec = 0.0
 
+    # E2E run-health metrics (reference one_logger_utils.py parity —
+    # utils/one_logger.py flushes through the standard metrics sinks).
+    from megatronapp_tpu.utils.one_logger import get_e2e_tracker
+    e2e = get_e2e_tracker()
+    e2e.reset()
+    e2e.on_train_start(start_step, consumed, train_cfg.train_iters,
+                       train_cfg.seq_length)
+    window_start_iter = start_step   # first iteration of the open window
+
     last_sync_iter = start_step
     rows = _RowBuffer(batch_iter)
     with ctx.mesh:
@@ -393,8 +402,10 @@ def pretrain_gpt(
                 losses.append(loss)
                 now = time.perf_counter()
                 dt = now - window_start
-                steps_in_window = (it % train_cfg.log_interval) + 1 \
-                    if (it + 1) % train_cfg.log_interval else train_cfg.log_interval
+                # Iteration-indexed window length (a modulo formula
+                # overcounts the first window after a mid-interval
+                # checkpoint resume).
+                steps_in_window = it + 1 - window_start_iter
                 tokens_per_sec = window_tokens / dt
                 step_time_ms = dt / max(steps_in_window, 1) * 1e3
                 tflops = (tokens_per_sec *
@@ -422,11 +433,16 @@ def pretrain_gpt(
                     "step_time_ms": step_time_ms,
                     "tflops_per_device": tflops,
                 })
+                e2e.track_iterations(
+                    steps_in_window, dt,
+                    window_tokens // train_cfg.seq_length)
                 window_tokens = 0
                 window_start = now
+                window_start_iter = it + 1
 
             if eval_step_fn is not None and \
                     (it + 1) % train_cfg.eval_interval == 0:
+                t_eval = time.perf_counter()
                 totals = []
                 for _ in range(train_cfg.eval_iters):
                     ebatch = reshape_global_batch(next(eval_batch_iter),
@@ -434,12 +450,23 @@ def pretrain_gpt(
                     totals.append(eval_step_fn(state, ebatch))
                 eval_loss = float(jax.device_get(
                     jnp.mean(jnp.stack(totals))))
+                eval_dt = time.perf_counter() - t_eval
+                e2e.track_validation(eval_dt)
+                # Keep eval time out of the next train window (it is
+                # reported under validation_* instead).
+                window_start += eval_dt
                 log_fn(f"eval @ iter {it+1}: loss {eval_loss:.4f} over "
                        f"{train_cfg.eval_iters} batches")
 
             if ckpt is not None and train_cfg.save_interval and \
                     (it + 1) % train_cfg.save_interval == 0:
+                t_save = time.perf_counter()
                 ckpt.save(it + 1, jax.device_get(state))
+                save_dt = time.perf_counter() - t_save
+                e2e.on_save_checkpoint(save_dt)
+                # Save dispatch time is reported under save_checkpoint_*,
+                # not the next train window.
+                window_start += save_dt
 
             if train_cfg.exit_interval and \
                     (it + 1) % train_cfg.exit_interval == 0:
@@ -455,6 +482,14 @@ def pretrain_gpt(
         tracer.finalize()
     if inspector is not None:
         inspector.stop()
+    # Flush a partial window (exit_interval or final iterations not
+    # aligned to log_interval) so the summary covers every step run.
+    final_iter = int(jax.device_get(state["step"]))
+    if final_iter > window_start_iter:
+        e2e.track_iterations(final_iter - window_start_iter,
+                             time.perf_counter() - window_start,
+                             window_tokens // train_cfg.seq_length)
+    e2e.finish(metrics_logger, log_fn=log_fn, step=final_iter)
     metrics_logger.close()
 
     return TrainResult(state=state, losses=losses,

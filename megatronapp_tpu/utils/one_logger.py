@@ -1,0 +1,118 @@
+"""E2E training-run metrics (one-logger parity).
+
+Parity with /root/reference/megatron/training/one_logger_utils.py
+(on_train_start :18, _produce_e2e_metrics :76, track_e2e_metrics :209,
+on_save_checkpoint_start/success/end :226-443, finish :463): a process-
+wide tracker accumulating end-to-end run health metrics — train-loop
+time, per-iteration averages, consumed samples/tokens, throughput,
+checkpoint save counts and sync time — flushed through the standard
+metrics sinks (training/metrics.py jsonl/tensorboard/wandb) instead of
+the reference's proprietary one-logger service.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+class E2EMetricsTracker:
+    """Accumulates E2E metrics across a training run."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._start_time: Optional[float] = None
+        self._start_iteration = 0
+        self._samples_start = 0
+        self._train_iters_target = 0
+        self._seq_length = 0
+        self._iter_time_total_s = 0.0
+        self._tracked_iterations = 0
+        self._validation_time_total_s = 0.0
+        self._validation_count = 0
+        self._save_count = 0
+        self._save_time_total_s = 0.0
+        self._consumed_samples = 0
+
+    # -- lifecycle ------------------------------------------------------
+    def on_train_start(self, start_iteration: int, consumed_samples: int,
+                       train_iters: int, seq_length: int):
+        """reference on_train_start: records loop start + targets."""
+        self._start_time = time.perf_counter()
+        self._start_iteration = start_iteration
+        self._samples_start = consumed_samples
+        self._consumed_samples = consumed_samples
+        self._train_iters_target = train_iters
+        self._seq_length = seq_length
+
+    def track_iterations(self, n: int, duration_s: float, samples: int):
+        """Accumulate a window of n completed iterations (the loop's
+        sync-point cadence; reference track_e2e_metrics per-iteration)."""
+        self._iter_time_total_s += duration_s
+        self._tracked_iterations += n
+        self._consumed_samples += samples
+
+    def track_validation(self, duration_s: float):
+        self._validation_time_total_s += duration_s
+        self._validation_count += 1
+
+    def on_save_checkpoint(self, duration_s: float):
+        """reference on_save_checkpoint_start/end: count + sync time."""
+        self._save_count += 1
+        self._save_time_total_s += duration_s
+
+    # -- reporting ------------------------------------------------------
+    def metrics(self) -> Dict[str, float]:
+        """The reference's e2e_metrics dict (names kept for familiarity,
+        msecs units as in _produce_e2e_metrics)."""
+        if self._start_time is None:
+            return {}
+        elapsed = time.perf_counter() - self._start_time
+        n = max(self._tracked_iterations, 1)
+        samples = self._consumed_samples - self._samples_start
+        tokens = samples * self._seq_length
+        out = {
+            "app_train_loop_time_msecs": round(elapsed * 1e3, 1),
+            "train_iterations_time_msecs_total":
+                round(self._iter_time_total_s * 1e3, 1),
+            "train_iterations_time_msecs_avg":
+                round(self._iter_time_total_s * 1e3 / n, 3),
+            "tracked_train_iterations": self._tracked_iterations,
+            "iteration_start": self._start_iteration,
+            "train_iterations_target": self._train_iters_target,
+            "train_samples_start": self._samples_start,
+            "train_samples": samples,
+            "train_tokens": tokens,
+            "validation_iterations_time_msecs_total":
+                round(self._validation_time_total_s * 1e3, 1),
+            "tracked_validation_iterations": self._validation_count,
+            "save_checkpoint_count": self._save_count,
+            "save_checkpoint_sync_time_total_secs":
+                round(self._save_time_total_s, 3),
+        }
+        if self._iter_time_total_s > 0:
+            out["train_throughput_tokens_per_sec"] = round(
+                tokens / self._iter_time_total_s, 1)
+        return out
+
+    def finish(self, metrics_logger=None, log_fn=None, step: int = 0):
+        """reference finish(): emit the final E2E summary through the
+        metrics sinks and/or the run log."""
+        m = self.metrics()
+        if not m:
+            return m
+        if metrics_logger is not None:
+            metrics_logger.log(step, {f"e2e/{k}": v for k, v in m.items()})
+        if log_fn is not None:
+            log_fn("e2e: " + " ".join(f"{k}={v}" for k, v in sorted(
+                m.items())))
+        return m
+
+
+_TRACKER = E2EMetricsTracker()
+
+
+def get_e2e_tracker() -> E2EMetricsTracker:
+    return _TRACKER
