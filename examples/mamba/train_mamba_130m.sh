@@ -1,0 +1,8 @@
+#!/bin/bash
+# Mamba-130M pretraining (reference examples/mamba/train.sh — pure-M
+# stack; add --hybrid-pattern for attention interleaves, e.g.
+# 'MMM*MMM*' per the reference hybrid allocation strings).
+python pretrain_mamba.py --preset mamba-130m \
+    --seq-length 2048 --micro-batch-size 4 --global-batch-size 32 \
+    --mamba-state-dim 16 --mamba-conv-kernel 4 --mamba-expand 2 \
+    --train-iters 1000 --lr 3e-4 --lr-warmup-iters 100 "$@"

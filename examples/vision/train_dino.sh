@@ -1,0 +1,10 @@
+#!/bin/bash
+# DINO self-supervised ViT pretraining (reference
+# pretrain_vision_dino.py flow: student/teacher EMA, multi-crop).
+python pretrain_vision_dino.py \
+    --num-layers 12 --hidden-size 384 --num-attention-heads 6 \
+    --img-size 224 --patch-dim 16 \
+    --dino-out-dim 65536 --dino-local-crops-number 8 \
+    --dino-warmup-teacher-temp-iters 3000 \
+    --micro-batch-size 8 --global-batch-size 64 \
+    --train-iters 10000 --lr 5e-4 --lr-warmup-iters 1000 "$@"

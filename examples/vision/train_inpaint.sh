@@ -1,0 +1,8 @@
+#!/bin/bash
+# ViT inpainting pretraining (reference pretrain_vision_inpaint.py:
+# masked-patch reconstruction, PSNR/SSIM metrics).
+python pretrain_vision_inpaint.py \
+    --num-layers 12 --hidden-size 384 --num-attention-heads 6 \
+    --img-size 224 --patch-dim 16 --mask-factor 0.25 \
+    --micro-batch-size 8 --global-batch-size 64 \
+    --train-iters 10000 --lr 5e-4 --lr-warmup-iters 1000 "$@"
