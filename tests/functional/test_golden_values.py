@@ -79,6 +79,16 @@ CASES = {
         opt=dict(lr=1e-3, lr_warmup_iters=2, lr_decay_iters=10),
         devices=2,
     ),
+    "vlm_tiny": dict(
+        family="vlm",
+        model=dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+                   vocab_size=128, max_position_embeddings=96),
+        parallel=dict(),
+        train=dict(micro_batch_size=2, global_batch_size=4, seq_length=32,
+                   train_iters=10, log_interval=2, seed=1234),
+        opt=dict(lr=1e-3, lr_warmup_iters=2, lr_decay_iters=10),
+        devices=2,
+    ),
     "gpt_tiny_fbd": dict(
         model=dict(num_layers=2, hidden_size=64, num_attention_heads=4,
                    vocab_size=128, max_position_embeddings=64),
@@ -125,6 +135,43 @@ def _run_enc_family(case, family):
         def batch_at(it):
             return mock_bert_batch(it, train.global_batch_size,
                                    train.seq_length, cfg.vocab_size)
+    elif family == "vlm":
+        import numpy as np
+
+        from megatronapp_tpu.models.multimodal import (
+            init_vlm_params, vlm_loss,
+        )
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.models.vision import VitSpec, vit_config
+        lm_cfg = TransformerConfig(compute_dtype=jnp.float32,
+                                   **case["model"])
+        spec = VitSpec(image_size=32, patch_size=8, num_classes=0)
+        vis_cfg = vit_config(
+            num_layers=2, hidden_size=32, num_attention_heads=4,
+            vocab_size=1, max_position_embeddings=1 + spec.num_patches,
+            compute_dtype=jnp.float32)
+        init = lambda k: init_vlm_params(  # noqa: E731
+            k, lm_cfg, vis_cfg, spec)
+        loss_fn = lambda p, m: vlm_loss(  # noqa: E731
+            p, m["images"], m["tokens"], m["labels"], m["loss_mask"],
+            lm_cfg, vis_cfg, spec, ctx=ctx)
+
+        def batch_at(it):
+            r = np.random.default_rng(train.seed + it)
+            toks = r.integers(0, lm_cfg.vocab_size,
+                              (train.global_batch_size,
+                               train.seq_length)).astype(np.int32)
+            return {
+                "images": r.normal(size=(
+                    train.global_batch_size, spec.image_size,
+                    spec.image_size, spec.num_channels)
+                ).astype(np.float32),
+                "tokens": toks,
+                "labels": np.roll(toks, -1, axis=-1),
+                "loss_mask": np.ones_like(toks, np.float32),
+            }
     else:
         from megatronapp_tpu.models.t5 import (
             init_t5_params, mock_t5_batch, t5_config, t5_loss,
