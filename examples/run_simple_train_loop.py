@@ -17,6 +17,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import jax
 
+# Re-apply the env var through jax.config: images whose sitecustomize
+# programmatically forces a platform (the tunneled-TPU image) override
+# the plain env var after JAX reads it.
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 

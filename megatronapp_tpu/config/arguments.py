@@ -137,6 +137,9 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
                    help="auto = flash above --flash-min-seq, dense below")
     g.add_argument("--flash-min-seq", type=int, default=2048,
                    help="flash/dense crossover sequence length (PERF.md)")
+    g.add_argument("--scan-unroll", type=int, default=1,
+                   help="lax.scan unroll factor for the layer stack "
+                        "(PERF.md lever #3)")
     g.add_argument("--bf16", action="store_true", default=True)
     g.add_argument("--fp32", action="store_true",
                    help="disable bf16 compute")
@@ -383,6 +386,7 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
             remat_policy=args.recompute_granularity,
             attention_impl=args.attention_impl,
             flash_min_seq=args.flash_min_seq,
+            scan_unroll=args.scan_unroll,
             compute_dtype=jnp.float32 if args.fp32 else jnp.bfloat16,
             heterogeneous_layers_config_json=_hetero_json(args),
         )

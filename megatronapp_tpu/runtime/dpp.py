@@ -190,6 +190,12 @@ class DppPipelineRunner:
         sender_stall = [0.0] * pp
         compute_wait = [0.0] * pp
         order_log: List[List[Tuple[int, int]]] = [[] for _ in range(pp)]
+        # Per-(chunk, mb) ship timestamps relative to run start: the
+        # direct observable for head-of-line blocking (a static sender
+        # ships ready work late; see tests/test_dpp_runtime.py).
+        ship_log: List[Dict[Tuple[int, int], float]] = [
+            {} for _ in range(pp)]
+        t_run0 = time.perf_counter()
 
         # Seed stage 0 with chunk-0 inputs.
         for m, h in enumerate(microbatch_inputs):
@@ -225,6 +231,7 @@ class DppPipelineRunner:
                         h = finished[stage].pop((c, m))  # block on it
                     sender_stall[stage] += time.perf_counter() - t0
                     order_log[stage].append((c, m))
+                    ship_log[stage][(c, m)] = time.perf_counter() - t_run0
                     hop = self._next_hop(stage, c)
                     if hop is None:
                         with out_lock:
@@ -259,6 +266,7 @@ class DppPipelineRunner:
             raise RuntimeError(f"pipeline produced {len(outputs)}/{M} "
                                "outputs (thread timeout?)")
         self.transfer_order = order_log
+        self.ship_time_s = ship_log
         self.sender_stall_s = sender_stall
         self.compute_wait_s = compute_wait
         self.pool_stall_s = [p.stall_s for p in pools]

@@ -29,7 +29,9 @@ from megatronapp_tpu.parallel.mesh import MeshContext, build_mesh
 from megatronapp_tpu.training.checkpointing import CheckpointManager
 from megatronapp_tpu.training.optimizer import get_optimizer
 from megatronapp_tpu.training.train_state import setup_train_state
-from megatronapp_tpu.training.train_step import make_train_step
+from megatronapp_tpu.training.train_step import (
+    globalize_batch, make_train_step,
+)
 from megatronapp_tpu.trace.tracer import get_tracer
 from megatronapp_tpu.utils.flops import flops_per_token
 
@@ -340,7 +342,8 @@ def pretrain_gpt(
             # Rampup consumes exactly cur_gbs rows from the stream (each
             # distinct size is its own compiled step shape; leftovers
             # carry over — no samples dropped).
-            batch = reshape_global_batch(rows.take(cur_gbs), cur_micro)
+            batch = globalize_batch(
+                reshape_global_batch(rows.take(cur_gbs), cur_micro), ctx)
             consumed += cur_gbs
             tokens_per_step = cur_gbs * train_cfg.seq_length
             straggler.start()
@@ -445,8 +448,9 @@ def pretrain_gpt(
                 t_eval = time.perf_counter()
                 totals = []
                 for _ in range(train_cfg.eval_iters):
-                    ebatch = reshape_global_batch(next(eval_batch_iter),
-                                                  num_micro)
+                    ebatch = globalize_batch(
+                        reshape_global_batch(next(eval_batch_iter),
+                                             num_micro), ctx)
                     totals.append(eval_step_fn(state, ebatch))
                 eval_loss = float(jax.device_get(
                     jnp.mean(jnp.stack(totals))))

@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from megatronapp_tpu.config.training_config import OptimizerConfig
@@ -38,6 +39,36 @@ def batch_shardings(ctx: MeshContext) -> Any:
         return {"tokens": sh, "labels": sh, "loss_mask": sh,
                 "position_ids": sh}
     return NamedSharding(ctx.mesh, P(None, *spec))
+
+
+def globalize_batch(batch: Any, ctx: MeshContext, shardings=None) -> Any:
+    """Host numpy batches → global jax.Arrays for multi-process runs.
+
+    Single-process jit accepts numpy directly; across hosts each process
+    holds the SAME deterministic global batch (the mock/data streams are
+    seed-identical per rank — reference per-rank loaders yield aligned
+    samples), so every device slices its shard out of the local copy
+    (jax.make_array_from_callback). No-op when one process."""
+    if jax.process_count() == 1:
+        return batch
+    shardings = shardings if shardings is not None else batch_shardings(ctx)
+    is_prefix = not isinstance(shardings, dict)
+
+    def conv(x, sh):
+        x = np.asarray(x)   # one host conversion; shards slice from it
+        return jax.make_array_from_callback(
+            x.shape, sh, lambda idx: x[idx])
+
+    if is_prefix:
+        return jax.tree.map(lambda x: conv(x, shardings), batch)
+    unmatched = set(batch) - set(shardings)
+    if unmatched:
+        # Host numpy mixed with global arrays fails far from the cause;
+        # refuse loudly (extend batch_shardings' cp>1 field set instead).
+        raise ValueError(
+            f"globalize_batch: no sharding for batch fields "
+            f"{sorted(unmatched)} under cp>1")
+    return {k: conv(v, shardings[k]) for k, v in batch.items()}
 
 
 def make_train_step(
