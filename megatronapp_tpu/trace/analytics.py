@@ -83,6 +83,33 @@ def phase_windows(events: List[dict]) -> Dict[str, Dict]:
     return dict(agg)
 
 
+def collective_stats(events: List[dict]) -> Dict[str, Dict]:
+    """Per-kind collective summary from profiler-derived records
+    (trace/profiler_collectives.py): count, total bytes, duration, and
+    mean/max bandwidth — the reference's per-op Gbps reporting
+    (training/trace.py:371-380) aggregated per collective kind."""
+    agg = defaultdict(lambda: {"count": 0, "bytes_total": 0,
+                               "time_us": 0.0, "gbps": []})
+    for e in events:
+        args = e.get("args", {})
+        if e.get("ph") != "X" or "bandwidth_gbps" not in args:
+            continue
+        a = agg[e["name"]]
+        a["count"] += 1
+        a["bytes_total"] += int(args.get("bytes", 0))
+        a["time_us"] += float(e.get("dur", 0.0))
+        if args["bandwidth_gbps"] > 0:
+            a["gbps"].append(args["bandwidth_gbps"])
+    out = {}
+    for kind, a in sorted(agg.items()):
+        gb = a.pop("gbps")
+        out[kind] = {**a,
+                     "gbps_mean": (round(sum(gb) / len(gb), 3)
+                                   if gb else 0.0),
+                     "gbps_max": max(gb) if gb else 0.0}
+    return out
+
+
 def analyze(trace_dir: str) -> Dict:
     """Full report over an aggregated (or raw per-rank) trace dir."""
     from megatronapp_tpu.trace.aggregate import aggregate_dir
@@ -92,6 +119,7 @@ def analyze(trace_dir: str) -> Dict:
         "iteration_time": iteration_time_stats(events),
         "compute_comm": compute_comm_ratio(events),
         "phases": phase_windows(events),
+        "collectives": collective_stats(events),
     }
 
 

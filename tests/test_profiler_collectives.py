@@ -252,3 +252,56 @@ class TestEndToEndTracedRun:
         assert related
         assert detect_stage2(events, related, 1) is True
         assert detect_stage2(events, related, 0) is False
+
+
+class TestCollectiveStats:
+    def test_per_kind_bandwidth_summary(self):
+        from megatronapp_tpu.trace.analytics import collective_stats
+        events = [
+            {"ph": "X", "name": "all-reduce", "dur": 10.0, "pid": 0,
+             "args": {"bytes": 1000, "bandwidth_gbps": 0.8}},
+            {"ph": "X", "name": "all-reduce", "dur": 20.0, "pid": 1,
+             "args": {"bytes": 1000, "bandwidth_gbps": 0.4}},
+            {"ph": "X", "name": "all-gather", "dur": 5.0, "pid": 0,
+             "args": {"bytes": 500, "bandwidth_gbps": 0.0}},
+            {"ph": "X", "name": "forward", "dur": 50.0, "pid": 0,
+             "args": {}},                      # non-collective: ignored
+        ]
+        stats = collective_stats(events)
+        assert set(stats) == {"all-reduce", "all-gather"}
+        ar = stats["all-reduce"]
+        assert ar["count"] == 2 and ar["bytes_total"] == 2000
+        assert ar["time_us"] == 30.0
+        assert ar["gbps_mean"] == pytest.approx(0.6)
+        assert ar["gbps_max"] == 0.8
+        assert stats["all-gather"]["gbps_mean"] == 0.0
+
+    def test_analyze_includes_collectives(self, devices8, tmp_path):
+        """analyze() over a real traced tp=2 run reports per-kind
+        collective bandwidth (reference profiling stats parity)."""
+        from megatronapp_tpu.config.parallel_config import ParallelConfig
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.trace.analytics import analyze
+        from megatronapp_tpu.training.train import pretrain_gpt
+
+        model = TransformerConfig(num_layers=2, hidden_size=64,
+                                  num_attention_heads=4, vocab_size=128,
+                                  max_position_embeddings=32)
+        par = ParallelConfig(tensor_parallel=2, data_parallel=2)
+        ctx = build_mesh(par, devices=devices8[:4])
+        trace_dir = str(tmp_path / "trace")
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=4,
+                               seq_length=32, train_iters=2,
+                               log_interval=1, trace=True,
+                               trace_dir=trace_dir, trace_interval=2,
+                               continuous_trace_iterations=1)
+        pretrain_gpt(model, par, train, OptimizerConfig(lr=1e-3), ctx=ctx)
+        report = analyze(trace_dir)
+        assert "all-reduce" in report["collectives"]
+        assert report["collectives"]["all-reduce"]["bytes_total"] > 0
