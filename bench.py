@@ -25,7 +25,7 @@ import sys
 import time
 
 PROBE_TIMEOUT_S = 90
-BENCH_TIMEOUT_S = 420
+BENCH_TIMEOUT_S = 600   # two attention impls = two compiles + windows
 ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", "4"))
 BACKOFF_S = (20, 60, 180)
 
@@ -57,13 +57,14 @@ def _load_last_good():
         return None
 
 
-def _run_child(mode: str, timeout_s: int):
+def _run_child(mode: str, timeout_s: int, extra_env=None):
     """Run this file in a subprocess; return parsed JSON from its last
     stdout line, or an error dict."""
+    env = dict(os.environ, **(extra_env or {}))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), mode],
-            capture_output=True, text=True, timeout=timeout_s)
+            capture_output=True, text=True, timeout=timeout_s, env=env)
     except subprocess.TimeoutExpired:
         return {"ok": False, "error": f"{mode} timed out after {timeout_s}s "
                                       "(tunnel hang)"}
@@ -87,14 +88,27 @@ def parent_main():
         if not probe.get("ok"):
             history.append(f"attempt {attempt+1} probe: {probe.get('error')}")
             continue
-        res = _run_child("--bench", BENCH_TIMEOUT_S)
-        # Presence check, not truthiness: a measured value of 0.0 is a
-        # (pathological but) completed run, not a failed attempt.
-        if res.get("metric") and res.get("value") is not None:
+        # Each attention impl runs as its OWN watchdogged child: a hang
+        # in one cannot destroy the other's measurement (the tunnel
+        # hangs rather than raising), and each gets the full budget.
+        by_impl = {}
+        for impl in ("auto", "pallas"):
+            r = _run_child("--bench", BENCH_TIMEOUT_S,
+                           extra_env={"BENCH_ATTENTION_IMPL": impl})
+            if r.get("metric") and r.get("value") is not None:
+                by_impl[impl] = r
+            else:
+                history.append(
+                    f"attempt {attempt+1} bench[{impl}]: {r.get('error')}")
+        if by_impl:
+            best = max(by_impl, key=lambda k: by_impl[k]["value"])
+            res = by_impl[best]
             res.setdefault("extra", {})["probe_s"] = probe.get("elapsed")
+            res["extra"]["attention_impl"] = best
+            res["extra"]["tok_s_by_impl"] = {
+                k: v["value"] for k, v in by_impl.items()}
             print(json.dumps(_save_last_good(res)))
             return
-        history.append(f"attempt {attempt+1} bench: {res.get('error')}")
     # All attempts failed (tunnel hang or crash): report the persisted
     # last-good measurement, flagged stale, instead of 0.0.  `history`
     # carries the per-attempt errors for diagnosis.
@@ -136,9 +150,9 @@ def probe_main():
                       "elapsed": round(time.time() - t0, 1)}))
 
 
-def bench_main():
+def _measure_impl(attention_impl: str):
+    """tokens/s for one attention implementation (differential timing)."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from megatronapp_tpu.config.parallel_config import ParallelConfig
@@ -149,13 +163,12 @@ def bench_main():
     from megatronapp_tpu.training.optimizer import get_optimizer
     from megatronapp_tpu.training.train_state import setup_train_state
     from megatronapp_tpu.training.train_step import make_train_step
-    from megatronapp_tpu.utils.flops import TPU_PEAK_FLOPS, flops_per_token
 
     # GPT-2 125M (reference run_single_gpt.sh class model).
     cfg = TransformerConfig(
         num_layers=12, hidden_size=768, num_attention_heads=12,
         vocab_size=50304, max_position_embeddings=1024,
-        remat_policy="selective",
+        remat_policy="selective", attention_impl=attention_impl,
     )
     seq, micro_bs, n_micro = 1024, 4, 1
     par = ParallelConfig()
@@ -203,7 +216,21 @@ def bench_main():
         dt = times[25] - times[5]
 
     tokens_per_step = micro_bs * n_micro * seq
-    tok_per_sec = tokens_per_step * n_steps / dt
+    return cfg, seq, tokens_per_step * n_steps / dt, dt / n_steps
+
+
+def bench_main():
+    """One attention impl per invocation (BENCH_ATTENTION_IMPL env; the
+    parent runs one watchdogged child per impl and picks the faster —
+    the flash/dense crossover at this shape was set from one noisy
+    round-2 sample, so the bench self-selects)."""
+    import jax
+
+    from megatronapp_tpu.utils.flops import TPU_PEAK_FLOPS, flops_per_token
+
+    impl = os.environ.get("BENCH_ATTENTION_IMPL", "auto")
+    cfg, seq, tok_per_sec, step_s = _measure_impl(impl)
+
     platform = jax.devices()[0].platform
     kind = getattr(jax.devices()[0], "device_kind", platform).lower()
     peak = next((v for k, v in TPU_PEAK_FLOPS.items() if k in kind),
@@ -216,7 +243,8 @@ def bench_main():
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": {"mfu": round(mfu, 4), "device": kind,
-                  "step_ms": round(dt / n_steps * 1e3, 2)},
+                  "step_ms": round(step_s * 1e3, 2),
+                  "attention_impl": impl},
     }))
 
 
