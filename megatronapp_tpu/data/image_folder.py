@@ -1,0 +1,223 @@
+"""Image-folder dataset + vision transforms for the vision entries.
+
+Parity with /root/reference/megatron/legacy/data/image_folder.py
+(class-per-subdirectory layout, classes_fraction /
+data_per_class_fraction subsampling :67-109) and
+legacy/data/vit_dataset.py (ClassificationTransform :50 — train
+RandomResizedCrop+flip / eval resize+center-crop, ImageNet
+normalization; DinoTransform :148 — global/local multi-crop). TPU-first:
+transforms are numpy (PIL for decode/resize only, no torchvision), and
+batches arrive as [B, H, W, C] float32 host arrays ready for the
+sharded train step.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".webp", ".npy")
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def _load_image(path: str) -> np.ndarray:
+    """Decode to float32 [H, W, 3] in [0, 1]."""
+    if path.endswith(".npy"):
+        arr = np.asarray(np.load(path), np.float32)
+        if arr.ndim == 2:
+            arr = np.repeat(arr[..., None], 3, -1)
+        if arr.max() > 1.5:   # stored in the 0-255 convention
+            arr = arr / 255.0
+        return np.clip(arr, 0.0, 1.0)
+    from PIL import Image
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"), np.float32) / 255.0
+
+
+def _resize(img: np.ndarray, size_hw) -> np.ndarray:
+    """Resize to (h, w) — or to (size, size) for an int size."""
+    from PIL import Image
+    h, w = (size_hw, size_hw) if isinstance(size_hw, int) else size_hw
+    im = Image.fromarray((np.clip(img, 0, 1) * 255).astype(np.uint8))
+    return np.asarray(im.resize((w, h), Image.BILINEAR),
+                      np.float32) / 255.0
+
+
+class ImageFolder:
+    """Class-per-subdirectory image dataset (reference ImageFolder).
+
+    root/
+      class_a/ img0.png ...
+      class_b/ ...
+    """
+
+    def __init__(self, root: str, classes_fraction: float = 1.0,
+                 data_per_class_fraction: float = 1.0):
+        self.root = root
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        classes = classes[: max(int(len(classes) * classes_fraction), 1)]
+        self.classes: List[str] = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: List[Tuple[str, int]] = []
+        for c in classes:
+            files = sorted(
+                f for f in os.listdir(os.path.join(root, c))
+                if f.lower().endswith(_EXTS))
+            keep = max(int(len(files) * data_per_class_fraction), 1)
+            self.samples.extend(
+                (os.path.join(root, c, f), self.class_to_idx[c])
+                for f in files[:keep])
+        if not self.samples:
+            raise FileNotFoundError(f"no images under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i) -> Tuple[np.ndarray, int]:
+        path, label = self.samples[i]
+        return _load_image(path), label
+
+
+# ---------------------------------------------------------------------------
+# Transforms (numpy; reference vit_dataset.py)
+
+
+def _random_resized_crop(img: np.ndarray, size: int, rng,
+                         scale=(0.08, 1.0)) -> np.ndarray:
+    h, w = img.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target = rng.uniform(*scale) * area
+        ar = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+        ch = int(round(np.sqrt(target / ar)))
+        cw = int(round(np.sqrt(target * ar)))
+        if ch <= h and cw <= w:
+            y = rng.integers(0, h - ch + 1)
+            x = rng.integers(0, w - cw + 1)
+            return _resize(img[y:y + ch, x:x + cw], size)
+    return _center_crop(img, size)
+
+
+def _center_crop(img: np.ndarray, size: int) -> np.ndarray:
+    """Aspect-preserving short-side resize (reference Resize(size*1.143
+    ≈ 256/224)) then center crop — no squash-to-square."""
+    h, w = img.shape[:2]
+    scale_size = max(int(size * 1.143), size)
+    if min(h, w) != scale_size:
+        if h < w:
+            new_h, new_w = scale_size, int(round(w * scale_size / h))
+        else:
+            new_h, new_w = int(round(h * scale_size / w)), scale_size
+        img = _resize(img, (new_h, new_w))
+        h, w = new_h, new_w
+    y, x = (h - size) // 2, (w - size) // 2
+    return img[y:y + size, x:x + size]
+
+
+def _normalize(img: np.ndarray) -> np.ndarray:
+    return (img - IMAGENET_MEAN) / IMAGENET_STD
+
+
+class ClassificationTransform:
+    """train: RandomResizedCrop + horizontal flip; eval: resize +
+    center-crop; both ImageNet-normalized (vit_dataset.py:50-71)."""
+
+    def __init__(self, image_size: int, train: bool = True,
+                 seed: int = 0):
+        self.image_size = image_size
+        self.train = train
+        self.rng = np.random.default_rng(seed)
+
+    def __call__(self, img: np.ndarray) -> np.ndarray:
+        if self.train:
+            img = _random_resized_crop(img, self.image_size, self.rng)
+            if self.rng.random() < 0.5:
+                img = img[:, ::-1]
+        else:
+            img = _center_crop(img, self.image_size)
+        return _normalize(np.ascontiguousarray(img)).astype(np.float32)
+
+
+class DinoTransform:
+    """2 global crops (scale 0.4-1) + N local crops (scale 0.05-0.4,
+    smaller size), flips, ImageNet normalization (vit_dataset.py:148-205;
+    color jitter/blur omitted — augmentation-strength knobs, not wire
+    contract)."""
+
+    def __init__(self, image_size: int, local_size: int,
+                 n_local: int, seed: int = 0):
+        self.image_size = image_size
+        self.local_size = local_size
+        self.n_local = n_local
+        self.rng = np.random.default_rng(seed)
+
+    def _crop(self, img, size, scale):
+        out = _random_resized_crop(img, size, self.rng, scale=scale)
+        if self.rng.random() < 0.5:
+            out = out[:, ::-1]
+        return _normalize(np.ascontiguousarray(out)).astype(np.float32)
+
+    def __call__(self, img: np.ndarray
+                 ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """→ (global [2, S, S, 3], local [n, s, s, 3] or None)."""
+        g = np.stack([self._crop(img, self.image_size, (0.4, 1.0))
+                      for _ in range(2)])
+        if self.n_local == 0:
+            return g, None
+        loc = np.stack([self._crop(img, self.local_size, (0.05, 0.4))
+                        for _ in range(self.n_local)])
+        return g, loc
+
+
+# ---------------------------------------------------------------------------
+# Batch iterators
+
+
+def _epoch_batches(dataset: ImageFolder, batch_size: int, seed: int
+                   ) -> Iterator[np.ndarray]:
+    """Endless shuffled epochs of index batches (shared epoch loop)."""
+    if batch_size > len(dataset):
+        raise ValueError(
+            f"batch_size={batch_size} exceeds dataset size "
+            f"{len(dataset)} ({dataset.root}); the epoch loop would "
+            "spin forever yielding nothing")
+    rng = np.random.default_rng(seed)
+    while True:
+        order = rng.permutation(len(dataset))
+        for i in range(0, len(order) - batch_size + 1, batch_size):
+            yield order[i:i + batch_size]
+
+
+def image_batches(dataset: ImageFolder, batch_size: int,
+                  transform: ClassificationTransform,
+                  seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    """Shuffled epochs of {'images' [B,S,S,3], 'labels' [B]}."""
+    for idx in _epoch_batches(dataset, batch_size, seed):
+        imgs, labels = zip(*(dataset[j] for j in idx))
+        yield {"images": np.stack([transform(im) for im in imgs]),
+               "labels": np.asarray(labels, np.int32)}
+
+
+def dino_batches(dataset: ImageFolder, batch_size: int,
+                 transform: DinoTransform,
+                 seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    """Shuffled epochs of multi-crop batches
+    {'global_crops' [B,2,S,S,3], 'local_crops' [B,n,s,s,3]}."""
+    for idx in _epoch_batches(dataset, batch_size, seed):
+        crops = [transform(dataset[j][0]) for j in idx]
+        batch = {"global_crops": np.stack([c[0] for c in crops])}
+        if crops[0][1] is not None:
+            batch["local_crops"] = np.stack([c[1] for c in crops])
+        yield batch
+
+
+def load_folder(data_path: str, log_fn=print) -> ImageFolder:
+    """Open + announce an image corpus (shared entry-point wiring)."""
+    ds = ImageFolder(data_path)
+    log_fn(f"image corpus: {len(ds)} images / {len(ds.classes)} "
+           f"classes from {data_path}")
+    return ds

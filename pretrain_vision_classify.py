@@ -54,20 +54,42 @@ def main(argv=None):
                               training.train_iters)
     num_micro = training.num_microbatches(ctx.dp * ctx.ep)
 
+    batch_iter = None
+    if args.data_path:
+        from megatronapp_tpu.data.image_folder import (
+            ClassificationTransform, image_batches, load_folder,
+        )
+        ds = load_folder(args.data_path)
+        if len(ds.classes) > spec.num_classes:
+            # Out-of-range labels would be silently clamped by the CE
+            # gather under jit — fail loudly instead.
+            raise SystemExit(
+                f"--num-classes {spec.num_classes} < {len(ds.classes)} "
+                f"class directories in {args.data_path}")
+        batch_iter = image_batches(
+            ds, training.global_batch_size,
+            ClassificationTransform(spec.image_size, train=True,
+                                    seed=training.seed),
+            seed=training.seed)
+
     rng = np.random.default_rng(training.seed)
     losses = []
     t0 = time.perf_counter()
     with ctx.mesh:
         for it in range(training.train_iters):
-            batch = reshape_global_batch({
-                "images": rng.normal(size=(
-                    training.global_batch_size, spec.image_size,
-                    spec.image_size, spec.num_channels)
-                ).astype(np.float32),
-                "labels": rng.integers(
-                    0, spec.num_classes,
-                    training.global_batch_size).astype(np.int32),
-            }, num_micro)
+            if batch_iter is not None:
+                batch = next(batch_iter)
+            else:
+                batch = {
+                    "images": rng.normal(size=(
+                        training.global_batch_size, spec.image_size,
+                        spec.image_size, spec.num_channels)
+                    ).astype(np.float32),
+                    "labels": rng.integers(
+                        0, spec.num_classes,
+                        training.global_batch_size).astype(np.int32),
+                }
+            batch = reshape_global_batch(batch, num_micro)
             state, metrics = step_fn(state, batch)
             if (it + 1) % training.log_interval == 0 or \
                     it + 1 == training.train_iters:

@@ -89,13 +89,25 @@ def main(argv=None):
     step_fn = make_dino_train_step(cfg, spec, dspec, optimizer, opt_cfg,
                                    ctx, shardings, training.train_iters)
 
+    batch_iter = None
+    if args.data_path:
+        from megatronapp_tpu.data.image_folder import (
+            DinoTransform, dino_batches, load_folder,
+        )
+        batch_iter = dino_batches(
+            load_folder(args.data_path), training.global_batch_size,
+            DinoTransform(spec.image_size, dspec.local_crop_size,
+                          dspec.n_local_crops, seed=training.seed),
+            seed=training.seed)
+
     rng = np.random.default_rng(training.seed)
     losses = []
     t0 = time.perf_counter()
     with ctx.mesh:
         for it in range(training.train_iters):
-            batch = synthetic_crops(rng, training.global_batch_size, spec,
-                                    dspec)
+            batch = (next(batch_iter) if batch_iter is not None else
+                     synthetic_crops(rng, training.global_batch_size,
+                                     spec, dspec))
             state, metrics = step_fn(state, batch)
             if (it + 1) % training.log_interval == 0 or \
                     it + 1 == training.train_iters:

@@ -52,6 +52,17 @@ def main(argv=None):
                               training.train_iters)
     num_micro = training.num_microbatches(ctx.dp * ctx.ep)
 
+    batch_iter = None
+    if args.data_path:
+        from megatronapp_tpu.data.image_folder import (
+            ClassificationTransform, image_batches, load_folder,
+        )
+        batch_iter = image_batches(
+            load_folder(args.data_path), training.global_batch_size,
+            ClassificationTransform(spec.image_size, train=True,
+                                    seed=training.seed),
+            seed=training.seed)
+
     rng = np.random.default_rng(training.seed)
     g = spec.image_size // spec.patch_size
     losses = []
@@ -62,11 +73,15 @@ def main(argv=None):
                     args.mask_factor).astype(np.float32)
             masks = np.repeat(np.repeat(bits, spec.patch_size, axis=1),
                               spec.patch_size, axis=2)[..., None]
-            batch = reshape_global_batch({
-                "images": rng.normal(size=(
+            if batch_iter is not None:
+                images = next(batch_iter)["images"]
+            else:
+                images = rng.normal(size=(
                     training.global_batch_size, spec.image_size,
                     spec.image_size, spec.num_channels)
-                ).astype(np.float32),
+                ).astype(np.float32)
+            batch = reshape_global_batch({
+                "images": images,
                 "masks": masks,
             }, num_micro)
             state, metrics = step_fn(state, batch)

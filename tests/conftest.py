@@ -15,6 +15,13 @@ if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Pin the ENV VAR too, not just jax.config: the image exports
+# JAX_PLATFORMS=axon, and entry points honor the env by design
+# (config/arguments.py parse_args re-applies it) — without this, the
+# first entry-smoke test in a fresh process would re-select the
+# tunneled TPU and hang the suite on a dead tunnel.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
 import jax
 
 # The axon TPU plugin (sitecustomize) force-sets jax_platforms='axon,cpu';

@@ -206,3 +206,126 @@ def test_blended_exhaustive_mode(tmp_path):
         BlendedDataset([a, b], None, num_samples=3)
     with _p.raises(ValueError):
         BlendedDataset([a, b], [0.5, 0.5])  # weights need num_samples
+
+
+class TestImageFolder:
+    """Image-folder dataset + vision transforms (reference
+    legacy/data/image_folder.py + vit_dataset.py)."""
+
+    @pytest.fixture(scope="class")
+    def image_root(self, tmp_path_factory):
+        from PIL import Image
+        root = tmp_path_factory.mktemp("imgs")
+        rng = np.random.default_rng(0)
+        for cls in ("cats", "dogs"):
+            d = root / cls
+            d.mkdir()
+            for i in range(6):
+                arr = (rng.random((48, 40, 3)) * 255).astype(np.uint8)
+                Image.fromarray(arr).save(d / f"{i}.png")
+        return str(root)
+
+    def test_listing_and_loading(self, image_root):
+        from megatronapp_tpu.data.image_folder import ImageFolder
+        ds = ImageFolder(image_root)
+        assert ds.classes == ["cats", "dogs"]
+        assert len(ds) == 12
+        img, label = ds[0]
+        assert img.shape == (48, 40, 3) and img.dtype == np.float32
+        assert 0.0 <= img.min() and img.max() <= 1.0
+        assert label == 0
+
+    def test_subsampling_fractions(self, image_root):
+        from megatronapp_tpu.data.image_folder import ImageFolder
+        ds = ImageFolder(image_root, classes_fraction=0.5,
+                         data_per_class_fraction=0.5)
+        assert ds.classes == ["cats"]
+        assert len(ds) == 3
+
+    def test_classification_transform(self, image_root):
+        from megatronapp_tpu.data.image_folder import (
+            ClassificationTransform, ImageFolder,
+        )
+        ds = ImageFolder(image_root)
+        img, _ = ds[0]
+        train_t = ClassificationTransform(32, train=True, seed=0)
+        eval_t = ClassificationTransform(32, train=False)
+        a = train_t(img)
+        b = eval_t(img)
+        assert a.shape == b.shape == (32, 32, 3)
+        # Normalized (ImageNet stats): values leave [0, 1].
+        assert a.min() < 0
+        # Eval transform is deterministic; train augments.
+        np.testing.assert_array_equal(eval_t(img), b)
+        assert not np.array_equal(train_t(img), a)
+
+    def test_dino_transform_shapes(self, image_root):
+        from megatronapp_tpu.data.image_folder import (
+            DinoTransform, ImageFolder,
+        )
+        ds = ImageFolder(image_root)
+        img, _ = ds[0]
+        g, loc = DinoTransform(32, 16, n_local=3, seed=0)(img)
+        assert g.shape == (2, 32, 32, 3)
+        assert loc.shape == (3, 16, 16, 3)
+        g2, loc2 = DinoTransform(32, 16, n_local=0, seed=0)(img)
+        assert g2.shape == (2, 32, 32, 3) and loc2 is None
+
+    def test_batch_iterators(self, image_root):
+        from megatronapp_tpu.data.image_folder import (
+            ClassificationTransform, DinoTransform, ImageFolder,
+            dino_batches, image_batches,
+        )
+        ds = ImageFolder(image_root)
+        it = image_batches(ds, 4, ClassificationTransform(32, seed=1),
+                           seed=1)
+        b = next(it)
+        assert b["images"].shape == (4, 32, 32, 3)
+        assert b["labels"].shape == (4,)
+        dit = dino_batches(ds, 4, DinoTransform(32, 16, 2, seed=1),
+                           seed=1)
+        db = next(dit)
+        assert db["global_crops"].shape == (4, 2, 32, 32, 3)
+        assert db["local_crops"].shape == (4, 2, 16, 16, 3)
+
+    def test_batch_size_guard_and_npy_rescale(self, image_root,
+                                               tmp_path):
+        from megatronapp_tpu.data.image_folder import (
+            ClassificationTransform, ImageFolder, _load_image,
+            image_batches,
+        )
+        ds = ImageFolder(image_root)
+        with pytest.raises(ValueError, match="exceeds dataset size"):
+            next(image_batches(ds, len(ds) + 1,
+                               ClassificationTransform(32)))
+        # .npy stored 0-255 rescales instead of clipping to white.
+        arr = (np.random.default_rng(0).random((8, 8)) * 255)
+        np.save(tmp_path / "x.npy", arr.astype(np.float32))
+        img = _load_image(str(tmp_path / "x.npy"))
+        assert img.max() <= 1.0 and 0.2 < img.mean() < 0.8
+
+    def test_center_crop_preserves_aspect(self):
+        from megatronapp_tpu.data.image_folder import _center_crop
+        # Vertical gradient in a tall image: squash-to-square would
+        # compress the gradient; aspect-preserving crop keeps the
+        # central band's local slope.
+        img = np.tile(np.linspace(0, 1, 96, dtype=np.float32)[:, None,
+                                                              None],
+                      (1, 32, 3))
+        out = _center_crop(img, 32)
+        assert out.shape == (32, 32, 3)
+        # The 32-px crop covers the middle ~32/109 of the gradient —
+        # range well below the full 0..1 span (a squashed resize would
+        # cover ~the whole span).
+        assert (out[..., 0].max() - out[..., 0].min()) < 0.5
+
+    def test_vision_entry_trains_on_folder(self, image_root):
+        """pretrain_vision_classify consumes a real image folder."""
+        import pretrain_vision_classify
+        pretrain_vision_classify.main(
+            ["--num-layers", "2", "--hidden-size", "32",
+             "--num-attention-heads", "4", "--train-iters", "2",
+             "--global-batch-size", "8", "--micro-batch-size", "1",
+             "--log-interval", "1", "--lr", "1e-3",
+             "--img-size", "32", "--patch-dim", "8",
+             "--num-classes", "2", "--data-path", image_root])
