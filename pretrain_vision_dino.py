@@ -15,7 +15,8 @@ import numpy as np
 
 from megatronapp_tpu.config.arguments import build_parser, configs_from_args, parse_args
 from megatronapp_tpu.models.dino import (
-    DinoSpec, make_dino_train_step, setup_dino_train_state,
+    DinoSpec, compute_features, knn_predict, make_dino_train_step,
+    setup_dino_train_state,
 )
 from megatronapp_tpu.models.vision import VitSpec, vit_config
 from megatronapp_tpu.parallel.mesh import build_mesh
@@ -37,6 +38,43 @@ def synthetic_crops(rng, batch, spec: VitSpec, dspec: DinoSpec):
             size=(batch, dspec.n_local_crops) + base.shape[2:]
         ).astype(np.float32)
         out["local_crops"] = loc[:, :, :s, :s, :]
+    return out
+
+
+def knn_eval(teacher, dataset, cfg, spec, seed=0, bank_size=256,
+             eval_size=64, ks=(10, 20)):
+    """Weighted-KNN probe on teacher features (reference knn_monitor
+    feature bank + knn_predict; pretrain_vision_dino.py loss_func eval
+    branch reports knn_acc@k)."""
+    import jax.numpy as jnp
+
+    from megatronapp_tpu.data.image_folder import ClassificationTransform
+    t = ClassificationTransform(spec.image_size, train=False)
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(len(dataset))
+    # Keep a held-out eval slice even on tiny corpora.
+    bank_size = min(bank_size, max(len(dataset) * 3 // 4, 1))
+    bank_idx = idx[:bank_size]
+    eval_idx = idx[bank_size:bank_size + eval_size]
+    if len(eval_idx) == 0:
+        return {}
+
+    def feats(ids):
+        imgs = np.stack([t(dataset[j][0]) for j in ids])
+        labels = np.asarray([dataset[j][1] for j in ids], np.int32)
+        return compute_features(teacher, jnp.asarray(imgs), cfg, spec), \
+            labels
+
+    bank, bank_labels = feats(bank_idx)
+    q, q_labels = feats(eval_idx)
+    out = {}
+    n_classes = len(dataset.classes)
+    for k in ks:
+        pred = knn_predict(q, bank.T, jnp.asarray(bank_labels),
+                           classes=n_classes,
+                           knn_k=min(k, len(bank_idx)), knn_t=0.07)
+        out[f"knn_acc_{k}"] = float(
+            (np.asarray(pred[:, 0]) == q_labels).mean())
     return out
 
 
@@ -90,12 +128,14 @@ def main(argv=None):
                                    ctx, shardings, training.train_iters)
 
     batch_iter = None
+    dataset = None
     if args.data_path:
         from megatronapp_tpu.data.image_folder import (
             DinoTransform, dino_batches, load_folder,
         )
+        dataset = load_folder(args.data_path)
         batch_iter = dino_batches(
-            load_folder(args.data_path), training.global_batch_size,
+            dataset, training.global_batch_size,
             DinoTransform(spec.image_size, dspec.local_crop_size,
                           dspec.n_local_crops, seed=training.seed),
             seed=training.seed)
@@ -116,6 +156,14 @@ def main(argv=None):
                 print(f"iter {it+1:6d}/{training.train_iters} | "
                       f"dino loss {float(metrics['loss']):.4f} | "
                       f"ema m {float(metrics['teacher_momentum']):.4f}")
+            if (dataset is not None and training.eval_interval and
+                    (it + 1) % training.eval_interval == 0):
+                accs = knn_eval(state["teacher"], dataset, cfg, spec,
+                                seed=training.seed)
+                if accs:
+                    print(f"knn @ iter {it+1}: " + "  ".join(
+                        f"acc@{k.split('_')[-1]}={v:.3f}"
+                        for k, v in sorted(accs.items())))
     dt = time.perf_counter() - t0
     print(f"done: final loss {losses[-1]:.4f}, "
           f"{training.train_iters * training.global_batch_size / dt:.1f} "

@@ -287,6 +287,33 @@ class TestEntryScripts:
                            "--dino-local-img-size", "16"])
         assert losses and np.isfinite(losses[-1])
 
+    def test_pretrain_vision_dino_knn_eval(self, tmp_path, capsys):
+        """--data-path + --eval-interval drives the weighted-KNN teacher
+        probe (reference knn_monitor eval branch)."""
+        import pretrain_vision_dino
+        from PIL import Image
+        rng = np.random.default_rng(0)
+        for ci, cls in enumerate(("a", "b")):
+            d = tmp_path / cls
+            d.mkdir()
+            base = rng.random((48, 48, 3)) * 0.3 + ci * 0.5
+            for i in range(10):
+                arr = np.clip(base + rng.random((48, 48, 3)) * 0.1, 0, 1)
+                Image.fromarray((arr * 255).astype(np.uint8)).save(
+                    d / f"{i}.png")
+        losses = pretrain_vision_dino.main(
+            self.COMMON + ["--img-size", "32", "--patch-dim", "8",
+                           "--dino-out-dim", "16",
+                           "--dino-head-hidden-size", "16",
+                           "--dino-bottleneck-size", "8",
+                           "--dino-local-crops-number", "1",
+                           "--dino-local-img-size", "16",
+                           "--data-path", str(tmp_path),
+                           "--eval-interval", "2"])
+        assert losses and np.isfinite(losses[-1])
+        out = capsys.readouterr().out
+        assert "knn @ iter 2" in out and "acc@10=" in out
+
     def test_pretrain_vision_inpaint(self):
         import pretrain_vision_inpaint
         losses = pretrain_vision_inpaint.main(
