@@ -97,10 +97,20 @@ class TextGenerationServer:
         # TEXT frames that arrive mid-generation are buffered in
         # `pending` and served in order once the current one finishes
         # (sequential pipelining, matching the old async-for semantics).
+        # Bounded: each buffered request later holds _gen_lock serially,
+        # so an unbounded queue lets one client grow memory and head-of-
+        # line latency without limit. Past the cap the socket is closed
+        # with a policy-violation code (client should await replies).
+        MAX_PENDING = 32
         import collections
         pending: collections.deque = collections.deque()
         recv_task = asyncio.ensure_future(ws.receive())
         while True:
+            if len(pending) > MAX_PENDING:
+                await ws.close(
+                    code=1008,
+                    message=b"too many pipelined requests; await replies")
+                break
             if pending:
                 msg = pending.popleft()
             else:
@@ -216,14 +226,16 @@ class TextGenerationServer:
                         return_when=asyncio.FIRST_COMPLETED)
                     if recv_task in done:
                         m = recv_task.result()
-                        if m.type == 1:
+                        if m.type == 1 and len(pending) < MAX_PENDING:
                             # Pipelined request: buffer it, keep
                             # streaming the current generation.
                             pending.append(m)
                             recv_task = asyncio.ensure_future(
                                 ws.receive())
                             continue
-                        break           # disconnect → abort
+                        if m.type == 1:
+                            pending.append(m)  # outer loop closes 1008
+                        break           # disconnect/flood → abort
                     payload = get_task.result()
                     if payload is _DONE:
                         completed = True

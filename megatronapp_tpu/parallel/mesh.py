@@ -94,6 +94,9 @@ class MeshContext:
             yield self
 
 
+_distributed_initialized = False
+
+
 def initialize_multi_host(coordinator_address: Optional[str] = None,
                           num_processes: Optional[int] = None,
                           process_id: Optional[int] = None) -> None:
@@ -115,14 +118,20 @@ def initialize_multi_host(coordinator_address: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
+    global _distributed_initialized
+    if _distributed_initialized:
+        return
     try:
         jax.distributed.initialize(**kwargs)
+        _distributed_initialized = True
     except RuntimeError as e:
         # jax.distributed exposes no public already-initialized query
-        # (global_state lives under jax._src); the stable contract is the
-        # error string raised on re-entry.
+        # (global_state lives under jax._src); the flag above handles
+        # re-entry within this process, and the error-string match below
+        # is only a fallback for initializes done outside this helper.
         if "only be called once" not in str(e):
             raise
+        _distributed_initialized = True
 
 
 def _dcn_slice_axis(shape: Sequence[int], n_slices: int) -> int:

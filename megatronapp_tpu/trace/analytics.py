@@ -90,19 +90,47 @@ def collective_stats(events: List[dict]) -> Dict[str, Dict]:
     (training/trace.py:371-380) aggregated per collective kind."""
     agg = defaultdict(lambda: {"count": 0, "bytes_total": 0,
                                "time_us": 0.0, "gbps": []})
+    # Convention: totals are per LOGICAL collective (the reference's
+    # per-op accounting), not per participant. Each device in a group
+    # contributes its own copy of the same event, so copies are deduped
+    # by (hlo_op, iteration) occurrence — robust to both aggregated
+    # traces (all copies present) and raw per-rank traces (only local
+    # devices' copies present, where a 1/len(group) weighting would
+    # undercount). bytes count once per occurrence; time_us takes the
+    # slowest participant (the collective's critical path); per-copy
+    # bandwidths all feed the mean/max.
+    seen: Dict[tuple, str] = {}
     for e in events:
         args = e.get("args", {})
         if e.get("ph") != "X" or "bandwidth_gbps" not in args:
             continue
         a = agg[e["name"]]
-        a["count"] += 1
-        a["bytes_total"] += int(args.get("bytes", 0))
-        a["time_us"] += float(e.get("dur", 0.0))
+        # Occurrence identity needs hlo_op (+iteration); events without
+        # it (hand-built or foreign traces) can't be deduped and each
+        # counts as its own occurrence.
+        occ = ((e["name"], args["hlo_op"], args.get("iteration"))
+               if args.get("hlo_op") else (id(e),))
+        dur = float(e.get("dur", 0.0))
+        if occ not in seen:
+            seen[occ] = e["name"]
+            a["count"] += 1
+            a["bytes_total"] += int(args.get("bytes", 0))
+            a["time_us"] += dur
+            a.setdefault("max_dur", {})[occ] = dur
+        else:
+            prev = a.setdefault("max_dur", {}).get(occ, 0.0)
+            if dur > prev:
+                a["time_us"] += dur - prev
+                a["max_dur"][occ] = dur
         if args["bandwidth_gbps"] > 0:
             a["gbps"].append(args["bandwidth_gbps"])
     out = {}
     for kind, a in sorted(agg.items()):
         gb = a.pop("gbps")
+        a.pop("max_dur", None)
+        a["count"] = int(a["count"])
+        a["bytes_total"] = int(a["bytes_total"])
+        a["time_us"] = round(a["time_us"], 3)
         out[kind] = {**a,
                      "gbps_mean": (round(sum(gb) / len(gb), 3)
                                    if gb else 0.0),

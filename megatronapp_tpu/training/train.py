@@ -256,7 +256,14 @@ def pretrain_gpt(
         from megatronapp_tpu.trace.profiler_collectives import (
             collective_events, extract_hlo_collectives, profile_run,
         )
-        key = id(active_fn)
+        # Keyed on batch leaf shapes as well as the fn: under batch-size
+        # rampup a later window recompiles the step, and joining profiler
+        # events against the first shape's HLO table would silently
+        # misattribute bytes/bandwidth per collective.
+        shape_key = tuple(
+            (getattr(l, "shape", ()), str(getattr(l, "dtype", "")))
+            for l in jax.tree_util.tree_leaves(batch))
+        key = (id(active_fn), shape_key)
         if key not in _coll["hlo"]:
             try:
                 compiled = active_fn.lower(state, batch).compile()

@@ -180,7 +180,12 @@ def attention_forward(
             # regardless of S.
             dense_bytes = 2 * 4 * b * nq * s * s
             if ctx is not None and ctx.num_devices > 1:
-                dense_bytes //= ctx.num_devices
+                # The [B,H,S,S] score tensor shards only over dp/ep/tp
+                # (batch and heads) — pp/cp devices each hold a full
+                # copy, so dividing by the whole mesh would undercount
+                # per-device memory by up to pp*cp x and OOM a config
+                # just below flash_min_seq.
+                dense_bytes //= max(1, ctx.dp * ctx.ep * ctx.tp)
             impl = ("pallas" if jax.default_backend() == "tpu"
                     and (s >= cfg.flash_min_seq or dense_bytes > 1 << 30)
                     else "reference")

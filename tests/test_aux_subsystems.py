@@ -452,9 +452,11 @@ class TestDCNMeshLayout:
 
 class TestMultiHostInitIdempotent:
     def test_second_call_is_noop(self, monkeypatch):
-        """jax.distributed raises on re-entry ('should only be called
-        once'); initialize_multi_host must swallow exactly that (repeated
-        parse_args in tests/notebooks) and re-raise anything else."""
+        """After one successful initialize, re-entry is a no-op via the
+        module flag — robust to jax rewording its re-init error (round-4
+        advisor). The error-string match stays only as a fallback for
+        initializes done outside this helper, and real failures
+        re-raise."""
         import jax
 
         from megatronapp_tpu.parallel import mesh as mesh_mod
@@ -463,14 +465,26 @@ class TestMultiHostInitIdempotent:
 
         def fake_init(**kw):
             calls.append(kw)
-            if len(calls) > 1:
-                raise RuntimeError(
-                    "jax.distributed.initialize should only be called once.")
 
+        monkeypatch.setattr(mesh_mod, "_distributed_initialized", False)
         monkeypatch.setattr(jax.distributed, "initialize", fake_init)
         mesh_mod.initialize_multi_host()
+        mesh_mod.initialize_multi_host()   # flag short-circuits
+        assert len(calls) == 1
+
+        # Fallback: initialized outside the helper → jax raises its
+        # re-entry error; the string match swallows it and arms the flag.
+        monkeypatch.setattr(mesh_mod, "_distributed_initialized", False)
+
+        def reentry(**kw):
+            raise RuntimeError(
+                "jax.distributed.initialize should only be called once.")
+
+        monkeypatch.setattr(jax.distributed, "initialize", reentry)
         mesh_mod.initialize_multi_host()   # must not raise
-        assert len(calls) == 2
+        assert mesh_mod._distributed_initialized
+
+        monkeypatch.setattr(mesh_mod, "_distributed_initialized", False)
 
         def other_err(**kw):
             raise RuntimeError("coordinator unreachable")
@@ -479,6 +493,7 @@ class TestMultiHostInitIdempotent:
         import pytest as _pytest
         with _pytest.raises(RuntimeError, match="unreachable"):
             mesh_mod.initialize_multi_host()
+        assert not mesh_mod._distributed_initialized
 
 
 class TestRampupPipelineValidation:

@@ -149,7 +149,7 @@ class TestCrossEntropy:
         """Vocab-parallel CE over a real tp mesh equals dense CE
         (reference cross_entropy.py:123 semantics)."""
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         tp = 4
         mesh = Mesh(np.array(devices8[:tp]), ("tp",))

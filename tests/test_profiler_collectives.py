@@ -276,6 +276,31 @@ class TestCollectiveStats:
         assert ar["gbps_max"] == 0.8
         assert stats["all-gather"]["gbps_mean"] == 0.0
 
+    def test_per_device_copies_dedupe_to_logical_ops(self):
+        """Per-device copies of one logical collective (same hlo_op +
+        iteration, different pids) count once: bytes once per
+        occurrence, time from the slowest participant — correct for
+        both aggregated and raw per-rank traces (round-4 advisor)."""
+        from megatronapp_tpu.trace.analytics import collective_stats
+        copies = [
+            {"ph": "X", "name": "all-reduce", "dur": d, "pid": pid,
+             "args": {"bytes": 1000, "bandwidth_gbps": g,
+                      "hlo_op": "all-reduce.1", "iteration": 7}}
+            for pid, d, g in [(0, 10.0, 0.8), (1, 20.0, 0.4),
+                              (2, 15.0, 0.5), (3, 12.0, 0.6)]
+        ]
+        # A second logical occurrence (different iteration), one copy.
+        copies.append(
+            {"ph": "X", "name": "all-reduce", "dur": 30.0, "pid": 0,
+             "args": {"bytes": 2000, "bandwidth_gbps": 0.2,
+                      "hlo_op": "all-reduce.1", "iteration": 8}})
+        stats = collective_stats(copies)
+        ar = stats["all-reduce"]
+        assert ar["count"] == 2
+        assert ar["bytes_total"] == 3000
+        assert ar["time_us"] == pytest.approx(20.0 + 30.0)
+        assert ar["gbps_max"] == 0.8
+
     def test_analyze_includes_collectives(self, devices8, tmp_path):
         """analyze() over a real traced tp=2 run reports per-kind
         collective bandwidth (reference profiling stats parity)."""
