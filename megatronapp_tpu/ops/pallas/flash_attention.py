@@ -94,6 +94,75 @@ def _dispatch_tiles(compute, causal, edge_mask, q_start, k_start,
 
 
 # ---------------------------------------------------------------------------
+# BlockSpec builders shared by all kernels. Every kernel runs on a
+# (b, h, major, minor) grid where (major, minor) is (iq, ik) for
+# q-major kernels (forward, dq) and (ik, iq) for kv-major ones (dkv);
+# `q_major` picks which grid slot indexes the q blocks. Segment-id and
+# lse/delta specs come in straight ([bq,1] columns) and transposed
+# ([1,bq] lane rows) orientations.
+# ---------------------------------------------------------------------------
+
+
+def _spec_q(block_q, d, q_major):
+    if q_major:
+        return pl.BlockSpec((1, 1, block_q, d),
+                            lambda b_, h_, iq, ik: (b_, h_, iq, 0))
+    return pl.BlockSpec((1, 1, block_q, d),
+                        lambda b_, h_, ik, iq: (b_, h_, iq, 0))
+
+
+def _spec_kv(block_kv, d, group, q_major):
+    if q_major:
+        return pl.BlockSpec(
+            (1, 1, block_kv, d),
+            lambda b_, h_, iq, ik, g_=group: (b_, h_ // g_, ik, 0))
+    return pl.BlockSpec(
+        (1, 1, block_kv, d),
+        lambda b_, h_, ik, iq, g_=group: (b_, h_ // g_, ik, 0))
+
+
+def _spec_segs(block_q, block_kv, q_major, transposed):
+    """(q_segs, kv_segs) specs. Straight orientation reads q ids as a
+    [bq, 1] column from [B,Sq,1] and kv ids as a [1, bkv] row from
+    [B,1,Skv]; the transposed kernels read q ids as a [1, bq] row and kv
+    ids as a [bkv, 1] column (callers swap the arrays to match)."""
+    if transposed:
+        q_shape, q_idx = (1, 1, block_q), (lambda b_, m, n: (b_, 0, m))
+        k_shape, k_idx = (1, block_kv, 1), (lambda b_, m, n: (b_, n, 0))
+    else:
+        q_shape, q_idx = (1, block_q, 1), (lambda b_, m, n: (b_, m, 0))
+        k_shape, k_idx = (1, 1, block_kv), (lambda b_, m, n: (b_, 0, n))
+    iq_of = (lambda mj, mn: mj) if q_major else (lambda mj, mn: mn)
+    ik_of = (lambda mj, mn: mn) if q_major else (lambda mj, mn: mj)
+    return [
+        pl.BlockSpec(q_shape,
+                     lambda b_, h_, mj, mn: q_idx(b_, iq_of(mj, mn),
+                                                  ik_of(mj, mn))),
+        pl.BlockSpec(k_shape,
+                     lambda b_, h_, mj, mn: k_idx(b_, iq_of(mj, mn),
+                                                  ik_of(mj, mn))),
+    ]
+
+
+def _spec_qcol(block_q, q_major):
+    """[bq, 1] per-q-row scalars (straight-orientation lse/delta)."""
+    if q_major:
+        return pl.BlockSpec((1, 1, block_q, 1),
+                            lambda b_, h_, iq, ik: (b_, h_, iq, 0))
+    return pl.BlockSpec((1, 1, block_q, 1),
+                        lambda b_, h_, ik, iq: (b_, h_, iq, 0))
+
+
+def _spec_qrow(block_q, q_major):
+    """[1, bq] lane-row scalars (transposed-orientation lse/delta)."""
+    if q_major:
+        return pl.BlockSpec((1, 1, 1, block_q),
+                            lambda b_, h_, iq, ik: (b_, h_, 0, iq))
+    return pl.BlockSpec((1, 1, 1, block_q),
+                        lambda b_, h_, ik, iq: (b_, h_, 0, iq))
+
+
+# ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
 
@@ -164,6 +233,128 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_kv,
         lse_ref[0, 0] = lse[:, None]
 
 
+def _fwd_kernel_t(*refs, scale, causal, block_q, block_kv,
+                  num_kv, seq_q, seq_kv, has_segs, bounded):
+    """Forward in transposed orientation for D < 128: scores as
+    s^T = k·q^T [bkv, bq], accumulator o^T [D, bq] filled by
+    (p·v)^T = v^T·p — full-width contraction (bkv) and output (bq) dims
+    where the straight orientation's p@v has only D output lanes. The
+    online-softmax running max/sum live as [1, bq] lane rows; reductions
+    run over sublanes (axis 0)."""
+    if has_segs:
+        (q_ref, k_ref, v_ref, qs_ref, ks_ref,
+         ot_ref, lse_ref, acc, m_scr, l_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, ot_ref, lse_ref, acc, m_scr, l_scr = refs
+        qs_ref = ks_ref = None
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q_start = iq * block_q
+    k_start = ik * block_kv
+
+    def compute(masked):
+        q = q_ref[0, 0].astype(jnp.float32) * scale   # [bq, D]
+        k = k_ref[0, 0]                               # [bkv, D]
+        v = v_ref[0, 0]
+        if bounded:
+            q = _mask_rows(q, q_start, seq_q)
+            k = _mask_rows(k, k_start, seq_kv)
+            v = _mask_rows(v, k_start, seq_kv)
+        st = jax.lax.dot_general(                     # k·q^T = s^T
+            k, q.astype(k.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bkv, bq]
+        if masked:
+            valid = _valid_mask_t(q_start, k_start, block_q, block_kv,
+                                  seq_q, seq_kv, causal, bounded,
+                                  qs_ref, ks_ref)
+            st = jnp.where(valid, st, _NEG_INF)
+
+        m_prev = m_scr[0]                             # [bq]
+        m_new = jnp.maximum(m_prev, jnp.max(st, axis=0))
+        m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+        p = jnp.exp(st - m_safe[None, :])             # [bkv, bq]
+        if masked:
+            p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+        corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
+        l_scr[0] = l_scr[0] * corr + jnp.sum(p, axis=0)
+        pvt = jax.lax.dot_general(                    # v^T·p = (p·v)^T
+            v, p.astype(v.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [D, bq]
+        acc[:] = acc[:] * corr[None, :] + pvt
+        m_scr[0] = m_new
+
+    _dispatch_tiles(compute, causal, bounded or has_segs, q_start, k_start,
+                    block_q, block_kv)
+
+    @pl.when(ik == num_kv - 1)
+    def _finalize():
+        l = l_scr[0]
+        ot_ref[0, 0] = (acc[:] / jnp.maximum(l, 1e-20)[None, :]).astype(
+            ot_ref.dtype)
+        m = m_scr[0]
+        lse = jnp.where(
+            l > 0, jnp.maximum(m, _NEG_INF / 2) + jnp.log(
+                jnp.maximum(l, 1e-20)), _NEG_INF)
+        lse_ref[0, 0] = lse[None, :]
+
+
+def _flash_forward_t(q, k, v, scale, causal, block_q, block_kv, nq, nk,
+                     bounded, group, segs):
+    """D<128 forward: transposed-orientation kernel; output comes out as
+    [B,H,D,Sq] and is swapped back here, lse as [B,H,1,Sq] rows."""
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    has_segs = segs is not None
+
+    kernel = functools.partial(
+        _fwd_kernel_t, scale=scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, num_kv=nk, seq_q=sq, seq_kv=skv,
+        has_segs=has_segs, bounded=bounded)
+
+    in_specs = [_spec_q(block_q, d, q_major=True),
+                _spec_kv(block_kv, d, group, q_major=True),
+                _spec_kv(block_kv, d, group, q_major=True)]
+    inputs = [q, k, v]
+    if has_segs:
+        q_segs, kv_segs = segs                # [B,Sq,1] / [B,1,Skv]
+        qs_row = jnp.swapaxes(q_segs, 1, 2)   # [B,1,Sq]
+        ks_col = jnp.swapaxes(kv_segs, 1, 2)  # [B,Skv,1]
+        in_specs += _spec_segs(block_q, block_kv, q_major=True,
+                               transposed=True)
+        inputs += [qs_row, ks_col]
+
+    ot, lse_row = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, d, block_q),
+                         lambda b_, h_, iq, ik: (b_, h_, 0, iq)),
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda b_, h_, iq, ik: (b_, h_, 0, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, d, sq), q.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((d, block_q), jnp.float32),
+            pltpu.VMEM((1, block_q), jnp.float32),
+            pltpu.VMEM((1, block_q), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*inputs)
+    return jnp.swapaxes(ot, -1, -2), lse_row[:, :, 0, :]
+
+
 def _flash_forward(q, k, v, scale, causal, block_q, block_kv, segs=None):
     b, h, sq, d = q.shape
     hkv, skv = k.shape[1], k.shape[2]
@@ -173,29 +364,24 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_kv, segs=None):
     nq = _cdiv(sq, block_q)
     nk = _cdiv(skv, block_kv)
 
+    bounded = (sq % block_q != 0) or (skv % block_kv != 0)
+    if d < 128:
+        return _flash_forward_t(q, k, v, scale, causal, block_q, block_kv,
+                                nq, nk, bounded, group, segs)
+
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_kv=block_kv, num_kv=nk, seq_q=sq, seq_kv=skv,
-        has_segs=segs is not None,
-        bounded=(sq % block_q != 0) or (skv % block_kv != 0))
+        has_segs=segs is not None, bounded=bounded)
 
-    in_specs = [
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_kv, d),
-                         lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
-            pl.BlockSpec((1, 1, block_kv, d),
-                         lambda b_, h_, iq, ik, g=group: (b_, h_ // g, ik, 0)),
-    ]
+    in_specs = [_spec_q(block_q, d, q_major=True),
+                _spec_kv(block_kv, d, group, q_major=True),
+                _spec_kv(block_kv, d, group, q_major=True)]
     inputs = [q, k, v]
     if segs is not None:
         q_segs, kv_segs = segs  # [B,Sq,1] / [B,1,Skv] int32
-        in_specs += [
-            pl.BlockSpec((1, block_q, 1),
-                         lambda b_, h_, iq, ik: (b_, iq, 0)),
-            pl.BlockSpec((1, 1, block_kv),
-                         lambda b_, h_, iq, ik: (b_, 0, ik)),
-        ]
+        in_specs += _spec_segs(block_q, block_kv, q_major=True,
+                               transposed=False)
         inputs += [q_segs, kv_segs]
 
     out, lse = pl.pallas_call(
@@ -224,7 +410,172 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_kv, segs=None):
 
 # ---------------------------------------------------------------------------
 # Backward kernels
+#
+# Two orientations. For D >= 128 the straightforward one: accumulators
+# [block, D] and the output-producing matmuls (dq = ds@k, dk = ds^T@q,
+# dv = p^T@do) have N = D output lanes. At D = 64 that leaves half the
+# MXU's 128 output columns (and half of every 128-lane vreg row of the
+# accumulator) idle — PERF.md's main backward-kernel lever. The
+# transposed orientation used when D < 128 computes dq^T = k^T·ds^T,
+# dk^T = q^T·ds, dv^T = do^T·p instead: contraction and output dims are
+# both the 512-wide sequence blocks (full MXU), the [D, block]
+# accumulators fill whole vregs, and only the D-contracted score matmuls
+# (s, dp) keep the intrinsic K=D underfill. Outputs land as [B,H,D,S]
+# and are swapped back outside (one XLA transpose, O(bytes)).
 # ---------------------------------------------------------------------------
+
+
+def _valid_mask_t(q_start, k_start, block_q, block_kv, seq_q, seq_kv,
+                  causal, bounded, qs_ref, ks_ref):
+    """Transposed-orientation [bkv, bq] validity mask (rows = kv
+    positions, cols = q positions) for the dq^T kernel."""
+    rows = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_kv, block_q), 0)
+    cols = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_kv, block_q), 1)
+    if bounded:
+        valid = (rows < seq_kv) & (cols < seq_q)
+        if causal:
+            valid = valid & (cols >= rows)
+    else:
+        valid = cols >= rows if causal else jnp.ones(
+            (block_kv, block_q), jnp.bool_)
+    if qs_ref is not None:
+        # qs_ref[0]: [1, bq] lane row; ks_ref[0]: [bkv, 1] column.
+        valid = valid & (ks_ref[0] == qs_ref[0])
+    return valid
+
+
+def _bwd_dq_kernel_t(*refs, scale, causal, block_q, block_kv, num_kv,
+                     seq_q, seq_kv, has_segs, bounded):
+    """dq in transposed orientation: scores as s^T = k·q^T [bkv, bq],
+    accumulator dq^T [D, bq], final matmul k^T·ds^T with full-width
+    contraction (bkv) and output (bq) dims."""
+    if has_segs:
+        (q_ref, k_ref, v_ref, qs_ref, ks_ref, do_ref, lse_ref, delta_ref,
+         dqt_ref, dqt_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dqt_ref, dqt_acc) = refs
+        qs_ref = ks_ref = None
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dqt_acc[:] = jnp.zeros_like(dqt_acc)
+
+    q_start = iq * block_q
+    k_start = ik * block_kv
+
+    def compute(masked):
+        q = q_ref[0, 0].astype(jnp.float32) * scale   # [bq, D]
+        k = k_ref[0, 0]                               # [bkv, D]
+        v = v_ref[0, 0]
+        if bounded:
+            k = _mask_rows(k, k_start, seq_kv)
+            v = _mask_rows(v, k_start, seq_kv)
+        do = do_ref[0, 0].astype(jnp.float32)         # [bq, D]
+        lse = lse_ref[0, 0]                           # [1, bq]
+        delta = delta_ref[0, 0]                       # [1, bq]
+
+        st = jax.lax.dot_general(                     # k·q^T = s^T
+            k, q.astype(k.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bkv, bq]
+        dpt = jax.lax.dot_general(                    # v·do^T = dp^T
+            v, do.astype(v.dtype), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bkv, bq]
+        if masked:
+            valid = _valid_mask_t(q_start, k_start, block_q, block_kv,
+                                  seq_q, seq_kv, causal, bounded,
+                                  qs_ref, ks_ref)
+            pt = jnp.where(valid, jnp.exp(st - lse), 0.0)
+            dst = jnp.where(valid, pt * (dpt - delta), 0.0)
+        else:
+            pt = jnp.exp(st - lse)
+            dst = pt * (dpt - delta)
+        dqt_acc[:] += jax.lax.dot_general(            # k^T·ds^T = dq^T
+            k, dst.astype(k.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [D, bq]
+
+    _dispatch_tiles(compute, causal, bounded or has_segs, q_start, k_start,
+                    block_q, block_kv)
+
+    @pl.when(ik == num_kv - 1)
+    def _finalize():
+        dqt_ref[0, 0] = dqt_acc[:].astype(dqt_ref.dtype)
+
+
+def _bwd_dkv_kernel_t(*refs, scale, causal,
+                      block_q, block_kv, num_q, seq_q, seq_kv, has_segs,
+                      bounded):
+    """dk/dv in transposed orientation: scores stay [bq, bkv] (so the
+    standard mask applies), but the accumulating matmuls contract over
+    bq with D-row outputs: dv^T = do^T·p, dk^T = q^T·ds — full-width
+    contraction and output dims, [D, bkv] accumulators."""
+    if has_segs:
+        (q_ref, k_ref, v_ref, qs_ref, ks_ref, do_ref, lse_ref, delta_ref,
+         dkt_ref, dvt_ref, dkt_acc, dvt_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dkt_ref, dvt_ref, dkt_acc, dvt_acc) = refs
+        qs_ref = ks_ref = None
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dkt_acc[:] = jnp.zeros_like(dkt_acc)
+        dvt_acc[:] = jnp.zeros_like(dvt_acc)
+
+    q_start = iq * block_q
+    k_start = ik * block_kv
+
+    def compute(masked):
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        if bounded:
+            q = _mask_rows(q, q_start, seq_q)
+            k = _mask_rows(k, k_start, seq_kv)
+            v = _mask_rows(v, k_start, seq_kv)
+            do = _mask_rows(do, q_start, seq_q)
+        lse = lse_ref[0, 0][:, 0]
+        delta = delta_ref[0, 0][:, 0]
+
+        s = jax.lax.dot_general(q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do.astype(v.dtype), v,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if masked:
+            valid = _valid_mask(q_start, k_start, block_q, block_kv,
+                                seq_q, seq_kv, causal, bounded,
+                                qs_ref, ks_ref)
+            p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+            ds = jnp.where(valid, p * (dp - delta[:, None]), 0.0)
+        else:
+            p = jnp.exp(s - lse[:, None])          # [bq, bkv]
+            ds = p * (dp - delta[:, None])         # [bq, bkv]
+        # dv^T += do^T @ p   (contract bq; [D, bkv])
+        dvt_acc[:] += jax.lax.dot_general(
+            do.astype(v.dtype), p.astype(v.dtype),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dk^T += q^T @ ds (q already has scale folded in)
+        dkt_acc[:] += jax.lax.dot_general(
+            q.astype(k.dtype), ds.astype(k.dtype),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    _dispatch_tiles(compute, causal, bounded or has_segs, q_start, k_start,
+                    block_q, block_kv)
+
+    @pl.when(iq == num_q - 1)
+    def _finalize():
+        dkt_ref[0, 0] = dkt_acc[:].astype(dkt_ref.dtype)
+        dvt_ref[0, 0] = dvt_acc[:].astype(dvt_ref.dtype)
 
 def _bwd_dq_kernel(*refs, scale, causal, block_q, block_kv, num_kv,
                    seq_q, seq_kv, has_segs, bounded):
@@ -363,35 +714,25 @@ def _flash_backward(res, g, scale, causal, block_q, block_kv, segs=None):
 
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)  # [B,H,Sq]
+    if d < 128:
+        return _flash_backward_t(
+            q, k, v, g, lse, delta, scale, causal, block_q, block_kv,
+            nq, nk, bounded, group, segs)
     lse4 = lse[..., None]
     delta4 = delta[..., None]
 
-    dq_in_specs = [
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_kv, d),
-                         lambda b_, h_, iq, ik, g_=group: (b_, h_ // g_, ik, 0)),
-            pl.BlockSpec((1, 1, block_kv, d),
-                         lambda b_, h_, iq, ik, g_=group: (b_, h_ // g_, ik, 0)),
-    ]
+    dq_in_specs = [_spec_q(block_q, d, q_major=True),
+                   _spec_kv(block_kv, d, group, q_major=True),
+                   _spec_kv(block_kv, d, group, q_major=True)]
     dq_inputs = [q, k, v]
     if segs is not None:
         q_segs, kv_segs = segs
-        dq_in_specs += [
-            pl.BlockSpec((1, block_q, 1),
-                         lambda b_, h_, iq, ik: (b_, iq, 0)),
-            pl.BlockSpec((1, 1, block_kv),
-                         lambda b_, h_, iq, ik: (b_, 0, ik)),
-        ]
+        dq_in_specs += _spec_segs(block_q, block_kv, q_major=True,
+                                  transposed=False)
         dq_inputs += [q_segs, kv_segs]
-    dq_in_specs += [
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
-    ]
+    dq_in_specs += [_spec_q(block_q, d, q_major=True),
+                    _spec_qcol(block_q, q_major=True),
+                    _spec_qcol(block_q, q_major=True)]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -410,32 +751,18 @@ def _flash_backward(res, g, scale, causal, block_q, block_kv, segs=None):
     # dk/dv computed at q-head granularity [B, H, Skv, D]; grouped heads are
     # reduced outside (GQA) — simple and correct; a fused variant can
     # accumulate in-kernel later.
-    dkv_in_specs = [
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_kv, d),
-                         lambda b_, h_, ik, iq, g_=group: (b_, h_ // g_, ik, 0)),
-            pl.BlockSpec((1, 1, block_kv, d),
-                         lambda b_, h_, ik, iq, g_=group: (b_, h_ // g_, ik, 0)),
-    ]
+    dkv_in_specs = [_spec_q(block_q, d, q_major=False),
+                    _spec_kv(block_kv, d, group, q_major=False),
+                    _spec_kv(block_kv, d, group, q_major=False)]
     dkv_inputs = [q, k, v]
     if segs is not None:
         q_segs, kv_segs = segs
-        dkv_in_specs += [
-            pl.BlockSpec((1, block_q, 1),
-                         lambda b_, h_, ik, iq: (b_, iq, 0)),
-            pl.BlockSpec((1, 1, block_kv),
-                         lambda b_, h_, ik, iq: (b_, 0, ik)),
-        ]
+        dkv_in_specs += _spec_segs(block_q, block_kv, q_major=False,
+                                   transposed=False)
         dkv_inputs += [q_segs, kv_segs]
-    dkv_in_specs += [
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1),
-                         lambda b_, h_, ik, iq: (b_, h_, iq, 0)),
-    ]
+    dkv_in_specs += [_spec_q(block_q, d, q_major=False),
+                     _spec_qcol(block_q, q_major=False),
+                     _spec_qcol(block_q, q_major=False)]
 
     dk_full, dv_full = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
@@ -466,6 +793,99 @@ def _flash_backward(res, g, scale, causal, block_q, block_kv, segs=None):
         dv = dv_full.reshape(b, hkv, group, skv, d).sum(axis=2)
     else:
         dk, dv = dk_full, dv_full
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _flash_backward_t(q, k, v, g, lse, delta, scale, causal,
+                      block_q, block_kv, nq, nk, bounded, group, segs):
+    """D<128 backward: transposed-orientation kernels (full MXU lanes —
+    see the orientation note above). Gradients come out as [B,H,D,S] and
+    are swapped back here."""
+    b, h, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    has_segs = segs is not None
+
+    # lse/delta as [B,H,1,Sq] lane rows for the dq^T kernel.
+    lse_row = lse[:, :, None, :]
+    delta_row = delta[:, :, None, :]
+
+    dq_in_specs = [_spec_q(block_q, d, q_major=True),
+                   _spec_kv(block_kv, d, group, q_major=True),
+                   _spec_kv(block_kv, d, group, q_major=True)]
+    dq_inputs = [q, k, v]
+    if has_segs:
+        q_segs, kv_segs = segs              # [B,Sq,1] / [B,1,Skv]
+        qs_row = jnp.swapaxes(q_segs, 1, 2)   # [B,1,Sq]
+        ks_col = jnp.swapaxes(kv_segs, 1, 2)  # [B,Skv,1]
+        dq_in_specs += _spec_segs(block_q, block_kv, q_major=True,
+                                  transposed=True)
+        dq_inputs += [qs_row, ks_col]
+    dq_in_specs += [_spec_q(block_q, d, q_major=True),
+                    _spec_qrow(block_q, q_major=True),
+                    _spec_qrow(block_q, q_major=True)]
+
+    dqt = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_t, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv, num_kv=nk,
+                          seq_q=sq, seq_kv=skv, has_segs=has_segs,
+                          bounded=bounded),
+        grid=(b, h, nq, nk),
+        in_specs=dq_in_specs,
+        out_specs=pl.BlockSpec((1, 1, d, block_q),
+                               lambda b_, h_, iq, ik: (b_, h_, 0, iq)),
+        out_shape=jax.ShapeDtypeStruct((b, h, d, sq), q.dtype),
+        scratch_shapes=[pltpu.VMEM((d, block_q), jnp.float32)],
+        interpret=_interpret(),
+    )(*dq_inputs, g, lse_row, delta_row)
+
+    lse4 = lse[..., None]
+    delta4 = delta[..., None]
+    dkv_in_specs = [_spec_q(block_q, d, q_major=False),
+                    _spec_kv(block_kv, d, group, q_major=False),
+                    _spec_kv(block_kv, d, group, q_major=False)]
+    dkv_inputs = [q, k, v]
+    if has_segs:
+        q_segs, kv_segs = segs
+        dkv_in_specs += _spec_segs(block_q, block_kv, q_major=False,
+                                   transposed=False)
+        dkv_inputs += [q_segs, kv_segs]
+    dkv_in_specs += [_spec_q(block_q, d, q_major=False),
+                     _spec_qcol(block_q, q_major=False),
+                     _spec_qcol(block_q, q_major=False)]
+
+    dkt_full, dvt_full = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_t, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv, num_q=nq,
+                          seq_q=sq, seq_kv=skv, has_segs=has_segs,
+                          bounded=bounded),
+        grid=(b, h, nk, nq),
+        in_specs=dkv_in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, d, block_kv),
+                         lambda b_, h_, ik, iq: (b_, h_, 0, ik)),
+            pl.BlockSpec((1, 1, d, block_kv),
+                         lambda b_, h_, ik, iq: (b_, h_, 0, ik)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, d, skv), k.dtype),
+            jax.ShapeDtypeStruct((b, h, d, skv), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((d, block_kv), jnp.float32),
+            pltpu.VMEM((d, block_kv), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*dkv_inputs, g, lse4, delta4)
+
+    dq = jnp.swapaxes(dqt, -1, -2)
+    if group > 1:
+        dk = jnp.swapaxes(
+            dkt_full.reshape(b, hkv, group, d, skv).sum(axis=2), -1, -2)
+        dv = jnp.swapaxes(
+            dvt_full.reshape(b, hkv, group, d, skv).sum(axis=2), -1, -2)
+    else:
+        dk = jnp.swapaxes(dkt_full, -1, -2)
+        dv = jnp.swapaxes(dvt_full, -1, -2)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 

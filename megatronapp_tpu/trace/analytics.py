@@ -93,23 +93,35 @@ def collective_stats(events: List[dict]) -> Dict[str, Dict]:
     # Convention: totals are per LOGICAL collective (the reference's
     # per-op accounting), not per participant. Each device in a group
     # contributes its own copy of the same event, so copies are deduped
-    # by (hlo_op, iteration) occurrence — robust to both aggregated
-    # traces (all copies present) and raw per-rank traces (only local
-    # devices' copies present, where a 1/len(group) weighting would
-    # undercount). bytes count once per occurrence; time_us takes the
+    # ACROSS pids by matching the n-th occurrence of
+    # (name, hlo_op, iteration, group) per pid — the same logical-op
+    # identity trace/dependency.py uses. This is robust to aggregated
+    # and raw per-rank traces alike (a 1/len(group) weighting would
+    # undercount the latter) while still counting repeated executions of
+    # one HLO op within an iteration (per-microbatch loop collectives)
+    # separately. bytes count once per occurrence; time_us takes the
     # slowest participant (the collective's critical path); per-copy
     # bandwidths all feed the mean/max.
     seen: Dict[tuple, str] = {}
-    for e in events:
+    per_pid_n: Dict[tuple, int] = {}
+    for e in sorted(events, key=lambda ev: (str(ev.get("pid")),
+                                            ev.get("ts", 0.0))):
         args = e.get("args", {})
         if e.get("ph") != "X" or "bandwidth_gbps" not in args:
             continue
         a = agg[e["name"]]
-        # Occurrence identity needs hlo_op (+iteration); events without
-        # it (hand-built or foreign traces) can't be deduped and each
-        # counts as its own occurrence.
-        occ = ((e["name"], args["hlo_op"], args.get("iteration"))
-               if args.get("hlo_op") else (id(e),))
+        # Occurrence identity needs hlo_op (+iteration+group); events
+        # without it (hand-built or foreign traces) can't be deduped and
+        # each counts as its own occurrence.
+        if args.get("hlo_op"):
+            ident = (e["name"], args["hlo_op"], args.get("iteration"),
+                     tuple(args.get("group") or ()))
+            pkey = (e.get("pid"),) + ident
+            n = per_pid_n.get(pkey, 0)
+            per_pid_n[pkey] = n + 1
+            occ = ident + (n,)
+        else:
+            occ = (id(e),)
         dur = float(e.get("dur", 0.0))
         if occ not in seen:
             seen[occ] = e["name"]

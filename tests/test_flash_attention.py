@@ -109,6 +109,76 @@ class TestFlashAttention:
             losses[impl] = float(loss)
         assert abs(losses["pallas"] - losses["reference"]) < 1e-4, losses
 
+    def test_d64_transposed_bwd_grads(self):
+        """D=64 takes the transposed-orientation backward kernels (full
+        128-lane MXU fill — PERF.md lever); uneven blocks + GQA compose
+        with it."""
+        q, k, v = make_qkv(s=160, h=4, hkv=2, d=64)
+
+        def loss_f(args):
+            return jnp.sum(flash_attention(*args, causal=True, block_q=64,
+                                           block_kv=64) ** 2)
+
+        def loss_r(args):
+            return jnp.sum(dot_product_attention(*args) ** 2)
+
+        gf = jax.grad(loss_f)((q, k, v))
+        gr = jax.grad(loss_r)((q, k, v))
+        for a, b in zip(gf, gr):
+            assert bool(jnp.all(jnp.isfinite(a)))
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5)
+
+    def test_d128_legacy_bwd_grads(self):
+        """D=128 keeps the straight-orientation backward kernels (lanes
+        already full); pin that path now that every smaller-D test runs
+        the transposed one."""
+        q, k, v = make_qkv(b=1, s=64, h=2, hkv=2, d=128)
+
+        def loss_f(args):
+            return jnp.sum(flash_attention(*args, causal=True, block_q=32,
+                                           block_kv=32) ** 2)
+
+        def loss_r(args):
+            return jnp.sum(dot_product_attention(*args) ** 2)
+
+        gf = jax.grad(loss_f)((q, k, v))
+        gr = jax.grad(loss_r)((q, k, v))
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5)
+
+    def test_segment_grads(self):
+        """Packed-segment backward through both transposed kernels (the
+        dq^T kernel needs the transposed [bkv, bq] validity mask)."""
+        b, s, h, d = 2, 96, 2, 32
+        q, k, v = make_qkv(b=b, s=s, h=h, hkv=h, d=d)
+        seg = jnp.concatenate([jnp.zeros((b, 40), jnp.int32),
+                               jnp.ones((b, s - 40), jnp.int32)], axis=1)
+
+        def seg_oracle(args):
+            qq, kk, vv = args
+            scale = 1.0 / (d ** 0.5)
+            sc = jnp.einsum("bqhd,bkhd->bhqk", qq, kk) * scale
+            mask = (seg[:, None, :, None] == seg[:, None, None, :])
+            tri = jnp.tril(jnp.ones((s, s), jnp.bool_))
+            mask = mask & tri[None, None]
+            sc = jnp.where(mask, sc, -1e30)
+            p = jax.nn.softmax(sc, axis=-1)
+            return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p, vv) ** 2)
+
+        def loss_f(args):
+            return jnp.sum(flash_attention(
+                *args, causal=True, block_q=32, block_kv=32,
+                segment_ids=seg) ** 2)
+
+        gf = jax.grad(loss_f)((q, k, v))
+        gr = jax.grad(seg_oracle)((q, k, v))
+        for a, b in zip(gf, gr):
+            assert bool(jnp.all(jnp.isfinite(a)))
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5)
+
     def test_gqa_grads(self):
         q, k, v = make_qkv(s=64, h=4, hkv=2, d=16)
 

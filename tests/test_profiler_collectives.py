@@ -301,6 +301,27 @@ class TestCollectiveStats:
         assert ar["time_us"] == pytest.approx(20.0 + 30.0)
         assert ar["gbps_max"] == 0.8
 
+    def test_repeated_executions_same_pid_count_separately(self):
+        """One HLO op executed N times within an iteration on the SAME
+        device (per-microbatch loop collectives) is N logical ops — the
+        cross-pid dedupe matches the n-th occurrence per pid, it does
+        not collapse a pid's own repeats."""
+        from megatronapp_tpu.trace.analytics import collective_stats
+        events = []
+        for pid in (0, 1):
+            for rep in range(3):
+                events.append(
+                    {"ph": "X", "name": "ppermute", "pid": pid,
+                     "ts": 100.0 * rep, "dur": 10.0 + pid,
+                     "args": {"bytes": 500, "bandwidth_gbps": 0.4,
+                              "hlo_op": "collective-permute.2",
+                              "iteration": 3, "group": [0, 1]}})
+        stats = collective_stats(events)
+        pp = stats["ppermute"]
+        assert pp["count"] == 3          # 3 logical ops, 2 copies each
+        assert pp["bytes_total"] == 1500
+        assert pp["time_us"] == pytest.approx(3 * 11.0)  # slowest copy
+
     def test_analyze_includes_collectives(self, devices8, tmp_path):
         """analyze() over a real traced tp=2 run reports per-kind
         collective bandwidth (reference profiling stats parity)."""
