@@ -411,6 +411,7 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
         distributed_optimizer=args.use_distributed_optimizer,
         forward_backward_disaggregating=args.forward_backward_disaggregating,
         pipeline_order_policy="bfc" if args.use_dpp else "dfc",
+        use_dpp=args.use_dpp,
     )
 
     # Cross-validation (reference validate_args: seq/cp divisibility :695).

@@ -64,6 +64,11 @@ class ParallelConfig:
     # schedule ('dfc' depth-first-chunk = interleaved, 'bfc'
     # breadth-first-chunk = sequential chunk passes; reference paper §5.2).
     pipeline_order_policy: str = "dfc"
+    # MegaDPP dynamic runtime: drive pp execution through the host
+    # readiness-driven scheduler (runtime/dpp_train.py) when the layout
+    # allows (pure pp); otherwise the policy above orders the SPMD
+    # schedule statically.
+    use_dpp: bool = False
 
     def __post_init__(self):
         for name in ("tensor_parallel", "pipeline_parallel", "context_parallel",

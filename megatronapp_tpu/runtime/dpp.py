@@ -173,13 +173,39 @@ class DppPipelineRunner:
             return 0, chunk + 1
         return None
 
+    def _prev_hop(self, stage: int, chunk: int
+                  ) -> Optional[Tuple[int, int]]:
+        """Reverse topology for the backward pass: where the gradient of
+        (stage, chunk)'s INPUT flows — the producer of that input — or
+        None for (0, 0), whose dh is a grad w.r.t. the pipeline seed
+        (reference backward_send direction,
+        shm_tensor_new_rdma.cpp:1550-1646)."""
+        if stage > 0:
+            return stage - 1, chunk
+        if chunk > 0:
+            return self.pp - 1, chunk - 1
+        return None
+
     # -- execution ----------------------------------------------------
 
-    def run(self, microbatch_inputs: Sequence[Any]) -> List[Any]:
-        """Execute the forward pipeline over all microbatches. Returns
-        outputs indexed by microbatch."""
-        if len(microbatch_inputs) != self.M:
-            raise ValueError("need one input per microbatch")
+    def _pipeline_phase(self, seeds: Dict[Tuple[int, int], Any],
+                        seed_stage: int,
+                        exec_fn: Callable[[int, int, Any, int], Any],
+                        next_hop: Callable[[int, int],
+                                           Optional[Tuple[int, int]]],
+                        keyfn: Callable[[Tuple[int, int]], Tuple],
+                        plan: List[Tuple[int, int]]) -> Dict[int, Any]:
+        """One scheduled pipeline sweep (forward OR backward — the
+        reference runs the same sender machinery in both directions).
+
+        seeds {(chunk, mb): value} enter ``seed_stage``'s inbox;
+        ``exec_fn(stage, chunk, value, mb)`` computes; finished values
+        ship along ``next_hop`` — readiness-first under ``keyfn`` when
+        dynamic, strict ``plan`` order otherwise — through a bounded
+        TransferPool per link. Items whose hop is None are collected
+        into the returned {mb: value}. Per-phase metrics land on
+        ``self`` (transfer_order, ship_time_s, sender_stall_s,
+        compute_wait_s, pool_stall_s, wall_s)."""
         pp, vpp, M = self.pp, self.vpp, self.M
         inboxes = [_Mailbox() for _ in range(pp)]       # compute inputs
         finished = [_Mailbox() for _ in range(pp)]      # awaiting send
@@ -197,12 +223,9 @@ class DppPipelineRunner:
             {} for _ in range(pp)]
         t_run0 = time.perf_counter()
 
-        # Seed stage 0 with chunk-0 inputs.
-        for m, h in enumerate(microbatch_inputs):
-            inboxes[0].put((0, m), jax.device_put(h, self.devices[0]))
-
-        def keyfn(cm):
-            return send_priority(cm[0], cm[1], pp, vpp, self.policy)
+        for (c, m), h in seeds.items():
+            inboxes[seed_stage].put(
+                (c, m), jax.device_put(h, self.devices[seed_stage]))
 
         def compute_loop(stage: int):
             try:
@@ -213,15 +236,14 @@ class DppPipelineRunner:
                     t0 = time.perf_counter()
                     (c, m), h = inboxes[stage].pop_best(keyfn)
                     compute_wait[stage] += time.perf_counter() - t0
-                    h = self.chunk_fn(stage, c, h, m)
+                    h = exec_fn(stage, c, h, m)
                     jax.block_until_ready(h)
                     finished[stage].put((c, m), h)
-            except BaseException as e:  # noqa: BLE001 — surfaced in run()
+            except BaseException as e:  # noqa: BLE001 — surfaced below
                 errors.append(e)
 
         def sender_loop(stage: int):
             try:
-                plan = static_order(pp, vpp, M, self.policy)
                 for i in range(len(plan)):
                     t0 = time.perf_counter()
                     if self.dynamic:
@@ -232,7 +254,7 @@ class DppPipelineRunner:
                     sender_stall[stage] += time.perf_counter() - t0
                     order_log[stage].append((c, m))
                     ship_log[stage][(c, m)] = time.perf_counter() - t_run0
-                    hop = self._next_hop(stage, c)
+                    hop = next_hop(stage, c)
                     if hop is None:
                         with out_lock:
                             outputs[m] = h
@@ -270,4 +292,103 @@ class DppPipelineRunner:
         self.sender_stall_s = sender_stall
         self.compute_wait_s = compute_wait
         self.pool_stall_s = [p.stall_s for p in pools]
+        return outputs
+
+    def run(self, microbatch_inputs: Sequence[Any]) -> List[Any]:
+        """Execute the forward pipeline over all microbatches. Returns
+        outputs indexed by microbatch."""
+        if len(microbatch_inputs) != self.M:
+            raise ValueError("need one input per microbatch")
+        pp, vpp, M = self.pp, self.vpp, self.M
+
+        def keyfn(cm):
+            return send_priority(cm[0], cm[1], pp, vpp, self.policy)
+
+        seeds = {(0, m): h for m, h in enumerate(microbatch_inputs)}
+        outputs = self._pipeline_phase(
+            seeds, 0,
+            lambda s, c, h, m: self.chunk_fn(s, c, h, m),
+            self._next_hop, keyfn, static_order(pp, vpp, M, self.policy))
         return [outputs[m] for m in range(M)]
+
+    def run_train(self, microbatch_inputs: Sequence[Any],
+                  chunk_vjp_fn: Callable[[int, int, Any, int],
+                                         Tuple[Any, Callable]],
+                  seed_grads_fn: Callable[[List[Any]],
+                                          Tuple[Sequence[Any], Any]],
+                  ) -> Tuple[List[Any], Dict[Tuple[int, int], Any],
+                             List[Any], Any]:
+        """Full fwd+bwd through the dynamic scheduler (the reference's
+        forward_send AND backward_send loops,
+        shm_tensor_new_rdma.cpp:1478-1646 — not argued by symmetry: the
+        backward pass executes through the same `_pipeline_phase`
+        machinery in reverse topology with mirrored priority).
+
+        chunk_vjp_fn(stage, chunk, h, mb) -> (h_out, vjp) where
+        vjp(g_out) -> (dparams, dh). seed_grads_fn(outputs) ->
+        (per-mb output grads, aux) runs the loss head after the forward
+        sweep. Returns (outputs, param_grads {(stage, chunk): pytree
+        summed over mbs}, input_grads per mb, aux).
+
+        Metrics: after return, fwd_metrics/bwd_metrics hold each phase's
+        (transfer_order, ship_time_s, sender_stall_s, compute_wait_s,
+        pool_stall_s, wall_s).
+        """
+        if len(microbatch_inputs) != self.M:
+            raise ValueError("need one input per microbatch")
+        pp, vpp, M = self.pp, self.vpp, self.M
+        residuals: Dict[Tuple[int, int, int], Callable] = {}
+
+        def fwd_key(cm):
+            return send_priority(cm[0], cm[1], pp, vpp, self.policy)
+
+        def fwd_exec(stage, c, h, m):
+            out, vjp = chunk_vjp_fn(stage, c, h, m)
+            residuals[(stage, c, m)] = vjp
+            return out
+
+        seeds = {(0, m): h for m, h in enumerate(microbatch_inputs)}
+        fwd_out = self._pipeline_phase(
+            seeds, 0, fwd_exec, self._next_hop, fwd_key,
+            static_order(pp, vpp, M, self.policy))
+        self.fwd_metrics = self._phase_metrics()
+        outputs = [fwd_out[m] for m in range(M)]
+
+        out_grads, aux = seed_grads_fn(outputs)
+        if len(out_grads) != M:
+            raise ValueError("seed_grads_fn must return one grad per "
+                             "microbatch")
+
+        # Mirrored priority: the latest-forward item goes backward first
+        # (the reference's backward traversal mirrors forward_send).
+        def bwd_key(cm):
+            return tuple(-x for x in fwd_key(cm))
+
+        param_grads: Dict[Tuple[int, int], Any] = {}
+
+        def bwd_exec(stage, c, g, m):
+            dparams, dh = residuals.pop((stage, c, m))(g)
+            acc = param_grads.get((stage, c))
+            param_grads[(stage, c)] = (
+                dparams if acc is None else jax.tree.map(
+                    lambda a, b: a + b, acc, dparams))
+            return dh
+
+        bwd_seeds = {(vpp - 1, m): g for m, g in enumerate(out_grads)}
+        bwd_out = self._pipeline_phase(
+            bwd_seeds, pp - 1, bwd_exec, self._prev_hop, bwd_key,
+            sorted([(c, m) for c in range(vpp) for m in range(M)],
+                   key=bwd_key))
+        self.bwd_metrics = self._phase_metrics()
+        input_grads = [bwd_out[m] for m in range(M)]
+        return outputs, param_grads, input_grads, aux
+
+    def _phase_metrics(self) -> Dict[str, Any]:
+        return {
+            "transfer_order": self.transfer_order,
+            "ship_time_s": self.ship_time_s,
+            "sender_stall_s": self.sender_stall_s,
+            "compute_wait_s": self.compute_wait_s,
+            "pool_stall_s": self.pool_stall_s,
+            "wall_s": self.wall_s,
+        }

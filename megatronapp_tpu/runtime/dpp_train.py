@@ -1,0 +1,290 @@
+"""MegaDPP in the real training path: a host-driven fwd+bwd GPT step.
+
+The reference initializes its dynamic transport inside ``pretrain_body``
+(/root/reference/megatron/training/training.py:746-783) — MegaDPP is a
+property of training runs, not a sidecar benchmark. This module gives the
+TPU framework the same: ``make_dpp_train_step`` builds a drop-in
+``step(state, batch) -> (state, metrics)`` whose pipeline-parallel
+execution runs through ``DppPipelineRunner.run_train`` — per-stage
+devices, readiness-driven transfer ordering, and a real backward sweep
+through the same scheduler (reference backward_send,
+shm_tensor_new_rdma.cpp:1550-1646) — instead of the jitted SPMD schedule.
+
+Scope (guarded with actionable errors): pure pipeline parallelism
+(dp = tp = cp = ep = 1 — the host runner places one stage per device),
+no MTP, no packed segments. Embedding runs on the first stage device and
+the LM head + loss on the last, the reference's stage placement.
+Numerics match ``gpt_pipeline_loss`` + ``spmd_pipeline`` (layer offset
+(chunk*pp + stage)*Lc, per-injection compute-dtype cast, aux summed over
+stage-chunk-mb then /M) — pinned by the golden-parity test in
+tests/test_dpp_runtime.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.runtime.dpp import DppPipelineRunner
+
+
+def make_dpp_gpt_value_and_grad(cfg, devices, vpp: int = 1,
+                                policy: str = "dfc", dynamic: bool = True,
+                                n_buffers: int = 4,
+                                jitter=None):
+    """Build vg(params, batch_mb) -> (loss, grads, metrics, runner).
+
+    batch_mb: {'tokens','labels','loss_mask': [M, mb, S]}. params is the
+    full GPT pytree with params['block'] stacked [pp, vpp, Lc, ...]
+    (models/gpt.py reshape convention). The returned callable reuses its
+    jitted chunk/head/embed closures across steps, so steady-state calls
+    do not recompile.
+    """
+    from megatronapp_tpu.models.gpt import (
+        gpt_embed, gpt_head, gpt_rope_tables,
+    )
+    from megatronapp_tpu.ops.cross_entropy import cross_entropy_loss
+    from megatronapp_tpu.transformer.block import block_forward
+
+    if getattr(cfg, "mtp_num_layers", 0):
+        raise NotImplementedError(
+            "the DPP runtime step does not support multi-token prediction "
+            "yet; drop --mtp-num-layers or --dpp-runtime")
+    pp = len(devices)
+
+    # One jitted forward per (stage, chunk) — the layer offset is baked
+    # in, matching spmd_pipeline's (chunk*pp + stage)*Lc indexing.
+    chunk_fwd_cache: Dict[Tuple[int, int], Callable] = {}
+    rope_cache: Dict[int, Tuple[Any, Any]] = {}
+
+    def chunk_fwd(stage: int, chunk: int, lc: int, s: int) -> Callable:
+        key = (stage, chunk)
+        if key not in chunk_fwd_cache:
+            offset = (chunk * pp + stage) * lc
+            if s not in rope_cache:
+                rope_cache[s] = gpt_rope_tables(cfg, s)
+            cos, sin = rope_cache[s]
+
+            @jax.jit
+            def f(pc, x, _off=offset, _cos=cos, _sin=sin):
+                return block_forward(pc, x, cfg, _cos, _sin, None,
+                                     layer_offset=_off, ctx=None)
+
+            chunk_fwd_cache[key] = f
+        return chunk_fwd_cache[key]
+
+    @jax.jit
+    def f_embed(params, tokens_flat):
+        return gpt_embed(params, tokens_flat, cfg, dtype=jnp.float32)
+
+    @jax.jit
+    def f_head(params, out_stack, targets_mb, loss_mask_mb):
+        logits = gpt_head(params, out_stack, cfg)
+        ce, _ = cross_entropy_loss(logits, targets_mb, loss_mask_mb)
+        return ce
+
+    def vg(params, batch_mb):
+        tokens_mb = jnp.asarray(batch_mb["tokens"])
+        targets_mb = jnp.asarray(batch_mb["labels"])
+        loss_mask_mb = batch_mb.get("loss_mask")
+        if loss_mask_mb is not None:
+            loss_mask_mb = jnp.asarray(loss_mask_mb)
+        if batch_mb.get("segment_ids") is not None:
+            raise NotImplementedError(
+                "the DPP runtime step does not support packed segments "
+                "yet; unpack the batch or drop --dpp-runtime")
+        m, mb, s = tokens_mb.shape
+        pipe = params["block"]
+        lc = jax.tree.leaves(pipe)[0].shape[2]
+        compute_dtype = cfg.compute_dtype
+
+        # Slice + place per-(stage, chunk) params (the executor's
+        # distribution step; on a pod this is the per-stage weight
+        # residency the reference gets from per-rank ownership).
+        placed = [[jax.device_put(
+            jax.tree.map(lambda x, s_=st, c_=c: x[s_, c_], pipe),
+            devices[st]) for c in range(vpp)] for st in range(pp)]
+
+        # Embed/head touch only the non-block params; place those copies
+        # explicitly (params may arrive mesh-sharded from the SPMD-layout
+        # train state — a single jit must not see mixed assignments).
+        light = {k: v for k, v in params.items() if k != "block"}
+        light_first = jax.device_put(light, devices[0])
+        light_last = jax.device_put(light, devices[-1])
+
+        # Embedding on the first stage device.
+        with jax.default_device(devices[0]):
+            h_flat, embed_vjp = jax.vjp(
+                f_embed, light_first,
+                jax.device_put(tokens_mb, devices[0]).reshape(m * mb, s))
+        h_mb = h_flat.reshape(m, mb, s, -1)
+
+        aux_parts = []
+
+        def chunk_vjp_fn(stage, c, h, m_idx):
+            if jitter and (stage, c) in jitter:
+                # A/B instrumentation: injected per-(stage, chunk) delay
+                # modeling a straggling stage (tools/dpp_ab_benchmark.py).
+                import time as _time
+                _time.sleep(jitter[(stage, c)])
+            f = chunk_fwd(stage, c, lc, s)
+            (y, a), vjp = jax.vjp(f, placed[stage][c], h)
+            aux_parts.append(a)
+
+            def wrapped(g_y, _vjp=vjp):
+                # Each chunk's aux loss enters the total as aux_sum / M.
+                return _vjp((g_y, jnp.asarray(1.0 / m, jnp.float32)))
+
+            return y, wrapped
+
+        loss_box = {}
+
+        def seed_grads_fn(outputs):
+            out_stack = jnp.stack(
+                [jax.device_put(o, devices[-1]) for o in outputs])
+            # Head runs on the last stage device: co-locate its operands.
+            targets_last = jax.device_put(targets_mb, devices[-1])
+            mask_last = (None if loss_mask_mb is None
+                         else jax.device_put(loss_mask_mb, devices[-1]))
+            with jax.default_device(devices[-1]):
+                ce, head_vjp = jax.vjp(
+                    f_head, light_last, out_stack, targets_last,
+                    mask_last)
+                g_params_head, g_out, _, _ = head_vjp(
+                    jnp.ones((), ce.dtype))
+            loss_box["ce"] = ce
+            loss_box["g_params_head"] = g_params_head
+            return [g_out[i] for i in range(m)], None
+
+        runner = DppPipelineRunner(
+            None, devices, pp, vpp, m, policy=policy, dynamic=dynamic,
+            n_buffers=n_buffers)
+        _, block_grads, input_grads, _ = runner.run_train(
+            [h_mb[i].astype(compute_dtype) for i in range(m)],
+            chunk_vjp_fn, seed_grads_fn)
+
+        # Assemble the stacked [pp, vpp, Lc, ...] block gradient.
+        def on0(t):
+            return jax.tree.map(lambda x: jax.device_put(x, devices[0]), t)
+
+        per_stage = [
+            jax.tree.map(lambda *cs: jnp.stack(cs),
+                         *[on0(block_grads[(st, c)]) for c in range(vpp)])
+            if vpp > 1 else
+            jax.tree.map(lambda x: x[None], on0(block_grads[(st, 0)]))
+            for st in range(pp)
+        ]
+        g_block = jax.tree.map(lambda *ss: jnp.stack(ss), *per_stage)
+
+        # Embedding grad: the runner consumed h.astype(compute_dtype), so
+        # chain the cast back to fp32 by hand.
+        dh_mb = jnp.stack([jax.device_put(g, devices[0])
+                           for g in input_grads]).astype(jnp.float32)
+        g_params_embed, _ = embed_vjp(dh_mb.reshape(m * mb, s, -1))
+
+        g_params_head = jax.tree.map(
+            lambda x: jax.device_put(x, devices[0]),
+            loss_box["g_params_head"])
+        grads = jax.tree.map(lambda a, b: a + b,
+                             g_params_embed, g_params_head)
+        grads = dict(grads)
+        grads["block"] = g_block
+
+        aux_total = sum(jax.device_get(a) for a in aux_parts)
+        aux = jnp.asarray(aux_total, jnp.float32) / m
+        ce = loss_box["ce"]
+        loss = ce + aux
+        metrics = {"lm_loss": ce, "moe_aux_loss": aux}
+        return loss, grads, metrics, runner
+
+    return vg
+
+
+def make_dpp_train_step(optimizer, opt_cfg, cfg, devices, train_iters: int,
+                        vpp: int = 1, policy: str = "dfc",
+                        dynamic: bool = True, check_nan: bool = True,
+                        state_shardings=None, jitter=None):
+    """Drop-in for make_train_step when the DPP runtime drives pp: the
+    value-and-grad half runs host-driven through the dynamic scheduler;
+    the optimizer half is one jitted update (same NaN gate, grad norm,
+    lr schedule and metrics contract as training/train_step.py).
+
+    state_shardings: when given (the train driver's mesh shardings), the
+    update step keeps the state in that layout across iterations so the
+    surrounding machinery (eval step, checkpointing, resharding) sees
+    the same state it would under the SPMD step."""
+    from megatronapp_tpu.training.optimizer import (
+        global_grad_norm, lr_schedule,
+    )
+
+    sched = lr_schedule(opt_cfg, train_iters)
+    vg = make_dpp_gpt_value_and_grad(cfg, devices, vpp=vpp, policy=policy,
+                                     dynamic=dynamic, jitter=jitter)
+
+    def apply(state, grads, loss):
+        params = state["params"]
+        grad_norm = global_grad_norm(grads)
+        finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+
+        def do_update(_):
+            updates, new_opt = optimizer.update(
+                grads, state["opt_state"], params)
+            new_params = jax.tree.map(
+                lambda p, u: (p + u.astype(p.dtype)), params, updates)
+            return new_params, new_opt
+
+        def skip(_):
+            return params, state["opt_state"]
+
+        if check_nan:
+            new_params, new_opt = jax.lax.cond(finite, do_update, skip,
+                                               operand=None)
+            skipped = jnp.where(finite, 0, 1).astype(jnp.int32)
+        else:
+            new_params, new_opt = do_update(None)
+            skipped = jnp.zeros((), jnp.int32)
+        new_state = {"step": state["step"] + 1, "params": new_params,
+                     "opt_state": new_opt}
+        return new_state, grad_norm, skipped
+
+    if state_shardings is not None:
+        param_sh = state_shardings["params"]
+        mesh = jax.tree.leaves(state_shardings)[0].mesh
+        from jax.sharding import NamedSharding, PartitionSpec
+        scalar_sh = NamedSharding(mesh, PartitionSpec())
+        apply = jax.jit(apply,
+                        in_shardings=(state_shardings, param_sh, scalar_sh),
+                        out_shardings=(state_shardings, None, None))
+    else:
+        param_sh = scalar_sh = None
+        apply = jax.jit(apply)
+
+    def step(state, batch):
+        loss, grads, aux, runner = vg(state["params"], batch)
+        # The loss lands on the last stage device (head placement) and
+        # grads on the first; re-lay them out for the update step (which
+        # keeps the state in the driver's mesh layout when given).
+        loss = jax.device_put(
+            loss, scalar_sh if scalar_sh is not None else devices[0])
+        if param_sh is not None:
+            grads = jax.device_put(grads, param_sh)
+        new_state, grad_norm, skipped = apply(state, grads, loss)
+        metrics = {
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "lr": sched(state["step"]),
+            "skipped": skipped,
+            **aux,
+            # Scheduler observables (PERF.md's DPP A/B metrics), per
+            # phase: downstream input wait is the stall DPP ordering
+            # removes.
+            "dpp_fwd_compute_wait_s": sum(
+                runner.fwd_metrics["compute_wait_s"][1:]),
+            "dpp_bwd_compute_wait_s": sum(
+                runner.bwd_metrics["compute_wait_s"][:-1]),
+        }
+        return new_state, metrics
+
+    return step

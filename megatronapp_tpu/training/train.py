@@ -203,14 +203,42 @@ def pretrain_gpt(
                 train_cfg.seq_length, model_cfg.vocab_size,
                 train_cfg.global_batch_size, seed=train_cfg.seed + 1)
 
-    step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
-                              train_cfg.train_iters,
-                              check_nan=train_cfg.check_for_nan_in_loss,
-                              pipeline=ctx.pp > 1)
+    # MegaDPP dynamic runtime in the training path (reference transport
+    # init inside pretrain_body, training.py:746-783): with --use-dpp and
+    # a pure-pp layout the step runs host-driven through the
+    # DppPipelineRunner (fwd+bwd dynamic scheduling, runtime/dpp_train.py)
+    # instead of the jitted SPMD schedule. Layouts the host runner cannot
+    # place (dp/tp/cp/ep > 1) fall back to the static bfc SPMD order.
+    use_dpp_runtime = False
+    if getattr(parallel_cfg, "use_dpp", False) and ctx.pp > 1:
+        if (ctx.dp == ctx.tp == ctx.cp == ctx.ep == 1
+                and not model_cfg.mtp_num_layers):
+            use_dpp_runtime = True
+        else:
+            log_fn("dpp: layout has dp/tp/cp/ep > 1 (or MTP) — host "
+                   "runner needs one stage per device; falling back to "
+                   "static bfc SPMD ordering")
+    if use_dpp_runtime:
+        from megatronapp_tpu.runtime.dpp_train import make_dpp_train_step
+        stage_devices = list(ctx.mesh.devices.flatten())
+        step_fn = make_dpp_train_step(
+            optimizer, opt_cfg, model_cfg, stage_devices,
+            train_cfg.train_iters, vpp=vpp,
+            policy=parallel_cfg.pipeline_order_policy,
+            check_nan=train_cfg.check_for_nan_in_loss,
+            state_shardings=shardings)
+        log_fn(f"dpp: dynamic runtime active (pp={ctx.pp}, vpp={vpp}, "
+               f"policy={parallel_cfg.pipeline_order_policy})")
+    else:
+        step_fn = make_train_step(
+            loss_fn, optimizer, opt_cfg, ctx, shardings,
+            train_cfg.train_iters,
+            check_nan=train_cfg.check_for_nan_in_loss,
+            pipeline=ctx.pp > 1)
     # Non-donating variant for rerun replay (compiles only if a failure is
     # ever classified; the donating step would delete the live state's
-    # buffers on replay).
-    replay_step_fn = make_train_step(
+    # buffers on replay). The DPP step never donates, so it replays as-is.
+    replay_step_fn = step_fn if use_dpp_runtime else make_train_step(
         loss_fn, optimizer, opt_cfg, ctx, shardings, train_cfg.train_iters,
         check_nan=train_cfg.check_for_nan_in_loss, pipeline=ctx.pp > 1,
         donate=False)
@@ -228,7 +256,13 @@ def pretrain_gpt(
         # reference's per-window tracing achieves this by skipping event
         # creation; under jit the instrumentation must be traced in).
         from megatronapp_tpu.trace.tracer import callbacks_supported
-        if callbacks_supported():
+        if use_dpp_runtime:
+            # The host-driven step has its own per-phase observability
+            # (runner transfer/stall metrics in the step metrics dict);
+            # in-graph phase markers only apply to the SPMD step.
+            log_fn("trace: dpp runtime active — schedule-phase spans come "
+                   "from the runner's per-phase metrics")
+        elif callbacks_supported():
             traced_step_fn = make_train_step(
                 loss_fn, optimizer, opt_cfg, ctx, shardings,
                 train_cfg.train_iters,
@@ -246,8 +280,10 @@ def pretrain_gpt(
     _coll = {"hlo": {}, "window": -1}
 
     def run_step_maybe_profiled(active_fn, state, batch, it):
-        if (not tracer.active or
+        if (not tracer.active or not hasattr(active_fn, "lower") or
                 train_cfg.trace_granularity not in ("full", "collective")):
+            # Host-driven (DPP) steps have no single lowered HLO to join
+            # profiler events against — the runner's metrics cover them.
             return active_fn(state, batch)
         window = it // tracer.interval
         if window == _coll["window"]:

@@ -147,3 +147,131 @@ class TestDppPipelineRunner:
         runner = _make_runner(devices8, 2, 1, 3)
         with pytest.raises(ValueError, match="one input per microbatch"):
             runner.run([jnp.zeros((2, 2))])
+
+
+class TestDppTrainStep:
+    """The dynamic runtime in the REAL training path (round-4 verdict
+    task: forward AND backward through the scheduler, golden-parity vs
+    spmd_pipeline)."""
+
+    def _setup(self, pp, vpp, M=4, mb=1, s=8):
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.models.gpt import init_gpt_params
+        cfg = TransformerConfig(
+            num_layers=4, hidden_size=64, num_attention_heads=4,
+            vocab_size=128, max_position_embeddings=64,
+            remat_policy="none", compute_dtype=jnp.float32)
+        p_pipe, _ = init_gpt_params(jax.random.PRNGKey(0), cfg,
+                                    pp=pp, vpp=vpp)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (M, mb, s),
+                                    0, 128)
+        labels = jnp.roll(tokens, -1, axis=-1)
+        mask = jnp.ones((M, mb, s), jnp.float32)
+        return cfg, p_pipe, tokens, labels, mask
+
+    @pytest.mark.parametrize("pp,vpp,dynamic", [(2, 1, True), (2, 2, True),
+                                                (2, 2, False)])
+    def test_golden_parity_vs_spmd(self, devices8, pp, vpp, dynamic):
+        """Host-driven fwd+bwd loss AND full param grads match the jitted
+        SPMD pipeline on identical params/data."""
+        from megatronapp_tpu.config.parallel_config import ParallelConfig
+        from megatronapp_tpu.models.gpt import gpt_pipeline_loss
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.runtime.dpp_train import (
+            make_dpp_gpt_value_and_grad,
+        )
+
+        cfg, p_pipe, tokens, labels, mask = self._setup(pp, vpp)
+        par = ParallelConfig(pipeline_parallel=pp,
+                             virtual_pipeline_parallel=vpp)
+        ctx = build_mesh(par, devices=devices8[:pp])
+        with ctx.mesh:
+            (ref_loss, _), ref_grads = jax.jit(jax.value_and_grad(
+                lambda p: gpt_pipeline_loss(p, tokens, labels, mask, cfg,
+                                            ctx, vpp=vpp),
+                has_aux=True))(p_pipe)
+
+        vg = make_dpp_gpt_value_and_grad(cfg, devices8[:pp], vpp=vpp,
+                                         dynamic=dynamic)
+        loss, grads, metrics, runner = vg(
+            p_pipe, {"tokens": tokens, "labels": labels,
+                     "loss_mask": mask})
+        assert abs(float(loss) - float(ref_loss)) < 1e-5, (
+            float(loss), float(ref_loss))
+        flat_ref, tree_ref = jax.tree_util.tree_flatten_with_path(ref_grads)
+        flat_got = dict(jax.tree_util.tree_flatten_with_path(grads)[0])
+        for path, leaf in flat_ref:
+            got = flat_got[path]
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(leaf), atol=2e-4,
+                err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
+        # Backward really ran through the scheduler: every stage shipped
+        # every (chunk, mb) in the backward phase too.
+        assert all(len(o) == vpp * 4 for o in
+                   runner.bwd_metrics["transfer_order"])
+
+    def test_train_step_loss_decreases(self, devices8):
+        """make_dpp_train_step drives real optimization (the metrics
+        contract matches make_train_step's)."""
+        from megatronapp_tpu.config.training_config import OptimizerConfig
+        from megatronapp_tpu.runtime.dpp_train import make_dpp_train_step
+        from megatronapp_tpu.training.optimizer import get_optimizer
+
+        pp, vpp, M = 2, 2, 4
+        cfg, p_pipe, tokens, labels, mask = self._setup(pp, vpp)
+        opt_cfg = OptimizerConfig(lr=1e-3)
+        optimizer = get_optimizer(opt_cfg, train_iters=10)
+        step = make_dpp_train_step(optimizer, opt_cfg, cfg,
+                                   devices8[:pp], train_iters=10, vpp=vpp)
+        state = {"step": jnp.zeros((), jnp.int32), "params": p_pipe,
+                 "opt_state": optimizer.init(p_pipe)}
+        batch = {"tokens": tokens, "labels": labels, "loss_mask": mask}
+        losses = []
+        for _ in range(5):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+            assert {"loss", "grad_norm", "lr", "skipped",
+                    "dpp_fwd_compute_wait_s"} <= set(metrics)
+        assert losses[-1] < losses[0], losses
+
+    def test_pretrain_gpt_use_dpp_end_to_end(self, devices8):
+        """--use-dpp drives pretrain_gpt's pp execution through the
+        dynamic runner (reference: transport init inside pretrain_body);
+        the loss trajectory tracks the SPMD run on identical data."""
+        from tests.test_training import learnable_batches
+
+        from megatronapp_tpu.config.parallel_config import ParallelConfig
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.training.train import pretrain_gpt
+
+        model = TransformerConfig(
+            num_layers=4, hidden_size=64, num_attention_heads=4,
+            vocab_size=128, max_position_embeddings=64,
+            remat_policy="none", compute_dtype=jnp.float32)
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                               seq_length=32, train_iters=8,
+                               log_interval=4, eval_interval=0)
+        opt = OptimizerConfig(lr=1e-3, lr_warmup_iters=2)
+
+        losses = {}
+        for use_dpp in (False, True):
+            par = ParallelConfig(pipeline_parallel=2,
+                                 virtual_pipeline_parallel=2,
+                                 use_dpp=use_dpp,
+                                 pipeline_order_policy="bfc")
+            ctx = build_mesh(par, devices=devices8[:2])
+            res = pretrain_gpt(model, par, train, opt, ctx=ctx,
+                               batch_iter=learnable_batches(32, 128, 8))
+            losses[use_dpp] = res.losses
+        assert losses[True][-1] < losses[True][0] - 0.1, losses[True]
+        # Same data, same init, fp32: the two executors track each other.
+        np.testing.assert_allclose(losses[True], losses[False],
+                                   rtol=2e-3, atol=2e-3)
