@@ -19,19 +19,63 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 
-def _relayout_leaf(x: np.ndarray, target_shape: tuple) -> np.ndarray:
+def _relayout_leaf(x: np.ndarray, target_shape: tuple,
+                   saved_layout: Optional[dict] = None,
+                   target_layout: Optional[dict] = None) -> np.ndarray:
     """Re-layout one stacked-layer leaf between pipeline layouts.
 
     Layouts are [L, *rest] (pp=1) or [pp, vpp, L/(pp*vpp), *rest]
     (parallel/pipeline.py reshape_params_for_pipeline: chunk-major
-    reshape + stage/chunk swap). The saved and target layouts are both
-    inferred from shapes: `rest` is the longest common suffix, the
-    leading dims factor the same layer count L. Mirrors the reference's
-    resharding.py PP-change path."""
+    reshape + stage/chunk swap). Mirrors the reference's resharding.py
+    PP-change path.
+
+    With explicit layouts ({'pp', 'vpp'} — checkpoint metadata on the
+    saved side, the restoring run's config on the target side) the lead
+    split is DERIVED, never guessed, and inconsistencies raise. Without
+    them (pre-metadata checkpoints) the split falls back to shape
+    enumeration — which resolves a pathological ambiguity (a rest dim
+    that equals Lc) by enumeration order."""
     if tuple(x.shape) == target_shape:
         return x
-    # A layer-stack leaf leads with [L] or [pp, vpp, Lc]; enumerate the
-    # split (a greedy common-suffix match would eat an equal Lc).
+
+    def lead_ndim(layout):
+        return 1 if layout["pp"] * layout.get("vpp", 1) == 1 else 3
+
+    if saved_layout is not None and target_layout is not None:
+        ls, lt = lead_ndim(saved_layout), lead_ndim(target_layout)
+        lead_s, rest_s = x.shape[:ls], x.shape[ls:]
+        lead_t, rest_t = target_shape[:lt], target_shape[lt:]
+        if ls == 3 and tuple(lead_s[:2]) != (saved_layout["pp"],
+                                             saved_layout.get("vpp", 1)):
+            raise ValueError(
+                f"checkpoint leaf {x.shape} does not lead with the saved "
+                f"layout (pp={saved_layout['pp']}, "
+                f"vpp={saved_layout.get('vpp', 1)})")
+        if lt == 3 and tuple(lead_t[:2]) != (target_layout["pp"],
+                                             target_layout.get("vpp", 1)):
+            raise ValueError(
+                f"target leaf {target_shape} does not lead with the "
+                f"current layout (pp={target_layout['pp']}, "
+                f"vpp={target_layout.get('vpp', 1)})")
+        if (tuple(rest_s) != tuple(rest_t) or
+                int(np.prod(lead_s)) != int(np.prod(lead_t))):
+            raise ValueError(
+                f"cannot relayout checkpoint leaf {x.shape} -> "
+                f"{target_shape} under layouts {saved_layout} -> "
+                f"{target_layout}: model geometry differs")
+        L = int(np.prod(lead_s))
+        if ls == 3:                   # [pp, vpp, Lc] → [L]
+            x = np.swapaxes(x, 0, 1).reshape((L,) + tuple(rest_s))
+        if lt == 3:                   # [L] → [pp, vpp, Lc]
+            pp, vpp, lc = lead_t
+            x = np.swapaxes(
+                x.reshape((vpp, pp, lc) + tuple(rest_s)), 0, 1)
+        return np.ascontiguousarray(x)
+
+    # Shape-driven fallback for checkpoints saved before layout metadata
+    # existed: a layer-stack leaf leads with [L] or [pp, vpp, Lc];
+    # enumerate the split (a greedy common-suffix match would eat an
+    # equal Lc).
     for ls in (1, 3):
         for lt in (1, 3):
             lead_s, rest_s = x.shape[:ls], x.shape[ls:]
@@ -70,12 +114,40 @@ class CheckpointManager:
             enable_async_checkpointing=async_save,
         )
         self._mngr = ocp.CheckpointManager(directory, options=options)
+        self._layout_path = os.path.join(directory, "layout.json")
 
-    def save(self, step: int, state: Any, force: bool = False) -> bool:
+    def save(self, step: int, state: Any, force: bool = False,
+             layout: Optional[dict] = None) -> bool:
+        """layout: the run's pipeline layout ({'pp', 'vpp'}, optionally
+        'num_layers') — persisted once per run directory so cross-layout
+        restores derive the stacked-leaf split from metadata instead of
+        shape guessing (reference resharding.py records the source
+        parallelism the same way). A run directory holds one layout."""
+        if layout is not None and jax.process_index() == 0:
+            import json
+            existing = self._read_layout()
+            if existing is not None and existing != dict(layout):
+                raise ValueError(
+                    f"checkpoint dir {self._mngr.directory} was saved "
+                    f"with layout {existing}; refusing to mix in "
+                    f"{dict(layout)} — use a fresh --save-dir per layout")
+            if existing is None:
+                tmp = self._layout_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(dict(layout), f)
+                os.replace(tmp, self._layout_path)
         return self._mngr.save(
             step, args=ocp.args.StandardSave(state), force=force)
 
-    def restore(self, state_struct: Any, step: Optional[int] = None) -> Any:
+    def _read_layout(self) -> Optional[dict]:
+        if not os.path.exists(self._layout_path):
+            return None
+        import json
+        with open(self._layout_path) as f:
+            return json.load(f)
+
+    def restore(self, state_struct: Any, step: Optional[int] = None,
+                layout: Optional[dict] = None) -> Any:
         """Restore into the shardings of `state_struct`.
 
         Mesh-only layout changes (tp/dp/fsdp degree) reshard natively:
@@ -85,8 +157,10 @@ class CheckpointManager:
         ...], models/gpt.py init layout) — the reference's
         dist_checkpointing/strategies/resharding.py TP/PP-change path.
         When shapes mismatch, leaves are restored in their saved shapes,
-        relayouted host-side (shape-driven, see _relayout_leaf), and
-        device_put into the target shardings."""
+        relayouted host-side (metadata-driven when the saved dir has a
+        layout.json and the caller passes its own `layout`; shape-driven
+        fallback otherwise — see _relayout_leaf), and device_put into
+        the target shardings."""
         if step is None:
             step = self._mngr.latest_step()
         if step is None:
@@ -144,13 +218,16 @@ class CheckpointManager:
             for s, t in zip(saved_leaves, target_leaves)])
         restored = self._mngr.restore(
             step, args=ocp.args.StandardRestore(saved_abstract))
+        saved_layout = self._read_layout()
         out_leaves = []
         for s, t, r in zip(saved_leaves, target_leaves,
                            jax.tree.leaves(restored)):
             if _mismatched(s, t):
                 r = jax.device_put(
                     _relayout_leaf(np.asarray(jax.device_get(r)),
-                                   tuple(t.shape)),
+                                   tuple(t.shape),
+                                   saved_layout=saved_layout,
+                                   target_layout=layout),
                     t.sharding)
             out_leaves.append(r)
         return jax.tree.unflatten(treedef, out_leaves)

@@ -146,6 +146,11 @@ def pretrain_gpt(
     # (training/checkpointing.py).
     ckpt = None
     start_step = 0
+    # Pipeline layout metadata saved with (and consulted by) checkpoints
+    # so cross-layout restores derive the stacked-leaf split instead of
+    # shape-guessing (reference resharding.py source-parallelism record).
+    ckpt_layout = {"pp": ctx.pp, "vpp": vpp,
+                   "num_layers": model_cfg.num_layers}
     if train_cfg.save_dir:
         ckpt = CheckpointManager(train_cfg.save_dir,
                                  save_interval=train_cfg.save_interval)
@@ -155,7 +160,8 @@ def pretrain_gpt(
             loader = CheckpointManager(train_cfg.load_dir)
         else:
             loader = ckpt
-        restored = loader.restore(state) if loader is not None else None
+        restored = (loader.restore(state, layout=ckpt_layout)
+                    if loader is not None else None)
         if restored is not None:
             state = restored
             start_step = int(jax.device_get(state["step"]))
@@ -508,7 +514,8 @@ def pretrain_gpt(
             if ckpt is not None and train_cfg.save_interval and \
                     (it + 1) % train_cfg.save_interval == 0:
                 t_save = time.perf_counter()
-                ckpt.save(it + 1, jax.device_get(state))
+                ckpt.save(it + 1, jax.device_get(state),
+                          layout=ckpt_layout)
                 save_dt = time.perf_counter() - t_save
                 e2e.on_save_checkpoint(save_dt)
                 # Save dispatch time is reported under save_checkpoint_*,
@@ -522,7 +529,8 @@ def pretrain_gpt(
     if ckpt is not None:
         final_step = int(jax.device_get(state["step"]))
         if train_cfg.save_interval and ckpt.latest_step != final_step:
-            ckpt.save(final_step, jax.device_get(state), force=True)
+            ckpt.save(final_step, jax.device_get(state), force=True,
+                      layout=ckpt_layout)
         ckpt.wait()
         ckpt.close()
     if train_cfg.trace:
@@ -603,6 +611,8 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
     # save_checkpoint_legacy analogue — ours reuses the standard manager).
     ckpt = None
     start_step = 0
+    ckpt_layout = {"pp": bwd_ctx.pp, "vpp": 1,
+                   "num_layers": model_cfg.num_layers}
     if train_cfg.save_dir:
         ckpt = CheckpointManager(train_cfg.save_dir,
                                  save_interval=train_cfg.save_interval)
@@ -611,7 +621,8 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
         loader = (CheckpointManager(train_cfg.load_dir)
                   if train_cfg.load_dir and
                   train_cfg.load_dir != train_cfg.save_dir else ckpt)
-        restored = loader.restore(executor.state) if loader else None
+        restored = (loader.restore(executor.state, layout=ckpt_layout)
+                    if loader else None)
         if restored is not None:
             executor.set_state(restored)
             start_step = int(jax.device_get(restored["step"]))
@@ -680,13 +691,14 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
             tracer.save()
         if ckpt is not None and train_cfg.save_interval and \
                 (it + 1) % train_cfg.save_interval == 0:
-            ckpt.save(it + 1, jax.device_get(executor.state))
+            ckpt.save(it + 1, jax.device_get(executor.state),
+                      layout=ckpt_layout)
     dt = time.perf_counter() - t0
     if ckpt is not None:
         final_step = int(jax.device_get(executor.state["step"]))
         if train_cfg.save_interval and ckpt.latest_step != final_step:
             ckpt.save(final_step, jax.device_get(executor.state),
-                      force=True)
+                      force=True, layout=ckpt_layout)
         ckpt.wait()
         ckpt.close()
     if train_cfg.trace:

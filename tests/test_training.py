@@ -199,6 +199,59 @@ class TestCheckpointResharding:
         with pytest.raises(ValueError, match="relayout"):
             _relayout_leaf(flat, (13, 4, 5))           # geometry mismatch
 
+    def test_relayout_metadata_beats_shape_ambiguity(self):
+        """Adversarial case (round-4 verdict weak #5): a leaf whose rest
+        dims make BOTH lead splits shape-plausible. With explicit
+        layouts the split is derived from metadata; inconsistent
+        metadata raises instead of silently picking by enumeration
+        order."""
+        from megatronapp_tpu.training.checkpointing import _relayout_leaf
+        rng = np.random.default_rng(1)
+        # Saved at pp=2/vpp=2 (Lc=2, L=8) with rest=(2, 5): every lead
+        # dim equals 2, so shapes alone cannot distinguish [2,2,2]+(2,5)
+        # from [2]+(2,2,2,5)-style splits.
+        pp2 = rng.normal(size=(2, 2, 2, 2, 5)).astype(np.float32)
+        saved = {"pp": 2, "vpp": 2}
+        flat = _relayout_leaf(pp2, (8, 2, 5), saved_layout=saved,
+                              target_layout={"pp": 1, "vpp": 1})
+        assert flat.shape == (8, 2, 5)
+        # Chunk-major semantics: stage 0 chunk 1 holds layers 4..5.
+        np.testing.assert_array_equal(flat[4:6], pp2[0, 1])
+        # Round trip under metadata.
+        back = _relayout_leaf(flat, (2, 2, 2, 2, 5),
+                              saved_layout={"pp": 1, "vpp": 1},
+                              target_layout=saved)
+        np.testing.assert_array_equal(back, pp2)
+        # Metadata inconsistent with the actual lead dims → loud error,
+        # not a silent wrong relayout.
+        with pytest.raises(ValueError, match="does not lead"):
+            _relayout_leaf(pp2, (8, 2, 5),
+                           saved_layout={"pp": 4, "vpp": 2},
+                           target_layout={"pp": 1, "vpp": 1})
+        with pytest.raises(ValueError, match="geometry differs"):
+            _relayout_leaf(pp2, (16, 5), saved_layout=saved,
+                           target_layout={"pp": 1, "vpp": 1})
+
+    def test_layout_json_roundtrip_and_mix_refusal(self, tmp_path):
+        """CheckpointManager persists layout.json once per run dir,
+        restores consult it, and saving a DIFFERENT layout into the same
+        dir is refused (one run dir = one layout)."""
+        import jax.numpy as jnp
+
+        from megatronapp_tpu.training.checkpointing import (
+            CheckpointManager,
+        )
+        d = str(tmp_path / "ck")
+        m = CheckpointManager(d, save_interval=1, async_save=False)
+        state = {"step": jnp.zeros((), jnp.int32),
+                 "w": jnp.arange(12.0).reshape(12, 1)}
+        m.save(1, state, layout={"pp": 2, "vpp": 2})
+        assert m._read_layout() == {"pp": 2, "vpp": 2}
+        with pytest.raises(ValueError, match="refusing to mix"):
+            m.save(2, state, layout={"pp": 4, "vpp": 1})
+        m.wait()
+        m.close()
+
     def test_resume_across_layout_change(self, devices8, tmp_path):
         """Train 5 iters at tp=2/pp=2, save; resume to 10 at tp=1/pp=4
         and at dp-only. Both must track the uninterrupted pp=2 run's
