@@ -182,10 +182,18 @@ class TrainingScopeServer:
         return web.FileResponse(path)
 
     def build_app(self):
+        import os
+
         from aiohttp import web
         app = web.Application()
         app.router.add_get("/", self.handle_index)
         app.router.add_get("/ws", self.handle_ws)
+        # Component modules (frontend/components/*.js + app.js) — the
+        # counterpart of the reference SPA's src/ tree, served directly
+        # (no build step).
+        app.router.add_static(
+            "/frontend", os.path.join(os.path.dirname(__file__),
+                                      "frontend"))
         return app
 
     def run(self):

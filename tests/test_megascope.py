@@ -4,6 +4,7 @@ Mirrors the reference script-driven MegaScope validation (SURVEY §4) as
 pytest."""
 
 import asyncio
+import os
 
 import jax
 import jax.numpy as jnp
@@ -230,3 +231,102 @@ class TestTrainingScopeServer:
         validate_payloads(payloads, vis)
         sites = {p.get("site") for p in payloads}
         assert "mlp1" in sites and "result" in sites
+
+
+class TestFrontendComponentTree:
+    """The component-structured frontend (round-4 verdict task 4): one
+    named ES-module counterpart per reference src/components/*.vue, a
+    resolvable import graph, and the server actually serving it."""
+
+    FRONTEND = os.path.join(os.path.dirname(__file__), "..",
+                            "megatronapp_tpu", "scope", "frontend")
+
+    REFERENCE_COMPONENTS = [
+        "AttentionMatrix", "ColoredVector", "HelloWorld", "MLPVector",
+        "MLPVectors", "OutputProbs", "PCAPlot", "QKVMatrix", "QKVVector",
+        "QKVVectors",
+    ]
+
+    def test_named_counterpart_per_reference_component(self):
+        cdir = os.path.join(self.FRONTEND, "components")
+        for name in self.REFERENCE_COMPONENTS:
+            path = os.path.join(cdir, name + ".js")
+            assert os.path.exists(path), f"missing counterpart {name}.js"
+            src = open(path).read()
+            assert f"export function {name}" in src, (
+                f"{name}.js does not export {name}()")
+            assert "transformer-visualize/src/components" in src, (
+                f"{name}.js lacks its reference citation")
+
+    def test_import_graph_resolves(self):
+        """Every relative import in app.js/components resolves to a file
+        that exports every imported symbol (no JS runtime in the image,
+        so rot is caught structurally)."""
+        import re
+        files = [os.path.join(self.FRONTEND, "app.js")]
+        cdir = os.path.join(self.FRONTEND, "components")
+        files += [os.path.join(cdir, f) for f in os.listdir(cdir)
+                  if f.endswith(".js")]
+        imp = re.compile(
+            r'import\s*{([^}]*)}\s*from\s*"(\./[^"]+|\./components/[^"]+)"')
+        for path in files:
+            src = open(path).read()
+            for m in imp.finditer(src):
+                names = [n.strip() for n in m.group(1).split(",")
+                         if n.strip()]
+                target = os.path.normpath(
+                    os.path.join(os.path.dirname(path), m.group(2)))
+                assert os.path.exists(target), (
+                    f"{path} imports missing module {m.group(2)}")
+                tsrc = open(target).read()
+                for n in names:
+                    assert re.search(
+                        rf"export (function|const) {n}\b", tsrc), (
+                        f"{target} does not export {n} "
+                        f"(imported by {path})")
+
+    def test_index_hosts_and_module_entry(self):
+        """index.html loads the module shell and provides every element
+        id app.js mounts into."""
+        import re
+        html = open(os.path.join(self.FRONTEND, "index.html")).read()
+        assert '<script type="module" src="/frontend/app.js">' in html
+        app = open(os.path.join(self.FRONTEND, "app.js")).read()
+        ids = set(re.findall(r'\$\("([a-z_0-9]+)"\)', app))
+        ids |= set(re.findall(r'mount\("([a-z_0-9]+)"', app))
+        for el_id in sorted(ids):
+            assert f'id="{el_id}"' in html, (
+                f"app.js references #{el_id} missing from index.html")
+
+    def test_server_serves_component_tree(self, devices8):
+        """GET / (shell), /frontend/app.js, and every component module
+        through the live training-scope app."""
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer as ATestServer
+
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.scope.ws_server import (
+            TrainingScopeServer, TrainingScopeSession,
+        )
+        ctx = build_mesh(ParallelConfig(), devices=devices8[:1])
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=2,
+                               seq_length=16, train_iters=10,
+                               log_interval=10)
+        srv = TrainingScopeServer(TrainingScopeSession(
+            tiny_cfg(), ParallelConfig(), train, OptimizerConfig(lr=1e-3),
+            ctx=ctx))
+
+        async def run():
+            client = TestClient(ATestServer(srv.build_app()))
+            await client.start_server()
+            for path in (["/", "/frontend/app.js"] +
+                         [f"/frontend/components/{n}.js"
+                          for n in self.REFERENCE_COMPONENTS + ["util"]]):
+                r = await client.get(path)
+                assert r.status == 200, (path, r.status)
+                body = await r.text()
+                assert body.strip(), path
+            await client.close()
+
+        asyncio.run(run())
