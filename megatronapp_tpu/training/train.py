@@ -275,8 +275,52 @@ def pretrain_gpt(
                 check_nan=train_cfg.check_for_nan_in_loss,
                 pipeline=ctx.pp > 1, trace_phases=True)
         else:
-            log_fn("trace: backend lacks host callbacks; schedule-phase "
-                   "spans disabled (host-side scopes only)")
+            # Host-timestamped dispatch windows (round-4 verdict task 6
+            # fallback): backends without host callbacks (the tunneled
+            # axon chip — tracer.callbacks_supported) cannot carry
+            # in-graph phase markers, so traced iterations run as FENCED
+            # dispatches instead: (1) a forward-only loss, fenced by
+            # device_get — the 'forward' span; (2) the full step, fenced
+            # — the 'backward' span, whose attrs carry the honest
+            # arithmetic (it re-runs the forward and includes the
+            # optimizer; backward_est_ms = span - forward). Cost (one
+            # extra forward + two fences) is confined to traced
+            # iterations — the reference's per-window tracing perturbs
+            # its traced iterations the same way.
+            log_fn("trace: backend lacks host callbacks; using fenced "
+                   "dispatch windows for schedule-phase spans")
+            if ctx.pp > 1:
+                _fwd_only = jax.jit(lambda p, b: loss_fn(p, b)[0])
+            else:
+                def _fwd_loss(p, b):
+                    def body(acc, micro):
+                        l, _ = loss_fn(p, micro)
+                        return acc + l, None
+                    tot, _ = jax.lax.scan(
+                        body, jnp.zeros((), jnp.float32), b)
+                    return tot / jax.tree.leaves(b)[0].shape[0]
+                _fwd_only = jax.jit(_fwd_loss)
+
+            def fenced_step(state, batch):
+                import time as _time
+                t0 = _time.perf_counter()
+                with tracer.scope("forward", fenced=True):
+                    jax.device_get(_fwd_only(state["params"], batch))
+                fwd_ms = (_time.perf_counter() - t0) * 1e3
+                with tracer.scope("backward", fenced=True,
+                                  includes="fwd_rerun+optimizer",
+                                  forward_ms=round(fwd_ms, 3)) as tr:
+                    new_state, metrics = step_fn(state, batch)
+                    jax.device_get(metrics["loss"])
+                    tr.set_attr(backward_est_ms=round(
+                        (_time.perf_counter() - t0) * 1e3 - 2 * fwd_ms,
+                        3))
+                return new_state, metrics
+
+            # The profiler-collectives join still needs compiled HLO;
+            # the fenced wrapper exposes the underlying jitted step.
+            fenced_step._hlo_source = step_fn
+            traced_step_fn = fenced_step
 
     # Per-collective events via the XLA profiler (reference
     # mappings.py:27-60 group+bytes instrumentation; here synthesized
@@ -286,10 +330,12 @@ def pretrain_gpt(
     _coll = {"hlo": {}, "window": -1}
 
     def run_step_maybe_profiled(active_fn, state, batch, it):
-        if (not tracer.active or not hasattr(active_fn, "lower") or
+        # Fenced traced steps expose their inner jitted step for the HLO
+        # join; host-driven (DPP) steps have no single lowered HLO at
+        # all — the runner's metrics cover them.
+        hlo_source = getattr(active_fn, "_hlo_source", active_fn)
+        if (not tracer.active or not hasattr(hlo_source, "lower") or
                 train_cfg.trace_granularity not in ("full", "collective")):
-            # Host-driven (DPP) steps have no single lowered HLO to join
-            # profiler events against — the runner's metrics cover them.
             return active_fn(state, batch)
         window = it // tracer.interval
         if window == _coll["window"]:
@@ -308,7 +354,7 @@ def pretrain_gpt(
         key = (id(active_fn), shape_key)
         if key not in _coll["hlo"]:
             try:
-                compiled = active_fn.lower(state, batch).compile()
+                compiled = hlo_source.lower(state, batch).compile()
                 _coll["hlo"][key] = extract_hlo_collectives(
                     compiled.as_text(), ctx.mesh)
             except Exception as e:  # pragma: no cover — backend-specific

@@ -179,6 +179,38 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-5)
 
+    def test_segment_gqa_grads_compose(self):
+        """GQA × packed segments through the transposed kernels: the
+        grouped-KV BlockSpecs and the transposed segment mask must
+        compose (each was tested alone above)."""
+        b, s, d = 2, 64, 32
+        q, k, v = make_qkv(b=b, s=s, h=4, hkv=2, d=d)
+        seg = jnp.concatenate([jnp.zeros((b, 24), jnp.int32),
+                               jnp.ones((b, s - 24), jnp.int32)], axis=1)
+
+        def seg_oracle(args):
+            qq, kk, vv = args
+            kk = jnp.repeat(kk, 2, axis=2)   # GQA: expand KV heads
+            vv = jnp.repeat(vv, 2, axis=2)
+            scale = 1.0 / (d ** 0.5)
+            sc = jnp.einsum("bqhd,bkhd->bhqk", qq, kk) * scale
+            mask = (seg[:, None, :, None] == seg[:, None, None, :])
+            mask = mask & jnp.tril(jnp.ones((s, s), jnp.bool_))[None, None]
+            p = jax.nn.softmax(jnp.where(mask, sc, -1e30), axis=-1)
+            return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p, vv) ** 2)
+
+        def loss_f(args):
+            return jnp.sum(flash_attention(
+                *args, causal=True, block_q=32, block_kv=32,
+                segment_ids=seg) ** 2)
+
+        gf = jax.grad(loss_f)((q, k, v))
+        gr = jax.grad(seg_oracle)((q, k, v))
+        for a, b_ in zip(gf, gr):
+            assert bool(jnp.all(jnp.isfinite(a)))
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=5e-5)
+
     def test_gqa_grads(self):
         q, k, v = make_qkv(s=64, h=4, hkv=2, d=16)
 

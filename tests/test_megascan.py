@@ -263,3 +263,56 @@ class TestTraceAnalytics:
         assert report["phases"], report
         for pid, d in report["compute_comm"].items():
             assert 0.0 <= d["comm_fraction"] <= 1.0
+
+
+class TestFencedPhaseSpans:
+    def test_fenced_spans_without_callbacks(self, devices8, tmp_path,
+                                            monkeypatch):
+        """Backends without host callbacks (the tunneled axon chip) get
+        schedule-phase spans from FENCED dispatches: traced iterations
+        run a forward-only fenced dispatch then the full fenced step, so
+        'forward'/'backward' spans exist with honest attribution attrs
+        (fenced=True, includes, backward_est_ms) — round-4 verdict task
+        6's no-profiler fallback, exercised on CPU by forcing the
+        capability probe off."""
+        from megatronapp_tpu.config.parallel_config import ParallelConfig
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.training import train as train_mod
+        from megatronapp_tpu.trace import tracer as tracer_mod
+
+        monkeypatch.setattr(tracer_mod, "callbacks_supported",
+                            lambda: False)
+        # train.py imports the symbol at call time from the module.
+        trace_dir = str(tmp_path / "trace")
+        model = TransformerConfig(num_layers=2, hidden_size=64,
+                                  num_attention_heads=4, vocab_size=128,
+                                  max_position_embeddings=64)
+        par = ParallelConfig()
+        ctx = build_mesh(par, devices=devices8[:1])
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=4,
+                               seq_length=32, train_iters=4,
+                               log_interval=2, trace=True,
+                               trace_dir=trace_dir, trace_interval=2,
+                               continuous_trace_iterations=1)
+        train_mod.pretrain_gpt(model, par, train, OptimizerConfig(lr=1e-3),
+                               ctx=ctx, log_fn=lambda s: None)
+
+        trace = aggregate_dir(trace_dir,
+                              os.path.join(trace_dir, "agg.json"))
+        spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        fwd = [e for e in spans if e["name"] == "forward"
+               and e.get("args", {}).get("fenced")]
+        bwd = [e for e in spans if e["name"] == "backward"
+               and e.get("args", {}).get("fenced")]
+        assert fwd, "no fenced forward spans"
+        assert bwd, "no fenced backward spans"
+        for e in bwd:
+            assert e["args"]["includes"] == "fwd_rerun+optimizer"
+            assert "backward_est_ms" in e["args"]
+            assert "forward_ms" in e["args"]
