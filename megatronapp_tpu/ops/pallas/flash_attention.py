@@ -32,6 +32,14 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _force_straight() -> bool:
+    """FLASH_STRAIGHT_ORIENTATION=1 pins the straight-orientation
+    kernels even for D<128 — the measurement knob for A/Bing the
+    transposed orientation on real hardware (tools/bench_profile.py)."""
+    import os
+    return os.environ.get("FLASH_STRAIGHT_ORIENTATION") == "1"
+
+
 def _cdiv(a, b):
     return (a + b - 1) // b
 
@@ -365,7 +373,7 @@ def _flash_forward(q, k, v, scale, causal, block_q, block_kv, segs=None):
     nk = _cdiv(skv, block_kv)
 
     bounded = (sq % block_q != 0) or (skv % block_kv != 0)
-    if d < 128:
+    if d < 128 and not _force_straight():
         return _flash_forward_t(q, k, v, scale, causal, block_q, block_kv,
                                 nq, nk, bounded, group, segs)
 
@@ -714,7 +722,7 @@ def _flash_backward(res, g, scale, causal, block_q, block_kv, segs=None):
 
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)  # [B,H,Sq]
-    if d < 128:
+    if d < 128 and not _force_straight():
         return _flash_backward_t(
             q, k, v, g, lse, delta, scale, causal, block_q, block_kv,
             nq, nk, bounded, group, segs)
