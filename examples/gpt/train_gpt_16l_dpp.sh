@@ -1,9 +1,14 @@
 #!/bin/bash
-# MegaDPP breadth-first-chunk schedule (reference --use-dpp).
+# MegaDPP (reference --use-dpp). On a pure-pp layout (dp=tp=cp=ep=1)
+# this engages the DYNAMIC runtime: host-driven fwd+bwd through the
+# readiness-first scheduler (runtime/dpp_train.py), per-phase
+# transfer-order/stall metrics in the step logs. On layouts the host
+# runner cannot place (e.g. tp>1), training falls back to the static
+# breadth-first-chunk SPMD schedule with a log line.
 python pretrain_gpt.py \
     --num-layers 16 --hidden-size 2048 --num-attention-heads 32 \
     --seq-length 2048 --max-position-embeddings 2048 \
     --micro-batch-size 2 --global-batch-size 16 \
-    --tensor-model-parallel-size 2 --pipeline-model-parallel-size 2 \
+    --pipeline-model-parallel-size 2 \
     --num-layers-per-virtual-pipeline-stage 4 --use-dpp \
     --train-iters 100 --lr 1e-4 "$@"

@@ -104,6 +104,21 @@ def main():
         q, q, q, causal=True).astype(jnp.float32).sum()))
     entry("flash_attn_fwd_bwd", _time_fn(flb, q), attn_flops * 3.5)
 
+    # 3b. orientation A/B: the straight-orientation kernels (pre-round-5)
+    # via the FLASH_STRAIGHT_ORIENTATION knob — attributes the
+    # transposed orientation's win directly (PERF.md round-5 item 1).
+    import os as _os
+    _os.environ["FLASH_STRAIGHT_ORIENTATION"] = "1"
+    try:
+        fl_st = jax.jit(lambda q: flash_attention(q, q, q, causal=True))
+        entry("flash_attn_fwd_straight", _time_fn(fl_st, q), attn_flops)
+        flb_st = jax.jit(jax.grad(lambda q: flash_attention(
+            q, q, q, causal=True).astype(jnp.float32).sum()))
+        entry("flash_attn_fwd_bwd_straight", _time_fn(flb_st, q),
+              attn_flops * 3.5)
+    finally:
+        del _os.environ["FLASH_STRAIGHT_ORIENTATION"]
+
     dn = jax.jit(lambda q: dot_product_attention(q, q, q))
     entry("dense_attn_fwd", _time_fn(dn, q), attn_flops)
     dnb = jax.jit(jax.grad(lambda q: dot_product_attention(
