@@ -60,7 +60,10 @@ def make_dpp_gpt_value_and_grad(cfg, devices, vpp: int = 1,
     rope_cache: Dict[int, Tuple[Any, Any]] = {}
 
     def chunk_fwd(stage: int, chunk: int, lc: int, s: int) -> Callable:
-        key = (stage, chunk)
+        # Keyed on s as well: the closure bakes in the rope tables for
+        # one sequence length, and a shape change (rampup, eval stream)
+        # must re-derive them rather than reuse stale tables.
+        key = (stage, chunk, s)
         if key not in chunk_fwd_cache:
             offset = (chunk * pp + stage) * lc
             if s not in rope_cache:
