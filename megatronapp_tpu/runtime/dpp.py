@@ -221,6 +221,14 @@ class DppPipelineRunner:
         # ships ready work late; see tests/test_dpp_runtime.py).
         ship_log: List[Dict[Tuple[int, int], float]] = [
             {} for _ in range(pp)]
+        # Absolute (perf_counter) compute/transfer windows per
+        # (chunk, mb) — the raw material for MegaScan trace events
+        # (trace_events(); the reference's tracer sees its shm/RDMA
+        # sends the same way).
+        compute_spans: List[Dict[Tuple[int, int], Tuple[float, float]]] = [
+            {} for _ in range(pp)]
+        send_spans: List[Dict[Tuple[int, int], Tuple[float, float]]] = [
+            {} for _ in range(pp)]
         t_run0 = time.perf_counter()
 
         for (c, m), h in seeds.items():
@@ -235,9 +243,12 @@ class DppPipelineRunner:
                     # schedule order when nothing is late).
                     t0 = time.perf_counter()
                     (c, m), h = inboxes[stage].pop_best(keyfn)
-                    compute_wait[stage] += time.perf_counter() - t0
+                    t1 = time.perf_counter()
+                    compute_wait[stage] += t1 - t0
                     h = exec_fn(stage, c, h, m)
                     jax.block_until_ready(h)
+                    compute_spans[stage][(c, m)] = (
+                        t1, time.perf_counter() - t1)
                     finished[stage].put((c, m), h)
             except BaseException as e:  # noqa: BLE001 — surfaced below
                 errors.append(e)
@@ -261,10 +272,13 @@ class DppPipelineRunner:
                         continue
                     nxt_stage, nxt_chunk = hop
                     pools[stage].acquire()
+                    t_send = time.perf_counter()
                     try:
                         h = jax.device_put(h, self.devices[nxt_stage])
                         jax.block_until_ready(h)
                     finally:
+                        send_spans[stage][(c, m)] = (
+                            t_send, time.perf_counter() - t_send)
                         pools[stage].release()
                     inboxes[nxt_stage].put((nxt_chunk, m), h)
             except BaseException as e:  # noqa: BLE001
@@ -292,6 +306,8 @@ class DppPipelineRunner:
         self.sender_stall_s = sender_stall
         self.compute_wait_s = compute_wait
         self.pool_stall_s = [p.stall_s for p in pools]
+        self.compute_spans = compute_spans
+        self.send_spans = send_spans
         return outputs
 
     def run(self, microbatch_inputs: Sequence[Any]) -> List[Any]:
@@ -391,4 +407,35 @@ class DppPipelineRunner:
             "compute_wait_s": self.compute_wait_s,
             "pool_stall_s": self.pool_stall_s,
             "wall_s": self.wall_s,
+            "compute_spans": self.compute_spans,
+            "send_spans": self.send_spans,
         }
+
+    def trace_events(self, t0: float) -> List[Dict[str, Any]]:
+        """MegaScan records for the last run_train: per-(chunk, mb)
+        compute and transfer spans on per-stage timelines (pid
+        5000+stage — disjoint from process pids and the profiler-device
+        1000-range), ts/dur in microseconds relative to ``t0`` (a
+        perf_counter taken at step entry). The reference's tracer shows
+        its shm/RDMA transport activity the same way (its SendOp/RecvOp
+        rows); feed through Tracer.add_collective_records."""
+        events: List[Dict[str, Any]] = []
+        for phase, metrics in (
+                ("forward", getattr(self, "fwd_metrics", None)),
+                ("backward", getattr(self, "bwd_metrics", None))):
+            if not metrics:
+                continue
+            for kind, tid, per_stage in (
+                    ("dpp-compute", 0, metrics["compute_spans"]),
+                    ("dpp-send", 1, metrics["send_spans"])):
+                for stage, spans in enumerate(per_stage):
+                    for (c, m), (t_abs, dur) in spans.items():
+                        events.append({
+                            "name": kind, "ph": "X",
+                            "pid": 5000 + stage, "tid": tid,
+                            "ts": (t_abs - t0) * 1e6,
+                            "dur": dur * 1e6,
+                            "args": {"stage": stage, "chunk": c,
+                                     "mb": m, "dir": phase},
+                        })
+        return events

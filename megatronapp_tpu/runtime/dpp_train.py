@@ -265,7 +265,20 @@ def make_dpp_train_step(optimizer, opt_cfg, cfg, devices, train_iters: int,
         apply = jax.jit(apply)
 
     def step(state, batch):
+        import time as _time
+
+        from megatronapp_tpu.trace.tracer import get_tracer
+        tracer = get_tracer()
+        tracing = tracer.enabled and tracer.active
+        t0 = _time.perf_counter()
+        anchor = tracer.now_in_iteration_us() if tracing else None
         loss, grads, aux, runner = vg(state["params"], batch)
+        if tracing:
+            # Per-(chunk, mb) compute/transfer spans on per-stage
+            # timelines — MegaScan sees the DPP transport like the
+            # reference's tracer sees its shm/RDMA sends.
+            tracer.add_collective_records(runner.trace_events(t0),
+                                          offset_us=anchor)
         # The loss lands on the last stage device (head placement) and
         # grads on the first; re-lay them out for the update step (which
         # keeps the state in the driver's mesh layout when given).

@@ -275,3 +275,57 @@ class TestDppTrainStep:
         # Same data, same init, fp32: the two executors track each other.
         np.testing.assert_allclose(losses[True], losses[False],
                                    rtol=2e-3, atol=2e-3)
+
+    def test_traced_dpp_run_emits_transport_spans(self, devices8,
+                                                  tmp_path):
+        """MegaScan over a --use-dpp run shows the dynamic transport:
+        per-(chunk, mb) dpp-compute/dpp-send X spans on per-stage
+        timelines (the reference's tracer sees its shm/RDMA sends; ours
+        sees the runner's) for BOTH pipeline directions."""
+        import json as _json
+        import os
+
+        from tests.test_training import learnable_batches
+
+        from megatronapp_tpu.config.parallel_config import ParallelConfig
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.trace.aggregate import aggregate_dir
+        from megatronapp_tpu.training.train import pretrain_gpt
+
+        trace_dir = str(tmp_path / "trace")
+        model = TransformerConfig(
+            num_layers=4, hidden_size=64, num_attention_heads=4,
+            vocab_size=128, max_position_embeddings=64,
+            remat_policy="none", compute_dtype=jnp.float32)
+        par = ParallelConfig(pipeline_parallel=2,
+                             virtual_pipeline_parallel=2,
+                             use_dpp=True, pipeline_order_policy="bfc")
+        ctx = build_mesh(par, devices=devices8[:2])
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                               seq_length=32, train_iters=4,
+                               log_interval=2, eval_interval=0,
+                               trace=True, trace_dir=trace_dir,
+                               trace_interval=2,
+                               continuous_trace_iterations=1)
+        pretrain_gpt(model, par, train, OptimizerConfig(lr=1e-3), ctx=ctx,
+                     batch_iter=learnable_batches(32, 128, 8),
+                     log_fn=lambda s: None)
+
+        trace = aggregate_dir(trace_dir,
+                              os.path.join(trace_dir, "agg.json"))
+        ev = [e for e in trace["traceEvents"]
+              if e.get("name") in ("dpp-compute", "dpp-send")]
+        assert ev, "no dpp transport spans in the trace"
+        dirs = {e["args"]["dir"] for e in ev}
+        assert dirs == {"forward", "backward"}, dirs
+        stages = {e["args"]["stage"] for e in ev}
+        assert stages == {0, 1}, stages
+        sends = [e for e in ev if e["name"] == "dpp-send"]
+        assert all({"chunk", "mb"} <= set(e["args"]) for e in sends)
+        assert all(e["dur"] >= 0 for e in ev)
