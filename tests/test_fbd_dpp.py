@@ -294,7 +294,12 @@ class TestShmRing:
         arrs = [rng.normal(size=(64, 64)).astype(np.float32)
                 for _ in range(8)]
         ring = ShmRing(name, capacity=1 << 20)
-        proc = mp.Process(target=_producer_proc, args=(name, arrs))
+        # spawn, not fork: this process has live JAX threads and fork()
+        # under them draws a RuntimeWarning (and real deadlock risk);
+        # the producer only touches numpy + the ring, so a fresh
+        # interpreter is cheap.
+        proc = mp.get_context("spawn").Process(
+            target=_producer_proc, args=(name, arrs))
         proc.start()
         got = []
         deadline = time.time() + 30
