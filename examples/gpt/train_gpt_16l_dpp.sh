@@ -1,7 +1,8 @@
 #!/bin/bash
-# MegaDPP (reference --use-dpp). On a pure-pp layout (dp=tp=cp=ep=1)
-# this engages the DYNAMIC runtime: host-driven fwd+bwd through the
-# readiness-first scheduler (runtime/dpp_train.py), per-phase
+# MegaDPP (reference --use-dpp). On a pp (optionally x dp) layout with
+# tp=cp=ep=1 this engages the DYNAMIC runtime: host-driven fwd+bwd
+# through the readiness-first scheduler (runtime/dpp_train.py; one
+# pipeline per dp replica, mask-weighted grad combine), per-phase
 # transfer-order/stall metrics in the step logs. On layouts the host
 # runner cannot place (e.g. tp>1), training falls back to the static
 # breadth-first-chunk SPMD schedule with a log line.

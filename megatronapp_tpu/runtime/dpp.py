@@ -411,11 +411,13 @@ class DppPipelineRunner:
             "send_spans": self.send_spans,
         }
 
-    def trace_events(self, t0: float) -> List[Dict[str, Any]]:
+    def trace_events(self, t0: float,
+                     pid_base: int = 5000) -> List[Dict[str, Any]]:
         """MegaScan records for the last run_train: per-(chunk, mb)
         compute and transfer spans on per-stage timelines (pid
-        5000+stage — disjoint from process pids and the profiler-device
-        1000-range), ts/dur in microseconds relative to ``t0`` (a
+        pid_base+stage — default 5000, disjoint from process pids and
+        the profiler-device 1000-range; dp replicas pass distinct
+        bases), ts/dur in microseconds relative to ``t0`` (a
         perf_counter taken at step entry). The reference's tracer shows
         its shm/RDMA transport activity the same way (its SendOp/RecvOp
         rows); feed through Tracer.add_collective_records."""
@@ -432,7 +434,7 @@ class DppPipelineRunner:
                     for (c, m), (t_abs, dur) in spans.items():
                         events.append({
                             "name": kind, "ph": "X",
-                            "pid": 5000 + stage, "tid": tid,
+                            "pid": pid_base + stage, "tid": tid,
                             "ts": (t_abs - t0) * 1e6,
                             "dur": dur * 1e6,
                             "args": {"stage": stage, "chunk": c,

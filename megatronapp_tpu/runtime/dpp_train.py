@@ -10,37 +10,60 @@ devices, readiness-driven transfer ordering, and a real backward sweep
 through the same scheduler (reference backward_send,
 shm_tensor_new_rdma.cpp:1550-1646) — instead of the jitted SPMD schedule.
 
-Scope (guarded with actionable errors): pure pipeline parallelism
-(dp = tp = cp = ep = 1 — the host runner places one stage per device),
-no MTP, no packed segments. Embedding runs on the first stage device and
-the LM head + loss on the last, the reference's stage placement.
-Numerics match ``gpt_pipeline_loss`` + ``spmd_pipeline`` (layer offset
+Layouts: pp over stage devices, optionally × dp — each data-parallel
+replica runs its own host pipeline over its own pp devices on its shard
+of every microbatch. Combine weights ride the cotangent seeds (CE:
+w_r/W mask-token shares — exactly the SPMD path's global masked-mean
+decomposition; aux: 1/dp), so gradient trees plain-sum and a fully
+masked shard still backprops its aux losses. MoE aux terms use
+PER-REPLICA batch statistics — the reference's own DDP semantics (each
+rank's router sees its tokens), approximately equal to the SPMD path's
+global-batch statistics for the nonlinear load-balance term. Still guarded with actionable errors: tp = cp = ep = 1
+(the host runner places one stage per device), no MTP, no packed
+segments. Embedding runs on each replica's first stage device and the
+LM head + loss on its last, the reference's stage placement. Numerics
+match ``gpt_pipeline_loss`` + ``spmd_pipeline`` (layer offset
 (chunk*pp + stage)*Lc, per-injection compute-dtype cast, aux summed over
-stage-chunk-mb then /M) — pinned by the golden-parity test in
+stage-chunk-mb then /M) — pinned by the golden-parity tests in
 tests/test_dpp_runtime.py.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Tuple
+import threading
+from typing import Any, Callable, Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from megatronapp_tpu.runtime.dpp import DppPipelineRunner
+
+
+def _device_grid(devices) -> List[List[Any]]:
+    """Normalize to [pp][dp]: a flat sequence means dp=1."""
+    arr = np.asarray(devices, dtype=object)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    if arr.ndim != 2:
+        raise ValueError(f"devices must be [pp] or [pp][dp], got shape "
+                         f"{arr.shape}")
+    return [list(row) for row in arr]
 
 
 def make_dpp_gpt_value_and_grad(cfg, devices, vpp: int = 1,
                                 policy: str = "dfc", dynamic: bool = True,
                                 n_buffers: int = 4,
                                 jitter=None):
-    """Build vg(params, batch_mb) -> (loss, grads, metrics, runner).
+    """Build vg(params, batch_mb) -> (loss, grads, metrics, runners).
 
     batch_mb: {'tokens','labels','loss_mask': [M, mb, S]}. params is the
     full GPT pytree with params['block'] stacked [pp, vpp, Lc, ...]
-    (models/gpt.py reshape convention). The returned callable reuses its
-    jitted chunk/head/embed closures across steps, so steady-state calls
-    do not recompile.
+    (models/gpt.py reshape convention). devices: [pp] stage devices, or
+    [pp][dp] for data-parallel replicas (each column runs one pipeline
+    on its batch shard). The returned callable reuses its jitted
+    chunk/head/embed closures across steps and replicas, so
+    steady-state calls do not recompile.
     """
     from megatronapp_tpu.models.gpt import (
         gpt_embed, gpt_head, gpt_rope_tables,
@@ -51,12 +74,14 @@ def make_dpp_gpt_value_and_grad(cfg, devices, vpp: int = 1,
     if getattr(cfg, "mtp_num_layers", 0):
         raise NotImplementedError(
             "the DPP runtime step does not support multi-token prediction "
-            "yet; drop --mtp-num-layers or --dpp-runtime")
-    pp = len(devices)
+            "yet; drop --mtp-num-layers or --use-dpp")
+    grid = _device_grid(devices)
+    pp, dp = len(grid), len(grid[0])
 
-    # One jitted forward per (stage, chunk) — the layer offset is baked
-    # in, matching spmd_pipeline's (chunk*pp + stage)*Lc indexing.
-    chunk_fwd_cache: Dict[Tuple[int, int], Callable] = {}
+    # One jitted forward per (stage, chunk, seq) — the layer offset is
+    # baked in, matching spmd_pipeline's (chunk*pp + stage)*Lc indexing;
+    # replicas share the callables (jit re-specializes per device).
+    chunk_fwd_cache: Dict[Tuple[int, int, int], Callable] = {}
     rope_cache: Dict[int, Tuple[Any, Any]] = {}
 
     def chunk_fwd(stage: int, chunk: int, lc: int, s: int) -> Callable:
@@ -88,16 +113,17 @@ def make_dpp_gpt_value_and_grad(cfg, devices, vpp: int = 1,
         ce, _ = cross_entropy_loss(logits, targets_mb, loss_mask_mb)
         return ce
 
-    def vg(params, batch_mb):
-        tokens_mb = jnp.asarray(batch_mb["tokens"])
-        targets_mb = jnp.asarray(batch_mb["labels"])
-        loss_mask_mb = batch_mb.get("loss_mask")
-        if loss_mask_mb is not None:
-            loss_mask_mb = jnp.asarray(loss_mask_mb)
-        if batch_mb.get("segment_ids") is not None:
-            raise NotImplementedError(
-                "the DPP runtime step does not support packed segments "
-                "yet; unpack the batch or drop --dpp-runtime")
+    def _replica_vg(params, tokens_mb, targets_mb, loss_mask_mb,
+                    rdevs, ce_seed: float, aux_seed: float, replica: int):
+        """One data-parallel replica's full fwd+bwd on its pp devices.
+
+        Returns (ce, aux, grads-on-rdevs[0], runner). The combine
+        weights ride the cotangent SEEDS — ce_seed = w_r/W (this
+        replica's mask-token share) on the head loss, aux_seed = 1/dp
+        on every chunk's aux output — so the caller combines gradient
+        trees by PLAIN SUM and the aux gradients survive even a fully
+        masked shard (w_r = 0 zeroes only the CE part, exactly like the
+        SPMD step)."""
         m, mb, s = tokens_mb.shape
         pipe = params["block"]
         lc = jax.tree.leaves(pipe)[0].shape[2]
@@ -108,20 +134,20 @@ def make_dpp_gpt_value_and_grad(cfg, devices, vpp: int = 1,
         # residency the reference gets from per-rank ownership).
         placed = [[jax.device_put(
             jax.tree.map(lambda x, s_=st, c_=c: x[s_, c_], pipe),
-            devices[st]) for c in range(vpp)] for st in range(pp)]
+            rdevs[st]) for c in range(vpp)] for st in range(pp)]
 
         # Embed/head touch only the non-block params; place those copies
         # explicitly (params may arrive mesh-sharded from the SPMD-layout
         # train state — a single jit must not see mixed assignments).
         light = {k: v for k, v in params.items() if k != "block"}
-        light_first = jax.device_put(light, devices[0])
-        light_last = jax.device_put(light, devices[-1])
+        light_first = jax.device_put(light, rdevs[0])
+        light_last = jax.device_put(light, rdevs[-1])
 
         # Embedding on the first stage device.
-        with jax.default_device(devices[0]):
+        with jax.default_device(rdevs[0]):
             h_flat, embed_vjp = jax.vjp(
                 f_embed, light_first,
-                jax.device_put(tokens_mb, devices[0]).reshape(m * mb, s))
+                jax.device_put(tokens_mb, rdevs[0]).reshape(m * mb, s))
         h_mb = h_flat.reshape(m, mb, s, -1)
 
         aux_parts = []
@@ -137,8 +163,11 @@ def make_dpp_gpt_value_and_grad(cfg, devices, vpp: int = 1,
             aux_parts.append(a)
 
             def wrapped(g_y, _vjp=vjp):
-                # Each chunk's aux loss enters the total as aux_sum / M.
-                return _vjp((g_y, jnp.asarray(1.0 / m, jnp.float32)))
+                # Each chunk's aux loss enters the total as
+                # aux_sum / (M · dp) — the seed carries the replica
+                # weighting (see docstring).
+                return _vjp((g_y, jnp.asarray(aux_seed / m,
+                                              jnp.float32)))
 
             return y, wrapped
 
@@ -146,23 +175,23 @@ def make_dpp_gpt_value_and_grad(cfg, devices, vpp: int = 1,
 
         def seed_grads_fn(outputs):
             out_stack = jnp.stack(
-                [jax.device_put(o, devices[-1]) for o in outputs])
+                [jax.device_put(o, rdevs[-1]) for o in outputs])
             # Head runs on the last stage device: co-locate its operands.
-            targets_last = jax.device_put(targets_mb, devices[-1])
+            targets_last = jax.device_put(targets_mb, rdevs[-1])
             mask_last = (None if loss_mask_mb is None
-                         else jax.device_put(loss_mask_mb, devices[-1]))
-            with jax.default_device(devices[-1]):
+                         else jax.device_put(loss_mask_mb, rdevs[-1]))
+            with jax.default_device(rdevs[-1]):
                 ce, head_vjp = jax.vjp(
                     f_head, light_last, out_stack, targets_last,
                     mask_last)
                 g_params_head, g_out, _, _ = head_vjp(
-                    jnp.ones((), ce.dtype))
+                    jnp.asarray(ce_seed, ce.dtype))
             loss_box["ce"] = ce
             loss_box["g_params_head"] = g_params_head
             return [g_out[i] for i in range(m)], None
 
         runner = DppPipelineRunner(
-            None, devices, pp, vpp, m, policy=policy, dynamic=dynamic,
+            None, rdevs, pp, vpp, m, policy=policy, dynamic=dynamic,
             n_buffers=n_buffers)
         _, block_grads, input_grads, _ = runner.run_train(
             [h_mb[i].astype(compute_dtype) for i in range(m)],
@@ -170,7 +199,7 @@ def make_dpp_gpt_value_and_grad(cfg, devices, vpp: int = 1,
 
         # Assemble the stacked [pp, vpp, Lc, ...] block gradient.
         def on0(t):
-            return jax.tree.map(lambda x: jax.device_put(x, devices[0]), t)
+            return jax.tree.map(lambda x: jax.device_put(x, rdevs[0]), t)
 
         per_stage = [
             jax.tree.map(lambda *cs: jnp.stack(cs),
@@ -183,12 +212,12 @@ def make_dpp_gpt_value_and_grad(cfg, devices, vpp: int = 1,
 
         # Embedding grad: the runner consumed h.astype(compute_dtype), so
         # chain the cast back to fp32 by hand.
-        dh_mb = jnp.stack([jax.device_put(g, devices[0])
+        dh_mb = jnp.stack([jax.device_put(g, rdevs[0])
                            for g in input_grads]).astype(jnp.float32)
         g_params_embed, _ = embed_vjp(dh_mb.reshape(m * mb, s, -1))
 
         g_params_head = jax.tree.map(
-            lambda x: jax.device_put(x, devices[0]),
+            lambda x: jax.device_put(x, rdevs[0]),
             loss_box["g_params_head"])
         grads = jax.tree.map(lambda a, b: a + b,
                              g_params_embed, g_params_head)
@@ -197,10 +226,77 @@ def make_dpp_gpt_value_and_grad(cfg, devices, vpp: int = 1,
 
         aux_total = sum(jax.device_get(a) for a in aux_parts)
         aux = jnp.asarray(aux_total, jnp.float32) / m
-        ce = loss_box["ce"]
+        return loss_box["ce"], aux, grads, runner
+
+    def vg(params, batch_mb):
+        tokens_mb = jnp.asarray(batch_mb["tokens"])
+        targets_mb = jnp.asarray(batch_mb["labels"])
+        loss_mask_mb = batch_mb.get("loss_mask")
+        if loss_mask_mb is not None:
+            loss_mask_mb = jnp.asarray(loss_mask_mb)
+        if batch_mb.get("segment_ids") is not None:
+            raise NotImplementedError(
+                "the DPP runtime step does not support packed segments "
+                "yet; unpack the batch or drop --use-dpp")
+        m, mb, s = tokens_mb.shape
+        if mb % dp:
+            raise ValueError(
+                f"per-microbatch batch {mb} not divisible by dp={dp} "
+                "under the DPP runtime")
+        shard = mb // dp
+        sls = [slice(r * shard, (r + 1) * shard) for r in range(dp)]
+        # Mask-token weights: the SPMD path's CE is a masked mean over
+        # the GLOBAL batch, which decomposes exactly as
+        # sum_r w_r*ce_r / sum_r w_r with w_r the replica's mask sum.
+        if loss_mask_mb is not None:
+            w = [float(jnp.sum(loss_mask_mb[:, sl])) for sl in sls]
+        else:
+            w = [float(m * shard * s)] * dp
+        W = sum(w) or 1.0
+
+        results: List[Any] = [None] * dp
+        errors: List[BaseException] = []
+
+        def run_replica(r):
+            try:
+                results[r] = _replica_vg(
+                    params, tokens_mb[:, sls[r]], targets_mb[:, sls[r]],
+                    None if loss_mask_mb is None
+                    else loss_mask_mb[:, sls[r]],
+                    [grid[st][r] for st in range(pp)],
+                    ce_seed=w[r] / W, aux_seed=1.0 / dp, replica=r)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(RuntimeError(
+                    f"DPP replica {r} failed: {e!r}"))
+                errors[-1].__cause__ = e
+
+        if dp == 1:
+            run_replica(0)
+        else:
+            ts = [threading.Thread(target=run_replica, args=(r,),
+                                   daemon=True) for r in range(dp)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        if errors:
+            raise errors[0]
+
+        dev0 = grid[0][0]
+        ce = sum((w[r] / W) * jax.device_put(results[r][0], dev0)
+                 for r in range(dp))
+        aux = sum(jax.device_put(results[r][1], dev0)
+                  for r in range(dp)) / dp
+        # Plain sum: the combine weights already rode the cotangent
+        # seeds (ce_seed/aux_seed), so loss and gradients stay
+        # consistent even for a fully masked shard.
+        grads = jax.tree.map(
+            lambda *gs: sum(jax.device_put(g, dev0) for g in gs),
+            *[results[r][2] for r in range(dp)])
+        runners = [results[r][3] for r in range(dp)]
         loss = ce + aux
         metrics = {"lm_loss": ce, "moe_aux_loss": aux}
-        return loss, grads, metrics, runner
+        return loss, grads, metrics, runners
 
     return vg
 
@@ -209,10 +305,11 @@ def make_dpp_train_step(optimizer, opt_cfg, cfg, devices, train_iters: int,
                         vpp: int = 1, policy: str = "dfc",
                         dynamic: bool = True, check_nan: bool = True,
                         state_shardings=None, jitter=None):
-    """Drop-in for make_train_step when the DPP runtime drives pp: the
-    value-and-grad half runs host-driven through the dynamic scheduler;
-    the optimizer half is one jitted update (same NaN gate, grad norm,
-    lr schedule and metrics contract as training/train_step.py).
+    """Drop-in for make_train_step when the DPP runtime drives pp (×dp):
+    the value-and-grad half runs host-driven through the dynamic
+    scheduler; the optimizer half is one jitted update (same NaN gate,
+    grad norm, lr schedule and metrics contract as
+    training/train_step.py).
 
     state_shardings: when given (the train driver's mesh shardings), the
     update step keeps the state in that layout across iterations so the
@@ -222,6 +319,7 @@ def make_dpp_train_step(optimizer, opt_cfg, cfg, devices, train_iters: int,
         global_grad_norm, lr_schedule,
     )
 
+    grid = _device_grid(devices)
     sched = lr_schedule(opt_cfg, train_iters)
     vg = make_dpp_gpt_value_and_grad(cfg, devices, vpp=vpp, policy=policy,
                                      dynamic=dynamic, jitter=jitter)
@@ -272,18 +370,21 @@ def make_dpp_train_step(optimizer, opt_cfg, cfg, devices, train_iters: int,
         tracing = tracer.enabled and tracer.active
         t0 = _time.perf_counter()
         anchor = tracer.now_in_iteration_us() if tracing else None
-        loss, grads, aux, runner = vg(state["params"], batch)
+        loss, grads, aux, runners = vg(state["params"], batch)
         if tracing:
             # Per-(chunk, mb) compute/transfer spans on per-stage
             # timelines — MegaScan sees the DPP transport like the
-            # reference's tracer sees its shm/RDMA sends.
-            tracer.add_collective_records(runner.trace_events(t0),
-                                          offset_us=anchor)
-        # The loss lands on the last stage device (head placement) and
-        # grads on the first; re-lay them out for the update step (which
-        # keeps the state in the driver's mesh layout when given).
+            # reference's tracer sees its shm/RDMA sends. Replica r's
+            # stage rows land on pids 5000+100r+stage.
+            for r, runner in enumerate(runners):
+                tracer.add_collective_records(
+                    runner.trace_events(t0, pid_base=5000 + 100 * r),
+                    offset_us=anchor)
+        # The loss lands on the first replica's lead device and grads
+        # with it; re-lay them out for the update step (which keeps the
+        # state in the driver's mesh layout when given).
         loss = jax.device_put(
-            loss, scalar_sh if scalar_sh is not None else devices[0])
+            loss, scalar_sh if scalar_sh is not None else grid[0][0])
         if param_sh is not None:
             grads = jax.device_put(grads, param_sh)
         new_state, grad_norm, skipped = apply(state, grads, loss)
@@ -294,12 +395,14 @@ def make_dpp_train_step(optimizer, opt_cfg, cfg, devices, train_iters: int,
             "skipped": skipped,
             **aux,
             # Scheduler observables (PERF.md's DPP A/B metrics), per
-            # phase: downstream input wait is the stall DPP ordering
-            # removes.
+            # phase, summed over replicas: downstream input wait is the
+            # stall DPP ordering removes.
             "dpp_fwd_compute_wait_s": sum(
-                runner.fwd_metrics["compute_wait_s"][1:]),
+                sum(ru.fwd_metrics["compute_wait_s"][1:])
+                for ru in runners),
             "dpp_bwd_compute_wait_s": sum(
-                runner.bwd_metrics["compute_wait_s"][:-1]),
+                sum(ru.bwd_metrics["compute_wait_s"][:-1])
+                for ru in runners),
         }
         return new_state, metrics
 

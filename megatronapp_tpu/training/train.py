@@ -213,27 +213,32 @@ def pretrain_gpt(
     # init inside pretrain_body, training.py:746-783): with --use-dpp and
     # a pure-pp layout the step runs host-driven through the
     # DppPipelineRunner (fwd+bwd dynamic scheduling, runtime/dpp_train.py)
-    # instead of the jitted SPMD schedule. Layouts the host runner cannot
-    # place (dp/tp/cp/ep > 1) fall back to the static bfc SPMD order.
+    # instead of the jitted SPMD schedule (one host pipeline per dp
+    # replica). Layouts the host runner cannot place (tp/cp/ep > 1)
+    # fall back to the static bfc SPMD order.
     use_dpp_runtime = False
     if getattr(parallel_cfg, "use_dpp", False) and ctx.pp > 1:
-        if (ctx.dp == ctx.tp == ctx.cp == ctx.ep == 1
+        if (ctx.tp == ctx.cp == ctx.ep == 1
                 and not model_cfg.mtp_num_layers):
             use_dpp_runtime = True
         else:
-            log_fn("dpp: layout has dp/tp/cp/ep > 1 (or MTP) — host "
-                   "runner needs one stage per device; falling back to "
-                   "static bfc SPMD ordering")
+            log_fn("dpp: layout has tp/cp/ep > 1 (or MTP) — host "
+                   "runner needs one stage per device per replica; "
+                   "falling back to static bfc SPMD ordering")
     if use_dpp_runtime:
         from megatronapp_tpu.runtime.dpp_train import make_dpp_train_step
-        stage_devices = list(ctx.mesh.devices.flatten())
+        # Mesh axis order (pp, dp, ep, cp, tp): with ep=cp=tp=1 the
+        # device array reshapes to a [pp][dp] grid — each dp column is
+        # one replica's stage chain.
+        device_grid = ctx.mesh.devices.reshape(ctx.pp, ctx.dp)
         step_fn = make_dpp_train_step(
-            optimizer, opt_cfg, model_cfg, stage_devices,
+            optimizer, opt_cfg, model_cfg, device_grid,
             train_cfg.train_iters, vpp=vpp,
             policy=parallel_cfg.pipeline_order_policy,
             check_nan=train_cfg.check_for_nan_in_loss,
             state_shardings=shardings)
-        log_fn(f"dpp: dynamic runtime active (pp={ctx.pp}, vpp={vpp}, "
+        log_fn(f"dpp: dynamic runtime active (pp={ctx.pp}, dp={ctx.dp}, "
+               f"vpp={vpp}, "
                f"policy={parallel_cfg.pipeline_order_policy})")
     else:
         step_fn = make_train_step(

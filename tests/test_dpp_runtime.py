@@ -195,9 +195,10 @@ class TestDppTrainStep:
 
         vg = make_dpp_gpt_value_and_grad(cfg, devices8[:pp], vpp=vpp,
                                          dynamic=dynamic)
-        loss, grads, metrics, runner = vg(
+        loss, grads, metrics, runners = vg(
             p_pipe, {"tokens": tokens, "labels": labels,
                      "loss_mask": mask})
+        runner = runners[0]
         assert abs(float(loss) - float(ref_loss)) < 1e-5, (
             float(loss), float(ref_loss))
         flat_ref, tree_ref = jax.tree_util.tree_flatten_with_path(ref_grads)
@@ -329,3 +330,108 @@ class TestDppTrainStep:
         sends = [e for e in ev if e["name"] == "dpp-send"]
         assert all({"chunk", "mb"} <= set(e["args"]) for e in sends)
         assert all(e["dur"] >= 0 for e in ev)
+
+    def test_dp_replicated_pipelines_match_spmd(self, devices8):
+        """pp=2 × dp=2: each dp replica runs its own host pipeline on
+        its batch shard; mask-token-weighted grad combine matches the
+        SPMD pp×dp step's loss AND full param grads (a NON-uniform loss
+        mask exercises the weighting)."""
+        from megatronapp_tpu.config.parallel_config import ParallelConfig
+        from megatronapp_tpu.models.gpt import gpt_pipeline_loss
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.runtime.dpp_train import (
+            make_dpp_gpt_value_and_grad,
+        )
+
+        pp, dp, M, mb, s = 2, 2, 4, 2, 8
+        cfg, p_pipe, tokens, labels, _ = self._setup(pp, 1, M=M, mb=mb,
+                                                     s=s)
+        # Non-uniform mask: replica shards carry different token counts.
+        mask = jnp.ones((M, mb, s), jnp.float32)
+        mask = mask.at[:, 1, : s // 2].set(0.0)
+
+        par = ParallelConfig(pipeline_parallel=pp, data_parallel=dp)
+        ctx = build_mesh(par, devices=devices8[:pp * dp])
+        with ctx.mesh:
+            (ref_loss, _), ref_grads = jax.jit(jax.value_and_grad(
+                lambda p: gpt_pipeline_loss(p, tokens, labels, mask, cfg,
+                                            ctx),
+                has_aux=True))(p_pipe)
+
+        grid = ctx.mesh.devices.reshape(pp, dp)
+        vg = make_dpp_gpt_value_and_grad(cfg, grid, vpp=1)
+        loss, grads, metrics, runners = vg(
+            p_pipe, {"tokens": tokens, "labels": labels,
+                     "loss_mask": mask})
+        assert len(runners) == dp
+        assert abs(float(loss) - float(ref_loss)) < 1e-5, (
+            float(loss), float(ref_loss))
+        flat_got = dict(jax.tree_util.tree_flatten_with_path(grads)[0])
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                ref_grads)[0]:
+            np.testing.assert_allclose(
+                np.asarray(flat_got[path]), np.asarray(leaf), atol=2e-4,
+                err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
+
+    def test_fully_masked_shard_keeps_aux_grads(self, devices8):
+        """A dp replica whose shard is FULLY masked contributes zero CE
+        gradient but still backprops its MoE aux losses (the weights
+        ride the cotangent seeds, so loss and grads stay consistent) —
+        parity with the SPMD step pins it."""
+        from megatronapp_tpu.config.parallel_config import ParallelConfig
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.models.gpt import (
+            gpt_pipeline_loss, init_gpt_params,
+        )
+        from megatronapp_tpu.parallel.mesh import build_mesh
+        from megatronapp_tpu.runtime.dpp_train import (
+            make_dpp_gpt_value_and_grad,
+        )
+
+        pp, dp, M, mb, s = 2, 2, 2, 2, 8
+        cfg = TransformerConfig(
+            num_layers=2, hidden_size=64, num_attention_heads=4,
+            vocab_size=128, max_position_embeddings=64,
+            num_moe_experts=4, moe_aux_loss_coeff=0.05,
+            remat_policy="none", compute_dtype=jnp.float32)
+        p_pipe, _ = init_gpt_params(jax.random.PRNGKey(0), cfg, pp=pp)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (M, mb, s),
+                                    0, 128)
+        labels = jnp.roll(tokens, -1, axis=-1)
+        # Replica 1's shard (mb index 1) fully masked.
+        mask = jnp.ones((M, mb, s), jnp.float32).at[:, 1].set(0.0)
+
+        par = ParallelConfig(pipeline_parallel=pp, data_parallel=dp)
+        ctx = build_mesh(par, devices=devices8[:pp * dp])
+        with ctx.mesh:
+            (ref_loss, _), ref_grads = jax.jit(jax.value_and_grad(
+                lambda p: gpt_pipeline_loss(p, tokens, labels, mask, cfg,
+                                            ctx),
+                has_aux=True))(p_pipe)
+
+        grid = ctx.mesh.devices.reshape(pp, dp)
+        vg = make_dpp_gpt_value_and_grad(cfg, grid, vpp=1)
+        loss, grads, metrics, runners = vg(
+            p_pipe, {"tokens": tokens, "labels": labels,
+                     "loss_mask": mask})
+        # MoE aux under dp uses PER-REPLICA batch statistics (the
+        # reference's own DDP semantics — each rank's router sees its
+        # tokens); the SPMD path computes them globally, so parity is
+        # approximate for the nonlinear load-balance term. CE itself
+        # decomposes exactly.
+        assert abs(float(loss) - float(ref_loss)) < 5e-3
+        flat_got = dict(jax.tree_util.tree_flatten_with_path(grads)[0])
+        router_norm = 0.0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                ref_grads)[0]:
+            got = np.asarray(flat_got[path])
+            np.testing.assert_allclose(
+                got, np.asarray(leaf), atol=5e-3,
+                err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
+            if "router" in jax.tree_util.keystr(path):
+                router_norm += float(np.abs(got).sum())
+        # The guarded failure mode: the masked replica's aux gradients
+        # must NOT vanish from the combine.
+        assert router_norm > 1e-6, "router (aux) grads vanished"
