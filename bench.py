@@ -24,10 +24,21 @@ import subprocess
 import sys
 import time
 
-PROBE_TIMEOUT_S = 90
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "90"))
 BENCH_TIMEOUT_S = 600   # two attention impls = two compiles + windows
+LOCAL_TIMEOUT_S = 300   # CPU micro-bench fallback (tiny model, compiles)
 ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", "4"))
 BACKOFF_S = (20, 60, 180)
+
+# Fail-fast (round-5 postmortem: 4 x 90 s probe hangs produced no usable
+# record): a probe TIMEOUT means the tunnel is in its multi-hour hang mode
+# — retrying with backoff never helps within a round, so bail to the CPU
+# fallback after the first one. Probe CRASHES (rc != 0) still retry.
+CPU_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                  + " --xla_force_host_platform_device_count=8").strip(),
+}
 
 # Every successful measurement is persisted here (and committed), so a
 # tunnel hang at end-of-round reports the last real number (stale-flagged)
@@ -66,8 +77,11 @@ def _run_child(mode: str, timeout_s: int, extra_env=None):
             [sys.executable, os.path.abspath(__file__), mode],
             capture_output=True, text=True, timeout=timeout_s, env=env)
     except subprocess.TimeoutExpired:
-        return {"ok": False, "error": f"{mode} timed out after {timeout_s}s "
-                                      "(tunnel hang)"}
+        # `timeout: True` is the structured fail-fast signal — don't key
+        # behavior off the human-readable message.
+        return {"ok": False, "timeout": True,
+                "error": f"{mode} timed out after {timeout_s}s "
+                         "(tunnel hang)"}
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
     if proc.returncode != 0 or not lines:
         tail = (proc.stderr or "").strip().splitlines()[-8:]
@@ -79,14 +93,51 @@ def _run_child(mode: str, timeout_s: int, extra_env=None):
         return {"ok": False, "error": f"{mode} emitted non-JSON: {lines[-1][:200]}"}
 
 
-def parent_main():
+def _tp_overlap_hook():
+    """Overlapped-vs-GSPMD A/B (tools/tp_overlap_benchmark.py) on the CPU
+    mesh — cheap, attached to every round's record so the tp-overlap step
+    time is tracked alongside the headline metric."""
+    if os.environ.get("BENCH_TP_OVERLAP", "1") != "1":
+        return None
+    r = _run_child("--tp-overlap", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("fwd") else None
+
+
+def _cpu_fallback_record(history):
+    """Real measurement on the CPU backend (tiny GPT) so a dead tunnel
+    round still emits a nonzero metric instead of value: 0.0."""
+    r = _run_child("--local-bench", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    if r.get("value"):
+        r["environment"] = "cpu-fallback"
+        r.setdefault("extra", {})["environment"] = "cpu-fallback"
+        r["extra"]["history"] = history
+    return r if r.get("value") else None
+
+
+def parent_main(local_only: bool = False):
     history = []
+    if local_only:
+        res = _cpu_fallback_record(["--local requested"])
+        if res is None:
+            res = {"metric": "gpt_tiny_tokens_per_sec_cpu", "value": 0.0,
+                   "unit": "tokens/s", "vs_baseline": 0.0,
+                   "extra": {"error": "local CPU bench failed"}}
+        tpo = _tp_overlap_hook()
+        if tpo:
+            res.setdefault("extra", {})["tp_overlap"] = tpo
+        print(json.dumps(res))
+        return
     for attempt in range(ATTEMPTS):
         if attempt:
             time.sleep(BACKOFF_S[min(attempt - 1, len(BACKOFF_S) - 1)])
         probe = _run_child("--probe", PROBE_TIMEOUT_S)
         if not probe.get("ok"):
             history.append(f"attempt {attempt+1} probe: {probe.get('error')}")
+            if probe.get("timeout"):
+                # Tunnel hang mode: no amount of backoff heals it within a
+                # round — fail fast to the fallback chain.
+                history.append("probe timeout -> fail-fast to fallback")
+                break
             continue
         # Each attention impl runs as its OWN watchdogged child: a hang
         # in one cannot destroy the other's measurement (the tunnel
@@ -107,11 +158,18 @@ def parent_main():
             res["extra"]["attention_impl"] = best
             res["extra"]["tok_s_by_impl"] = {
                 k: v["value"] for k, v in by_impl.items()}
-            print(json.dumps(_save_last_good(res)))
+            res = _save_last_good(res)
+            tpo = _tp_overlap_hook()
+            if tpo:
+                res.setdefault("extra", {})["tp_overlap"] = tpo
+            print(json.dumps(res))
             return
     # All attempts failed (tunnel hang or crash): report the persisted
     # last-good measurement, flagged stale, instead of 0.0.  `history`
-    # carries the per-attempt errors for diagnosis.
+    # carries the per-attempt errors for diagnosis; a fresh CPU
+    # micro-bench rides along so the round still has a live signal.
+    cpu = _cpu_fallback_record(history)
+    tpo = _tp_overlap_hook()
     last = _load_last_good()
     if last is not None:
         # Top-level `stale` so the consumer can verifiably distinguish this
@@ -124,7 +182,21 @@ def parent_main():
                                          "last-good measurement from "
                                          "extra.measured_at")
         last["extra"]["history"] = history
+        if cpu:
+            last["extra"]["cpu_fallback"] = {
+                "metric": cpu["metric"], "value": cpu["value"],
+                "unit": cpu["unit"], "extra": cpu.get("extra", {})}
+        if tpo:
+            last["extra"]["tp_overlap"] = tpo
         print(json.dumps(last))
+        return
+    if cpu:
+        # No last-good chip number exists: the CPU micro-bench IS the
+        # round's metric — real and nonzero, tagged so consumers never
+        # compare it against chip rounds.
+        if tpo:
+            cpu.setdefault("extra", {})["tp_overlap"] = tpo
+        print(json.dumps(cpu))
         return
     print(json.dumps({
         "metric": "gpt2_125m_tokens_per_sec_per_chip",
@@ -135,6 +207,85 @@ def parent_main():
                            "exists; see history and PERF.md",
                   "history": history},
     }))
+
+
+def local_bench_main():
+    """CPU micro-bench (fallback child; JAX_PLATFORMS=cpu set by the
+    parent BEFORE this process imports jax). Tiny GPT, differential
+    timing — seconds, not minutes, and always a real nonzero number."""
+    import jax
+    import numpy as np
+
+    from megatronapp_tpu.config.parallel_config import ParallelConfig
+    from megatronapp_tpu.config.training_config import OptimizerConfig
+    from megatronapp_tpu.config.transformer_config import TransformerConfig
+    from megatronapp_tpu.models.gpt import gpt_loss, init_gpt_params
+    from megatronapp_tpu.parallel.mesh import build_mesh
+    from megatronapp_tpu.training.optimizer import get_optimizer
+    from megatronapp_tpu.training.train_state import setup_train_state
+    from megatronapp_tpu.training.train_step import make_train_step
+
+    cfg = TransformerConfig(
+        num_layers=2, hidden_size=128, num_attention_heads=4,
+        vocab_size=2048, max_position_embeddings=256,
+        remat_policy="selective")
+    seq, micro_bs = 128, 2
+    ctx = build_mesh(ParallelConfig(), devices=jax.devices()[:1])
+    opt_cfg = OptimizerConfig(lr=1e-4)
+    optimizer = get_optimizer(opt_cfg, 100)
+    state, shardings, _ = setup_train_state(
+        jax.random.PRNGKey(0), lambda k: init_gpt_params(k, cfg),
+        optimizer, ctx)
+
+    def loss_fn(params, micro):
+        return gpt_loss(params, micro["tokens"], micro["labels"],
+                        micro["loss_mask"], cfg)
+
+    step_fn = make_train_step(loss_fn, optimizer, opt_cfg, ctx, shardings,
+                              100, check_nan=False)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size,
+                          (1, micro_bs, seq)).astype(np.int32)
+    batch = {
+        "tokens": tokens,
+        "labels": np.roll(tokens, -1, axis=-1),
+        "loss_mask": np.ones_like(tokens, dtype=np.float32),
+        "position_ids": np.tile(np.arange(seq, dtype=np.int32),
+                                (1, micro_bs, 1)),
+    }
+    with ctx.mesh:
+        state, metrics = step_fn(state, batch)  # compile + warmup
+        _ = jax.device_get(metrics["loss"])
+        times = {}
+        for n_steps in (2, 6):
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                state, metrics = step_fn(state, batch)
+            _ = jax.device_get(metrics["loss"])
+            times[n_steps] = time.perf_counter() - t0
+        dt = times[6] - times[2]
+    tok_per_sec = micro_bs * seq * 4 / dt
+    print(json.dumps({
+        "metric": "gpt_tiny_tokens_per_sec_cpu",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "environment": "cpu-fallback",
+        "extra": {"environment": "cpu-fallback",
+                  "device": str(jax.devices()[0]),
+                  "step_ms": round(dt / 4 * 1e3, 2),
+                  "model": "gpt-tiny 2L/128H", "seq": seq,
+                  "note": "chip unreachable this round; tiny-GPT CPU "
+                          "measurement so the round has a live nonzero "
+                          "signal (NOT comparable to chip tokens/s)"},
+    }))
+
+
+def tp_overlap_main():
+    """tp-comm-overlap A/B child (CPU mesh env set by the parent)."""
+    from tools.tp_overlap_benchmark import run
+    print(json.dumps(run(tp=4, batch=2, seq=256, hidden=128, ffn=512,
+                         iters=5, warmup=1)))
 
 
 def probe_main():
@@ -253,5 +404,9 @@ if __name__ == "__main__":
         probe_main()
     elif "--bench" in sys.argv:
         bench_main()
+    elif "--local-bench" in sys.argv:
+        local_bench_main()
+    elif "--tp-overlap" in sys.argv:
+        tp_overlap_main()
     else:
-        parent_main()
+        parent_main(local_only="--local" in sys.argv)

@@ -94,6 +94,10 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     g.add_argument("--num-layers-per-virtual-pipeline-stage", type=int,
                    default=None)
     g.add_argument("--sequence-parallel", action="store_true")
+    g.add_argument("--tp-comm-overlap", action="store_true",
+                   help="overlap tensor-parallel collectives with the "
+                        "dependent GEMMs via manual ring all-gather / "
+                        "reduce-scatter matmuls (parallel/overlap.py)")
     g.add_argument("--use-distributed-optimizer", action="store_true",
                    default=True)
     g.add_argument("--cp-comm-type", default="p2p",
@@ -328,6 +332,7 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
             "vocab_size": "vocab_size",
             "max_position_embeddings": "max_position_embeddings",
             "init_method_std": "init_method_std",
+            "tp_comm_overlap": "tp_comm_overlap",
         }
         for flag, field in flag_to_field.items():
             val = getattr(args, flag)
@@ -384,6 +389,7 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
                 args.hierarchical_context_parallel_sizes[0]
                 if args.hierarchical_context_parallel_sizes else 2),
             remat_policy=args.recompute_granularity,
+            tp_comm_overlap=args.tp_comm_overlap,
             attention_impl=args.attention_impl,
             flash_min_seq=args.flash_min_seq,
             scan_unroll=args.scan_unroll,

@@ -153,6 +153,15 @@ class TransformerConfig:
     # XLA dense path below it, reference elsewhere.
     attention_impl: str = "auto"
 
+    # Latency-hiding tensor-parallel matmuls (reference --tp-comm-overlap;
+    # parallel/overlap.py): replace the GSPMD column/row-parallel
+    # projections in attention/MLP with manual ring all-gather-matmul /
+    # matmul-reduce-scatter so the tp collective hops ride under the
+    # dependent GEMM chunks. Chunk count auto-derives from the tp degree.
+    # Defaults off; ineligible layouts (tp=1, cp>1, inside a manual pp
+    # region, indivisible projection dims) silently keep the GSPMD path.
+    tp_comm_overlap: bool = False
+
     # Flash/dense crossover for 'auto' (PERF.md lever #2): at short
     # sequences the O(S^2) dense backward is FASTER on this chip than
     # the flash backward kernels at D=64 (measured 8x at S=1024 —

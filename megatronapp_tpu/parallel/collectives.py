@@ -16,13 +16,24 @@ import jax.numpy as jnp
 
 def current_manual_axes() -> Tuple[str, ...]:
     """Mesh axes that are Manual in the ambient context (nested shard_maps
-    accumulate them)."""
-    m = jax.sharding.get_abstract_mesh()
-    if m is None or not m.shape:
+    accumulate them).
+
+    Newer jax exposes this via the abstract mesh's axis types; on the
+    jax 0.4.x builds this image ships (no get_abstract_mesh/AxisType) the
+    manual axes are exactly the names shard_map bound into the tracing
+    axis env — same mechanism pmap/ppermute name resolution uses."""
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        m = jax.sharding.get_abstract_mesh()
+        if m is None or not m.shape:
+            return ()
+        Manual = jax.sharding.AxisType.Manual
+        return tuple(name for name, t in zip(m.axis_names, m.axis_types)
+                     if t == Manual)
+    try:
+        from jax._src.core import trace_ctx
+        return tuple(trace_ctx.axis_env.axis_names())
+    except (ImportError, AttributeError):
         return ()
-    Manual = jax.sharding.AxisType.Manual
-    return tuple(name for name, t in zip(m.axis_names, m.axis_types)
-                 if t == Manual)
 
 
 def _anchor(like: jnp.ndarray) -> jnp.ndarray:

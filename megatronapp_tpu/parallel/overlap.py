@@ -1,0 +1,426 @@
+"""Latency-hiding tensor-parallel matmuls (ring overlap).
+
+The reference hides tensor-parallel collective latency behind the dependent
+GEMMs (`--tp-comm-overlap`, delegating to TE's bulk/ring overlap; T3
+arXiv:2401.16677 makes the general case for fine-grained compute-collective
+fusion). Our GSPMD path instead lets XLA insert one blocking collective per
+column->row projection pair, which serializes a full all-gather /
+reduce-scatter (or all-reduce) against the matmuls it feeds.
+
+This module implements the manual alternative behind
+``TransformerConfig.tp_comm_overlap``:
+
+``all_gather_matmul(x, w, mesh)``
+    Column-parallel ``x @ w`` with ``w`` sharded on its OUTPUT dim over tp.
+    Inside a shard_map manual over tp only, the sequence dim of ``x`` is
+    ring-all-gathered via ``lax.ppermute`` in tp chunks; every received
+    chunk is immediately multiplied into its rows of the accumulator, so
+    each permute hop rides under the previous chunk's GEMM.
+
+``matmul_reduce_scatter(y, w, mesh)``
+    Row-parallel ``y @ w`` with ``w`` sharded on its INPUT dim over tp.
+    The partial products are ring-reduce-scattered along the sequence dim:
+    each step permutes the running partial sum while the next sequence
+    chunk's local GEMM is computed.
+
+Both carry a ``jax.custom_vjp`` whose backward overlaps symmetrically and
+FUSED: one ring pass per primitive serves the dgrad (all-gather /
+reduce-scatter of cotangents) and the wgrad accumulation together.
+
+Design notes:
+- The chunk count is the ring length and is auto-derived from the tp mesh
+  degree (tp chunks of S/tp sequence rows each); sequence lengths not
+  divisible by tp are zero-padded outside the custom_vjp boundary.
+- Output layouts match the GSPMD path exactly: ``all_gather_matmul``
+  returns [B, S, N] sharded over tp on the last dim, so downstream
+  bias/activation/split code is unchanged; ``matmul_reduce_scatter``
+  returns the full [B, S, H] (sequence manually sharded over tp — the
+  consumer's residual add re-gathers it, total comm volume identical to
+  the all-reduce GSPMD emits).
+- The shard_map is FULLY manual (every mesh axis): on the jax 0.4.x
+  builds this image ships, partial-auto regions lower ppermute/axis_index
+  through an SPMD path that XLA:CPU aborts on (spmd_partitioner
+  IsManualSubgroup check / unsupported PartitionId) — the batch dim is
+  therefore threaded explicitly over (dp, ep) and pp/cp ride along
+  replicated (eligibility requires cp == 1 and a non-manual context).
+- MegaScan: when tracing is enabled at trace time, per-chunk
+  ``tp-overlap-compute`` / ``tp-overlap-permute`` spans are emitted (one
+  timeline per tp rank, tid = rank + 1) so the overlap is visible in the
+  merged trace.
+- This module and ``parallel/collectives.py`` are the approved homes for
+  raw manual collectives — ``tools/check_vma.py`` enforces that new
+  shard_map code routes through them.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from megatronapp_tpu.config.parallel_config import DP_AXIS, EP_AXIS, TP_AXIS
+from megatronapp_tpu.parallel.collectives import zeros_like_vma
+
+# MegaScan span names (trace/tracer.py GRANULARITY_EVENTS 'collective').
+OVERLAP_COMPUTE_EVENT = "tp-overlap-compute"
+OVERLAP_PERMUTE_EVENT = "tp-overlap-permute"
+
+# Activation batch dims shard over (dp, ep) — mesh.py batch_spec.
+_BATCH = (DP_AXIS, EP_AXIS)
+
+
+def _shard_map(body, mesh, in_specs, out_specs):
+    """Full-manual shard_map across jax versions.
+
+    Newer jax: ``jax.shard_map(..., check_vma=False)`` (the bodies are
+    plain ring code; vma annotation adds nothing under full manual).
+    jax 0.4.x (this image): ``jax.experimental.shard_map.shard_map`` with
+    ``check_rep=False`` — the old rep checker predates varying-manual-axes
+    types and rejects valid ring accumulations."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(body, mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
+def _ring_perm(tp: int):
+    """Ring permutation: rank r sends to r-1, i.e. after one hop rank r
+    holds what r+1 held — at step s every rank holds chunk (r + s) % tp."""
+    return [(r, (r - 1) % tp) for r in range(tp)]
+
+
+def _mark(name: str, ph: str, dep, *, op: str, step: int):
+    """Per-chunk MegaScan record from inside the jitted ring body.
+
+    Inserted only when tracing is enabled at trace time (zero overhead
+    otherwise). Uses ``jax.debug.callback`` — the only callback flavor
+    supported inside shard_map manual regions in this build (ordered
+    io_callback is rejected there); the data dependency on ``dep`` anchors
+    the record near the op it brackets. One timeline per tp rank
+    (tid = rank + 1; tid 0 stays the host-scope timeline)."""
+    from megatronapp_tpu.trace.tracer import callbacks_supported, get_tracer
+
+    tracer = get_tracer()
+    if not (tracer.enabled and callbacks_supported()):
+        return
+
+    def _cb(rank, _):
+        tracer.phase_event(name, ph, tid=int(rank) + 1, op=op, step=step)
+
+    anchor = lax.stop_gradient(dep).ravel()[0]
+    jax.debug.callback(_cb, lax.axis_index(TP_AXIS), anchor)
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+# ---------------------------------------------------------------------------
+# all_gather_matmul: ring AG of x's sequence chunks, overlapped with the
+# column-parallel GEMM.
+# ---------------------------------------------------------------------------
+
+def _ag_mm_body(tp, op_name, xl, wls):
+    """xl [b, S/tp, H] (this rank's batch rows + seq chunk), wls: tuple of
+    [H, N_j/tp] column-parallel weights sharing ONE ring all-gather of x
+    (the fused-QKV case: gathering x once instead of once per projection
+    halves the permute traffic). Returns a tuple of y_j [b, S, N_j/tp]."""
+    me = lax.axis_index(TP_AXIS)
+    b, sc, _ = xl.shape
+    ys = [zeros_like_vma((b, sc * tp, wl.shape[1]),
+                         jnp.result_type(xl.dtype, wl.dtype), xl)
+          for wl in wls]
+    perm = _ring_perm(tp)
+    chunk = xl
+    for step in range(tp):
+        nxt = None
+        if step + 1 < tp:
+            # Issue the permute BEFORE the dependent GEMM so the hop rides
+            # under it (TPU async collectives; XLA:CPU runs it serially).
+            _mark(OVERLAP_PERMUTE_EVENT, "B", chunk, op=op_name, step=step)
+            nxt = lax.ppermute(chunk, TP_AXIS, perm)
+        owner = (me + step) % tp  # global chunk index currently held
+        _mark(OVERLAP_COMPUTE_EVENT, "B", chunk, op=op_name, step=step)
+        last = None
+        for j, wl in enumerate(wls):
+            piece = chunk @ wl
+            ys[j] = lax.dynamic_update_slice_in_dim(ys[j], piece,
+                                                    owner * sc, axis=1)
+            last = piece
+        _mark(OVERLAP_COMPUTE_EVENT, "E", last, op=op_name, step=step)
+        if nxt is not None:
+            _mark(OVERLAP_PERMUTE_EVENT, "E", nxt, op=op_name, step=step)
+            chunk = nxt
+    return tuple(ys)
+
+
+def _ag_mm_bwd_body(tp, xl, wls, dyls):
+    """Fused backward ring for all_gather_matmul.
+
+    xl [b, S/tp, H], wls: tuple of [H, N_j/tp], dyls: matching cotangents
+    [b, S, N_j/tp]. One ring pass of x chunks accumulates EVERY weight's
+    wgrad; the dgrad is the symmetric matmul-reduce-scatter of the summed
+    dy_j @ w_j^T. Returns (dx_local [b, S/tp, H], tuple of dw_j)."""
+    me = lax.axis_index(TP_AXIS)
+    b, sc, h = xl.shape
+    perm = _ring_perm(tp)
+    op = "all-gather-matmul-bwd"
+
+    # wgrad: dw_j = sum over seq chunks  x_c^T @ dy_j_c  (ring AG of x
+    # chunks; fp32 accumulators — chunked serial adds would otherwise
+    # round in bf16 where one big GEMM accumulates wide).
+    dws = [zeros_like_vma((h, wl.shape[1]), jnp.float32, xl) for wl in wls]
+    chunk = xl
+    for step in range(tp):
+        nxt = None
+        if step + 1 < tp:
+            _mark(OVERLAP_PERMUTE_EVENT, "B", chunk, op=op, step=step)
+            nxt = lax.ppermute(chunk, TP_AXIS, perm)
+        owner = (me + step) % tp
+        _mark(OVERLAP_COMPUTE_EVENT, "B", chunk, op=op, step=step)
+        pm = None
+        for j, (wl, dyl) in enumerate(zip(wls, dyls)):
+            dyc = lax.dynamic_slice_in_dim(dyl, owner * sc, sc, axis=1)
+            pm = (chunk.reshape(b * sc, h).T
+                  @ dyc.reshape(b * sc, wl.shape[1]))
+            dws[j] = dws[j] + pm.astype(jnp.float32)
+        _mark(OVERLAP_COMPUTE_EVENT, "E", pm, op=op, step=step)
+        if nxt is not None:
+            _mark(OVERLAP_PERMUTE_EVENT, "E", nxt, op=op, step=step)
+            chunk = nxt
+
+    # dgrad: dx = reduce-scatter over seq of  sum_j dy_j @ w_j^T  (ring
+    # RS; the per-chunk partials of every projection sum before the hop).
+    dx = _mm_rs_rings(tp, dyls, tuple(wl.T for wl in wls), op_name=op)
+    # The batch dim is manually sharded over (dp, ep); the weights are
+    # replicated there, so their grads must be reduced across the batch
+    # shards — the all-reduce GSPMD would have inserted for us. fp32
+    # reduction (bf16 manual all-reduces crash XLA:CPU — README).
+    dws = [lax.psum(dw, (DP_AXIS, EP_AXIS)) for dw in dws]
+    return (dx.astype(xl.dtype),
+            tuple(dw.astype(wl.dtype) for dw, wl in zip(dws, wls)))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _ag_mm(mesh, x, ws):
+    return _ag_mm_fwd(mesh, x, ws)[0]
+
+
+def _ag_mm_fwd(mesh, x, ws):
+    tp = mesh.shape[TP_AXIS]
+    n = len(ws)
+    ys = _shard_map(
+        functools.partial(_ag_mm_body, tp, "all-gather-matmul"), mesh,
+        in_specs=(P(_BATCH, TP_AXIS, None), (P(None, TP_AXIS),) * n),
+        out_specs=(P(_BATCH, None, TP_AXIS),) * n)(x, ws)
+    return ys, (x, ws)
+
+
+def _ag_mm_bwd(mesh, res, dys):
+    x, ws = res
+    tp = mesh.shape[TP_AXIS]
+    n = len(ws)
+    dx, dws = _shard_map(
+        functools.partial(_ag_mm_bwd_body, tp), mesh,
+        in_specs=(P(_BATCH, TP_AXIS, None), (P(None, TP_AXIS),) * n,
+                  (P(_BATCH, None, TP_AXIS),) * n),
+        out_specs=(P(_BATCH, TP_AXIS, None),
+                   (P(None, TP_AXIS),) * n))(x, ws, dys)
+    return dx, dws
+
+
+_ag_mm.defvjp(_ag_mm_fwd, _ag_mm_bwd)
+
+
+# ---------------------------------------------------------------------------
+# matmul_reduce_scatter: row-parallel GEMM whose partial-product reduction
+# is a ring reduce-scatter along the sequence dim.
+# ---------------------------------------------------------------------------
+
+def _mm_rs_rings(tp, yls, wls, op_name="matmul-reduce-scatter"):
+    """yls: tuple of [b, S, N_j/tp]; wls: matching [N_j/tp, H] →
+    this rank's reduced seq chunk [b, S/tp, H] of sum_j y_j @ w_j.
+    Each step's local chunk GEMMs are issued while the running partial
+    sum permutes around the ring."""
+    if not isinstance(yls, tuple):
+        yls, wls = (yls,), (wls,)
+    me = lax.axis_index(TP_AXIS)
+    sc = yls[0].shape[1] // tp
+    perm = _ring_perm(tp)
+
+    def piece(c, step):
+        _mark(OVERLAP_COMPUTE_EVENT, "B", yls[0], op=op_name, step=step)
+        out = None
+        for yl, wl in zip(yls, wls):
+            yc = lax.dynamic_slice_in_dim(yl, c * sc, sc, axis=1)
+            out = yc @ wl if out is None else out + yc @ wl
+        _mark(OVERLAP_COMPUTE_EVENT, "E", out, op=op_name, step=step)
+        return out
+
+    # acc_r after step s = sum_{j=r..r+s} partial_j[chunk (r+s+1) % tp];
+    # after tp-1 hops rank r holds the fully reduced chunk r.
+    acc = piece((me + 1) % tp, 0)
+    for step in range(1, tp):
+        _mark(OVERLAP_PERMUTE_EVENT, "B", acc, op=op_name, step=step)
+        moving = lax.ppermute(acc, TP_AXIS, perm)
+        nxt = piece((me + 1 + step) % tp, step)
+        _mark(OVERLAP_PERMUTE_EVENT, "E", moving, op=op_name, step=step)
+        acc = moving + nxt
+    return acc
+
+
+def _mm_rs_bwd_body(tp, yl, wl, dol):
+    """Fused backward ring for matmul_reduce_scatter.
+
+    yl [b, S, N/tp], wl [N/tp, H], dol [b, S/tp, H] (this rank's cotangent
+    seq chunk). ONE ring all-gather of the dout chunks feeds both the dgrad
+    (dy = dout @ w^T, written rows-at-a-time) and the wgrad accumulation
+    (dw = sum_c y_c^T @ dout_c). Returns (dy [b,S,N/tp], dw [N/tp,H])."""
+    me = lax.axis_index(TP_AXIS)
+    b, sc, h = dol.shape
+    nl = wl.shape[0]
+    perm = _ring_perm(tp)
+    op = "matmul-reduce-scatter-bwd"
+
+    dy = zeros_like_vma((b, sc * tp, nl),
+                        jnp.result_type(dol.dtype, wl.dtype), dol)
+    dw = zeros_like_vma((nl, h), jnp.float32, dol)
+    chunk = dol
+    for step in range(tp):
+        nxt = None
+        if step + 1 < tp:
+            _mark(OVERLAP_PERMUTE_EVENT, "B", chunk, op=op, step=step)
+            nxt = lax.ppermute(chunk, TP_AXIS, perm)
+        owner = (me + step) % tp
+        _mark(OVERLAP_COMPUTE_EVENT, "B", chunk, op=op, step=step)
+        dyc = chunk @ wl.T
+        yc = lax.dynamic_slice_in_dim(yl, owner * sc, sc, axis=1)
+        pm = yc.reshape(b * sc, nl).T @ chunk.reshape(b * sc, h)
+        _mark(OVERLAP_COMPUTE_EVENT, "E", dyc, op=op, step=step)
+        dy = lax.dynamic_update_slice_in_dim(dy, dyc, owner * sc, axis=1)
+        dw = dw + pm.astype(jnp.float32)
+        if nxt is not None:
+            _mark(OVERLAP_PERMUTE_EVENT, "E", nxt, op=op, step=step)
+            chunk = nxt
+    # Weight grad: reduce across the manual (dp, ep) batch shards (see
+    # _ag_mm_bwd_body) — fp32 before the cast.
+    dw = lax.psum(dw, (DP_AXIS, EP_AXIS))
+    return dy.astype(yl.dtype), dw.astype(wl.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _mm_rs(mesh, y, w):
+    return _mm_rs_fwd(mesh, y, w)[0]
+
+
+def _mm_rs_fwd(mesh, y, w):
+    tp = mesh.shape[TP_AXIS]
+    out = _shard_map(
+        functools.partial(_mm_rs_rings, tp), mesh,
+        in_specs=(P(_BATCH, None, TP_AXIS), P(TP_AXIS, None)),
+        out_specs=P(_BATCH, TP_AXIS, None))(y, w)
+    return out, (y, w)
+
+
+def _mm_rs_bwd(mesh, res, dout):
+    y, w = res
+    tp = mesh.shape[TP_AXIS]
+    dy, dw = _shard_map(
+        functools.partial(_mm_rs_bwd_body, tp), mesh,
+        in_specs=(P(_BATCH, None, TP_AXIS), P(TP_AXIS, None),
+                  P(_BATCH, TP_AXIS, None)),
+        out_specs=(P(_BATCH, None, TP_AXIS), P(TP_AXIS, None)))(y, w, dout)
+    return dy, dw
+
+
+_mm_rs.defvjp(_mm_rs_fwd, _mm_rs_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+def all_gather_matmul(x, w, mesh):
+    """Column-parallel ``x @ w`` with ring-overlapped sequence all-gather.
+
+    x: [B, S, H]; w: [H, N] with N % tp == 0 (sharded over tp on N) — or
+    a tuple of such weights, in which case ONE ring all-gather of x
+    feeds every projection (fused QKV: half the permute traffic of two
+    separate calls) and a tuple of outputs is returned.
+    Each output is [B, S, N_j] sharded over tp on the last dim —
+    layout-identical to the GSPMD column-parallel matmul. S not divisible
+    by tp is zero-padded internally (outside the custom_vjp, so gradients
+    of the pad/slice are automatic)."""
+    tp = mesh.shape[TP_AXIS]
+    fused = isinstance(w, (tuple, list))
+    ws = tuple(w) if fused else (w,)
+    for wj in ws:
+        if wj.shape[-1] % tp:
+            raise ValueError(
+                f"all_gather_matmul: output dim {wj.shape[-1]} not "
+                f"divisible by tp={tp} (gate callers on "
+                "tp_overlap_eligible)")
+    s = x.shape[1]
+    sp = _round_up(s, tp)
+    if sp != s:
+        x = jnp.pad(x, ((0, 0), (0, sp - s), (0, 0)))
+    ys = _ag_mm(mesh, x, ws)
+    if sp != s:
+        ys = tuple(y[:, :s] for y in ys)
+    return ys if fused else ys[0]
+
+
+def matmul_reduce_scatter(y, w, mesh):
+    """Row-parallel ``y @ w`` with ring-overlapped partial-sum
+    reduce-scatter along the sequence dim.
+
+    y: [B, S, N] with N % tp == 0 (sharded over tp on N); w: [N, H].
+    Returns the full [B, S, H] (manually sharded over tp along S; a
+    replicated consumer triggers the trailing all-gather — same total
+    volume as the GSPMD all-reduce, with the RS half overlapped)."""
+    tp = mesh.shape[TP_AXIS]
+    if y.shape[-1] % tp or y.shape[-1] != w.shape[0]:
+        raise ValueError(
+            f"matmul_reduce_scatter: inner dim {y.shape[-1]} must match "
+            f"w rows {w.shape[0]} and divide by tp={tp}")
+    s = y.shape[1]
+    sp = _round_up(s, tp)
+    if sp != s:
+        y = jnp.pad(y, ((0, 0), (0, sp - s), (0, 0)))
+    out = _mm_rs(mesh, y, w)
+    return out[:, :s] if sp != s else out
+
+
+def tp_overlap_eligible(cfg, ctx, *tp_dims, batch=None) -> bool:
+    """Whether the manual overlap path may replace the GSPMD matmuls here.
+
+    tp_dims: every weight dim that must shard evenly over tp (column
+    output dims and row input dims of the projection pair — one decision
+    per pair keeps fwd layouts consistent). batch: the activation batch
+    dim, which the full-manual region shards over (dp, ep).
+
+    Falls back to GSPMD when: the flag is off; no mesh context; tp == 1
+    (nothing to overlap); cp > 1 (seq already compiler-sharded over cp);
+    inside an existing manual region (nested shard_map unsupported —
+    README known constraints); FBD abstract meshes (eager abstract-mesh
+    shard_maps unsupported); or any dim indivisible."""
+    if not getattr(cfg, "tp_comm_overlap", False):
+        return False
+    if ctx is None:
+        return False
+    if getattr(ctx, "abstract_collectives", False):
+        return False
+    tp = ctx.tp
+    if tp <= 1 or ctx.cp > 1:
+        return False
+    if batch is not None and batch % (ctx.dp * ctx.ep) != 0:
+        return False
+    from megatronapp_tpu.parallel.collectives import current_manual_axes
+    if current_manual_axes():
+        return False
+    return all(d % tp == 0 for d in tp_dims)

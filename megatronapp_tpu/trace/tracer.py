@@ -44,7 +44,7 @@ GRANULARITY_EVENTS = {
     },
     "collective": {
         "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
-        "all-to-all",
+        "all-to-all", "tp-overlap-compute", "tp-overlap-permute",
     },
 }
 
@@ -178,10 +178,14 @@ class Tracer:
                     break
 
     # -- in-graph phase spans ----------------------------------------------
-    def phase_event(self, name: str, ph: str):
-        """Host-side record emission used by in-graph callbacks."""
+    def phase_event(self, name: str, ph: str, tid: int = 0, **attrs):
+        """Host-side record emission used by in-graph callbacks.
+
+        tid: per-process timeline; 0 is the host-scope timeline, the
+        tp-overlap ring spans use tid = tp_rank + 1 (parallel/overlap.py)
+        so per-rank B/E pairs nest cleanly in the merged trace."""
         if self.enabled and self.active:
-            self._emit(name, ph, _now_ns() - self._iter_t0, {})
+            self._emit(name, ph, _now_ns() - self._iter_t0, attrs, tid=tid)
 
     # -- in-graph markers ---------------------------------------------------
     def marker(self, name: str, x, **attrs):
@@ -209,11 +213,12 @@ class Tracer:
         return jax.tree.unflatten(jax.tree.structure(x), leaves)
 
     # -- record handling -----------------------------------------------------
-    def _emit(self, name: str, ph: str, ts_ns: int, args: Dict[str, Any]):
+    def _emit(self, name: str, ph: str, ts_ns: int, args: Dict[str, Any],
+              tid: int = 0):
         rec = {
             "name": name, "ph": ph, "ts": ts_ns / 1e3,  # Chrome trace: µs
             "pid": self.process_index,
-            "tid": 0,
+            "tid": tid,
             "iteration": self._iteration,
             "args": dict(args),
         }

@@ -91,11 +91,31 @@ def attention_forward(
     # tensor_parallel/layers.py:944-951 applies it to every parallel
     # linear's weights).
     from megatronapp_tpu.scope.disturbance import get_disturbance
+    from megatronapp_tpu.parallel.overlap import (
+        all_gather_matmul, matmul_reduce_scatter, tp_overlap_eligible,
+    )
     _dist = get_disturbance()
+    # Latency-hiding tp path (--tp-comm-overlap, parallel/overlap.py):
+    # QKV column-parallel via ring all-gather-matmul, out-proj row-parallel
+    # via matmul-reduce-scatter. The flat projection dims (not head counts)
+    # must shard evenly over tp — the ring reproduces the global layout, so
+    # GQA head counts indivisible by tp still work when nq*d / 2*nkv*d do.
+    # (kv_cache = decode: S∈{1,prefill} matmuls are tiny and latency-bound,
+    # the ring would be pure overhead — keep GSPMD there.)
+    overlap = (kv_cache is None
+               and tp_overlap_eligible(cfg, ctx, nq * d, 2 * nkv * d,
+                                       batch=b))
     q_kernel = _dist.apply("weight", p["q_kernel"], layer_id)
     kv_kernel = _dist.apply("weight", p["kv_kernel"], layer_id)
-    q = x @ q_kernel.astype(cfg.compute_dtype)
-    kv = x @ kv_kernel.astype(cfg.compute_dtype)
+    if overlap:
+        # Fused call: one ring all-gather of x feeds both column-parallel
+        # projections (two calls would move x around the ring twice).
+        q, kv = all_gather_matmul(
+            x, (q_kernel.astype(cfg.compute_dtype),
+                kv_kernel.astype(cfg.compute_dtype)), ctx.shard_map_mesh)
+    else:
+        q = x @ q_kernel.astype(cfg.compute_dtype)
+        kv = x @ kv_kernel.astype(cfg.compute_dtype)
     if "q_bias" in p:
         q = q + p["q_bias"].astype(cfg.compute_dtype)
         kv = kv + p["kv_bias"].astype(cfg.compute_dtype)
@@ -265,7 +285,12 @@ def attention_forward(
     attn_out = scope_capture("context", attn_out, layer_id)
 
     out_kernel = _dist.apply("weight", p["out_kernel"], layer_id)
-    out = attn_out.reshape(b, s, nq * d) @ out_kernel.astype(cfg.compute_dtype)
+    out_kernel = out_kernel.astype(cfg.compute_dtype)
+    if overlap:
+        out = matmul_reduce_scatter(attn_out.reshape(b, s, nq * d),
+                                    out_kernel, ctx.shard_map_mesh)
+    else:
+        out = attn_out.reshape(b, s, nq * d) @ out_kernel
     if "out_bias" in p:
         out = out + p["out_bias"].astype(cfg.compute_dtype)
     return (out, new_cache) if kv_cache is not None else (out, None)

@@ -111,7 +111,7 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
         mlp_out, aux = moe_forward(p["moe"], h, cfg, layer_id=layer_id,
                                    ctx=ctx)
     else:
-        mlp_out = mlp_forward(p["mlp"], h, cfg, layer_id=layer_id)
+        mlp_out = mlp_forward(p["mlp"], h, cfg, layer_id=layer_id, ctx=ctx)
     x = residual + mlp_out.astype(residual.dtype)
     # MegaScope 'system' perturbation + capture site between layers
     # (transformer_block.py:542-544).

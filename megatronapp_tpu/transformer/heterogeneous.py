@@ -193,7 +193,8 @@ def hetero_block_forward(per_layer_params, x: jnp.ndarray,
                 out = hdn.astype(cfg.compute_dtype) @ \
                     p["mlp_linear"].astype(cfg.compute_dtype)
             else:
-                out = mlp_forward(p["mlp"], hdn, lcfg, layer_id=lid)
+                out = mlp_forward(p["mlp"], hdn, lcfg, layer_id=lid,
+                                  ctx=ctx)
             x = residual + out.astype(residual.dtype)
         return x
 

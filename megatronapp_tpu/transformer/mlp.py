@@ -42,12 +42,27 @@ def init_mlp_params(rng, cfg: TransformerConfig, out_std: float,
     return p, ax
 
 
-def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None):
+def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
+                ctx=None):
     from megatronapp_tpu.scope.disturbance import get_disturbance
+    from megatronapp_tpu.parallel.overlap import (
+        all_gather_matmul, matmul_reduce_scatter, tp_overlap_eligible,
+    )
     _dist = get_disturbance()
+    # Latency-hiding tp path (--tp-comm-overlap): fc1 column-parallel via
+    # ring all-gather-matmul, fc2 row-parallel via matmul-reduce-scatter.
+    # One eligibility decision covers the pair (both weight dims must
+    # shard evenly) so the intermediate layout stays consistent.
+    overlap = tp_overlap_eligible(cfg, ctx, p["fc1_kernel"].shape[1],
+                                  p["fc2_kernel"].shape[0],
+                                  batch=x.shape[0])
     x = x.astype(cfg.compute_dtype)
     fc1_kernel = _dist.apply("weight", p["fc1_kernel"], layer_id)
-    y = x @ fc1_kernel.astype(cfg.compute_dtype)
+    fc1_kernel = fc1_kernel.astype(cfg.compute_dtype)
+    if overlap:
+        y = all_gather_matmul(x, fc1_kernel, ctx.shard_map_mesh)
+    else:
+        y = x @ fc1_kernel
     if "fc1_bias" in p:
         y = y + p["fc1_bias"].astype(cfg.compute_dtype)
     y = scope_capture("mlp1", y, layer_id)
@@ -60,7 +75,11 @@ def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None):
     else:
         y = apply_activation(cfg.activation, y)
     fc2_kernel = _dist.apply("weight", p["fc2_kernel"], layer_id)
-    out = y @ fc2_kernel.astype(cfg.compute_dtype)
+    fc2_kernel = fc2_kernel.astype(cfg.compute_dtype)
+    if overlap:
+        out = matmul_reduce_scatter(y, fc2_kernel, ctx.shard_map_mesh)
+    else:
+        out = y @ fc2_kernel
     if "fc2_bias" in p:
         out = out + p["fc2_bias"].astype(cfg.compute_dtype)
     out = scope_capture("mlp2", out, layer_id)

@@ -152,12 +152,17 @@ def moe_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
 
     from megatronapp_tpu.parallel.collectives import current_manual_axes
     if (ctx is not None and getattr(ctx, "ep", 1) > 1
-            and not current_manual_axes()):
+            and not current_manual_axes()
+            and hasattr(jax, "shard_map")):
         # Explicit ep all-to-all dispatch. Unavailable inside an ambient
         # manual region (the pp/cp pipeline body): nesting shard_maps is
         # unsupported in this JAX build, so moe+pp falls through to the
         # compiler-sharded dispatch below — GSPMD partitions the expert
         # einsums over the ep axis from the fc1/fc2 shardings instead.
+        # Also unavailable on jax-0.4.x images (no jax.shard_map, and its
+        # partial-auto manual regions abort XLA:CPU — parallel/overlap.py
+        # docstring): same compiler-sharded fallback, at the cost of the
+        # known GSPMD resharding churn.
         out, aux = _a2a_expert_forward(p, x, cfg, ctx)
         x_flat = x.reshape(t, h)
         return _with_shared(p, x_flat, out.reshape(t, h), cfg).reshape(

@@ -103,6 +103,29 @@ class TestTraining:
         # same batches as the uninterrupted run: losses must match closely.
         assert abs(res_resumed.losses[-1] - res_full.losses[-1]) < 1e-4
 
+    def test_tp_comm_overlap_loss_parity(self, devices8):
+        """2-step GPT training with tp_comm_overlap on vs off produces the
+        same losses (ISSUE 1: the flag is loss-neutral, so it is safe to
+        default on later). fp32 compute so the only difference between
+        runs is the ring-vs-GSPMD collective schedule."""
+        import dataclasses
+
+        losses = {}
+        for flag in (False, True):
+            model = tiny_model(compute_dtype=jnp.float32)
+            model = dataclasses.replace(model, tp_comm_overlap=flag)
+            par = ParallelConfig(tensor_parallel=2)
+            ctx = build_mesh(par, devices=devices8[:4])  # tp=2 x dp=2
+            train = TrainingConfig(micro_batch_size=2, global_batch_size=4,
+                                   seq_length=32, train_iters=2,
+                                   log_interval=1)
+            res = pretrain_gpt(model, par, train, OptimizerConfig(lr=1e-3),
+                               ctx=ctx,
+                               batch_iter=learnable_batches(32, 128, 4))
+            losses[flag] = res.losses
+        np.testing.assert_allclose(losses[True], losses[False],
+                                   rtol=1e-5, atol=1e-5)
+
     def test_nan_skip(self, devices8):
         """A NaN loss must skip the update, not poison params (reference
         rerun_state_machine / skipped-iter accounting)."""

@@ -1,0 +1,92 @@
+"""Static check: raw manual collectives stay in approved modules.
+
+Manual-collective code (`lax.psum` / `ppermute` / `all_gather` /
+`all_to_all` / `psum_scatter` inside shard_map bodies) is easy to get
+subtly wrong on this stack: varying-manual-axes typing, the XLA:CPU bf16
+manual all-reduce crash, the partial-auto ppermute abort (see
+parallel/overlap.py docstring), and missing cross-axis weight-grad
+reductions are all failure modes we hit and now pin in tests. New code
+must therefore route manual collectives through the traced, tested entry
+points — `parallel/collectives.py` (shared helpers) and
+`parallel/overlap.py` (ring tp overlap) — or be explicitly audited and
+added to the allowlist below with a short justification.
+
+Runs in tier-1 via tests/test_tp_overlap.py and standalone:
+
+    python tools/check_vma.py          # exit 1 + report on violations
+"""
+
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Collective primitives that imply manual-region communication. axis_index
+# and axis_size are bookkeeping, not communication — not flagged.
+COLLECTIVE_RE = re.compile(
+    r"\blax\.(ppermute|psum_scatter|psum|all_gather|all_to_all|pshuffle"
+    r"|pmax|pmin|pbroadcast|pcast)\b")
+
+# Audited homes for raw collectives, relative to the repo root.
+APPROVED = {
+    # The designated entry points (ISSUE 1 satellite: future manual
+    # collectives go here).
+    "megatronapp_tpu/parallel/collectives.py",
+    "megatronapp_tpu/parallel/overlap.py",
+    # Grandfathered, audited manual-collective subsystems:
+    "megatronapp_tpu/ops/context_parallel.py",   # cp ring/a2a attention
+    "megatronapp_tpu/ops/cross_entropy.py",      # vocab-parallel CE
+    "megatronapp_tpu/parallel/pipeline.py",      # pp schedule ring
+    "megatronapp_tpu/transformer/moe.py",        # ep a2a dispatcher
+}
+
+SCAN_DIRS = ("megatronapp_tpu",)
+
+
+def _code_lines(path):
+    """Yield (lineno, line) with comments stripped; skips docstring-only
+    mentions conservatively by requiring a call-shaped `lax.<name>` (the
+    regex matches the identifier — docstrings citing ``psum`` without the
+    lax. prefix never trip it)."""
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            yield i, line.split("#", 1)[0]
+
+
+def find_violations(root: str = REPO_ROOT):
+    """Return [(relpath, lineno, snippet), ...] for raw collectives
+    outside the approved modules."""
+    out = []
+    for scan in SCAN_DIRS:
+        base = os.path.join(root, scan)
+        for dirpath, _, files in os.walk(base):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                if rel in APPROVED:
+                    continue
+                for lineno, line in _code_lines(path):
+                    if COLLECTIVE_RE.search(line):
+                        out.append((rel, lineno, line.strip()))
+    return out
+
+
+def main():
+    violations = find_violations()
+    if not violations:
+        print("check_vma: OK — all raw manual collectives live in "
+              f"{len(APPROVED)} approved modules")
+        return 0
+    print("check_vma: raw manual collectives outside the approved "
+          "modules (route through parallel/collectives.py or "
+          "parallel/overlap.py, or audit + allowlist):")
+    for rel, lineno, line in violations:
+        print(f"  {rel}:{lineno}: {line}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
