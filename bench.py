@@ -103,6 +103,27 @@ def _tp_overlap_hook():
     return r if r.get("fwd") else None
 
 
+def _cp_a2a_hook():
+    """Ring-attention + MoE chunked-a2a A/B (tools/cp_a2a_benchmark.py) on
+    the CPU mesh — attached to every round's record like the tp-overlap
+    hook so the cp/ep overlap paths are tracked round over round."""
+    if os.environ.get("BENCH_CP_A2A", "1") != "1":
+        return None
+    r = _run_child("--cp-a2a", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("ring_attention") else None
+
+
+def _attach_overlap_hooks(res):
+    """Attach the tp-overlap and cp/a2a A/B results to a round record."""
+    tpo = _tp_overlap_hook()
+    if tpo:
+        res.setdefault("extra", {})["tp_overlap"] = tpo
+    cpa = _cp_a2a_hook()
+    if cpa:
+        res.setdefault("extra", {})["cp_a2a"] = cpa
+    return res
+
+
 def _cpu_fallback_record(history):
     """Real measurement on the CPU backend (tiny GPT) so a dead tunnel
     round still emits a nonzero metric instead of value: 0.0."""
@@ -122,9 +143,7 @@ def parent_main(local_only: bool = False):
             res = {"metric": "gpt_tiny_tokens_per_sec_cpu", "value": 0.0,
                    "unit": "tokens/s", "vs_baseline": 0.0,
                    "extra": {"error": "local CPU bench failed"}}
-        tpo = _tp_overlap_hook()
-        if tpo:
-            res.setdefault("extra", {})["tp_overlap"] = tpo
+        res = _attach_overlap_hooks(res)
         print(json.dumps(res))
         return
     for attempt in range(ATTEMPTS):
@@ -159,9 +178,7 @@ def parent_main(local_only: bool = False):
             res["extra"]["tok_s_by_impl"] = {
                 k: v["value"] for k, v in by_impl.items()}
             res = _save_last_good(res)
-            tpo = _tp_overlap_hook()
-            if tpo:
-                res.setdefault("extra", {})["tp_overlap"] = tpo
+            res = _attach_overlap_hooks(res)
             print(json.dumps(res))
             return
     # All attempts failed (tunnel hang or crash): report the persisted
@@ -170,6 +187,7 @@ def parent_main(local_only: bool = False):
     # micro-bench rides along so the round still has a live signal.
     cpu = _cpu_fallback_record(history)
     tpo = _tp_overlap_hook()
+    cpa = _cp_a2a_hook()
     last = _load_last_good()
     if last is not None:
         # Top-level `stale` so the consumer can verifiably distinguish this
@@ -188,6 +206,8 @@ def parent_main(local_only: bool = False):
                 "unit": cpu["unit"], "extra": cpu.get("extra", {})}
         if tpo:
             last["extra"]["tp_overlap"] = tpo
+        if cpa:
+            last["extra"]["cp_a2a"] = cpa
         print(json.dumps(last))
         return
     if cpu:
@@ -196,6 +216,8 @@ def parent_main(local_only: bool = False):
         # compare it against chip rounds.
         if tpo:
             cpu.setdefault("extra", {})["tp_overlap"] = tpo
+        if cpa:
+            cpu.setdefault("extra", {})["cp_a2a"] = cpa
         print(json.dumps(cpu))
         return
     print(json.dumps({
@@ -286,6 +308,13 @@ def tp_overlap_main():
     from tools.tp_overlap_benchmark import run
     print(json.dumps(run(tp=4, batch=2, seq=256, hidden=128, ffn=512,
                          iters=5, warmup=1)))
+
+
+def cp_a2a_main():
+    """cp ring + moe a2a overlap A/B child (CPU mesh env set by parent)."""
+    from tools.cp_a2a_benchmark import run
+    print(json.dumps(run(cp=4, ep=4, batch=2, seq=256, heads=8, kv_heads=4,
+                         head_dim=32, iters=5, warmup=1)))
 
 
 def probe_main():
@@ -408,5 +437,7 @@ if __name__ == "__main__":
         local_bench_main()
     elif "--tp-overlap" in sys.argv:
         tp_overlap_main()
+    elif "--cp-a2a" in sys.argv:
+        cp_a2a_main()
     else:
         parent_main(local_only="--local" in sys.argv)

@@ -98,6 +98,17 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
                    help="overlap tensor-parallel collectives with the "
                         "dependent GEMMs via manual ring all-gather / "
                         "reduce-scatter matmuls (parallel/overlap.py)")
+    g.add_argument("--no-cp-comm-overlap", action="store_false",
+                   dest="cp_comm_overlap",
+                   help="disable the latency-hiding ring-attention path "
+                        "(pre-issued KV hops + fused custom_vjp reverse "
+                        "ring, ops/context_parallel.py); falls back to "
+                        "the plain unrolled ring")
+    g.add_argument("--no-moe-comm-overlap", action="store_false",
+                   dest="moe_comm_overlap",
+                   help="disable the chunked latency-hiding MoE "
+                        "all-to-all (transformer/moe.py); falls back to "
+                        "the bulk two-collective dispatch")
     g.add_argument("--use-distributed-optimizer", action="store_true",
                    default=True)
     g.add_argument("--cp-comm-type", default="p2p",
@@ -390,6 +401,8 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
                 if args.hierarchical_context_parallel_sizes else 2),
             remat_policy=args.recompute_granularity,
             tp_comm_overlap=args.tp_comm_overlap,
+            cp_comm_overlap=args.cp_comm_overlap,
+            moe_comm_overlap=args.moe_comm_overlap,
             attention_impl=args.attention_impl,
             flash_min_seq=args.flash_min_seq,
             scan_unroll=args.scan_unroll,

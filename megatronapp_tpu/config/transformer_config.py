@@ -146,6 +146,19 @@ class TransformerConfig:
     # chunks i and 2cp-1-i — the reference's TE ring behavior). Disable to
     # force the contiguous-layout ring (debug/oracle comparisons).
     cp_zigzag: bool = True
+    # Latency-hiding contiguous ring attention (ops/context_parallel.py):
+    # every KV-block ppermute hop is issued before the block compute it
+    # feeds, and the p2p ring carries a custom_vjp whose backward runs the
+    # symmetric reverse ring fused with the dK/dV accumulation (one pass,
+    # accumulators travel with their blocks). Disable to fall back to the
+    # plain unrolled ring differentiated by autodiff (debug/A-B baselines).
+    cp_comm_overlap: bool = True
+    # Latency-hiding MoE expert dispatch (transformer/moe.py
+    # _chunked_a2a_ffn): the ep token exchange is decomposed into per-peer
+    # ppermute hops, each issued before the expert GEMMs on the
+    # previously-arrived chunk (results return the same way). Disable for
+    # the bulk two-all_to_all dispatch (debug/A-B baselines).
+    moe_comm_overlap: bool = True
 
     # Kernel implementation selection (spec_utils.py ModuleSpec analogue):
     # 'reference' = pure jnp; 'pallas' = fused Pallas flash attention;

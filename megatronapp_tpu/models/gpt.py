@@ -326,9 +326,18 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
         # of cos/sin then picks each rank's zigzag positions. Targets are
         # permuted identically below, so the loss is unchanged.
         idx = jnp.asarray(zigzag_indices(s, ctx.cp))
-        tokens_mb = jnp.take(tokens_mb, idx, axis=2)
-        targets_mb = jnp.take(targets_mb, idx, axis=2)
-        loss_mask_mb = jnp.take(loss_mask_mb, idx, axis=2)
+        # jnp.take along the cp-SHARDED seq axis of the dp-sharded batch
+        # arrays makes this build's SPMD partitioner emit an invalid
+        # dynamic-slice (hlo verifier: "Slice dim size > dynamic slice
+        # dimension" when mb is dp-sharded and seq cp-sharded). The
+        # arrays are tiny ([M, mb, S] ints/mask), so replicate them for
+        # the permutation — the embed/pipeline constraints re-shard
+        # immediately downstream.
+        rep = jax.sharding.NamedSharding(ctx.mesh,
+                                         jax.sharding.PartitionSpec())
+        tokens_mb, targets_mb, loss_mask_mb = (
+            jnp.take(jax.lax.with_sharding_constraint(x, rep), idx, axis=2)
+            for x in (tokens_mb, targets_mb, loss_mask_mb))
         positions = idx
     # fp32 across the shard_map boundary (spmd_pipeline casts to the compute
     # dtype at microbatch injection — see pipeline.py body notes).

@@ -8,27 +8,40 @@ kernel of its own; here each mode is implemented natively on the 'cp' mesh
 axis (SURVEY §5.7: "must implement ring attention + all-to-all head-parallel
 attention natively ... collective permute over ICI").
 
-All functions run INSIDE a shard_map manual over 'cp' with sequence sharded
-[B, S/cp, H, D] per shard; `context_attention` is the outer wrapper that
-sets up the shard_map (auto for every other axis).
+All impl functions run INSIDE a shard_map manual over 'cp' with sequence
+sharded [B, S/cp, H, D] per shard; `context_attention` is the outer wrapper
+that sets up the shard_map. The wrapper is FULLY manual over every mesh axis
+(parallel/collectives.shard_map_compat): batch threads over (dp, ep), heads
+over tp when divisible, pp rides replicated — on the jax 0.4.x builds this
+image ships, partial-auto manual regions lower ppermute/axis_index through
+an SPMD path XLA:CPU aborts on (parallel/overlap.py design notes).
 
 Ring attention = blockwise online-softmax attention (flash-attention style
 running max/sum in fp32) with K,V blocks rotated around the cp ring via
-ppermute — each hop rides a single ICI neighbor link. Causal masking skips
-future blocks entirely (their contribution is zero), matching the reference
-ring's P2P schedule.
+ppermute. The rings are LATENCY-HIDING: every hop is issued BEFORE the
+dependent block's attention compute, so on hardware with an async collective
+engine (TPU ICI) the permute of block s+1 rides under the flash update of
+block s (T3-style fine-grained overlap, arXiv:2401.16677; XLA:CPU runs the
+hop synchronously, so CPU-mesh wins come from the causal block skip below).
 
 Causal ring comes in two layouts:
-- contiguous (`ring_attention`): rank i holds sequence chunk i. Every
-  lock-step round computes the full local score block (masked-out blocks
-  still burn MXU time), so per-rank cost is the full S²/cp — no causal
-  savings.
+- contiguous (`ring_attention`): rank i holds sequence chunk i. Blocks from
+  ranks src > i are entirely masked under causal attention and are SKIPPED
+  (lax.cond) — per-rank cost ranges from S²/cp² (rank 0) to S²/cp (rank
+  cp-1), total S²/2cp on average but imbalanced across ranks.
+  When `overlap=True` and no segment ids, this path carries a
+  ``jax.custom_vjp`` whose backward runs the symmetric reverse ring FUSED:
+  one ring pass rotates (K, V, dK, dV) together — each rank adds its dK/dV
+  contribution for the block it holds while the next K/V hop is already in
+  flight, and after cp hops the accumulated dK/dV land back on their home
+  rank. dQ accumulates locally (no extra pass).
 - zigzag (`zigzag_ring_attention`): rank i holds chunks (i, 2cp-1-i) of a
   2cp-way split (the reference's TE ring layout). Each non-diagonal round
   computes exactly half the score block — the visible half is known from
   (rank, src) alone — so per-rank cost is ~S²/(2cp), balanced across ranks.
   Callers permute the sequence into zigzag order first (`zigzag_indices`);
-  models do this transparently (models/gpt.py).
+  models do this transparently (models/gpt.py). Hops are pre-issued the
+  same way.
 """
 
 from __future__ import annotations
@@ -38,12 +51,25 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from megatronapp_tpu.config.parallel_config import CP_AXIS
+from megatronapp_tpu.config.parallel_config import (
+    CP_AXIS, DP_AXIS, EP_AXIS, TP_AXIS,
+)
 from megatronapp_tpu.ops.attention import repeat_kv
+from megatronapp_tpu.parallel.collectives import (
+    axis_size, full_like_vma, ring_span, shard_map_compat, zeros_like_vma,
+)
 
 _NEG_INF = -1e30
+
+# MegaScan span names (trace/tracer.py GRANULARITY_EVENTS 'collective').
+CP_OVERLAP_COMPUTE_EVENT = "cp-overlap-compute"
+CP_OVERLAP_PERMUTE_EVENT = "cp-overlap-permute"
+
+# Activation batch dims shard over (dp, ep) — mesh.py batch_spec.
+_BATCH = (DP_AXIS, EP_AXIS)
 
 
 def _block_scores(q, k, scale):
@@ -53,37 +79,211 @@ def _block_scores(q, k, scale):
     return s * scale
 
 
+def _mark(ph: str, kind: str, dep, axis_name, *, op: str, step: int):
+    name = (CP_OVERLAP_COMPUTE_EVENT if kind == "compute"
+            else CP_OVERLAP_PERMUTE_EVENT)
+    ring_span(name, ph, dep, axis_name, step=step, op=op)
+
+
+# ---------------------------------------------------------------------------
+# Contiguous ring, overlapped custom_vjp path (causal/bidirectional, no
+# segment ids): pre-issued hops + fused reverse-ring backward.
+# ---------------------------------------------------------------------------
+
+def _softmax_block_update(o, m, l, s, v_blk, h):
+    """One online-softmax update with UNnormalized state (o, m, l) and
+    pre-masked scores s [B,H,Sq,Skv]; v_blk [B,Skv,Hkv,Dv]."""
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+    p = jnp.exp(s - m_safe[..., None])
+    corr = jnp.exp(jnp.minimum(m - m_new, 0.0))
+    corr = jnp.where(m <= _NEG_INF / 2, 0.0, corr)
+    l = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v_blk.dtype),
+                    repeat_kv(v_blk, h),
+                    preferred_element_type=jnp.float32)
+    o = o * corr[..., None] + pv
+    return o, m_new, l
+
+
+def _ring_overlap_fwd_impl(axis_name, causal, scale, q, k, v):
+    cp = axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    dv = v.shape[-1]
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+    op = "ring-attention"
+
+    o = zeros_like_vma((b, h, sq, dv), jnp.float32, q)
+    m = full_like_vma((b, h, sq), _NEG_INF, jnp.float32, q)
+    l = zeros_like_vma((b, h, sq), jnp.float32, q)
+    k_blk, v_blk = k, v
+    for step in range(cp):
+        nxt = None
+        if step + 1 < cp:
+            # Issue the hop BEFORE the dependent flash update so it rides
+            # under the compute (TPU async collectives; XLA:CPU is sync).
+            _mark("B", "permute", k_blk, axis_name, op=op, step=step)
+            nxt = (lax.ppermute(k_blk, axis_name, perm),
+                   lax.ppermute(v_blk, axis_name, perm))
+
+        def update(o, m, l, k_blk=k_blk, v_blk=v_blk, step=step):
+            s = _block_scores(q, repeat_kv(k_blk, h), scale)
+            if causal and step == 0:
+                # Diagonal block: causal mask within the chunk. Off-diagonal
+                # causal blocks are either fully visible (src < me) or
+                # skipped entirely below.
+                within = (jnp.arange(sq)[:, None]
+                          >= jnp.arange(k_blk.shape[1])[None, :])
+                s = jnp.where(within[None, None], s, _NEG_INF)
+            return _softmax_block_update(o, m, l, s, v_blk, h)
+
+        _mark("B", "compute", k_blk, axis_name, op=op, step=step)
+        if causal and step > 0:
+            # After `step` rotations this rank holds the block originally
+            # from src = me - step; src > me ⇒ entirely in the future ⇒
+            # skip the whole block's FLOPs (cond, not select).
+            src = (me - step) % cp
+            o, m, l = lax.cond(src > me, lambda o, m, l: (o, m, l), update,
+                               o, m, l)
+        else:
+            o, m, l = update(o, m, l)
+        _mark("E", "compute", o, axis_name, op=op, step=step)
+        if nxt is not None:
+            _mark("E", "permute", nxt[0], axis_name, op=op, step=step)
+            k_blk, v_blk = nxt
+
+    lse = m + jnp.log(jnp.maximum(l, 1e-20))
+    out = o / jnp.maximum(l, 1e-20)[..., None]
+    out = jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Sq,H,Dv]
+    return out, lse
+
+
+def _ring_overlap_bwd_impl(axis_name, causal, scale, res, do):
+    """Fused reverse ring: ONE pass rotates (k, v, dk, dv) together.
+
+    Each rank adds its dK/dV contribution for the block it currently holds
+    (the K/V hop for the NEXT block is pre-issued before the compute, so it
+    rides underneath); the accumulators hop with their blocks and after cp
+    hops land back home. dQ accumulates locally."""
+    q, k, v, out, lse = res
+    cp = axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    hkv, dv = k.shape[2], v.shape[-1]
+    g = h // hkv
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+    op = "ring-attention-bwd"
+
+    q32 = q.astype(jnp.float32)
+    do32 = do.astype(jnp.float32)
+    # D_i = sum_e do_ie * out_ie (rowwise), the flash-backward correction.
+    delta = jnp.einsum("bqhe,bqhe->bhq", do32, out.astype(jnp.float32))
+
+    dq = zeros_like_vma((b, sq, h, d), jnp.float32, q)
+    dk_blk = zeros_like_vma(k.shape, jnp.float32, q)
+    dv_blk = zeros_like_vma(v.shape, jnp.float32, q)
+    k_blk, v_blk = k, v
+    for step in range(cp):
+        nxt = None
+        if step + 1 < cp:
+            _mark("B", "permute", k_blk, axis_name, op=op, step=step)
+            nxt = (lax.ppermute(k_blk, axis_name, perm),
+                   lax.ppermute(v_blk, axis_name, perm))
+
+        def update(dq, dk_blk, dv_blk, k_blk=k_blk, v_blk=v_blk, step=step):
+            skv = k_blk.shape[1]
+            s = _block_scores(q, repeat_kv(k_blk, h), scale)
+            if causal and step == 0:
+                within = (jnp.arange(sq)[:, None]
+                          >= jnp.arange(skv)[None, :])
+                s = jnp.where(within[None, None], s, _NEG_INF)
+            # lse-normalized probabilities (rows always have ≥1 visible
+            # key on the un-skipped blocks, so lse is finite).
+            p = jnp.exp(s - lse[..., None])                    # [B,H,Sq,Skv]
+            dv_rep = jnp.einsum("bhqk,bqhe->bkhe", p, do32)
+            dp = jnp.einsum("bqhe,bkhe->bhqk", do32,
+                            repeat_kv(v_blk, h).astype(jnp.float32))
+            ds = p * (dp - delta[..., None]) * scale
+            dq_add = jnp.einsum("bhqk,bkhd->bqhd", ds,
+                                repeat_kv(k_blk, h).astype(jnp.float32))
+            dk_rep = jnp.einsum("bhqk,bqhd->bkhd", ds, q32)
+            # GQA: fold the repeated query-head groups back onto kv heads.
+            dk_add = dk_rep.reshape(b, skv, hkv, g, d).sum(3)
+            dv_add = dv_rep.reshape(b, skv, hkv, g, dv).sum(3)
+            return dq + dq_add, dk_blk + dk_add, dv_blk + dv_add
+
+        _mark("B", "compute", k_blk, axis_name, op=op, step=step)
+        if causal and step > 0:
+            src = (me - step) % cp
+            dq, dk_blk, dv_blk = lax.cond(
+                src > me, lambda a, b_, c: (a, b_, c), update,
+                dq, dk_blk, dv_blk)
+        else:
+            dq, dk_blk, dv_blk = update(dq, dk_blk, dv_blk)
+        _mark("E", "compute", dq, axis_name, op=op, step=step)
+        # The accumulators travel WITH their block: after this hop the
+        # next rank holds (block, partial dK/dV) together; the cp-th hop
+        # returns them to the block's home rank.
+        dk_blk = lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = lax.ppermute(dv_blk, axis_name, perm)
+        if nxt is not None:
+            _mark("E", "permute", nxt[0], axis_name, op=op, step=step)
+            k_blk, v_blk = nxt
+
+    return (dq.astype(q.dtype), dk_blk.astype(k.dtype),
+            dv_blk.astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _ring_overlap(axis_name, causal, scale, q, k, v):
+    return _ring_overlap_fwd_impl(axis_name, causal, scale, q, k, v)[0]
+
+
+def _ring_overlap_fwd(axis_name, causal, scale, q, k, v):
+    out, lse = _ring_overlap_fwd_impl(axis_name, causal, scale, q, k, v)
+    return out, (q, k, v, out, lse)
+
+
+_ring_overlap.defvjp(_ring_overlap_fwd, _ring_overlap_bwd_impl)
+
+
 def ring_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
                    softmax_scale: Optional[float] = None,
-                   segment_ids=None):
+                   segment_ids=None, overlap: bool = True):
     """Ring attention over the cp axis (inside shard_map).
 
     q,k,v: local [B, S/cp, H(q)/H(kv), D]. Returns [B, S/cp, H, D].
     segment_ids: local [B, S/cp] packed map — kv segment ids ride the ring
     with the k/v blocks and mask cross-segment scores.
+
+    overlap=True (and no segment ids): the latency-hiding custom_vjp path
+    (pre-issued hops, fused reverse-ring backward, causal block skip).
+    Segment ids route through the general unrolled ring below, which
+    pre-issues its hops the same way but differentiates through the loop.
     """
-    cp = jax.lax.axis_size(axis_name)
-    my = jax.lax.axis_index(axis_name)
+    cp = axis_size(axis_name)
+    my = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
-    dv = v.shape[-1]  # may differ from d (MLA: nope+rope keys vs values)
     if softmax_scale is None:
         softmax_scale = 1.0 / (d ** 0.5)
+    if overlap and segment_ids is None:
+        return _ring_overlap(axis_name, causal, float(softmax_scale),
+                             q, k, v)
     # GQA: K/V ride the ring un-repeated (fewer bytes per ppermute hop);
     # heads are broadcast per block at the matmul.
+    dv = v.shape[-1]  # may differ from d (MLA: nope+rope keys vs values)
 
     # fp32 online-softmax state; varying-manual-axes type inherited from q
     # (cp here, plus pp when nested inside the pipeline shard_map — parent
     # axis names cannot be referenced directly in a nested manual region).
-    from megatronapp_tpu.parallel.collectives import (
-        full_like_vma, zeros_like_vma,
-    )
     o = zeros_like_vma((b, h, sq, dv), jnp.float32, q)
     m = full_like_vma((b, h, sq), _NEG_INF, jnp.float32, q)
     l = zeros_like_vma((b, h, sq), jnp.float32, q)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
     def block_update(o, m, l, k_blk, v_blk, src, kv_seg_blk=None):
-        s = _block_scores(q, repeat_kv(k_blk, h), softmax_scale)  # [B,H,Sq,Skv]
+        s = _block_scores(q, repeat_kv(k_blk, h), softmax_scale)
         blk_mask = None
         if causal:
             # Block-level: src > my → entirely masked; src == my → causal
@@ -117,35 +317,19 @@ def ring_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
         o = o * corr[..., None] + pv
         return o, m_new, l
 
-    # Local block first, then cp-1 rotate-then-compute steps — the final
-    # rotation (returning blocks home) would be wasted ICI traffic.
-    o, m, l = block_update(o, m, l, k, v, my, segment_ids)
-
-    if segment_ids is None:
-        def body(carry, step):
-            o, m, l, k_blk, v_blk = carry
-            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-            # After `step` rotations my shard holds the block originally
-            # from rank (my - step) mod cp.
-            src = (my - step) % cp
-            o, m, l = block_update(o, m, l, k_blk, v_blk, src)
-            return (o, m, l, k_blk, v_blk), None
-
-        (o, m, l, _, _), _ = jax.lax.scan(body, (o, m, l, k, v),
-                                          jnp.arange(1, cp))
-    else:
-        def body(carry, step):
-            o, m, l, k_blk, v_blk, seg_blk = carry
-            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-            seg_blk = jax.lax.ppermute(seg_blk, axis_name, perm)
-            src = (my - step) % cp
-            o, m, l = block_update(o, m, l, k_blk, v_blk, src, seg_blk)
-            return (o, m, l, k_blk, v_blk, seg_blk), None
-
-        (o, m, l, _, _, _), _ = jax.lax.scan(
-            body, (o, m, l, k, v, segment_ids), jnp.arange(1, cp))
+    # Unrolled ring with pre-issued hops: the hop for block s+1 is issued
+    # before block s's flash update, so it can ride underneath. The final
+    # rotation (returning blocks home) would be wasted traffic — skipped.
+    carry = (k, v) if segment_ids is None else (k, v, segment_ids)
+    nxt = None
+    for step in range(cp):
+        if step + 1 < cp:
+            nxt = tuple(lax.ppermute(x, axis_name, perm) for x in carry)
+        src = (my - step) % cp
+        o, m, l = block_update(o, m, l, carry[0], carry[1], src,
+                               carry[2] if segment_ids is not None else None)
+        if nxt is not None:
+            carry, nxt = nxt, None
     out = o / jnp.maximum(l, 1e-20)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Sq,H,D]
 
@@ -201,6 +385,8 @@ def zigzag_ring_attention(q, k, v, axis_name: str = CP_AXIS,
     The two off-diagonal cases each compute a half-size score block of
     EQUAL flop count, selected with lax.cond — every rank does the same
     work every round (~S²/(2cp) total vs the contiguous ring's S²/cp).
+    The ring is unrolled with every hop issued BEFORE the round it feeds,
+    so the permute rides under the previous round's half-block compute.
     Reference: TE ring P2P zigzag (transformer_config.py:458-462 cp_comm_
     type='p2p'); layout produced by get_batch_on_this_cp_rank-style
     permutation (training/utils.py).
@@ -212,13 +398,14 @@ def zigzag_ring_attention(q, k, v, axis_name: str = CP_AXIS,
         # Bidirectional attention has no imbalance; plain ring is optimal.
         return ring_attention(q, k, v, axis_name, causal=False,
                               softmax_scale=softmax_scale)
-    cp = jax.lax.axis_size(axis_name)
-    my = jax.lax.axis_index(axis_name)
+    cp = axis_size(axis_name)
+    my = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
     dv = v.shape[-1]
     c = sq // 2  # one global chunk
     if softmax_scale is None:
         softmax_scale = 1.0 / (d ** 0.5)
+    op = "zigzag-ring-attention"
 
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
@@ -246,6 +433,15 @@ def zigzag_ring_attention(q, k, v, axis_name: str = CP_AXIS,
                 jax.lax.dynamic_update_slice_in_dim(m, m_new, rows[0], axis=2),
                 jax.lax.dynamic_update_slice_in_dim(l, l_r, rows[0], axis=2))
 
+    # Hop 1 is issued BEFORE the diagonal round so it rides under it.
+    k_blk, v_blk = k, v
+    nxt = None
+    if cp > 1:
+        _mark("B", "permute", k_blk, axis_name, op=op, step=0)
+        nxt = (lax.ppermute(k_blk, axis_name, perm),
+               lax.ppermute(v_blk, axis_name, perm))
+        _mark("E", "permute", nxt[0], axis_name, op=op, step=0)
+
     # Diagonal round (src == my): full local block with the zigzag position
     # mask (half the scores are masked; only paid once).
     q_pos = positions(my)
@@ -259,20 +455,24 @@ def zigzag_ring_attention(q, k, v, axis_name: str = CP_AXIS,
     o = jnp.einsum("bhqk,bkhd->bhqd", p0.astype(v.dtype), repeat_kv(v, h),
                    preferred_element_type=jnp.float32)
 
-    def body(carry, step):
-        o, m, l, k_blk, v_blk = carry
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    for step in range(1, cp):
+        k_blk, v_blk = nxt
+        nxt = None
+        if step + 1 < cp:
+            _mark("B", "permute", k_blk, axis_name, op=op, step=step)
+            nxt = (lax.ppermute(k_blk, axis_name, perm),
+                   lax.ppermute(v_blk, axis_name, perm))
+            _mark("E", "permute", nxt[0], axis_name, op=op, step=step)
         src = (my - step) % cp
 
-        def lower(o, m, l):
+        def lower(o, m, l, k_blk=k_blk, v_blk=v_blk):
             # src < my: kv chunk `src` (first half) fully visible to all q.
             k_lo = repeat_kv(k_blk[:, :c], h)
             v_lo = repeat_kv(v_blk[:, :c], h)
             s = _block_scores(q, k_lo, softmax_scale)  # [B,H,2c,c]
             return softmax_update(o, m, l, s, v_lo, (0, sq))
 
-        def upper(o, m, l):
+        def upper(o, m, l, k_blk=k_blk, v_blk=v_blk):
             # src > my: q chunk `2cp-1-my` (second half) sees both kv
             # chunks fully.
             k_all = repeat_kv(k_blk, h)
@@ -280,11 +480,10 @@ def zigzag_ring_attention(q, k, v, axis_name: str = CP_AXIS,
             s = _block_scores(q[:, c:], k_all, softmax_scale)  # [B,H,c,2c]
             return softmax_update(o, m, l, s, v_all, (c, c))
 
+        _mark("B", "compute", k_blk, axis_name, op=op, step=step)
         o, m, l = jax.lax.cond(src < my, lower, upper, o, m, l)
-        return (o, m, l, k_blk, v_blk), None
+        _mark("E", "compute", o, axis_name, op=op, step=step)
 
-    (o, m, l, _, _), _ = jax.lax.scan(body, (o, m, l, k, v),
-                                      jnp.arange(1, cp))
     out = o / jnp.maximum(l, 1e-20)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
@@ -302,7 +501,7 @@ def ulysses_attention(q, k, v, axis_name: str = CP_AXIS, causal: bool = True,
     from megatronapp_tpu.ops.attention import dot_product_attention
     from megatronapp_tpu.config.transformer_config import AttnMaskType
 
-    cp = jax.lax.axis_size(axis_name)
+    cp = axis_size(axis_name)
 
     def scatter_heads(x):
         # [B, S/cp, H, D] → [B, S, H/cp, D]
@@ -342,8 +541,8 @@ def allgather_attention(q, k, v, axis_name: str = CP_AXIS,
     from megatronapp_tpu.ops.attention import dot_product_attention
     from megatronapp_tpu.config.transformer_config import AttnMaskType
 
-    cp = jax.lax.axis_size(axis_name)
-    my = jax.lax.axis_index(axis_name)
+    cp = axis_size(axis_name)
+    my = lax.axis_index(axis_name)
     sq = q.shape[1]
     k_full = jax.lax.all_gather(k, axis_name, axis=1, tiled=True)
     v_full = jax.lax.all_gather(v, axis_name, axis=1, tiled=True)
@@ -370,7 +569,8 @@ def hierarchical_attention(q, k, v, axis_name: str = CP_AXIS,
     transformer_config.py:458-462 + hierarchical CP groups
     parallel_state.py:100-121): Ulysses head-scatter WITHIN inner groups of
     `a2a_size` adjacent ranks (cheap links), ring P2P ACROSS the
-    ring_size = cp/a2a_size outer groups (one KV span per hop).
+    ring_size = cp/a2a_size outer groups (one KV span per hop, pre-issued
+    before the round it feeds like the flat rings).
 
     After the inner all-to-all each rank holds its inner group's contiguous
     sequence span [g*S/ring, (g+1)*S/ring) with H/a2a_size heads; the outer
@@ -383,10 +583,10 @@ def hierarchical_attention(q, k, v, axis_name: str = CP_AXIS,
     the K/V spans' ids ride the outer ring with them; the within-segment
     equality mask composes with the group-granular causal mask per block.
     """
-    cp = jax.lax.axis_size(axis_name)
+    cp = axis_size(axis_name)
     assert cp % a2a_size == 0, (cp, a2a_size)
     ring_size = cp // a2a_size
-    my = jax.lax.axis_index(axis_name)
+    my = lax.axis_index(axis_name)
     my_group = my // a2a_size
     inner_groups = [[g * a2a_size + i for i in range(a2a_size)]
                     for g in range(ring_size)]
@@ -449,36 +649,25 @@ def hierarchical_attention(q, k, v, axis_name: str = CP_AXIS,
         o = o * corr[..., None] + pv
         return o, m_new, l
 
-    from megatronapp_tpu.parallel.collectives import (
-        full_like_vma, zeros_like_vma,
-    )
     o = zeros_like_vma((b, h, sq, dv), jnp.float32, q)
     m = full_like_vma((b, h, sq), _NEG_INF, jnp.float32, q)
     l = zeros_like_vma((b, h, sq), jnp.float32, q)
+
+    # Pre-issue the first outer-ring hop so it rides under the diagonal
+    # span's compute (same discipline as the flat rings).
+    carry = (k, v) if segs is None else (k, v, segs)
+    nxt = None
+    if ring_size > 1:
+        nxt = tuple(lax.ppermute(x, axis_name, perm) for x in carry)
     o, m, l = block_update(o, m, l, k, v, my_group, segs)
 
-    def body(carry, step):
-        if segs is None:
-            o, m, l, k_blk, v_blk = carry
-            kv_segs_blk = None
-        else:
-            o, m, l, k_blk, v_blk, kv_segs_blk = carry
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        if kv_segs_blk is not None:
-            kv_segs_blk = jax.lax.ppermute(kv_segs_blk, axis_name, perm)
+    for step in range(1, ring_size):
+        carry, nxt = nxt, None
+        if step + 1 < ring_size:
+            nxt = tuple(lax.ppermute(x, axis_name, perm) for x in carry)
         src_group = (my_group - step) % ring_size
-        o, m, l = block_update(o, m, l, k_blk, v_blk, src_group,
-                               kv_segs_blk)
-        new_carry = ((o, m, l, k_blk, v_blk) if segs is None
-                     else (o, m, l, k_blk, v_blk, kv_segs_blk))
-        return new_carry, None
-
-    if ring_size > 1:
-        init = ((o, m, l, k, v) if segs is None
-                else (o, m, l, k, v, segs))
-        carry, _ = jax.lax.scan(body, init, jnp.arange(1, ring_size))
-        o, m, l = carry[0], carry[1], carry[2]
+        o, m, l = block_update(o, m, l, carry[0], carry[1], src_group,
+                               carry[2] if segs is not None else None)
     out = o / jnp.maximum(l, 1e-20)[..., None]
     out = jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
     return gather_heads(out)
@@ -516,19 +705,35 @@ def zigzag_active(cfg, ctx) -> bool:
 def context_attention(q, k, v, mesh, cp_comm_type: str = "p2p",
                       causal: bool = True,
                       softmax_scale: Optional[float] = None,
-                      segment_ids=None, a2a_size: int = 2):
-    """Outer wrapper: shard_map over 'cp' (auto for all other axes).
+                      segment_ids=None, a2a_size: int = 2,
+                      overlap_ring: bool = True):
+    """Outer wrapper: FULL-MANUAL shard_map over every mesh axis.
 
     q,k,v: GLOBAL [B, S, H, D] arrays with S sharded over cp. Returns global
     [B, S, H, D] with the same sharding. segment_ids: GLOBAL [B, S] packed
     map (sharded over cp alongside the sequence).
-    """
+
+    The manual region threads batch over (dp, ep) and heads over tp when
+    they divide evenly (replicating them otherwise — identical math,
+    redundant compute, exactly what GSPMD would emit for an unshardable
+    dim); pp rides replicated. Partial-auto regions (cp manual, rest auto)
+    abort XLA:CPU on this jax build — see the module docstring.
+
+    S not divisible by cp is zero-padded to the next multiple and the pad
+    masked out via synthetic segment ids (pad tokens get segment 0, real
+    tokens segment ids shifted up by 1), so every mode stays exact;
+    the padded rows are sliced off on return.
+
+    overlap_ring: route the contiguous ring through the latency-hiding
+    custom_vjp path (TransformerConfig.cp_comm_overlap)."""
     if cp_comm_type not in _CP_IMPLS:
         raise ValueError(
             f"cp_comm_type must be one of {sorted(_CP_IMPLS)}, got "
             f"{cp_comm_type!r}")
     impl = _CP_IMPLS[cp_comm_type]
     extra = ({"a2a_size": a2a_size} if cp_comm_type == "a2a+p2p" else {})
+    if cp_comm_type == "p2p":
+        extra["overlap"] = overlap_ring
     fn = functools.partial(impl, causal=causal, softmax_scale=softmax_scale,
                            **extra)
 
@@ -539,19 +744,52 @@ def context_attention(q, k, v, mesh, cp_comm_type: str = "p2p",
     if CP_AXIS in current_manual_axes():
         return fn(q, k, v, segment_ids=segment_ids)
 
+    cp = mesh.shape[CP_AXIS]
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+
+    pad = (-s) % cp
+    if pad:
+        if cp_comm_type == "p2p_zigzag":
+            raise ValueError(
+                "zigzag layout requires seq divisible by 2*cp; callers "
+                "(zigzag_indices) enforce this before permuting")
+        if segment_ids is None:
+            segment_ids = jnp.ones((b, s), jnp.int32)
+        else:
+            segment_ids = segment_ids + 1  # keep 0 free for the pad
+        segment_ids = jnp.pad(segment_ids, ((0, 0), (0, pad)))
+        q = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+    # Heads shard over tp when every per-shard constraint holds; otherwise
+    # they stay replicated across tp (redundant compute, exact math).
+    tp = mesh.shape[TP_AXIS]
+    heads_tp = tp > 1 and h % tp == 0 and hkv % tp == 0
+    if heads_tp and cp_comm_type == "a2a":
+        heads_tp = (h // tp) % cp == 0 and (hkv // tp) % cp == 0
+    if heads_tp and cp_comm_type == "a2a+p2p":
+        heads_tp = (h // tp) % a2a_size == 0 and (hkv // tp) % a2a_size == 0
+    head_spec = TP_AXIS if heads_tp else None
+    # Batch threads over the (dp, ep) shards when it divides evenly.
+    dpep = mesh.shape[DP_AXIS] * mesh.shape[EP_AXIS]
+    batch_spec = _BATCH if b % dpep == 0 else None
+
+    qkv_spec = P(batch_spec, CP_AXIS, head_spec, None)
+    seg_spec = P(batch_spec, CP_AXIS)
     if segment_ids is None:
-        sm = jax.jit(jax.shard_map(
+        sm = jax.jit(shard_map_compat(
             lambda q, k, v: fn(q, k, v),
-            mesh=mesh,
-            in_specs=(P(None, CP_AXIS), P(None, CP_AXIS), P(None, CP_AXIS)),
-            out_specs=P(None, CP_AXIS),
-            axis_names={CP_AXIS}))
-        return sm(q, k, v)
-    sm = jax.jit(jax.shard_map(
-        lambda q, k, v, s: fn(q, k, v, segment_ids=s),
-        mesh=mesh,
-        in_specs=(P(None, CP_AXIS), P(None, CP_AXIS), P(None, CP_AXIS),
-                  P(None, CP_AXIS)),
-        out_specs=P(None, CP_AXIS),
-        axis_names={CP_AXIS}))
-    return sm(q, k, v, segment_ids)
+            mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec))
+        out = sm(q, k, v)
+    else:
+        sm = jax.jit(shard_map_compat(
+            lambda q, k, v, s: fn(q, k, v, segment_ids=s),
+            mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),
+            out_specs=qkv_spec))
+        out = sm(q, k, v, segment_ids)
+    return out[:, :s] if pad else out

@@ -4,14 +4,80 @@ The reference wraps torch.distributed in
 virtual_tensor_parallel_communication.py; here the collectives themselves are
 jax.lax primitives — this module only holds small shared utilities for code
 running inside shard_map manual regions.
+
+This module and ``parallel/overlap.py`` are the designated homes for raw
+manual collectives (tools/check_vma.py); every full-manual subsystem
+(tp overlap, cp ring attention, ep all-to-all dispatch, the pp pipeline)
+builds on the compat wrappers here.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+
+
+def shard_map_compat(body, mesh, in_specs, out_specs):
+    """FULL-MANUAL shard_map across jax versions.
+
+    Newer jax: ``jax.shard_map(..., check_vma=False)`` (the bodies are
+    plain ring code; vma annotation adds nothing under full manual).
+    jax 0.4.x (this image): ``jax.experimental.shard_map.shard_map`` with
+    ``check_rep=False`` — the old rep checker predates varying-manual-axes
+    types and rejects valid ring accumulations.
+
+    Full manual (every mesh axis) is load-bearing on this stack: the jax
+    0.4.x partial-auto manual regions lower ppermute/axis_index through an
+    SPMD path XLA:CPU aborts on (spmd_partitioner IsManualSubgroup check /
+    unsupported PartitionId) — see parallel/overlap.py design notes. Axes a
+    body does not communicate over are simply threaded through the specs
+    (split batch dims) or replicated (unmentioned spec dims).
+
+    Autodiff note (verified on jax 0.4.37): grads of inputs whose spec
+    leaves axes unmentioned come out correct — the transpose feeds output
+    cotangents to a single shard along unmentioned out-spec axes and sums
+    input cotangents across unmentioned in-spec axes — so replicated
+    params (split batch) and redundantly-computed axes both transpose
+    right without explicit psums. Explicit psums are still required for
+    reductions the MATH needs inside custom_vjp bodies (e.g. wgrads
+    across manual batch shards in overlap.py)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(body, mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a bound mesh axis, across jax versions.
+
+    jax 0.4.x has no ``lax.axis_size``; ``lax.psum(1, name)`` is the
+    canonical spelling there and constant-folds to a Python int."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pvary(x, axes: Tuple[str, ...]):
+    """Mark a replicated-over-``axes`` input as varying inside a manual
+    region, so its cotangent is psummed over ``axes`` exactly once.
+
+    Version-portable replacement for ``lax.pcast(x, axes, to="varying")``
+    at full-manual shard_map boundaries (pipeline stage params over cp and
+    the (dp, ep) microbatch shards; microbatch inputs over pp). On jax
+    0.4.x there is no pcast AND none is needed: the shard_map transpose
+    already psums input cotangents over every axis the in_spec leaves
+    unmentioned (verified on 0.4.37 — an explicit extra psum here would
+    double-count). Keep inputs fp32 at these call sites — bf16 manual
+    all-reduces crash this XLA:CPU build (README known constraints)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, tuple(axes), to="varying")
+    return x
 
 
 def current_manual_axes() -> Tuple[str, ...]:
@@ -34,6 +100,51 @@ def current_manual_axes() -> Tuple[str, ...]:
         return tuple(trace_ctx.axis_env.axis_names())
     except (ImportError, AttributeError):
         return ()
+
+
+def ring_span(name: str, ph: str, dep, axis_name: str, *, step=None,
+              **attrs):
+    """Per-hop MegaScan record from inside a jitted manual ring body.
+
+    Shared emission helper behind the tp/cp/ep overlap spans
+    (tp-overlap-*, cp-overlap-*, moe-a2a-*, pp-overlap-*). Inserted only
+    when tracing is enabled at trace time (zero overhead otherwise). Uses
+    ``jax.debug.callback`` — the only callback flavor supported inside
+    shard_map manual regions in this build (ordered io_callback is
+    rejected there); the data dependency on ``dep`` anchors the record
+    near the op it brackets. One timeline per rank along ``axis_name``
+    (tid = rank + 1; tid 0 stays the host-scope timeline).
+
+    The timeline id is the shard's linearized rank over EVERY ambient
+    manual axis (not just ``axis_name``): two shards that share a ring
+    rank but differ on another axis (e.g. the dp shards of one cp rank)
+    must not interleave B/E pairs onto one Chrome-trace tid, whose pairing
+    is a per-tid stack. On single-ring meshes this degenerates to
+    ring-rank + 1 exactly as before.
+
+    step may be a Python int (unrolled rings) or a traced scalar (the pp
+    schedule's scanned step) — it rides into the callback as an operand."""
+    from megatronapp_tpu.trace.tracer import callbacks_supported, get_tracer
+
+    tracer = get_tracer()
+    if not (tracer.enabled and callbacks_supported()):
+        return
+
+    rank = lax.axis_index(axis_name)
+    tid = jnp.zeros((), jnp.int32)
+    for n in sorted(current_manual_axes()):
+        tid = tid * axis_size(n) + lax.axis_index(n)
+
+    def _cb(rank_, tid_, step_, _):
+        a = dict(attrs, rank=int(rank_))
+        if int(step_) >= 0:
+            a["step"] = int(step_)
+        tracer.phase_event(name, ph, tid=int(tid_) + 1, **a)
+
+    anchor = lax.stop_gradient(dep).ravel()[0]
+    jax.debug.callback(_cb, rank, tid,
+                       jnp.asarray(-1 if step is None else step, jnp.int32),
+                       anchor)
 
 
 def _anchor(like: jnp.ndarray) -> jnp.ndarray:

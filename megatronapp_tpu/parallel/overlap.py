@@ -62,7 +62,9 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from megatronapp_tpu.config.parallel_config import DP_AXIS, EP_AXIS, TP_AXIS
-from megatronapp_tpu.parallel.collectives import zeros_like_vma
+from megatronapp_tpu.parallel.collectives import (
+    ring_span, shard_map_compat as _shard_map, zeros_like_vma,
+)
 
 # MegaScan span names (trace/tracer.py GRANULARITY_EVENTS 'collective').
 OVERLAP_COMPUTE_EVENT = "tp-overlap-compute"
@@ -72,22 +74,6 @@ OVERLAP_PERMUTE_EVENT = "tp-overlap-permute"
 _BATCH = (DP_AXIS, EP_AXIS)
 
 
-def _shard_map(body, mesh, in_specs, out_specs):
-    """Full-manual shard_map across jax versions.
-
-    Newer jax: ``jax.shard_map(..., check_vma=False)`` (the bodies are
-    plain ring code; vma annotation adds nothing under full manual).
-    jax 0.4.x (this image): ``jax.experimental.shard_map.shard_map`` with
-    ``check_rep=False`` — the old rep checker predates varying-manual-axes
-    types and rejects valid ring accumulations."""
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-    from jax.experimental.shard_map import shard_map as _sm
-    return _sm(body, mesh, in_specs=in_specs, out_specs=out_specs,
-               check_rep=False)
-
-
 def _ring_perm(tp: int):
     """Ring permutation: rank r sends to r-1, i.e. after one hop rank r
     holds what r+1 held — at step s every rank holds chunk (r + s) % tp."""
@@ -95,25 +81,8 @@ def _ring_perm(tp: int):
 
 
 def _mark(name: str, ph: str, dep, *, op: str, step: int):
-    """Per-chunk MegaScan record from inside the jitted ring body.
-
-    Inserted only when tracing is enabled at trace time (zero overhead
-    otherwise). Uses ``jax.debug.callback`` — the only callback flavor
-    supported inside shard_map manual regions in this build (ordered
-    io_callback is rejected there); the data dependency on ``dep`` anchors
-    the record near the op it brackets. One timeline per tp rank
-    (tid = rank + 1; tid 0 stays the host-scope timeline)."""
-    from megatronapp_tpu.trace.tracer import callbacks_supported, get_tracer
-
-    tracer = get_tracer()
-    if not (tracer.enabled and callbacks_supported()):
-        return
-
-    def _cb(rank, _):
-        tracer.phase_event(name, ph, tid=int(rank) + 1, op=op, step=step)
-
-    anchor = lax.stop_gradient(dep).ravel()[0]
-    jax.debug.callback(_cb, lax.axis_index(TP_AXIS), anchor)
+    """Per-chunk MegaScan record (collectives.ring_span over tp)."""
+    ring_span(name, ph, dep, TP_AXIS, op=op, step=step)
 
 
 def _round_up(n: int, m: int) -> int:

@@ -5,14 +5,27 @@ Parity with /root/reference/megatron/core/pipeline_parallel/schedules.py
 p2p_communication.py (:303 _communicate) — re-designed TPU-first:
 
 Instead of imperative per-rank send/recv schedules, the whole pipeline is ONE
-jitted SPMD program: a ``shard_map`` manual only over 'pp'
-(axis_names={'pp'}; tp/dp/cp/ep stay compiler-sharded inside the body), with
-a ``lax.scan`` over schedule steps and a ring ``ppermute`` carrying
-activations stage→stage. Differentiating the scan yields the reverse
-(backward) pipeline automatically — the transpose of ppermute is the reverse
-ppermute — so XLA schedules and overlaps what Megatron encodes by hand, and
-the 1F1B memory profile is recovered with per-stage rematerialization
-(stage inputs are the only per-step residuals).
+jitted SPMD program: a FULL-MANUAL ``shard_map`` over every mesh axis
+(parallel/collectives.shard_map_compat), with a ``lax.scan`` over schedule
+steps and a ring ``ppermute`` carrying activations stage→stage.
+Differentiating the scan yields the reverse (backward) pipeline
+automatically — the transpose of ppermute is the reverse ppermute — so XLA
+schedules and overlaps what Megatron encodes by hand, and the 1F1B memory
+profile is recovered with per-stage rematerialization (stage inputs are the
+only per-step residuals).
+
+Full manual (vs the earlier partial-auto region manual only over pp/cp):
+on the jax 0.4.x builds this image ships, partial-auto manual regions
+lower ppermute/axis_index through an SPMD path XLA:CPU aborts on
+(parallel/overlap.py design notes), and nested shard_maps are unsupported
+— so the body owns EVERY axis. The microbatch dim threads over (dp, ep)
+when it divides evenly, sequence over cp (attention dispatches to the cp
+ring impls directly via the ambient-manual check), and tp rides replicated
+inside the body (each tp rank redundantly computes the stage; the tp-GSPMD
+sharding of the old partial-auto region needed exactly the partial-auto
+mode this build aborts on). Stage hand-offs emit per-step
+``pp-overlap-permute`` MegaScan spans so the schedule's comm is visible in
+the merged trace.
 
 Unified schedule (steps t = 0..M*vpp + pp - 2), u = t - stage:
   round r = u // (pp*vpp), within-round w = u % (pp*vpp),
@@ -37,11 +50,19 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from megatronapp_tpu.config.parallel_config import CP_AXIS, PP_AXIS
+from megatronapp_tpu.config.parallel_config import (
+    CP_AXIS, DP_AXIS, EP_AXIS, PP_AXIS,
+)
 from megatronapp_tpu.parallel.mesh import MeshContext
 
 
-from megatronapp_tpu.parallel.collectives import zeros_like_vma
+from megatronapp_tpu.parallel.collectives import (
+    pvary, ring_span, shard_map_compat, zeros_like_vma,
+)
+
+# MegaScan span name for the stage→stage ring hop (tracer GRANULARITY
+# 'collective' set).
+PP_OVERLAP_PERMUTE_EVENT = "pp-overlap-permute"
 
 
 def reshape_params_for_pipeline(stacked_params, pp: int, vpp: int = 1):
@@ -170,34 +191,39 @@ def spmd_pipeline(
     mesh = ctx.mesh
     total_steps = M * vpp + pp - 1
     cycle = pp * vpp
-    # Context parallelism composes by WIDENING this manual region (nested
+    # Context parallelism composes INSIDE this (full-)manual region (nested
     # shard_maps are unreliable in this JAX build): with cp > 1 the body is
-    # manual over both pp and cp, sequence enters pre-sharded [.., S/cp, ..],
+    # manual over cp too, sequence enters pre-sharded [.., S/cp, ..],
     # and attention calls the ring/a2a impls directly (context_attention
-    # detects the ambient manual cp).
+    # detects the ambient manual cp). The microbatch dim threads over
+    # (dp, ep) when it divides evenly; otherwise it rides replicated
+    # (identical math, redundant compute).
     cp = ctx.cp
-    manual_axes = {PP_AXIS} | ({CP_AXIS} if cp > 1 else set())
+    mb_size = h_mb.shape[1]
+    dpep = ctx.dp * ctx.ep
+    batch_axes = (DP_AXIS, EP_AXIS) if mb_size % dpep == 0 else None
 
     def body(params_local, h_mb_in, aux_mb_in):
-        # params_local: [1, vpp, Lc, ...]; h_mb_in: [M, mb, S(/cp), H].
-        # h_mb_in MUST be fp32 at this boundary: its transpose-psum (and the
-        # pcast below) must not be a bf16 manual all-reduce (XLA:CPU bug —
+        # params_local: [1, vpp, Lc, ...]; h_mb_in: [M, mb(/dp/ep), S(/cp), H].
+        # h_mb_in MUST be fp32 at this boundary: its transpose-psum (the
+        # pvary below) must not be a bf16 manual all-reduce (XLA:CPU bug —
         # see collectives.zeros_like_vma). Casting to the compute dtype
-        # happens per injection, after the pcast.
-        h_mb_in = jax.lax.pcast(h_mb_in, (PP_AXIS,), to="varying")
+        # happens per injection, after the pvary.
+        h_mb_in = pvary(h_mb_in, (PP_AXIS,))
         aux_mb_in = jax.tree.map(
-            lambda a: jax.lax.pcast(a, (PP_AXIS,), to="varying"), aux_mb_in)
+            lambda a: pvary(a, (PP_AXIS,)), aux_mb_in)
         stage = jax.lax.axis_index(PP_AXIS)
         params_s = jax.tree.map(lambda x: x[0], params_local)
-        if cp > 1:
-            # Make params cp-varying up front: otherwise every bf16 use of a
-            # cp-invariant param inside the stage transposes to a bf16
-            # psum_invariant over cp (the XLA:CPU crash). Params are fp32
-            # here, so this pcast's transpose is a single fp32 psum per
-            # param — which is also exactly the cp grad reduction.
+        # Params enter replicated over the token-splitting axes (cp seq
+        # chunks; (dp, ep) microbatch shards) but every shard contributes a
+        # partial wgrad: pvary's backward is the single fp32 psum per param
+        # that IS the data-parallel/cp grad reduction. tp needs no entry —
+        # it computes redundantly, so per-tp-shard cotangents are already
+        # complete.
+        grad_axes = (batch_axes or ()) + ((CP_AXIS,) if cp > 1 else ())
+        if grad_axes:
             params_s = jax.tree.map(
-                lambda p: jax.lax.pcast(p, (CP_AXIS,), to="varying"),
-                params_s)
+                lambda p: pvary(p, grad_axes), params_s)
         layers_per_chunk = jax.tree.leaves(params_s)[0].shape[1]
         mb_shape = h_mb_in.shape[1:]
 
@@ -243,36 +269,48 @@ def spmd_pipeline(
             outputs = jax.lax.dynamic_update_index_in_dim(
                 outputs, jnp.where(collect, y, prev), m_safe, 0)
 
+            # Stage hand-off: one ring hop per schedule step. The span
+            # makes the exposed hop visible per pp rank in MegaScan traces
+            # (t is traced — ring_span threads it into the callback).
+            # Caveat (this jax build): scan linearization under jax.grad
+            # drops in-scan debug callbacks, so these spans appear in
+            # forward/eval executions; the cp/moe spans inside the
+            # remat'd layer bodies survive training steps too.
+            ring_span(PP_OVERLAP_PERMUTE_EVENT, "B", y, PP_AXIS, step=t,
+                      op="pp-schedule")
             state = jax.lax.ppermute(
                 y, PP_AXIS, [(i, (i + 1) % pp) for i in range(pp)])
+            ring_span(PP_OVERLAP_PERMUTE_EVENT, "E", state, PP_AXIS, step=t,
+                      op="pp-schedule")
             return (state, outputs, aux), None
 
         (state, outputs, aux), _ = jax.lax.scan(
             step, (state, outputs, aux), jnp.arange(total_steps))
-        # Sum aux losses across stages (and average over cp shards, whose
-        # aux terms are per-local-token means); outputs live on the last
-        # stage.
-        if cp > 1:
-            aux = jax.lax.psum(aux, (PP_AXIS, CP_AXIS)) / cp
-        else:
-            aux = jax.lax.psum(aux, PP_AXIS)
+        # Sum aux losses across stages; average over the token-splitting
+        # shards (cp seq chunks, (dp, ep) microbatch shards), whose aux
+        # terms are per-local-token means. Outputs live on the last stage.
+        red_axes = (PP_AXIS,) + ((CP_AXIS,) if cp > 1 else ()) \
+            + (batch_axes or ())
+        denom = cp * (dpep if batch_axes else 1)
+        aux = jax.lax.psum(aux, red_axes) / denom
         return outputs[None], aux[None]
 
-    h_spec = P(None, None, CP_AXIS) if cp > 1 else P(None)
-    out_spec = (P(PP_AXIS, None, None, CP_AXIS) if cp > 1
-                else P(PP_AXIS))
+    cp_spec = CP_AXIS if cp > 1 else None
+    h_spec = P(None, batch_axes, cp_spec)
+    out_spec = P(PP_AXIS, None, batch_axes, cp_spec)
     aux_mb = {} if aux_mb is None else aux_mb
-    if cp > 1:
-        # Leaves [M, mb, S, ...]: sequence axis (dim 2) cp-sharded.
-        aux_specs = jax.tree.map(
-            lambda a: P(*([None, None, CP_AXIS]
-                          + [None] * (a.ndim - 3))), aux_mb)
-    else:
-        aux_specs = jax.tree.map(lambda a: P(None), aux_mb)
-    sm = jax.jit(jax.shard_map(
-        body, mesh=ctx.shard_map_mesh,
+
+    # Leaves [M, mb, S, ...]: microbatch axis (dim 1) over (dp, ep),
+    # sequence axis (dim 2) cp-sharded. Lower-rank leaves (e.g. a per-
+    # microbatch [M, mb] scalar input) take the prefix of the spec.
+    def _aux_spec(a):
+        dims = [None, batch_axes, cp_spec] + [None] * max(0, a.ndim - 3)
+        return P(*dims[:a.ndim])
+
+    aux_specs = jax.tree.map(_aux_spec, aux_mb)
+    sm = jax.jit(shard_map_compat(
+        body, ctx.shard_map_mesh,
         in_specs=(P(PP_AXIS), h_spec, aux_specs),
-        out_specs=(out_spec, P(PP_AXIS)),
-        axis_names=manual_axes))
+        out_specs=(out_spec, P(PP_AXIS))))
     outputs_all, aux_all = sm(pipe_params, h_mb, aux_mb)
     return outputs_all[-1], aux_all[0]

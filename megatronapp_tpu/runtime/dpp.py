@@ -32,6 +32,7 @@ the generic scheduler both halves share.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -148,7 +149,8 @@ class DppPipelineRunner:
     def __init__(self, chunk_fn: Callable[[int, int, Any, int], Any],
                  devices: Sequence[Any], pp: int, vpp: int,
                  num_microbatches: int, policy: str = "dfc",
-                 dynamic: bool = True, n_buffers: int = 4):
+                 dynamic: bool = True, n_buffers: int = 4,
+                 join_timeout_s: Optional[float] = None):
         if len(devices) < pp:
             raise ValueError(f"need {pp} devices, got {len(devices)}")
         self.chunk_fn = chunk_fn
@@ -156,6 +158,13 @@ class DppPipelineRunner:
         self.pp, self.vpp, self.M = pp, vpp, num_microbatches
         self.policy, self.dynamic = policy, dynamic
         self.n_buffers = n_buffers
+        # Per-phase thread-join budget: constructor arg, else the
+        # MEGATRON_DPP_JOIN_TIMEOUT_S env (big models on slow hosts
+        # legitimately exceed the default), else 300 s.
+        if join_timeout_s is None:
+            join_timeout_s = float(os.environ.get(
+                "MEGATRON_DPP_JOIN_TIMEOUT_S", "300"))
+        self.join_timeout_s = join_timeout_s
         # Per-run state (populated by run()).
         self.transfer_order: List[List[Tuple[int, int]]] = []
         self.sender_stall_s: List[float] = []
@@ -287,20 +296,37 @@ class DppPipelineRunner:
         threads = []
         for s in range(pp):
             threads.append(threading.Thread(target=compute_loop, args=(s,),
-                                            daemon=True))
+                                            daemon=True,
+                                            name=f"dpp-compute-{s}"))
             threads.append(threading.Thread(target=sender_loop, args=(s,),
-                                            daemon=True))
+                                            daemon=True,
+                                            name=f"dpp-sender-{s}"))
         t_start = time.perf_counter()
         for t in threads:
             t.start()
+        deadline = time.perf_counter() + self.join_timeout_s
         for t in threads:
-            t.join(timeout=300.0)
+            t.join(timeout=max(0.0, deadline - time.perf_counter()))
+        timed_out = [t.name for t in threads if t.is_alive()]
         self.wall_s = time.perf_counter() - t_start
         if errors:
             raise errors[0]
+        if timed_out:
+            # Distinct from "output genuinely missing" below: the phase is
+            # still RUNNING (deadlock or slow host), not silently done-
+            # but-short. Raise with the knob that widens the budget.
+            raise RuntimeError(
+                f"dpp pipeline phase exceeded join_timeout_s="
+                f"{self.join_timeout_s:.0f}s with {len(timed_out)} "
+                f"thread(s) still running ({', '.join(timed_out)}); "
+                f"produced {len(outputs)}/{M} outputs so far — raise "
+                "join_timeout_s (or MEGATRON_DPP_JOIN_TIMEOUT_S) if the "
+                "host is just slow")
         if len(outputs) != M:
-            raise RuntimeError(f"pipeline produced {len(outputs)}/{M} "
-                               "outputs (thread timeout?)")
+            raise RuntimeError(
+                f"pipeline produced {len(outputs)}/{M} outputs although "
+                "every phase thread exited cleanly — a schedule/topology "
+                "bug dropped microbatches (NOT a timeout)")
         self.transfer_order = order_log
         self.ship_time_s = ship_log
         self.sender_stall_s = sender_stall

@@ -102,24 +102,63 @@ def collective_stats(events: List[dict]) -> Dict[str, Dict]:
     # separately. bytes count once per occurrence; time_us takes the
     # slowest participant (the collective's critical path); per-copy
     # bandwidths all feed the mean/max.
+    #
+    # Dropped-event guard (ADVICE round 5): when a pid dropped copies,
+    # its occurrence numbering lags the other pids', so its n-th event
+    # would pair with a DIFFERENT logical op and corrupt the
+    # slowest-participant merge. The cross-pid matching window is
+    # therefore CLAMPED to the minimum per-pid occurrence count of the
+    # ident; occurrences beyond it keep per-pid identities (each counts
+    # as its own logical op — a conservative overcount of at most the
+    # dropped tail). An EARLY drop can still misalign pairings inside the
+    # common window (occurrence indices carry no timing); the clamp
+    # bounds the damage to that window instead of letting the tail
+    # inflate counts too — a span-overlap tie-breaker would be the full
+    # fix if early drops show up in practice.
+    def _is_copy(ev):
+        return ev.get("ph") == "X" and "bandwidth_gbps" in ev.get(
+            "args", {})
+
+    def _ident_of(ev):
+        args = ev.get("args", {})
+        if not args.get("hlo_op"):
+            return None
+        return (ev["name"], args["hlo_op"], args.get("iteration"),
+                tuple(args.get("group") or ()))
+
+    ident_pid_totals: Dict[tuple, Dict] = defaultdict(
+        lambda: defaultdict(int))
+    for e in events:
+        if _is_copy(e):
+            ident = _ident_of(e)
+            if ident is not None:
+                ident_pid_totals[ident][e.get("pid")] += 1
+    n_common = {ident: min(by_pid.values())
+                for ident, by_pid in ident_pid_totals.items()}
+
     seen: Dict[tuple, str] = {}
     per_pid_n: Dict[tuple, int] = {}
     for e in sorted(events, key=lambda ev: (str(ev.get("pid")),
                                             ev.get("ts", 0.0))):
         args = e.get("args", {})
-        if e.get("ph") != "X" or "bandwidth_gbps" not in args:
+        if not _is_copy(e):
             continue
         a = agg[e["name"]]
         # Occurrence identity needs hlo_op (+iteration+group); events
         # without it (hand-built or foreign traces) can't be deduped and
         # each counts as its own occurrence.
-        if args.get("hlo_op"):
-            ident = (e["name"], args["hlo_op"], args.get("iteration"),
-                     tuple(args.get("group") or ()))
+        ident = _ident_of(e)
+        if ident is not None:
             pkey = (e.get("pid"),) + ident
             n = per_pid_n.get(pkey, 0)
             per_pid_n[pkey] = n + 1
-            occ = ident + (n,)
+            if n < n_common[ident]:
+                occ = ident + (n,)
+            else:
+                # Beyond the common window: some pid dropped copies of
+                # this ident — keep per-pid identity (longer key shape,
+                # so it can never collide with a merged occurrence).
+                occ = ident + (e.get("pid"), n)
         else:
             occ = (id(e),)
         dur = float(e.get("dur", 0.0))

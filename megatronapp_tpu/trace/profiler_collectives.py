@@ -133,6 +133,43 @@ def extract_hlo_collectives(hlo_text: str, mesh=None) -> Dict[str, dict]:
     return out
 
 
+def _attach_thread_ordinals(payload_events: List[dict],
+                            events: List[dict]) -> None:
+    """Synthesize ``args.device_ordinal`` on profiler builds that report
+    all devices under ONE host plane.
+
+    Newer jax profilers emit one Chrome-trace pid per device plane and a
+    ``device_ordinal`` arg; the 0.4.x CPU profiler instead reports a
+    single '/host:CPU' pid whose per-device EXECUTION THREADS carry the
+    HLO X events (thread_name 'tf_XLATfrtCpuClient/...'). Map each thread
+    that executed HLO ops to a device ordinal by thread_sort_index order
+    (the profiler assigns them in device order) so the per-device pid
+    attribution downstream keeps working."""
+    missing = [e for e in events
+               if "device_ordinal" not in e.get("args", {})]
+    if not missing:
+        return
+    sort_index: Dict[tuple, int] = {}
+    for e in payload_events:
+        if e.get("ph") == "M" and e.get("name") == "thread_sort_index":
+            sort_index[(e.get("pid"), e.get("tid"))] = int(
+                e["args"]["sort_index"])
+    # Only UNannotated threads get synthesized ordinals, numbered after
+    # any real annotated ordinals so a mixed trace (device planes
+    # annotated, host-plane HLO events not) never aliases a host thread
+    # onto an existing device.
+    annotated = {int(e["args"]["device_ordinal"]) for e in events
+                 if "device_ordinal" in e.get("args", {})}
+    base = max(annotated) + 1 if annotated else 0
+    exec_threads = sorted(
+        {(e.get("pid"), e.get("tid")) for e in missing},
+        key=lambda k: (sort_index.get(k, 1 << 30), k))
+    ordinal_of = {k: base + i for i, k in enumerate(exec_threads)}
+    for e in missing:
+        e.setdefault("args", {})["device_ordinal"] = \
+            ordinal_of[(e.get("pid"), e.get("tid"))]
+
+
 def parse_profile_dir(trace_dir: str, cleanup: bool = False) -> List[dict]:
     """Read a jax.profiler output directory → the raw per-device
     Chrome-trace X events that carry an hlo_op."""
@@ -142,8 +179,10 @@ def parse_profile_dir(trace_dir: str, cleanup: bool = False) -> List[dict]:
     if paths:
         with gzip.open(paths[-1]) as f:
             payload = json.load(f)
-        events = [e for e in payload.get("traceEvents", [])
+        all_events = payload.get("traceEvents", [])
+        events = [e for e in all_events
                   if e.get("ph") == "X" and "hlo_op" in e.get("args", {})]
+        _attach_thread_ordinals(all_events, events)
     if cleanup:
         import shutil
         shutil.rmtree(trace_dir, ignore_errors=True)

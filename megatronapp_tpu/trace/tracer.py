@@ -45,6 +45,8 @@ GRANULARITY_EVENTS = {
     "collective": {
         "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
         "all-to-all", "tp-overlap-compute", "tp-overlap-permute",
+        "cp-overlap-compute", "cp-overlap-permute",
+        "moe-a2a-compute", "moe-a2a-permute", "pp-overlap-permute",
     },
 }
 

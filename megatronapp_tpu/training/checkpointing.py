@@ -123,15 +123,20 @@ class CheckpointManager:
         restores derive the stacked-leaf split from metadata instead of
         shape guessing (reference resharding.py records the source
         parallelism the same way). A run directory holds one layout."""
-        if layout is not None and jax.process_index() == 0:
+        if layout is not None:
             import json
+            # The consistency check runs on EVERY process: if only rank 0
+            # raised, the other ranks would enter the collective save and
+            # hang waiting for it (multi-host checkpoint dirs are shared
+            # filesystems, so each rank can read layout.json itself). Only
+            # the layout.json WRITE stays on process 0.
             existing = self._read_layout()
             if existing is not None and existing != dict(layout):
                 raise ValueError(
                     f"checkpoint dir {self._mngr.directory} was saved "
                     f"with layout {existing}; refusing to mix in "
                     f"{dict(layout)} — use a fresh --save-dir per layout")
-            if existing is None:
+            if existing is None and jax.process_index() == 0:
                 tmp = self._layout_path + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(dict(layout), f)

@@ -185,7 +185,8 @@ def attention_forward(
             q, k, v, ctx.shard_map_mesh, comm,
             causal=cfg.attn_mask_type == AttnMaskType.causal,
             segment_ids=segment_ids,
-            a2a_size=cfg.hierarchical_cp_a2a_size)
+            a2a_size=cfg.hierarchical_cp_a2a_size,
+            overlap_ring=getattr(cfg, "cp_comm_overlap", True))
     else:
         from megatronapp_tpu.parallel.collectives import current_manual_axes
 
@@ -237,34 +238,33 @@ def attention_forward(
                 from megatronapp_tpu.config.parallel_config import (
                     DP_AXIS, EP_AXIS, TP_AXIS,
                 )
+                from megatronapp_tpu.parallel.collectives import (
+                    shard_map_compat,
+                )
+                # Full-manual region (shard_map_compat): the kernel is
+                # purely local over (dp, ep, tp) shards; pp/cp ride
+                # replicated (eligibility requires cp == 1 here).
                 spec = P((DP_AXIS, EP_AXIS), None, TP_AXIS, None)
                 seg_spec = P((DP_AXIS, EP_AXIS), None)
                 if segment_ids is None:
-                    flash = jax.jit(jax.shard_map(
+                    flash = jax.jit(shard_map_compat(
                         lambda q_, k_, v_: flash_attention(
                             q_, k_, v_, causal=causal,
                             block_q=cfg.flash_block_q,
                             block_kv=cfg.flash_block_kv),
-                        mesh=ctx.shard_map_mesh,
+                        ctx.shard_map_mesh,
                         in_specs=(spec, spec, spec),
-                        out_specs=spec,
-                        axis_names={DP_AXIS, EP_AXIS, TP_AXIS},
-                        # pallas out_shapes carry no vma info; the kernel
-                        # is purely local (no collectives), so skip vma
-                        # checking.
-                        check_vma=False))
+                        out_specs=spec))
                     attn_out = flash(q, k, v)
                 else:
-                    flash = jax.jit(jax.shard_map(
+                    flash = jax.jit(shard_map_compat(
                         lambda q_, k_, v_, s_: flash_attention(
                             q_, k_, v_, causal=causal,
                             block_q=cfg.flash_block_q,
                             block_kv=cfg.flash_block_kv, segment_ids=s_),
-                        mesh=ctx.shard_map_mesh,
+                        ctx.shard_map_mesh,
                         in_specs=(spec, spec, spec, seg_spec),
-                        out_specs=spec,
-                        axis_names={DP_AXIS, EP_AXIS, TP_AXIS},
-                        check_vma=False))
+                        out_specs=spec))
                     attn_out = flash(q, k, v, segment_ids)
             else:
                 attn_out = flash_attention(

@@ -191,7 +191,8 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
             q_full, k_full, v, ctx.shard_map_mesh, cfg.cp_comm_type,
             causal=cfg.attn_mask_type == AttnMaskType.causal,
             softmax_scale=float(1.0 / (dqk + dpe) ** 0.5),
-            a2a_size=cfg.hierarchical_cp_a2a_size)
+            a2a_size=cfg.hierarchical_cp_a2a_size,
+            overlap_ring=getattr(cfg, "cp_comm_overlap", True))
     else:
         out = dot_product_attention(
             q_full, k_full, v, mask_type=mask_type,

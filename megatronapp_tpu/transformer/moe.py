@@ -59,12 +59,21 @@ def init_moe_params(rng, cfg: TransformerConfig, out_std: float):
     return p, ax
 
 
-def _router(p, x_flat: jnp.ndarray, cfg: TransformerConfig):
+def _router(p, x_flat: jnp.ndarray, cfg: TransformerConfig,
+            stats_mean=None):
     """Top-k softmax router with load-balance + z losses.
 
     x_flat: [T, H]. Returns (topk_idx [T,K], topk_probs [T,K], aux_loss).
     Softmax-then-topk with prob renormalization — reference TopKRouter
     (router.py:102) default scoring.
+
+    stats_mean: optional reducer applied to the per-expert token-mean
+    statistics (frac, mean_prob, z² mean) BEFORE the nonlinear aux-loss
+    combination. The manual-ep dispatch passes a pmean over the
+    token-splitting mesh axes so the aux loss is computed from GLOBAL
+    stats — bit-matching the single-shard router instead of averaging
+    per-shard products (which differs whenever shards see different
+    routing mixes).
     """
     e = cfg.num_moe_experts
     logits = x_flat.astype(jnp.float32) @ p["router_kernel"]
@@ -73,6 +82,8 @@ def _router(p, x_flat: jnp.ndarray, cfg: TransformerConfig):
     topk_probs = topk_probs / jnp.maximum(
         jnp.sum(topk_probs, -1, keepdims=True), 1e-9)
 
+    if stats_mean is None:
+        stats_mean = lambda s: s  # noqa: E731 — identity reducer
     aux = jnp.zeros((), jnp.float32)
     if cfg.moe_aux_loss_coeff:
         # Switch/GShard load-balancing loss (moe_utils.py switch_load_balancing
@@ -80,12 +91,14 @@ def _router(p, x_flat: jnp.ndarray, cfg: TransformerConfig):
         # the 1/topk keeps the loss scale invariant in k (reference
         # normalization; advisor finding r1).
         onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)  # [T,K,E]
-        frac = jnp.mean(jnp.sum(onehot, axis=1), axis=0) / cfg.moe_router_topk
-        mean_prob = jnp.mean(probs, axis=0)
+        frac = stats_mean(
+            jnp.mean(jnp.sum(onehot, axis=1), axis=0) / cfg.moe_router_topk)
+        mean_prob = stats_mean(jnp.mean(probs, axis=0))
         aux = aux + cfg.moe_aux_loss_coeff * e * jnp.sum(frac * mean_prob)
     if cfg.moe_z_loss_coeff:
         z = jax.nn.logsumexp(logits, axis=-1)
-        aux = aux + cfg.moe_z_loss_coeff * jnp.mean(jnp.square(z))
+        aux = aux + cfg.moe_z_loss_coeff * stats_mean(
+            jnp.mean(jnp.square(z)))
     return topk_idx, topk_probs, aux
 
 
@@ -153,23 +166,41 @@ def moe_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
     from megatronapp_tpu.parallel.collectives import current_manual_axes
     if (ctx is not None and getattr(ctx, "ep", 1) > 1
             and not current_manual_axes()
-            and hasattr(jax, "shard_map")):
-        # Explicit ep all-to-all dispatch. Unavailable inside an ambient
+            and e % ctx.ep == 0
+            and b % (ctx.dp * ctx.ep) == 0
+            and (ctx.cp == 1 or s % ctx.cp == 0)):
+        # Explicit ep all-to-all dispatch (full-manual shard_map — the
+        # partial-auto manual regions of this jax build abort XLA:CPU,
+        # parallel/overlap.py docstring). Unavailable inside an ambient
         # manual region (the pp/cp pipeline body): nesting shard_maps is
         # unsupported in this JAX build, so moe+pp falls through to the
-        # compiler-sharded dispatch below — GSPMD partitions the expert
-        # einsums over the ep axis from the fc1/fc2 shardings instead.
-        # Also unavailable on jax-0.4.x images (no jax.shard_map, and its
-        # partial-auto manual regions abort XLA:CPU — parallel/overlap.py
-        # docstring): same compiler-sharded fallback, at the cost of the
-        # known GSPMD resharding churn.
+        # local dense dispatch below (each manual shard routes its own
+        # tokens against the full expert stack). Ineligible layouts
+        # (indivisible batch/experts) keep the compiler-sharded GSPMD
+        # fallback.
         out, aux = _a2a_expert_forward(p, x, cfg, ctx)
         x_flat = x.reshape(t, h)
         return _with_shared(p, x_flat, out.reshape(t, h), cfg).reshape(
             b, s, h).astype(x.dtype), aux
 
     x_flat = x.reshape(t, h)
-    topk_idx, topk_probs, aux = _router(p, x_flat, cfg)
+    # Inside an ambient manual region (the pp/cp pipeline body) each shard
+    # routes only its local tokens; pmean the router stats over the
+    # token-splitting manual axes BEFORE the nonlinear aux combination so
+    # the load-balance loss matches the global router exactly — the same
+    # global-stats discipline as the _a2a dispatch path above.
+    stats_mean = None
+    manual = current_manual_axes()
+    if manual:
+        from megatronapp_tpu.config.parallel_config import (
+            CP_AXIS, DP_AXIS, EP_AXIS,
+        )
+        token_axes = tuple(a for a in (DP_AXIS, EP_AXIS, CP_AXIS)
+                           if a in manual)
+        if token_axes:
+            stats_mean = lambda st: jax.lax.pmean(st, token_axes)  # noqa: E731
+    topk_idx, topk_probs, aux = _router(p, x_flat, cfg,
+                                        stats_mean=stats_mean)
 
     if cfg.moe_capacity_factor is None:
         out = _dropless_experts(p, x_flat, topk_idx, topk_probs, cfg)
@@ -179,17 +210,90 @@ def moe_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
         b, s, h).astype(x.dtype), aux
 
 
+def _chunked_a2a_ffn(send, fc1, fc2, cfg: TransformerConfig, ep: int):
+    """Decomposed, latency-hiding all-to-all → expert FFN → all-to-all.
+
+    send [ep, e_loc, cap, h]: send[j] = this shard's capacity buffer bound
+    for the experts on shard j. Instead of one bulk ``lax.all_to_all``
+    followed by one big grouped GEMM (exposed exchange, then exposed
+    compute), the exchange is decomposed into ep-1 ``ppermute`` hops —
+    hop s delivers the chunk from shard me-s — and each hop is issued
+    BEFORE the expert GEMMs on the previously-arrived chunk, so on
+    hardware with an async collective engine the token exchange rides
+    under expert compute (T3-style, arXiv:2401.16677). Results return the
+    same way: the return hop for chunk s is issued while chunk s+1's FFN
+    runs. Returns y [ep, e_loc, cap, h] with y[j] = the FFN outputs of
+    this shard's tokens that were dispatched to shard j.
+    """
+    from megatronapp_tpu.config.parallel_config import EP_AXIS
+    from megatronapp_tpu.parallel.collectives import ring_span
+
+    me = jax.lax.axis_index(EP_AXIS)
+    params = {"fc1_kernel": fc1, "fc2_kernel": fc2}
+
+    def chunk_for_shift(s):
+        # What I must hand to the shard s hops ahead: send[(me + s) % ep].
+        return jax.lax.dynamic_index_in_dim(send, (me + s) % ep,
+                                            keepdims=False)
+
+    y = jnp.zeros_like(send)
+    # Own chunk needs no comm; hop 1 is issued first so it flies under it.
+    # Hop s delivers chunk(i, i+s) from every source i to its dest i+s —
+    # each shard receives the chunk from shard me-s bound for its experts.
+    nxt = None
+    if ep > 1:
+        ring_span("moe-a2a-permute", "B", send, EP_AXIS, step=0, op="fwd")
+        nxt = jax.lax.ppermute(
+            chunk_for_shift(1), EP_AXIS,
+            [(i, (i + 1) % ep) for i in range(ep)])
+        ring_span("moe-a2a-permute", "E", nxt, EP_AXIS, step=0, op="fwd")
+    ring_span("moe-a2a-compute", "B", send, EP_AXIS, step=0, op="fwd")
+    y = jax.lax.dynamic_update_index_in_dim(
+        y, _expert_ffn(params, chunk_for_shift(0), cfg), me, 0)
+    ring_span("moe-a2a-compute", "E", y, EP_AXIS, step=0, op="fwd")
+    for s in range(1, ep):
+        arrived = nxt
+        nxt = None
+        if s + 1 < ep:
+            # Pre-issue the next inbound hop under this chunk's GEMMs.
+            ring_span("moe-a2a-permute", "B", arrived, EP_AXIS, step=s,
+                      op="fwd")
+            nxt = jax.lax.ppermute(
+                chunk_for_shift(s + 1), EP_AXIS,
+                [(i, (i + s + 1) % ep) for i in range(ep)])
+            ring_span("moe-a2a-permute", "E", nxt, EP_AXIS, step=s,
+                      op="fwd")
+        ring_span("moe-a2a-compute", "B", arrived, EP_AXIS, step=s,
+                  op="fwd")
+        ys = _expert_ffn(params, arrived, cfg)
+        ring_span("moe-a2a-compute", "E", ys, EP_AXIS, step=s, op="fwd")
+        # Return the results to the tokens' home shard (dest i-s); what
+        # arrives here is MY chunk's result from shard me+s. The receive
+        # side of this hop overlaps the next iteration's FFN.
+        ring_span("moe-a2a-permute", "B", ys, EP_AXIS, step=s, op="ret")
+        back = jax.lax.ppermute(
+            ys, EP_AXIS, [(i, (i - s) % ep) for i in range(ep)])
+        ring_span("moe-a2a-permute", "E", back, EP_AXIS, step=s, op="ret")
+        y = jax.lax.dynamic_update_index_in_dim(y, back, (me + s) % ep, 0)
+    return y
+
+
 def _a2a_expert_forward(p, x: jnp.ndarray, cfg: TransformerConfig, ctx
                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Expert-parallel dispatch as explicit ICI all-to-alls.
+    """Expert-parallel dispatch as explicit ICI collectives.
 
-    shard_map manual over the ep axis ONLY (dp/tp/cp stay under compiler
-    control — the gated fc1 split and the fc2 contraction reshard
-    automatically): each ep shard routes its own tokens, packs per-expert
-    capacity buffers, all-to-alls them to the experts' home shards, runs
-    the local expert FFNs, and all-to-alls results back — the reference's
-    a2a dispatcher made of two lax.all_to_all collectives instead of
-    torch.distributed.all_to_all.
+    FULL-MANUAL shard_map over every mesh axis (the partial-auto regions
+    of this jax build abort XLA:CPU — parallel/overlap.py design notes):
+    token batch threads over (dp, ep), sequence over cp, expert weights
+    over ep; tp rides replicated inside the region (the expert GEMMs
+    compute redundantly per tp rank — the GSPMD mlp-dim sharding of the
+    old partial-auto region needed exactly the mode this build aborts
+    on). Each (dp, ep, cp) shard routes its own tokens, packs per-expert
+    capacity buffers, exchanges them with the experts' home ep shards,
+    runs the local expert FFNs, and sends results back — the reference's
+    MoEAlltoAllTokenDispatcher. With ``cfg.moe_comm_overlap`` (default)
+    the exchange is the chunked, latency-hiding ``_chunked_a2a_ffn``
+    above; otherwise one bulk lax.all_to_all each way.
 
     Capacity: moe_capacity_factor when set (GShard drop semantics);
     otherwise T_local*k — every copy provably fits, keeping the default
@@ -197,11 +301,15 @@ def _a2a_expert_forward(p, x: jnp.ndarray, cfg: TransformerConfig, ctx
     reference pads to capacity on this path too,
     --moe-pad-expert-input-to-capacity).
     """
-    from megatronapp_tpu.config.parallel_config import EP_AXIS
+    from megatronapp_tpu.config.parallel_config import (
+        CP_AXIS, DP_AXIS, EP_AXIS,
+    )
+    from megatronapp_tpu.parallel.collectives import shard_map_compat
 
     e = cfg.num_moe_experts
     k = cfg.moe_router_topk
     ep = ctx.ep
+    cp = ctx.cp
     e_loc = e // ep
     dt = cfg.compute_dtype
     if cfg.moe_capacity_factor is not None and cfg.moe_capacity_factor <= 0:
@@ -209,17 +317,18 @@ def _a2a_expert_forward(p, x: jnp.ndarray, cfg: TransformerConfig, ctx
             f"moe_capacity_factor must be > 0 (got "
             f"{cfg.moe_capacity_factor}); omit it (None) for dropless "
             "dispatch")
+    # Token-splitting axes of the manual region: aux stats pmean over them
+    # so the load-balance loss is computed from GLOBAL per-expert stats
+    # (exact parity with the single-shard router).
+    token_axes = (DP_AXIS, EP_AXIS) + ((CP_AXIS,) if cp > 1 else ())
 
     def body(router_kernel, fc1, fc2, x_loc):
         bl, sl, h = x_loc.shape
         t_loc = bl * sl
         xf = x_loc.reshape(t_loc, h)
         topk_idx, topk_probs, aux = _router(
-            {"router_kernel": router_kernel}, xf, cfg)
-        # Aux stats are per-ep-shard token means; average across shards
-        # (the dp-sharded token dim is auto, so its mean is already
-        # global over dp).
-        aux = jax.lax.pmean(aux, EP_AXIS)
+            {"router_kernel": router_kernel}, xf, cfg,
+            stats_mean=lambda st: jax.lax.pmean(st, token_axes))
 
         if cfg.moe_capacity_factor is not None:
             cap = max(int(cfg.moe_capacity_factor * t_loc * k / e), 1)
@@ -243,14 +352,19 @@ def _a2a_expert_forward(p, x: jnp.ndarray, cfg: TransformerConfig, ctx
         # shard i holds [i*e_loc, (i+1)*e_loc), the fc1/fc2 'experts'
         # axis sharding).
         send = send.reshape(ep, e_loc, cap, h)
-        recv = jax.lax.all_to_all(send, EP_AXIS, split_axis=0,
-                                  concat_axis=0)              # [ep_src,...]
-        xin = recv.transpose(1, 0, 2, 3).reshape(e_loc, ep * cap, h)
-        y = _expert_ffn({"fc1_kernel": fc1, "fc2_kernel": fc2}, xin, cfg)
-        y = y.reshape(e_loc, ep, cap, h).transpose(1, 0, 2, 3)
-        y = jax.lax.all_to_all(y, EP_AXIS, split_axis=0,
-                               concat_axis=0)                 # back home
-        y = y.reshape(e, cap, h)
+        if getattr(cfg, "moe_comm_overlap", True):
+            y = _chunked_a2a_ffn(send, fc1, fc2, cfg, ep)
+            y = y.reshape(e, cap, h)
+        else:
+            recv = jax.lax.all_to_all(send, EP_AXIS, split_axis=0,
+                                      concat_axis=0)          # [ep_src,...]
+            xin = recv.transpose(1, 0, 2, 3).reshape(e_loc, ep * cap, h)
+            y = _expert_ffn({"fc1_kernel": fc1, "fc2_kernel": fc2}, xin,
+                            cfg)
+            y = y.reshape(e_loc, ep, cap, h).transpose(1, 0, 2, 3)
+            y = jax.lax.all_to_all(y, EP_AXIS, split_axis=0,
+                                   concat_axis=0)             # back home
+            y = y.reshape(e, cap, h)
 
         w = (topk_probs.reshape(t_loc * k) *
              valid.astype(topk_probs.dtype))
@@ -259,11 +373,12 @@ def _a2a_expert_forward(p, x: jnp.ndarray, cfg: TransformerConfig, ctx
         return out.reshape(bl, sl, h), aux
 
     from jax.sharding import PartitionSpec as P
-    sm = jax.shard_map(
-        body, mesh=ctx.shard_map_mesh,
-        in_specs=(P(), P(EP_AXIS), P(EP_AXIS), P(EP_AXIS)),
-        out_specs=(P(EP_AXIS), P()),
-        axis_names={EP_AXIS})
+    batch_axes = (DP_AXIS, EP_AXIS)
+    x_spec = P(batch_axes, CP_AXIS if cp > 1 else None, None)
+    sm = shard_map_compat(
+        body, ctx.shard_map_mesh,
+        in_specs=(P(), P(EP_AXIS), P(EP_AXIS), x_spec),
+        out_specs=(x_spec, P()))
     return sm(p["router_kernel"], p["fc1_kernel"], p["fc2_kernel"], x)
 
 

@@ -16,6 +16,7 @@ from megatronapp_tpu.config.transformer_config import TransformerConfig
 from megatronapp_tpu.models.gpt import gpt_loss, init_gpt_params
 from megatronapp_tpu.ops.attention import dot_product_attention
 from megatronapp_tpu.ops.context_parallel import context_attention
+from megatronapp_tpu.parallel.collectives import shard_map_compat
 from megatronapp_tpu.parallel.mesh import build_mesh
 from megatronapp_tpu.training.train import pretrain_gpt
 
@@ -83,10 +84,10 @@ class TestZigzagRing:
         v = jax.random.normal(jax.random.PRNGKey(2), (b, s, 2, d))
         idx = jnp.asarray(zigzag_indices(s, cp))
         inv = jnp.asarray(zigzag_inverse_indices(s, cp))
-        f = jax.shard_map(
+        f = shard_map_compat(
             lambda a, b_, c: zigzag_ring_attention(a, b_, c, axis_name="cp"),
-            mesh=mesh, in_specs=(P(None, "cp"),) * 3,
-            out_specs=P(None, "cp"), axis_names={"cp"})
+            mesh, in_specs=(P(None, "cp"),) * 3,
+            out_specs=P(None, "cp"))
 
         def zz(q, k, v):
             args = [jnp.take(x, idx, axis=1) for x in (q, k, v)]
@@ -204,12 +205,12 @@ class TestHierarchicalCP:
         ref = dot_product_attention(
             q, k, v, mask_type=(AttnMaskType.causal if causal
                                 else AttnMaskType.bidirectional))
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map_compat(
             lambda a, b_, c: hierarchical_attention(
                 a, b_, c, axis_name="cp", causal=causal,
                 a2a_size=a2a_size),
-            mesh=mesh, in_specs=(P(None, "cp"),) * 3,
-            out_specs=P(None, "cp"), axis_names={"cp"}))
+            mesh, in_specs=(P(None, "cp"),) * 3,
+            out_specs=P(None, "cp")))
         np.testing.assert_allclose(np.asarray(f(q, k, v)),
                                    np.asarray(ref), atol=3e-5)
 
@@ -241,12 +242,12 @@ class TestHierarchicalCP:
             q, k, v, mask_type=(AttnMaskType.causal if causal
                                 else AttnMaskType.bidirectional),
             attention_mask=seg_mask)
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map_compat(
             lambda a, b_, c, sg: hierarchical_attention(
                 a, b_, c, axis_name="cp", causal=causal,
                 a2a_size=a2a_size, segment_ids=sg),
-            mesh=mesh, in_specs=(P(None, "cp"),) * 3 + (P(None, "cp"),),
-            out_specs=P(None, "cp"), axis_names={"cp"}))
+            mesh, in_specs=(P(None, "cp"),) * 3 + (P(None, "cp"),),
+            out_specs=P(None, "cp")))
         np.testing.assert_allclose(np.asarray(f(q, k, v, segs)),
                                    np.asarray(ref), atol=3e-5)
 

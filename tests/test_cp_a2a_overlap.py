@@ -1,0 +1,382 @@
+"""Latency-hiding cp ring attention + MoE chunked a2a tests (ISSUE 2).
+
+Numeric parity of the overlapped custom_vjp contiguous ring (fwd + grads,
+1e-5) against the dense oracle for cp∈{2,4} including GQA and sequence
+lengths NOT divisible by cp; chunked-vs-bulk MoE dispatch equivalence;
+2-step loss-parity train runs for the recovered compositions (cp>1,
+moe-ep — the layouts that aborted under partial-auto shard_map); the
+per-hop MegaScan spans; and the A/B benchmark tool."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatronapp_tpu.config.parallel_config import ParallelConfig
+from megatronapp_tpu.config.training_config import (
+    OptimizerConfig, TrainingConfig,
+)
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.ops.attention import dot_product_attention
+from megatronapp_tpu.ops.context_parallel import context_attention
+from megatronapp_tpu.parallel.mesh import build_mesh
+
+
+def cp_mesh(devices8, cp):
+    return build_mesh(ParallelConfig(context_parallel=cp),
+                      devices=devices8[:cp])
+
+
+def qkv(b, s, h, hkv, d, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (jax.random.normal(ks[0], (b, s, h, d)),
+            jax.random.normal(ks[1], (b, s, hkv, d)),
+            jax.random.normal(ks[2], (b, s, hkv, d)))
+
+
+class TestOverlappedRingParity:
+    """context_attention 'p2p' (custom_vjp overlapped ring) vs the dense
+    oracle, fwd + grads to 1e-5."""
+
+    @pytest.mark.parametrize("cp", [2, 4])
+    @pytest.mark.parametrize("hkv", [4, 2])  # 2 = GQA (kv heads < q heads)
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense_fwd_and_grads(self, devices8, cp, hkv, causal):
+        from megatronapp_tpu.config.transformer_config import AttnMaskType
+        ctx = cp_mesh(devices8, cp)
+        b, s, h, d = 2, 32, 4, 16
+        q, k, v = qkv(b, s, h, hkv, d)
+        ref_fn = lambda q, k, v: dot_product_attention(
+            q, k, v, mask_type=(AttnMaskType.causal if causal
+                                else AttnMaskType.bidirectional))
+        with ctx.mesh:
+            cp_fn = jax.jit(lambda q, k, v: context_attention(
+                q, k, v, ctx.mesh, "p2p", causal=causal))
+            out = cp_fn(q, k, v)
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.asarray(ref_fn(q, k, v)),
+                                       rtol=1e-5, atol=1e-5)
+            g_cp = jax.jit(jax.grad(
+                lambda t: jnp.sum(cp_fn(*t) ** 2)))((q, k, v))
+        g_ref = jax.grad(lambda t: jnp.sum(ref_fn(*t) ** 2))((q, k, v))
+        for a, b_ in zip(jax.tree.leaves(g_cp), jax.tree.leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("cp,s", [(2, 9), (4, 35)])
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_seq_not_divisible_by_cp(self, devices8, cp, s, causal):
+        """S % cp != 0 pads inside the wrapper and masks the pad via
+        synthetic segment ids — exact for causal AND bidirectional."""
+        from megatronapp_tpu.config.transformer_config import AttnMaskType
+        ctx = cp_mesh(devices8, cp)
+        q, k, v = qkv(1, s, 2, 2, 8, seed=3)
+        ref_fn = lambda q, k, v: dot_product_attention(
+            q, k, v, mask_type=(AttnMaskType.causal if causal
+                                else AttnMaskType.bidirectional))
+        with ctx.mesh:
+            cp_fn = jax.jit(lambda q, k, v: context_attention(
+                q, k, v, ctx.mesh, "p2p", causal=causal))
+            out = cp_fn(q, k, v)
+            assert out.shape == q.shape
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.asarray(ref_fn(q, k, v)),
+                                       rtol=1e-5, atol=1e-5)
+            g_cp = jax.jit(jax.grad(
+                lambda t: jnp.sum(cp_fn(*t) ** 2)))((q, k, v))
+        g_ref = jax.grad(lambda t: jnp.sum(ref_fn(*t) ** 2))((q, k, v))
+        for a, b_ in zip(jax.tree.leaves(g_cp), jax.tree.leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_overlap_off_matches_overlap_on(self, devices8):
+        """--no-cp-comm-overlap (plain unrolled ring, autodiff backward)
+        and the custom_vjp path agree to float tolerance."""
+        ctx = cp_mesh(devices8, 4)
+        q, k, v = qkv(2, 32, 4, 2, 16, seed=5)
+        with ctx.mesh:
+            on = jax.jit(lambda q, k, v: context_attention(
+                q, k, v, ctx.mesh, "p2p", overlap_ring=True))(q, k, v)
+            off = jax.jit(lambda q, k, v: context_attention(
+                q, k, v, ctx.mesh, "p2p", overlap_ring=False))(q, k, v)
+        np.testing.assert_allclose(np.asarray(on), np.asarray(off),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_mla_style_dv_neq_dk(self, devices8):
+        """Value head dim != key head dim (the MLA layout) flows through
+        the overlapped ring."""
+        ctx = cp_mesh(devices8, 2)
+        ks = jax.random.split(jax.random.PRNGKey(7), 3)
+        q = jax.random.normal(ks[0], (1, 16, 2, 12))
+        k = jax.random.normal(ks[1], (1, 16, 2, 12))
+        v = jax.random.normal(ks[2], (1, 16, 2, 8))
+        ref = dot_product_attention(q, k, v)
+        with ctx.mesh:
+            out = jax.jit(lambda q, k, v: context_attention(
+                q, k, v, ctx.mesh, "p2p"))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestChunkedA2AEquivalence:
+    def _cfg(self, **kw):
+        d = dict(num_layers=1, hidden_size=32, num_attention_heads=4,
+                 vocab_size=64, max_position_embeddings=32,
+                 num_moe_experts=4, moe_router_topk=2,
+                 moe_aux_loss_coeff=0.01, compute_dtype=jnp.float32,
+                 remat_policy="none")
+        d.update(kw)
+        return TransformerConfig(**d)
+
+    @pytest.mark.parametrize("ep", [2, 4])
+    def test_chunked_matches_bulk_dispatch(self, devices8, ep):
+        """moe_comm_overlap on/off produce identical outputs, aux, and
+        grads — the chunked ring is a pure re-scheduling of the bulk
+        all-to-all."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from megatronapp_tpu.transformer.moe import (
+            init_moe_params, moe_forward,
+        )
+        par = ParallelConfig(expert_parallel=ep,
+                             data_parallel=8 // ep)
+        ctx = build_mesh(par, devices=devices8)
+        outs = {}
+        for overlap in (True, False):
+            cfg = self._cfg(moe_comm_overlap=overlap)
+            p, _ = init_moe_params(jax.random.PRNGKey(0), cfg,
+                                   out_std=0.02)
+            x = jax.random.normal(jax.random.PRNGKey(1), (8, 8, 32),
+                                  jnp.float32)
+            with ctx.mesh:
+                xs = jax.device_put(x, NamedSharding(
+                    ctx.mesh, P(("dp", "ep"), None, None)))
+
+                def loss(q):
+                    out, aux = moe_forward(q, xs, cfg, ctx=ctx)
+                    return jnp.sum(out ** 2) + aux, (out, aux)
+
+                (l, (out, aux)), g = jax.jit(
+                    jax.value_and_grad(loss, has_aux=True))(p)
+            outs[overlap] = (np.asarray(out), float(aux), float(l),
+                             jax.device_get(g))
+        np.testing.assert_allclose(outs[True][0], outs[False][0],
+                                   rtol=1e-6, atol=1e-6)
+        assert outs[True][1] == pytest.approx(outs[False][1], abs=1e-7)
+        for a, b in zip(jax.tree.leaves(outs[True][3]),
+                        jax.tree.leaves(outs[False][3])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_aux_loss_matches_single_shard_router(self, devices8):
+        """The manual region computes the load-balance loss from GLOBAL
+        per-expert stats (pmean'd before the product), so aux equals the
+        unsharded router's bit-for-bit up to fp32 reduction order."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from megatronapp_tpu.transformer.moe import (
+            init_moe_params, moe_forward,
+        )
+        cfg = self._cfg()
+        p, _ = init_moe_params(jax.random.PRNGKey(0), cfg, out_std=0.02)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 8, 32),
+                              jnp.float32)
+        _, aux_ref = moe_forward(p, x, cfg)
+        ctx = build_mesh(ParallelConfig(expert_parallel=2,
+                                        data_parallel=4),
+                         devices=devices8)
+        with ctx.mesh:
+            xs = jax.device_put(x, NamedSharding(
+                ctx.mesh, P(("dp", "ep"), None, None)))
+            _, aux = jax.jit(
+                lambda q, y: moe_forward(q, y, cfg, ctx=ctx))(p, xs)
+        assert float(aux) == pytest.approx(float(aux_ref), abs=1e-6)
+
+
+class TestRecoveredCompositionTraining:
+    """2-step loss-parity train runs on the CPU mesh for the layouts that
+    aborted under partial-auto shard_map (cp>1, moe-ep)."""
+
+    def _train(self, model, par, devices, iters=2):
+        from tests.test_training import learnable_batches
+        from megatronapp_tpu.training.train import pretrain_gpt
+        ctx = build_mesh(par, devices=devices)
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=4,
+                               seq_length=32, train_iters=iters,
+                               log_interval=1)
+        res = pretrain_gpt(model, par, train,
+                           OptimizerConfig(lr=1e-3, lr_decay_iters=iters),
+                           ctx=ctx,
+                           batch_iter=learnable_batches(32, 128, 4))
+        return res.losses
+
+    def test_cp2_two_step_losses_match_cp1(self, devices8):
+        kw = dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+                  vocab_size=128, max_position_embeddings=64,
+                  compute_dtype=jnp.float32)
+        ref = self._train(TransformerConfig(**kw), ParallelConfig(),
+                          devices8[:1])
+        cp2 = self._train(TransformerConfig(**kw),
+                          ParallelConfig(context_parallel=2), devices8[:2])
+        np.testing.assert_allclose(cp2, ref, atol=1e-4)
+
+    def test_moe_ep2_two_step_losses_match_single(self, devices8):
+        kw = dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+                  vocab_size=128, max_position_embeddings=64,
+                  num_moe_experts=4, moe_router_topk=2,
+                  moe_aux_loss_coeff=0.01, compute_dtype=jnp.float32)
+        ref = self._train(TransformerConfig(**kw), ParallelConfig(),
+                          devices8[:1])
+        ep2 = self._train(TransformerConfig(**kw),
+                          ParallelConfig(expert_parallel=2), devices8[:2])
+        # The a2a capacity-buffer dispatch and the single-device sorted
+        # ragged_dot path sum expert outputs in different fp32 orders
+        # (~1e-5/step of reduction noise, compounded by the optimizer) —
+        # 3e-4 bounds two steps of it while still catching real drift.
+        np.testing.assert_allclose(ep2, ref, atol=3e-4)
+
+
+class TestMegaScanSpans:
+    def test_ring_spans_emitted(self, devices8, tmp_path):
+        """With tracing enabled the overlapped ring emits per-hop
+        cp-overlap-compute / cp-overlap-permute B/E records on per-rank
+        timelines, forward AND fused backward."""
+        from megatronapp_tpu.trace.tracer import get_tracer
+        ctx = cp_mesh(devices8, 4)
+        tracer = get_tracer()
+        tracer.configure(enabled=True, trace_dir=str(tmp_path), interval=1,
+                         continuous_iterations=1, granularity="full",
+                         mesh_ctx=ctx)
+        try:
+            q, k, v = qkv(1, 32, 4, 2, 16)
+            tracer.iteration_begin(0)
+            with ctx.mesh:
+                loss, g = jax.jit(jax.value_and_grad(
+                    lambda q: jnp.sum(context_attention(
+                        q, k, v, ctx.mesh, "p2p") ** 2)))(q)
+                jax.block_until_ready(g)
+            jax.effects_barrier()
+            tracer.iteration_end(0, fence=loss)
+            recs = tracer.drain()
+        finally:
+            tracer.enabled = False
+        compute = [r for r in recs if r["name"] == "cp-overlap-compute"]
+        permute = [r for r in recs if r["name"] == "cp-overlap-permute"]
+        assert compute and permute
+        assert {r["ph"] for r in compute} == {"B", "E"}
+        assert {r["tid"] for r in compute} == {1, 2, 3, 4}
+        ops = {r["args"]["op"] for r in compute}
+        assert "ring-attention" in ops
+        assert "ring-attention-bwd" in ops
+        # Every ring step is bracketed on every rank.
+        assert {r["args"]["step"] for r in compute} == {0, 1, 2, 3}
+        # Chrome-trace B/E pairing is a per-tid stack: every span kind
+        # must be BALANCED per timeline or the merged trace corrupts.
+        for rs in (compute, permute):
+            assert sum(r["ph"] == "B" for r in rs) == \
+                sum(r["ph"] == "E" for r in rs)
+
+    def test_moe_a2a_spans_emitted(self, devices8, tmp_path):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from megatronapp_tpu.trace.tracer import get_tracer
+        from megatronapp_tpu.transformer.moe import (
+            init_moe_params, moe_forward,
+        )
+        cfg = TransformerConfig(
+            num_layers=1, hidden_size=32, num_attention_heads=4,
+            vocab_size=64, max_position_embeddings=32, num_moe_experts=4,
+            moe_router_topk=2, compute_dtype=jnp.float32,
+            remat_policy="none")
+        ctx = build_mesh(ParallelConfig(expert_parallel=2),
+                         devices=devices8[:2])
+        tracer = get_tracer()
+        tracer.configure(enabled=True, trace_dir=str(tmp_path), interval=1,
+                         continuous_iterations=1, granularity="full",
+                         mesh_ctx=ctx)
+        try:
+            p, _ = init_moe_params(jax.random.PRNGKey(0), cfg,
+                                   out_std=0.02)
+            x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 32),
+                                  jnp.float32)
+            tracer.iteration_begin(0)
+            with ctx.mesh:
+                xs = jax.device_put(x, NamedSharding(
+                    ctx.mesh, P(("dp", "ep"), None, None)))
+                out, _ = jax.jit(
+                    lambda q, y: moe_forward(q, y, cfg, ctx=ctx))(p, xs)
+                jax.block_until_ready(out)
+            jax.effects_barrier()
+            tracer.iteration_end(0, fence=out)
+            recs = tracer.drain()
+        finally:
+            tracer.enabled = False
+        compute = [r for r in recs if r["name"] == "moe-a2a-compute"]
+        permute = [r for r in recs if r["name"] == "moe-a2a-permute"]
+        assert compute and permute
+        assert {r["tid"] for r in compute} == {1, 2}
+        assert {r["args"]["step"] for r in compute} == {0, 1}
+        # fwd hops AND return hops, all balanced per-ph (see above).
+        assert {r["args"]["op"] for r in permute} == {"fwd", "ret"}
+        for rs in (compute, permute):
+            assert sum(r["ph"] == "B" for r in rs) == \
+                sum(r["ph"] == "E" for r in rs)
+
+
+class TestPipelineSpans:
+    def test_pp_hop_spans_emitted_forward(self, devices8, tmp_path):
+        """The pp schedule brackets every stage hand-off with balanced
+        pp-overlap-permute B/E records (forward executions — this build's
+        scan linearization drops in-scan callbacks under grad; the cp/moe
+        spans live inside the remat'd layer bodies and survive both)."""
+        from megatronapp_tpu.parallel.pipeline import spmd_pipeline
+        from megatronapp_tpu.trace.tracer import get_tracer
+        ctx = build_mesh(ParallelConfig(pipeline_parallel=2),
+                         devices=devices8[:2])
+        tracer = get_tracer()
+        tracer.configure(enabled=True, trace_dir=str(tmp_path), interval=1,
+                         continuous_iterations=1, granularity="full",
+                         mesh_ctx=ctx)
+        try:
+            params = {"w": jnp.ones((2, 1, 2, 4, 4))}
+            h = jnp.ones((2, 1, 8, 4))
+
+            def stage_fn(cp_params, x, off):
+                return jnp.tanh(x @ cp_params["w"][0]), jnp.zeros(
+                    (), jnp.float32)
+
+            tracer.iteration_begin(0)
+            with ctx.mesh:
+                out, _ = jax.jit(lambda p, h: spmd_pipeline(
+                    stage_fn, p, h, ctx, 2,
+                    compute_dtype=jnp.float32))(params, h)
+                jax.block_until_ready(out)
+            jax.effects_barrier()
+            tracer.iteration_end(0, fence=out)
+            recs = tracer.drain()
+        finally:
+            tracer.enabled = False
+        hops = [r for r in recs if r["name"] == "pp-overlap-permute"]
+        assert hops
+        assert {r["tid"] for r in hops} == {1, 2}
+        # M*vpp + pp - 1 = 3 schedule steps, each bracketed B/E per rank.
+        assert {r["args"]["step"] for r in hops} == {0, 1, 2}
+        assert sum(r["ph"] == "B" for r in hops) == \
+            sum(r["ph"] == "E" for r in hops)
+
+
+class TestBenchmarkTool:
+    def test_ring_pair_reports_and_parity(self, devices8):
+        from tools.cp_a2a_benchmark import run_ring
+        res = run_ring(cp=2, batch=1, seq=64, heads=4, kv_heads=2,
+                       head_dim=16, iters=2, warmup=1)
+        assert res["fwd"]["gspmd_ms"] > 0
+        assert res["fwd"]["overlap_ms"] > 0
+        assert res["max_abs_diff"] < 1e-5
+        assert res["max_abs_grad_diff"] < 1e-4
+
+    def test_a2a_pair_reports_and_parity(self, devices8):
+        from tools.cp_a2a_benchmark import run_a2a
+        res = run_a2a(ep=2, batch=4, seq=16, hidden=32, moe_ffn=64,
+                      experts=4, topk=2, iters=2, warmup=1)
+        assert res["fwd"]["gspmd_ms"] > 0
+        assert res["fwd"]["overlap_ms"] > 0
+        assert res["max_abs_diff"] < 1e-5
+        assert res["max_abs_grad_diff"] < 1e-4

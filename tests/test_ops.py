@@ -149,7 +149,7 @@ class TestCrossEntropy:
         """Vocab-parallel CE over a real tp mesh equals dense CE
         (reference cross_entropy.py:123 semantics)."""
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax import shard_map
+        from megatronapp_tpu.parallel.collectives import shard_map_compat
 
         tp = 4
         mesh = Mesh(np.array(devices8[:tp]), ("tp",))
@@ -161,8 +161,8 @@ class TestCrossEntropy:
             start = jax.lax.axis_index("tp") * (v // tp)
             return shard_map_cross_entropy(lg, tg, start, "tp")
 
-        per_token = jax.jit(shard_map(
-            local_fn, mesh=mesh,
+        per_token = jax.jit(shard_map_compat(
+            local_fn, mesh,
             in_specs=(P(None, None, "tp"), P(None, None)),
             out_specs=P(None, None)))(logits, targets)
         _, ref = cross_entropy_loss(logits, targets)

@@ -31,14 +31,19 @@ COLLECTIVE_RE = re.compile(
 # Audited homes for raw collectives, relative to the repo root.
 APPROVED = {
     # The designated entry points (ISSUE 1 satellite: future manual
-    # collectives go here).
+    # collectives go here). collectives.py owns the compat wrappers
+    # (shard_map_compat / axis_size / pvary / ring_span) every
+    # full-manual subsystem builds on.
     "megatronapp_tpu/parallel/collectives.py",
     "megatronapp_tpu/parallel/overlap.py",
-    # Grandfathered, audited manual-collective subsystems:
-    "megatronapp_tpu/ops/context_parallel.py",   # cp ring/a2a attention
+    # Audited FULL-MANUAL subsystems (ISSUE 2: ported off the
+    # partial-auto shard_map this jax build aborts on; each routes its
+    # region setup through collectives.shard_map_compat and emits
+    # *-overlap-* MegaScan spans via collectives.ring_span):
+    "megatronapp_tpu/ops/context_parallel.py",   # cp rings (custom_vjp p2p)
     "megatronapp_tpu/ops/cross_entropy.py",      # vocab-parallel CE
     "megatronapp_tpu/parallel/pipeline.py",      # pp schedule ring
-    "megatronapp_tpu/transformer/moe.py",        # ep a2a dispatcher
+    "megatronapp_tpu/transformer/moe.py",        # ep chunked-a2a dispatch
 }
 
 SCAN_DIRS = ("megatronapp_tpu",)
