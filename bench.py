@@ -113,14 +113,28 @@ def _cp_a2a_hook():
     return r if r.get("ring_attention") else None
 
 
+def _paged_kv_hook():
+    """Paged-vs-dense serving A/B (tools/paged_kv_benchmark.py) on the
+    CPU backend — decode throughput, memory footprint, and prefix-cache
+    hit rate tracked round over round like the other hooks."""
+    if os.environ.get("BENCH_PAGED_KV", "1") != "1":
+        return None
+    r = _run_child("--paged-kv", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("decode") else None
+
+
 def _attach_overlap_hooks(res):
-    """Attach the tp-overlap and cp/a2a A/B results to a round record."""
+    """Attach the tp-overlap, cp/a2a, and paged-kv A/B results to a
+    round record."""
     tpo = _tp_overlap_hook()
     if tpo:
         res.setdefault("extra", {})["tp_overlap"] = tpo
     cpa = _cp_a2a_hook()
     if cpa:
         res.setdefault("extra", {})["cp_a2a"] = cpa
+    pkv = _paged_kv_hook()
+    if pkv:
+        res.setdefault("extra", {})["paged_kv"] = pkv
     return res
 
 
@@ -188,6 +202,7 @@ def parent_main(local_only: bool = False):
     cpu = _cpu_fallback_record(history)
     tpo = _tp_overlap_hook()
     cpa = _cp_a2a_hook()
+    pkv = _paged_kv_hook()
     last = _load_last_good()
     if last is not None:
         # Top-level `stale` so the consumer can verifiably distinguish this
@@ -208,6 +223,8 @@ def parent_main(local_only: bool = False):
             last["extra"]["tp_overlap"] = tpo
         if cpa:
             last["extra"]["cp_a2a"] = cpa
+        if pkv:
+            last["extra"]["paged_kv"] = pkv
         print(json.dumps(last))
         return
     if cpu:
@@ -218,6 +235,8 @@ def parent_main(local_only: bool = False):
             cpu.setdefault("extra", {})["tp_overlap"] = tpo
         if cpa:
             cpu.setdefault("extra", {})["cp_a2a"] = cpa
+        if pkv:
+            cpu.setdefault("extra", {})["paged_kv"] = pkv
         print(json.dumps(cpu))
         return
     print(json.dumps({
@@ -315,6 +334,13 @@ def cp_a2a_main():
     from tools.cp_a2a_benchmark import run
     print(json.dumps(run(cp=4, ep=4, batch=2, seq=256, heads=8, kv_heads=4,
                          head_dim=32, iters=5, warmup=1)))
+
+
+def paged_kv_main():
+    """paged-vs-dense serving A/B child (CPU env set by the parent)."""
+    from tools.paged_kv_benchmark import run
+    print(json.dumps(run(max_batch=4, block_size=8, max_new=6,
+                         n_requests=6, prefix_len=48)))
 
 
 def probe_main():
@@ -439,5 +465,7 @@ if __name__ == "__main__":
         tp_overlap_main()
     elif "--cp-a2a" in sys.argv:
         cp_a2a_main()
+    elif "--paged-kv" in sys.argv:
+        paged_kv_main()
     else:
         parent_main(local_only="--local" in sys.argv)

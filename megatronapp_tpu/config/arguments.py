@@ -23,6 +23,39 @@ from megatronapp_tpu.config.transformer_config import (
 )
 
 
+def add_serving_args(ap: argparse.ArgumentParser):
+    """Serving / paged-KV flags (ISSUE 3) — single source of truth shared
+    by the main parser (so config-YAML runs and --use-checkpoint-args
+    carry them) and tools/run_text_generation_server.py, which consumes
+    them to assemble the engine."""
+    g = ap.add_argument_group("serving")
+    g.add_argument("--engine", choices=["static", "dynamic", "mamba"],
+                   default="static",
+                   help="dynamic = continuous batching (connections "
+                        "share one decode batch through the server's "
+                        "stepper thread, inference/dynamic_engine.py); "
+                        "mamba = recurrent-state decode for pure-M "
+                        "presets (reference mamba server tool)")
+    g.add_argument("--max-batch", type=int, default=4,
+                   help="dynamic engine: concurrent decode slots")
+    g.add_argument("--paged-kv-cache", action="store_true",
+                   help="with --engine dynamic: block-pool paged KV "
+                        "cache + ragged paged-attention decode "
+                        "(inference/paged_cache.py, "
+                        "ops/pallas/paged_attention.py) — per-block "
+                        "admission, prefix caching, preemption")
+    g.add_argument("--kv-block-size", type=int, default=16,
+                   help="tokens per KV block")
+    g.add_argument("--num-kv-blocks", type=int, default=None,
+                   help="pool size (default: dense capacity max_batch * "
+                        "ceil(max_seq_len/block_size); size down to run "
+                        "oversubscribed with preemption)")
+    g.add_argument("--no-prefix-caching", action="store_false",
+                   dest="prefix_caching",
+                   help="disable refcounted shared-prefix block reuse")
+    return g
+
+
 def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         description=title, allow_abbrev=False,
@@ -203,6 +236,8 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     g.add_argument("--log-straggler", action="store_true")
     g.add_argument("--run-workload-inspector-server", action="store_true")
     g.add_argument("--workload-inspector-port", type=int, default=0)
+
+    add_serving_args(ap)   # paged KV serving flags (ISSUE 3)
 
     g = ap.add_argument_group("megascan")  # reference arguments.py:2705ff
     g.add_argument("--trace", action="store_true")

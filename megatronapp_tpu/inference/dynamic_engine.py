@@ -1,4 +1,4 @@
-"""Dynamic inference engine: continuous batching over a slot-based KV cache.
+"""Dynamic inference engine: continuous batching over slot or paged KV.
 
 Parity with /root/reference/megatron/core/inference/engines/dynamic_engine.py
 + contexts/dynamic_context.py + scheduler.py: requests of different lengths
@@ -6,14 +6,28 @@ enter a waiting queue; the engine admits them into free cache slots
 (prefill), decodes ONE token per step for every active slot, and retires
 finished requests — new requests join mid-flight without draining the batch.
 
-TPU-first: all shapes static. The shared cache is [L, max_batch, S_max,
-Hkv, D] K/V for standard attention, or the compressed MLA pair
-(latent [L, max_batch, S_max, kv_lora_rank] + shared roped key
-[L, max_batch, S_max, dpe]); per-slot sequence lengths live in a
-[max_batch] int32 array; the decode step is ONE jit for all slots
-(per-row rope positions + per-row causal masks), and prefill runs
-through length-bucketed jits (a handful of compilations instead of one
-per prompt length).
+Two cache backends:
+
+- dense (default): the shared cache is [L, max_batch, S_max, Hkv, D] K/V
+  (MLA: the compressed latent + shared roped key pair) — every slot pays
+  for S_max regardless of actual length. Kept bit-exact as the parity
+  oracle for the paged backend.
+- ``paged=True``: KV lives in a shared block pool
+  [L, num_blocks, block_size, Hkv, D] with per-request page tables
+  (inference/paged_cache.py — vLLM-style): admission is by block
+  availability rather than whole slots, identical prompt prefixes are
+  served from the refcounted prefix cache instead of recomputed,
+  exhaustion preempts the lowest-priority running request back to the
+  waiting queue (it resumes by re-prefilling prompt+generated, usually
+  re-hitting its own cached blocks), and decode attends through the
+  ragged paged-attention Pallas kernel
+  (ops/pallas/paged_attention.py).
+
+TPU-first: all shapes static; the decode step is ONE jit for all slots
+(per-row rope positions + per-row masking), prefill runs through
+length-bucketed jits, and sampling is ONE batched on-device jit per step
+(per-request streams stay reproducible via fold_in key chains —
+PRNGKey(seed) ∘ request_id ∘ step — independent of batch composition).
 """
 
 from __future__ import annotations
@@ -29,20 +43,26 @@ import numpy as np
 
 from megatronapp_tpu.config.transformer_config import TransformerConfig
 from megatronapp_tpu.inference.engine import (
-    SamplingParams, init_kv_cache, mask_padded_vocab, sample_logits,
+    SamplingParams, init_kv_cache, mask_padded_vocab,
 )
+from megatronapp_tpu.inference.paged_cache import PagedKVCache, cdiv
 from megatronapp_tpu.models.gpt import gpt_embed, gpt_head, gpt_rope_tables
 from megatronapp_tpu.transformer.block import layer_forward
 
 
 @dataclasses.dataclass
 class Request:
-    """One generation request (reference inference_request.py analogue)."""
+    """One generation request (reference inference_request.py analogue).
+
+    priority: lower = more important; the paged backend preempts the
+    highest (priority, request_id) running request when the block pool
+    is exhausted."""
     request_id: int
     prompt: np.ndarray                  # [P] int32
     max_new_tokens: int
     sampling: SamplingParams
     eod_id: Optional[int] = None
+    priority: int = 0
     # Filled by the engine:
     slot: int = -1
     generated: list = dataclasses.field(default_factory=list)
@@ -56,7 +76,7 @@ class Request:
 
 def _decode_step(params, tokens, cache, lengths, active,
                  cfg: TransformerConfig):
-    """One-token decode for every slot.
+    """One-token decode for every slot (dense backend).
 
     tokens [B,1] (last token per slot), cache [L,B,Smax,...], lengths [B]
     (tokens already in cache per slot), active [B] bool. Returns
@@ -95,17 +115,105 @@ def _decode_step(params, tokens, cache, lengths, active,
     return logits, new_caches
 
 
+def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
+                       cfg: TransformerConfig, max_seq_len: int):
+    """One-token decode for every slot against the paged block pool.
+
+    pages: ([L, NB, bs, Hkv, D], same) K/V pools (MLA: latent + k_pe
+    pools); page_table [B, max_blocks_per_seq] int32; lengths [B] append
+    positions; active [B] bool (inactive rows' writes are dropped and
+    their outputs discarded). Returns (last_logits [B,V], new pages)."""
+    h = gpt_embed(params, tokens, cfg, position_ids=lengths[:, None])
+    cos_full, sin_full = gpt_rope_tables(cfg, max_seq_len)
+    if cos_full is not None:
+        cos = jnp.take(cos_full, lengths, axis=0)[:, None]
+        sin = jnp.take(sin_full, lengths, axis=0)[:, None]
+    else:
+        cos = sin = None
+
+    if cfg.multi_latent_attention:
+        # The MLA paged path gathers each slot's latent run back to a
+        # contiguous [B, MB*bs, .] layout (kv_up reconstitution needs
+        # dense rows); gathered row index == sequence position, so the
+        # per-row mask is the same attend-up-to-length mask as dense.
+        mb, bs = page_table.shape[1], pages[0].shape[2]
+        kv_pos = jnp.arange(mb * bs)
+        attend = kv_pos[None, :] <= lengths[:, None]
+        mask = attend[:, None, None, :]
+    else:
+        mask = None      # the ragged kernel masks by per-row kv length
+
+    pa, pb = pages
+
+    def body(carry, layer_in):
+        hh = carry
+        layer_p, a_l, b_l, lid = layer_in
+        (hh, new_cache), _ = layer_forward(
+            layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
+            kv_cache=(a_l, b_l), cache_index=None,
+            cache_positions=lengths, page_table=page_table, active=active)
+        return hh, new_cache
+
+    h, new_pages = jax.lax.scan(
+        body, h, (params["block"], pa, pb, jnp.arange(cfg.num_layers)))
+    logits = gpt_head(params, h, cfg)[:, -1]
+    return logits, new_pages
+
+
+def _request_keys(seeds, rids, steps):
+    """Per-row PRNG keys: PRNGKey(seed) ∘ fold_in(request_id) ∘
+    fold_in(step). The previous additive scheme
+    (seed + step*7919 + request_id) collided across requests/steps —
+    e.g. (rid, step) and (rid + 7919, step - 1) shared a key."""
+    def one(s, r, t):
+        return jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(s), r), t)
+    return jax.vmap(one)(seeds, rids, steps)
+
+
+def _sample_batched(logits, seeds, rids, steps, temps, top_ks, top_ps,
+                    greedys):
+    """Batched on-device sampling, one jit for all slots (replaces the
+    per-request device_get loop). Per-row params; rows mirror
+    engine.sample_logits semantics exactly: temperature → top-k →
+    top-p → categorical, greedy bypasses all. logits [B,V] → [B]."""
+    keys = _request_keys(seeds, rids, steps)
+    v = logits.shape[-1]
+    x = logits / jnp.maximum(temps[:, None], 1e-6)
+    sorted_desc = jnp.sort(x, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(top_ks - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    x = jnp.where((top_ks[:, None] > 0) & (x < kth), -1e30, x)
+    sorted2 = jnp.sort(x, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted2, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < top_ps[:, None], axis=-1)
+    cutoff = jnp.take_along_axis(sorted2, cutoff_idx[:, None], axis=-1)
+    x = jnp.where((top_ps[:, None] > 0.0) & (x < cutoff), -1e30, x)
+    sampled = jax.vmap(jax.random.categorical)(keys, x)
+    return jnp.where(greedys, jnp.argmax(logits, axis=-1),
+                     sampled).astype(jnp.int32)
+
+
 class DynamicInferenceEngine:
     """Continuous-batching engine (reference DynamicInferenceEngine).
 
     add_request() any time; step() decodes one token for every active
     request and admits waiting requests into free slots. Finished requests
     surface through the returned events and the optional token_callback.
+
+    paged=True switches to the block-pool backend (see module docstring):
+    block_size/num_blocks size the pool (num_blocks defaults to dense
+    capacity — pass less to run oversubscribed with preemption), and
+    enable_prefix_caching turns shared-prefix block reuse on/off.
     """
 
     def __init__(self, params, cfg: TransformerConfig, tokenizer=None,
                  max_batch: int = 4, max_seq_len: Optional[int] = None,
-                 prefill_buckets: Tuple[int, ...] = (32, 128, 512)):
+                 prefill_buckets: Tuple[int, ...] = (32, 128, 512),
+                 paged: bool = False, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 enable_prefix_caching: bool = True):
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -115,44 +223,136 @@ class DynamicInferenceEngine:
             b for b in sorted(prefill_buckets) if b <= self.max_seq_len
         ) or (self.max_seq_len,)
 
-        self.cache = init_kv_cache(cfg, max_batch, self.max_seq_len)
-        self.lengths = jnp.zeros((max_batch,), jnp.int32)
+        self.paged = paged
+        if paged:
+            self.pool = PagedKVCache(
+                cfg, max_batch, self.max_seq_len, num_blocks=num_blocks,
+                block_size=block_size,
+                enable_prefix_caching=enable_prefix_caching)
+            self.cache = None
+        else:
+            self.pool = None
+            self.cache = init_kv_cache(cfg, max_batch, self.max_seq_len)
+        self.lengths = np.zeros((max_batch,), np.int32)
         self.last_tokens = np.zeros((max_batch, 1), np.int32)
         self.slots: List[Optional[Request]] = [None] * max_batch
         self.waiting: deque = deque()
+        self.requests: Dict[int, Request] = {}
+        self._aborted: List[Request] = []   # aborted mid-admission
         self._ids = itertools.count()
         self._build_jits()
 
     def _build_jits(self):
         cfg = self.cfg
-        self._decode = jax.jit(
-            lambda p, t, c, l, a: _decode_step(p, t, c, l, a, cfg))
-        # Prefill reuses the static engine's whole-prompt forward on a
-        # [1, bucket] batch, then scatters the kv rows into the slot.
         import functools
 
         from megatronapp_tpu.inference.engine import _forward_with_cache
         self._prefill = jax.jit(
             functools.partial(_forward_with_cache, cfg=cfg))
+        self._sample_b = jax.jit(_sample_batched)
+        if self.paged:
+            msl = self.max_seq_len
+            self._decode = jax.jit(
+                lambda p, t, pages, tbl, l, a: _paged_decode_step(
+                    p, t, pages, tbl, l, a, cfg, msl),
+                donate_argnums=(2,))
+            from megatronapp_tpu.ops.pallas.paged_attention import (
+                gather_prefix_pages, write_prompt_pages,
+            )
+            self._write_pages = jax.jit(write_prompt_pages)
+            self._gather_prefix = jax.jit(gather_prefix_pages,
+                                          static_argnums=(2,))
+        else:
+            self._decode = jax.jit(
+                lambda p, t, c, l, a: _decode_step(p, t, c, l, a, cfg))
 
     def reset_compilation(self):
         """Re-trace on next call (after MegaScope hook toggles — see
-        StaticInferenceEngine.reset_compilation)."""
+        StaticInferenceEngine.reset_compilation). Rebuilds the paged
+        decode/scatter/gather jits too, so toggled capture hooks cannot
+        pin stale traces in the paged backend."""
         self._build_jits()
 
     # ---- request lifecycle ------------------------------------------------
     def add_request(self, prompt_tokens, max_new_tokens: int,
                     sampling: Optional[SamplingParams] = None,
-                    eod_id: Optional[int] = None) -> int:
+                    eod_id: Optional[int] = None,
+                    priority: int = 0) -> int:
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError(
+                "empty prompt: prefill samples the first token from the "
+                "last PROMPT position, so at least one token (e.g. BOS/"
+                "eod) is required")
         if len(prompt) + max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f"prompt({len(prompt)}) + max_new({max_new_tokens}) exceeds "
                 f"max_seq_len({self.max_seq_len})")
+        if self.paged:
+            need = cdiv(len(prompt) + max_new_tokens, self.pool.block_size)
+            if need > self.pool.num_blocks:
+                raise ValueError(
+                    f"request needs {need} blocks "
+                    f"(prompt {len(prompt)} + max_new {max_new_tokens} at "
+                    f"block_size {self.pool.block_size}) but the pool has "
+                    f"only {self.pool.num_blocks}")
         req = Request(next(self._ids), prompt, max_new_tokens,
-                      sampling or SamplingParams(), eod_id=eod_id)
+                      sampling or SamplingParams(), eod_id=eod_id,
+                      priority=priority)
         self.waiting.append(req)
+        self.requests[req.request_id] = req
         return req.request_id
+
+    def pop_request(self, request_id: int) -> Optional[Request]:
+        """Remove and return a finished request (server-side consumers)."""
+        return self.requests.pop(request_id, None)
+
+    def abort_request(self, request_id: int) -> Optional[str]:
+        """Cancel a request. Returns 'waiting' if it was dequeued before
+        running (no finish event will fire), 'running' if it was marked
+        to retire on the next step, or None if unknown/already done."""
+        req = self.requests.get(request_id)
+        if req is None:
+            return None
+        if req in self.waiting:
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                pass    # raced with admission: treat as running below
+            else:
+                req.finished = True
+                return "waiting"
+        if not req.finished:
+            # Running — or mid-admission on the stepper thread (slot not
+            # yet assigned): either way, marking finished retires it on
+            # the next step, releasing its cache.
+            req.finished = True
+            return "running"
+        return None
+
+    def abort_all(self):
+        """Drop ALL queued and running requests (server error recovery).
+
+        Paged blocks are released through the pool so capacity is
+        reclaimed and the slot bookkeeping stays consistent — clearing
+        slots without releasing would trip PagedKVCache.admit's
+        slot-still-holds-blocks assert on the next request. Best-effort
+        if the failure left pool bookkeeping itself inconsistent."""
+        for req in list(self.waiting):
+            self.requests.pop(req.request_id, None)
+        self.waiting.clear()
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            if self.paged:
+                try:
+                    self.pool.release(slot, np.asarray(req.tokens),
+                                      int(self.lengths[slot]))
+                except Exception:  # noqa: BLE001 — best-effort reclaim
+                    pass
+            self.slots[slot] = None
+            self.lengths[slot] = 0
+            self.requests.pop(req.request_id, None)
 
     @property
     def has_work(self) -> bool:
@@ -164,37 +364,136 @@ class DynamicInferenceEngine:
         for slot in range(self.max_batch):
             if self.slots[slot] is not None or not self.waiting:
                 continue
+            # Pop FIRST (re-appended on failure): a peek-then-pop window
+            # would race a concurrent abort_request removing the head —
+            # popleft would then silently drop the NEXT request.
             req = self.waiting.popleft()
+            if req.finished:          # aborted while queued (racy path)
+                self._aborted.append(req)
+                continue
+            plan = None
+            if self.paged:
+                # Admission by block availability: if the pool cannot
+                # host this prompt now, keep FIFO order and wait for
+                # retirements/preemptions to free blocks.
+                plan = self.pool.admit(slot, req.tokens)
+                if plan is None:
+                    self.waiting.appendleft(req)
+                    break
             req.slot = slot
             self.slots[slot] = req
-            self._prefill_into_slot(req)
+            self._prefill_into_slot(req, plan)
             admitted.append(req)
         return admitted
 
-    def _prefill_into_slot(self, req: Request):
-        p_len = len(req.prompt)
+    def _prefill_into_slot(self, req: Request, plan=None):
+        # req.tokens (prompt + any pre-preemption generated tokens): a
+        # resumed request re-prefills its full history and samples the
+        # NEXT token, exactly like a fresh admission.
+        tokens = req.tokens
+        p_len = len(tokens)
         bucket = next((b for b in self.prefill_buckets if b >= p_len),
                       self.max_seq_len)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :p_len] = req.prompt
-        tmp_cache = init_kv_cache(self.cfg, 1, self.max_seq_len)
-        logits, tmp_cache = self._prefill(self.params,
-                                          jnp.asarray(padded), tmp_cache, 0)
-        # Scatter the prompt's kv rows into this slot of the shared cache.
-        slot = req.slot
-        self.cache = tuple(
-            c.at[:, slot, :].set(t[:, 0, :]) for c, t in
-            zip(self.cache, tmp_cache))
-        self.lengths = self.lengths.at[slot].set(p_len)
+        if bucket < p_len:
+            raise AssertionError(
+                f"no prefill bucket covers length {p_len} (buckets "
+                f"{self.prefill_buckets}, max_seq_len {self.max_seq_len})")
+        if self.paged:
+            logits_last = self._paged_prefill(req, tokens, p_len, bucket,
+                                              plan)
+        else:
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :p_len] = tokens
+            tmp_cache = init_kv_cache(self.cfg, 1, bucket)
+            logits, tmp_cache = self._prefill(
+                self.params, jnp.asarray(padded), tmp_cache, 0)
+            # Scatter the kv rows into this slot of the shared cache.
+            slot = req.slot
+            self.cache = tuple(
+                c.at[:, slot, :bucket].set(t[:, 0]) for c, t in
+                zip(self.cache, tmp_cache))
+            logits_last = logits[0, p_len - 1]
+        self.lengths[req.slot] = p_len
         # First generated token comes from the last PROMPT position.
-        logits_last = mask_padded_vocab(logits[0, p_len - 1], self.cfg)
+        logits_last = mask_padded_vocab(logits_last, self.cfg)
         tok = self._sample(logits_last[None], req)
         self._record_token(req, int(tok[0]))
 
+    def _paged_prefill(self, req: Request, tokens, p_len: int, bucket: int,
+                       plan) -> jnp.ndarray:
+        """Prefill through the block pool: only tokens past the cached
+        prefix are computed (through a bucket-sized dense temp cache,
+        never S_max), and the new KV rows are scattered page-table-aware
+        on device. Returns the last prompt position's logits [V]."""
+        assert plan is not None
+        slot = req.slot
+        pool = self.pool
+        cached = plan.cached_tokens
+        table_row = jnp.asarray(pool.page_table[slot])
+
+        tmp = init_kv_cache(self.cfg, 1, bucket)
+        if cached:
+            nblocks = cdiv(cached, pool.block_size)
+            tmp = tuple(
+                t.at[:, 0, :cached].set(
+                    self._gather_prefix(p, table_row, nblocks)[:, :cached])
+                for t, p in zip(tmp, pool.pages))
+
+        s_step = bucket - cached
+        padded = np.zeros((1, s_step), np.int32)
+        padded[0, :p_len - cached] = tokens[cached:]
+        logits, tmp = self._prefill(self.params, jnp.asarray(padded), tmp,
+                                    cached)
+        count = p_len - cached
+        pool.pages = tuple(
+            self._write_pages(p, t[:, 0, cached:], table_row, cached, count)
+            for p, t in zip(pool.pages, tmp))
+        # Register the prompt's full blocks so concurrent same-prefix
+        # requests hit them immediately.
+        pool.register_prefix(slot, np.asarray(tokens), p_len)
+        return logits[0, count - 1]
+
     def _sample(self, logits, req: Request):
-        rng = jax.random.PRNGKey(
-            req.sampling.seed + len(req.generated) * 7919 + req.request_id)
-        return jax.device_get(sample_logits(logits, rng, req.sampling))
+        """Single-row sampling (prefill). Same fold_in key chain as the
+        batched decode sampler, so a request's sample stream is
+        reproducible and independent of batch composition."""
+        s = req.sampling
+        tok = self._sample_b(
+            logits,
+            jnp.asarray([s.seed], jnp.int32),
+            jnp.asarray([req.request_id], jnp.int32),
+            jnp.asarray([len(req.generated)], jnp.int32),
+            jnp.asarray([s.temperature], jnp.float32),
+            jnp.asarray([s.top_k], jnp.int32),
+            jnp.asarray([s.top_p], jnp.float32),
+            jnp.asarray([s.greedy], bool))
+        return jax.device_get(tok)
+
+    def _sample_all(self, logits) -> np.ndarray:
+        """Batched on-device sampling for every slot (inactive rows get
+        default params; their tokens are ignored). ONE device round-trip
+        per decode step instead of one per request."""
+        b = self.max_batch
+        seeds = np.zeros(b, np.int32)
+        rids = np.zeros(b, np.int32)
+        steps = np.zeros(b, np.int32)
+        temps = np.ones(b, np.float32)
+        top_ks = np.zeros(b, np.int32)
+        top_ps = np.zeros(b, np.float32)
+        greedys = np.zeros(b, bool)
+        for i, r in enumerate(self.slots):
+            if r is None or r.finished:
+                continue
+            s = r.sampling
+            seeds[i], rids[i], steps[i] = s.seed, r.request_id, \
+                len(r.generated)
+            temps[i], top_ks[i], top_ps[i], greedys[i] = (
+                s.temperature, s.top_k, s.top_p, s.greedy)
+        toks = self._sample_b(
+            logits, jnp.asarray(seeds), jnp.asarray(rids),
+            jnp.asarray(steps), jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(top_ps), jnp.asarray(greedys))
+        return np.asarray(jax.device_get(toks))
 
     def _record_token(self, req: Request, tok: int):
         req.generated.append(tok)
@@ -203,13 +502,54 @@ class DynamicInferenceEngine:
                 len(req.generated) >= req.max_new_tokens):
             req.finished = True
 
+    # ---- paged-backend pressure handling ---------------------------------
+    def _preempt(self, req: Request, out: List[Request]):
+        """Push a running request back to the waiting queue, releasing its
+        blocks (full blocks stay prefix-cached while evictable, so the
+        resume prefill usually re-hits its own KV)."""
+        slot = req.slot
+        self.pool.release(slot, np.asarray(req.tokens),
+                          int(self.lengths[slot]), preempted=True)
+        self.slots[slot] = None
+        self.lengths[slot] = 0
+        req.slot = -1
+        self.waiting.appendleft(req)
+        out.append(req)
+
+    def _ensure_decode_capacity(self) -> List[Request]:
+        """Before a decode step, every active slot needs the block that
+        covers its append position. Exhaustion preempts the
+        lowest-priority running request (highest (priority, request_id));
+        the needy request preempts ITSELF when it is the lowest."""
+        preempted: List[Request] = []
+        runners = sorted(
+            (r for r in self.slots if r is not None and not r.finished),
+            key=lambda r: (r.priority, r.request_id))
+        for req in runners:
+            if req.slot < 0:
+                continue                 # preempted earlier this step
+            while not self.pool.ensure_capacity(
+                    req.slot, int(self.lengths[req.slot])):
+                victim = next(r for r in reversed(runners)
+                              if r.slot >= 0)
+                self._preempt(victim, preempted)
+                if victim is req:
+                    break
+        return preempted
+
     def _retire(self) -> List[Request]:
         done = []
         for slot, req in enumerate(self.slots):
             if req is not None and req.finished:
                 done.append(req)
+                if self.paged:
+                    # The cache holds tokens[:-1] (the final sampled
+                    # token's KV was never written) — register/release
+                    # only the written rows.
+                    self.pool.release(slot, np.asarray(req.tokens),
+                                      int(self.lengths[slot]))
                 self.slots[slot] = None
-                self.lengths = self.lengths.at[slot].set(0)
+                self.lengths[slot] = 0
         return done
 
     # ---- main loop --------------------------------------------------------
@@ -217,31 +557,46 @@ class DynamicInferenceEngine:
         """Admit → decode one token for all active slots → retire.
 
         Returns {"admitted": [ids], "tokens": [(id, tok)], "finished":
-        [ids]} for this step."""
+        [ids], "preempted": [ids]} for this step."""
         admitted = self._admit()
         events = {"admitted": [r.request_id for r in admitted],
                   "tokens": [(r.request_id, r.generated[-1])
                              for r in admitted],
-                  "finished": []}
+                  "finished": [], "preempted": []}
+
+        if self.paged:
+            events["preempted"] = [
+                r.request_id for r in self._ensure_decode_capacity()]
 
         active = [r for r in self.slots
                   if r is not None and not r.finished]
         if active:
-            active_mask = jnp.asarray(
+            active_np = np.array(
                 [self.slots[i] is not None and not self.slots[i].finished
                  for i in range(self.max_batch)])
-            logits, self.cache = self._decode(
-                self.params, jnp.asarray(self.last_tokens), self.cache,
-                self.lengths, active_mask)
+            active_mask = jnp.asarray(active_np)
+            lengths = jnp.asarray(self.lengths)
+            if self.paged:
+                logits, self.pool.pages = self._decode(
+                    self.params, jnp.asarray(self.last_tokens),
+                    self.pool.pages, jnp.asarray(self.pool.page_table),
+                    lengths, active_mask)
+            else:
+                logits, self.cache = self._decode(
+                    self.params, jnp.asarray(self.last_tokens), self.cache,
+                    lengths, active_mask)
             # The decode wrote each active row's kv at lengths[slot].
-            self.lengths = self.lengths + active_mask.astype(jnp.int32)
+            self.lengths += active_np.astype(np.int32)
             logits = mask_padded_vocab(logits, self.cfg)
+            toks = self._sample_all(logits)
             for req in active:
-                tok = self._sample(logits[req.slot][None], req)
-                self._record_token(req, int(tok[0]))
-                events["tokens"].append((req.request_id, int(tok[0])))
+                tok = int(toks[req.slot])
+                self._record_token(req, tok)
+                events["tokens"].append((req.request_id, tok))
 
         events["finished"] = [r.request_id for r in self._retire()]
+        events["finished"] += [r.request_id for r in self._aborted]
+        self._aborted = []
         return events
 
     def run_to_completion(self,
@@ -251,18 +606,16 @@ class DynamicInferenceEngine:
         {request_id: full token array}."""
         results: Dict[int, np.ndarray] = {}
         finished_reqs: Dict[int, Request] = {}
-        known: Dict[int, Request] = {}
         while self.has_work:
-            for r in list(self.waiting) + [r for r in self.slots if r]:
-                known[r.request_id] = r
             ev = self.step()
             if token_callback is not None:
                 for rid, tok in ev["tokens"]:
                     token_callback(rid, tok)
             for rid in ev["finished"]:
-                finished_reqs[rid] = known[rid]
+                finished_reqs[rid] = self.requests[rid]
         for rid, req in finished_reqs.items():
             results[rid] = req.tokens
+            self.requests.pop(rid, None)
         return results
 
     def generate_text(self, prompts, max_new_tokens: int,

@@ -1,0 +1,261 @@
+"""Paged KV-cache block pool: allocator, prefix cache, preemption support.
+
+vLLM-style block management for the continuous-batching engine
+(inference/dynamic_engine.py `paged=True`): KV storage is a shared pool
+shaped [L, num_blocks, block_size, Hkv, D] (MLA: the compressed latent
+[L, num_blocks, block_size, kv_lora_rank] + shared roped key
+[..., qk_pos_emb_head_dim] pair), and each slot owns an ordered page
+table row [max_blocks_per_seq] int32. Capacity is admitted per block, so
+a 6-token request costs one block, not an S_max row.
+
+Prefix caching: full blocks are keyed by a rolling hash of the token
+prefix they complete (hash chains over whole prefixes, so a hit
+guarantees exact token equality up to the block boundary) and
+refcounted. Blocks whose refcount drops to zero stay resident on an LRU
+list and remain hittable until the allocator evicts them for fresh
+demand. A request whose prompt fully hits still needs the last
+position's logits, so its final block is **copy-on-write**: the shared
+block's rows are copied into a private block and only the diverging row
+is recomputed — shared blocks are never written.
+
+All bookkeeping is host-side (numpy/python); the page DATA lives in jnp
+arrays on `self.pages` and is only touched by jit-able scatter/gather
+helpers (ops/pallas/paged_attention.py) plus the small copy-on-write
+block copy here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from collections import OrderedDict, deque
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+@dataclasses.dataclass
+class AdmitPlan:
+    """Result of admitting a token sequence into a slot."""
+    blocks: List[int]        # page-table row, sequence order
+    cached_tokens: int       # leading tokens whose KV is already resident
+    cow: bool                # last block was copy-on-write'd (full hit)
+
+
+class PagedKVCache:
+    """Block pool + page tables + refcounted prefix cache."""
+
+    def __init__(self, cfg: TransformerConfig, max_batch: int,
+                 max_seq_len: int, num_blocks: Optional[int] = None,
+                 block_size: int = 16, enable_prefix_caching: bool = True):
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_seq_len = max_seq_len
+        self.block_size = block_size
+        self.max_blocks_per_seq = cdiv(max_seq_len, block_size)
+        # Default pool = dense capacity (max_batch full sequences); size
+        # it down for the actual workload to realize the memory win.
+        self.num_blocks = (num_blocks if num_blocks is not None
+                           else max_batch * self.max_blocks_per_seq)
+        self.enable_prefix_caching = enable_prefix_caching
+
+        l = cfg.num_layers
+        nb, bs = self.num_blocks, self.block_size
+        if cfg.multi_latent_attention:
+            self.pages: Tuple[jnp.ndarray, ...] = (
+                jnp.zeros((l, nb, bs, cfg.kv_lora_rank), cfg.compute_dtype),
+                jnp.zeros((l, nb, bs, cfg.qk_pos_emb_head_dim),
+                          cfg.compute_dtype))
+        else:
+            shape = (l, nb, bs, cfg.num_query_groups, cfg.head_dim)
+            self.pages = (jnp.zeros(shape, cfg.compute_dtype),
+                          jnp.zeros(shape, cfg.compute_dtype))
+
+        self.page_table = np.zeros((max_batch, self.max_blocks_per_seq),
+                                   np.int32)
+        self._free: deque = deque(range(nb))
+        self._refcount = np.zeros((nb,), np.int32)
+        self._table: dict = {}            # prefix hash -> block id
+        self._hash_of: dict = {}          # block id -> prefix hash
+        self._lru: OrderedDict = OrderedDict()  # rc==0 hashed blocks
+        self._slot_blocks: List[List[int]] = [[] for _ in range(max_batch)]
+        self.stats = {"prefix_hit_tokens": 0, "prefill_tokens": 0,
+                      "cow_copies": 0, "evictions": 0, "preemptions": 0,
+                      "peak_blocks_in_use": 0}
+
+    # ---- sizing ----------------------------------------------------------
+    @property
+    def bytes_total(self) -> int:
+        return sum(p.size * p.dtype.itemsize for p in self.pages)
+
+    def blocks_in_use(self) -> int:
+        """Blocks with live references (excludes free + evictable)."""
+        return self.num_blocks - len(self._free) - len(self._lru)
+
+    def available_blocks(self) -> int:
+        return len(self._free) + len(self._lru)
+
+    def refcount(self, block: int) -> int:
+        return int(self._refcount[block])
+
+    def slot_blocks(self, slot: int) -> List[int]:
+        return list(self._slot_blocks[slot])
+
+    # ---- low-level block lifecycle --------------------------------------
+    def _take_free(self) -> Optional[int]:
+        if self._free:
+            return self._free.popleft()
+        if self._lru:
+            blk, _ = self._lru.popitem(last=False)   # least recently used
+            key = self._hash_of.pop(blk, None)
+            if key is not None and self._table.get(key) == blk:
+                del self._table[key]
+            self.stats["evictions"] += 1
+            return blk
+        return None
+
+    def _acquire_cached(self, blk: int):
+        self._refcount[blk] += 1
+        self._lru.pop(blk, None)
+
+    def _release_block(self, blk: int):
+        self._refcount[blk] -= 1
+        assert self._refcount[blk] >= 0, f"block {blk} over-released"
+        if self._refcount[blk] == 0:
+            if blk in self._hash_of:
+                self._lru[blk] = None    # evictable, still hittable
+            else:
+                self._free.append(blk)
+
+    def _copy_block(self, src: int, dst: int):
+        self.pages = tuple(p.at[:, dst].set(p[:, src]) for p in self.pages)
+        self.stats["cow_copies"] += 1
+
+    def _note_usage(self):
+        self.stats["peak_blocks_in_use"] = max(
+            self.stats["peak_blocks_in_use"], self.blocks_in_use())
+
+    # ---- prefix hashing --------------------------------------------------
+    def _block_keys(self, tokens: np.ndarray, limit: int) -> List[bytes]:
+        """Rolling hash per FULL block of tokens[:limit] (key i commits to
+        the whole prefix through block i, so a table hit is an exact
+        prefix match)."""
+        bs = self.block_size
+        keys, digest = [], b""
+        for i in range(limit // bs):
+            digest = hashlib.sha1(
+                digest + np.ascontiguousarray(
+                    tokens[i * bs:(i + 1) * bs], dtype=np.int32).tobytes()
+            ).digest()
+            keys.append(digest)
+        return keys
+
+    # ---- engine-facing API ----------------------------------------------
+    def admit(self, slot: int, tokens: np.ndarray) -> Optional[AdmitPlan]:
+        """Install blocks covering `tokens` into `slot`'s page table,
+        reusing cached prefix blocks. Returns None (state rolled back)
+        when the pool cannot supply the fresh blocks."""
+        assert not self._slot_blocks[slot], f"slot {slot} still holds blocks"
+        p_len = len(tokens)
+        need_total = cdiv(p_len, self.block_size)
+
+        hits: List[int] = []
+        if self.enable_prefix_caching:
+            for key in self._block_keys(tokens, p_len):
+                blk = self._table.get(key)
+                if blk is None:
+                    break
+                hits.append(blk)
+        cached = len(hits) * self.block_size
+        cow = cached >= p_len        # full hit: recompute the last token
+        if cow:
+            cached = p_len - 1
+
+        for blk in hits:
+            self._acquire_cached(blk)
+        fresh_needed = need_total - len(hits) + (1 if cow else 0)
+        fresh: List[int] = []
+        for _ in range(fresh_needed):
+            blk = self._take_free()
+            if blk is None:
+                for b in fresh:
+                    self._refcount[b] = 0
+                    self._free.append(b)
+                for b in hits:
+                    self._release_block(b)
+                return None
+            self._refcount[blk] = 1
+            fresh.append(blk)
+
+        if cow:
+            src = hits[-1]
+            dst = fresh[0]
+            self._copy_block(src, dst)
+            self._release_block(src)
+            blocks = hits[:-1] + [dst] + fresh[1:]
+        else:
+            blocks = hits + fresh
+
+        self._slot_blocks[slot] = blocks
+        self.page_table[slot, :] = 0
+        self.page_table[slot, :len(blocks)] = blocks
+        self.stats["prefix_hit_tokens"] += cached
+        self.stats["prefill_tokens"] += p_len - cached
+        self._note_usage()
+        return AdmitPlan(blocks, cached, cow)
+
+    def ensure_capacity(self, slot: int, position: int) -> bool:
+        """Make sure `slot` owns the block covering `position` (decode
+        appends grow one block at a time)."""
+        idx = position // self.block_size
+        owned = self._slot_blocks[slot]
+        if idx < len(owned):
+            return True
+        assert idx == len(owned), (
+            f"slot {slot} skipped a block: position {position} needs block "
+            f"{idx}, owns {len(owned)}")
+        blk = self._take_free()
+        if blk is None:
+            return False
+        self._refcount[blk] = 1
+        owned.append(blk)
+        self.page_table[slot, idx] = blk
+        self._note_usage()
+        return True
+
+    def register_prefix(self, slot: int, tokens: np.ndarray, valid_len: int):
+        """Hash this slot's full blocks over tokens[:valid_len] so later
+        same-prefix requests hit them (only rows actually written count —
+        the engine passes valid_len excluding the pending last token)."""
+        if not self.enable_prefix_caching:
+            return
+        owned = self._slot_blocks[slot]
+        for i, key in enumerate(self._block_keys(tokens, valid_len)):
+            if i >= len(owned):
+                break
+            blk = owned[i]
+            if blk not in self._hash_of and key not in self._table:
+                self._table[key] = blk
+                self._hash_of[blk] = key
+
+    def release(self, slot: int, tokens: np.ndarray, valid_len: int,
+                preempted: bool = False):
+        """Return a slot's blocks to the pool. Full blocks get registered
+        in the prefix cache first (so a preempted request can re-hit its
+        own KV on resume, and finished prompts stay warm for followers),
+        then every block is de-referenced — rc==0 hashed blocks park on
+        the LRU list, unhashed ones go straight to the free list."""
+        self.register_prefix(slot, tokens, valid_len)
+        for blk in self._slot_blocks[slot]:
+            self._release_block(blk)
+        self._slot_blocks[slot] = []
+        self.page_table[slot, :] = 0
+        if preempted:
+            self.stats["preemptions"] += 1

@@ -6,6 +6,13 @@ Parity with /root/reference/megatron/inference/text_generation_server.py
 tools/run_text_generation_server.py. aiohttp replaces Flask+ws (both in one
 event loop; generation runs in a worker thread so the loop stays live).
 
+With a DynamicInferenceEngine (--engine dynamic), the server runs TRUE
+continuous batching: every connection submits into one shared engine and
+a single stepper thread (DynamicBatchingDriver) drives engine.step(), so
+concurrent requests decode in the same batch instead of serializing
+whole generations behind _gen_lock. Static/mamba engines keep the
+serialized path (their caches are per-generation).
+
 REST:  PUT /api  {"prompts": [...], "tokens_to_generate": N,
                   "temperature": f, "top_k": i, "top_p": f, "greedy": b}
        → {"text": [...], "segments": [...]}
@@ -39,6 +46,99 @@ class _ClientGone(Exception):
     mid-stream (cooperative cancellation via the token callback)."""
 
 
+class DynamicBatchingDriver:
+    """One stepper thread drives a shared DynamicInferenceEngine for ALL
+    server connections (continuous batching across clients).
+
+    submit() is thread-safe and returns (request_id, done_event); the
+    optional token_cb(rid, token) fires from the stepper thread for every
+    generated token. cancel() aborts a request (waiting requests complete
+    immediately; running ones retire on the next step, releasing their
+    cache). The stepper is a daemon thread started on first submit and
+    parks on a condition variable whenever the engine has no work."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._cv = threading.Condition()
+        self._subs = {}     # rid -> {"cb": fn|None, "done": Event}
+        self._errors = {}   # rid -> Exception from a failed step
+        self._thread = None
+        self.max_active = 0   # high-water concurrently-active slots
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="dynamic-engine-stepper",
+                daemon=True)
+            self._thread.start()
+
+    def submit(self, prompt_ids, max_new_tokens, sampling, eod_id=None,
+               token_cb=None, priority: int = 0):
+        with self._cv:
+            rid = self.engine.add_request(prompt_ids, max_new_tokens,
+                                          sampling, eod_id=eod_id,
+                                          priority=priority)
+            done = threading.Event()
+            self._subs[rid] = {"cb": token_cb, "done": done}
+            self._ensure_thread()
+            self._cv.notify_all()
+        return rid, done
+
+    def cancel(self, rid):
+        with self._cv:
+            state = self.engine.abort_request(rid)
+            if state == "waiting":
+                # Never ran: no finish event will fire — complete here.
+                self.engine.pop_request(rid)
+                sub = self._subs.pop(rid, None)
+                if sub:
+                    sub["done"].set()
+
+    def result_tokens(self, rid):
+        """Full token array of a finished request (pops it). Raises the
+        stepper-side error if the request's step failed."""
+        err = self._errors.pop(rid, None)
+        if err is not None:
+            raise err
+        req = self.engine.pop_request(rid)
+        return None if req is None else req.tokens
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self.engine.has_work:
+                    self._cv.wait()
+            try:
+                ev = self.engine.step()
+            except Exception as e:  # noqa: BLE001 — broadcast & reset
+                with self._cv:
+                    for rid, sub in self._subs.items():
+                        self._errors[rid] = e
+                        sub["done"].set()
+                    self._subs.clear()
+                    # Drop ALL queued/running work: the engine state is
+                    # suspect, and leaving occupied slots would spin this
+                    # loop on the same exception forever. abort_all
+                    # releases paged pool blocks too — clearing slots by
+                    # hand would leak them and poison every later admit.
+                    self.engine.abort_all()
+                continue
+            self.max_active = max(self.max_active, sum(
+                1 for r in self.engine.slots if r is not None))
+            with self._cv:
+                for rid, tok in ev["tokens"]:
+                    sub = self._subs.get(rid)
+                    if sub and sub["cb"] is not None:
+                        try:
+                            sub["cb"](rid, int(tok))
+                        except Exception:  # noqa: BLE001 — dead sink
+                            sub["cb"] = None
+                for rid in ev["finished"]:
+                    sub = self._subs.pop(rid, None)
+                    if sub:
+                        sub["done"].set()
+
+
 
 def _sampling_from_request(req: dict) -> SamplingParams:
     return SamplingParams(
@@ -56,12 +156,54 @@ class TextGenerationServer:
         self.engine = engine
         self.host = host
         self.port = port
-        # One generation at a time: the engine, capture hooks, and
-        # disturbance are process-global, and viz requests re-trace the
-        # engine's jits — concurrent generations would cross-contaminate
-        # (the reference server serializes with a lock too,
-        # text_generation_server.py MegatronServer).
+        # One generation at a time (static/mamba engines): the engine,
+        # capture hooks, and disturbance are process-global, and viz
+        # requests re-trace the engine's jits — concurrent generations
+        # would cross-contaminate (the reference server serializes with a
+        # lock too, text_generation_server.py MegatronServer).
         self._gen_lock = threading.Lock()
+        # Continuous batching for DynamicInferenceEngine: connections
+        # share one engine through a single stepper thread.
+        from megatronapp_tpu.inference.dynamic_engine import (
+            DynamicInferenceEngine,
+        )
+        self._driver = (DynamicBatchingDriver(engine)
+                        if isinstance(engine, DynamicInferenceEngine)
+                        else None)
+
+    # ------------------------------------------------------------------
+    def _submit_and_wait(self, prompts, n, sampling,
+                         cancel: Optional[threading.Event] = None,
+                         token_cb=None):
+        """Driver path (dynamic engine): submit every prompt into the
+        shared batch, wait for completion, detokenize. token_cb(rid, tok)
+        streams tokens of the FIRST prompt (WS contract)."""
+        import numpy as np
+        tok = self.engine.tokenizer
+        assert tok is not None, "tokenizer required"
+        eod = getattr(tok, "eod", None)
+        subs = []
+        for i, prompt in enumerate(prompts):
+            ids = np.asarray(tok.tokenize(prompt), np.int32)
+            rid, done = self._driver.submit(
+                ids, n, sampling, eod_id=eod,
+                token_cb=token_cb if i == 0 else None)
+            subs.append((ids, rid, done))
+        texts = []
+        for ids, rid, done in subs:
+            while not done.wait(timeout=0.1):
+                if cancel is not None and cancel.is_set():
+                    self._driver.cancel(rid)
+                    done.wait(timeout=60)   # retires on the next step
+                    break
+            toks = self._driver.result_tokens(rid)
+            if cancel is not None and cancel.is_set():
+                raise _ClientGone()
+            new_ids = [] if toks is None else toks[len(ids):].tolist()
+            if eod is not None and eod in new_ids:
+                new_ids = new_ids[: new_ids.index(eod)]
+            texts.append(tok.detokenize(new_ids))
+        return texts
 
     # ------------------------------------------------------------------
     async def handle_api(self, request):
@@ -74,6 +216,10 @@ class TextGenerationServer:
             loop = asyncio.get_running_loop()
 
             def run_api():
+                if self._driver is not None:
+                    # Continuous batching: concurrent /api calls share
+                    # the decode batch instead of queueing on the lock.
+                    return self._submit_and_wait(prompts, n, sampling)
                 with self._gen_lock:
                     return self.engine.generate_text(prompts, n, sampling)
 
@@ -124,6 +270,13 @@ class TextGenerationServer:
             n = int(req.get("tokens_to_generate", 64))
             sampling = _sampling_from_request(req)
             viz = req.get("visualization")
+            if viz and self._driver is not None:
+                await ws.send_json({
+                    "type": "error",
+                    "message": "visualization requires --engine static "
+                               "(the continuous-batching backend shares "
+                               "one step loop across connections)"})
+                continue
             queue: asyncio.Queue = asyncio.Queue()
             # Client-gone cancellation: a disconnect mid-stream must not
             # leave the generation running to completion while holding
@@ -153,6 +306,31 @@ class TextGenerationServer:
                 loop.call_soon_threadsafe(queue.put_nowait, payload)
 
             def run_generation():
+                if self._driver is not None:
+                    # Dynamic engine: stream through the shared stepper
+                    # (no lock — other connections keep decoding in the
+                    # same batch). The driver callback must never raise
+                    # in the stepper thread; disconnects abort via
+                    # driver.cancel inside _submit_and_wait.
+                    state = {"step": 0}
+
+                    def driver_cb(rid, token):
+                        if cancel.is_set():
+                            return
+                        payload = {
+                            "type": "token", "step": state["step"],
+                            "token": int(token),
+                            "text": (self.engine.tokenizer.detokenize(
+                                [int(token)]) if self.engine.tokenizer
+                                else ""),
+                        }
+                        state["step"] += 1
+                        loop.call_soon_threadsafe(queue.put_nowait,
+                                                  payload)
+
+                    return self._submit_and_wait(
+                        prompts[:1], n, sampling, cancel=cancel,
+                        token_cb=driver_cb)
                 # Capture hooks are thread-local and baked in at trace
                 # time: activate in THIS worker thread and re-trace the
                 # engine around the toggle. The lock serializes against

@@ -1,0 +1,240 @@
+"""Ragged paged-attention decode kernel (Pallas TPU) + page helpers.
+
+vLLM-style paged KV serving ("Ragged Paged Attention", arXiv 2604.15464,
+PAPERS.md): the decode cache lives in a shared block pool shaped
+[num_blocks, block_size, Hkv, D]; each slot owns an ordered page table of
+block ids, and one query token per active slot gathers K/V through its
+table with an online softmax over VALID blocks only — no slot pays for
+another slot's length, and admission is per-block instead of per-S_max
+row (inference/paged_cache.py is the allocator).
+
+Kernel shape choices mirror ops/pallas/flash_attention.py: fp32
+accumulators, whole-block skip of out-of-length tiles, GQA via an
+[Hkv, group, D] query reshape (q head h reads kv head h // group, the
+same grouping attention.py uses), and `interpret=_interpret()` so the
+kernel runs (and is tier-1 tested) on CPU. Page-table indirection uses
+`pltpu.PrefetchScalarGridSpec`: the table and per-slot kv lengths are
+scalar-prefetched so the BlockSpec index map can DMA block
+`table[b, j]` directly from HBM — the kernel never materializes a
+contiguous [B, S_max] cache.
+
+A pure-jnp `paged_attention_reference` with the same signature is the
+parity oracle for tests, and `write_prompt_pages` /
+`append_token_pages` / `gather_pages*` are the jit-able scatter/gather
+paths that replace the dense engine's host-side cache scatter.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Decode kernel
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc, m_scr, l_scr, *, scale, block_size, num_blocks_seq,
+                   hkv, group):
+    """Grid (B, max_blocks_per_seq); block j of slot b is DMA'd from page
+    table_ref[b, j]. Online softmax over the ragged valid range
+    [0, lens_ref[b]); fully-out-of-range blocks are skipped whole."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    hq = hkv * group
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    kv_len = lens_ref[b]
+
+    @pl.when(j * block_size < kv_len)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # [Hq, D]
+        k = k_ref[0]                                      # [bs, Hkv, D]
+        v = v_ref[0]
+        d = q.shape[-1]
+        q3 = q.reshape(hkv, group, d)
+        k3 = jnp.swapaxes(k, 0, 1)                        # [Hkv, bs, D]
+        v3 = jnp.swapaxes(v, 0, 1)
+        s = jax.lax.dot_general(                          # [Hkv, g, bs]
+            q3.astype(k3.dtype), k3,
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1)[0]
+        valid = pos < kv_len                              # [bs]
+        s = jnp.where(valid[None, None, :], s, _NEG_INF)
+        s2 = s.reshape(hq, block_size)
+
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s2, axis=1))
+        m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+        p = jnp.exp(s2 - m_safe[:, None])
+        p = jnp.where(valid[None, :], p, 0.0)
+        corr = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+        corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
+        l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=1)
+        p3 = p.reshape(hkv, group, block_size)
+        pv = jax.lax.dot_general(                         # [Hkv, g, D]
+            p3.astype(v3.dtype), v3,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc[:] = acc[:] * corr[:, None] + pv.reshape(hq, d)
+        m_scr[:, 0] = m_new
+
+    @pl.when(j == num_blocks_seq - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, 0], 1e-20)
+        o_ref[0] = (acc[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray,
+                           v_pages: jnp.ndarray, page_table: jnp.ndarray,
+                           kv_lens: jnp.ndarray,
+                           softmax_scale: Optional[float] = None
+                           ) -> jnp.ndarray:
+    """One-token-per-slot ragged paged attention.
+
+    q [B, Hq, D]; k_pages/v_pages [num_blocks, block_size, Hkv, D];
+    page_table [B, max_blocks_per_seq] int32 (entries beyond a slot's
+    allocation may be anything in range — they are masked, not read for
+    math); kv_lens [B] int32 valid kv positions per slot (>= 1).
+    Returns [B, Hq, D]."""
+    b, hq, d = q.shape
+    nb, bs, hkv, _ = k_pages.shape
+    mb = page_table.shape[1]
+    group = hq // hkv
+    if softmax_scale is None:
+        softmax_scale = 1.0 / (d ** 0.5)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=float(softmax_scale), block_size=bs,
+        num_blocks_seq=mb, hkv=hkv, group=group)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((1, hq, d), lambda b_, j, t, l: (b_, 0, 0)),
+            pl.BlockSpec((1, bs, hkv, d),
+                         lambda b_, j, t, l: (t[b_, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, hkv, d),
+                         lambda b_, j, t, l: (t[b_, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hq, d), lambda b_, j, t, l: (b_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hq, d), jnp.float32),
+            pltpu.VMEM((hq, 1), jnp.float32),
+            pltpu.VMEM((hq, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
+        interpret=_interpret(),
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_attention_reference(q: jnp.ndarray, k_pages: jnp.ndarray,
+                              v_pages: jnp.ndarray, page_table: jnp.ndarray,
+                              kv_lens: jnp.ndarray,
+                              softmax_scale: Optional[float] = None
+                              ) -> jnp.ndarray:
+    """Pure-jnp oracle with the same signature (gathers dense, masks)."""
+    b, hq, d = q.shape
+    nb, bs, hkv, _ = k_pages.shape
+    mb = page_table.shape[1]
+    group = hq // hkv
+    if softmax_scale is None:
+        softmax_scale = 1.0 / (d ** 0.5)
+    k = k_pages[page_table].reshape(b, mb * bs, hkv, d)
+    v = v_pages[page_table].reshape(b, mb * bs, hkv, d)
+    k = jnp.repeat(k, group, axis=2)
+    v = jnp.repeat(v, group, axis=2)
+    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * softmax_scale
+    pos = jnp.arange(mb * bs)
+    s = jnp.where(pos[None, None, :] < kv_lens[:, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Page write / gather helpers (jit-able; `mode="drop"` keeps every invalid
+# position out of the pool instead of clamping onto live blocks)
+# ---------------------------------------------------------------------------
+
+
+def write_prompt_pages(pages: jnp.ndarray, rows: jnp.ndarray,
+                       table_row: jnp.ndarray, start, count) -> jnp.ndarray:
+    """Scatter a prefill's new KV rows into the block pool.
+
+    pages [L, num_blocks, block_size, ...]; rows [L, S_step, ...] where
+    row i holds absolute sequence position start + i; table_row
+    [max_blocks_per_seq]; count = number of valid rows (the rest are
+    bucket padding and are dropped)."""
+    nb, bs = pages.shape[1], pages.shape[2]
+    s_step = rows.shape[1]
+    pos = start + jnp.arange(s_step)
+    blocks = jnp.take(table_row, pos // bs, mode="clip")
+    blocks = jnp.where(jnp.arange(s_step) < count, blocks, nb)
+    return pages.at[:, blocks, pos % bs].set(rows, mode="drop")
+
+
+def append_token_pages(pages: jnp.ndarray, vals: jnp.ndarray,
+                       page_table: jnp.ndarray, positions: jnp.ndarray,
+                       active: jnp.ndarray) -> jnp.ndarray:
+    """Write one decode token per slot at its own (block, offset).
+
+    pages [num_blocks, block_size, ...]; vals [B, ...]; positions [B]
+    (append position per slot); active [B] bool — inactive slots' page
+    tables may reference freed blocks, so their writes are dropped, not
+    clamped (the dense engine could write inactive rows harmlessly; a
+    shared pool cannot)."""
+    nb, bs = pages.shape[0], pages.shape[1]
+    b = vals.shape[0]
+    blocks = jnp.take_along_axis(page_table, (positions // bs)[:, None],
+                                 axis=1)[:, 0]
+    blocks = jnp.where(active, blocks, nb)
+    return pages.at[blocks, positions % bs].set(vals, mode="drop")
+
+
+def gather_prefix_pages(pages: jnp.ndarray, table_row: jnp.ndarray,
+                        num_blocks: int) -> jnp.ndarray:
+    """Gather the first `num_blocks` (static) blocks of one slot into a
+    contiguous run: pages [L, NB, bs, ...] → [L, num_blocks*bs, ...]
+    (prefix-cache hits re-enter the dense bucketed prefill this way)."""
+    sel = jnp.take(pages, table_row[:num_blocks], axis=1, mode="clip")
+    return sel.reshape((pages.shape[0], num_blocks * pages.shape[2])
+                       + pages.shape[3:])
+
+
+def gather_pages_batched(pages: jnp.ndarray, page_table: jnp.ndarray
+                         ) -> jnp.ndarray:
+    """pages [NB, bs, ...] + table [B, MB] → [B, MB*bs, ...] (block order
+    is sequence order; rows past a slot's length are garbage and must be
+    masked by the caller). Used by the MLA paged decode, whose latent →
+    kv_up reconstitution needs the contiguous latent run."""
+    b, mb = page_table.shape
+    bs = pages.shape[1]
+    out = jnp.take(pages, page_table.reshape(-1), axis=0, mode="clip")
+    return out.reshape((b, mb * bs) + pages.shape[2:])

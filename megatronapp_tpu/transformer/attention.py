@@ -71,8 +71,18 @@ def attention_forward(
     kv_cache=None, cache_index=None, cache_positions=None,
     layer_id=None, ctx=None, zigzag: bool = False,
     segment_ids: Optional[jnp.ndarray] = None,
+    page_table: Optional[jnp.ndarray] = None,
+    active: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """x: [B, S, H] → [B, S, H]. Returns (out, new_kv_cache).
+
+    page_table: [B, max_blocks_per_seq] int32 — marks kv_cache as PAGED
+    block-pool storage [num_blocks, block_size, Hkv, D]
+    (inference/paged_cache.py): each row appends its token at its own
+    (block, offset) and attends through the ragged paged-attention
+    kernel, which masks by per-row kv length (no caller mask needed).
+    active: [B] bool — inactive rows' writes are dropped (their page
+    tables may reference blocks re-allocated to other requests).
 
     zigzag: the CALLER laid the sequence out in zigzag cp order (model-side
     permutation, models/gpt.py) — required before the zigzag ring kernel may
@@ -137,10 +147,27 @@ def attention_forward(
         k = rotary.apply_rope(k, rope_cos, rope_sin)
 
     new_cache = None
+    paged_out = None
     mask_type = cfg.attn_mask_type
     if kv_cache is not None:
         ck, cv = kv_cache
-        if cache_positions is not None:
+        if page_table is not None:
+            # Paged continuous-batching decode: kv_cache is the shared
+            # block pool; cache_positions[b] is row b's append position.
+            from megatronapp_tpu.ops.pallas.paged_attention import (
+                append_token_pages, paged_attention_decode,
+            )
+            if active is None:
+                active = jnp.ones((b,), bool)
+            ck = append_token_pages(ck, k[:, 0], page_table,
+                                    cache_positions, active)
+            cv = append_token_pages(cv, v[:, 0], page_table,
+                                    cache_positions, active)
+            new_cache = (ck, cv)
+            paged_out = paged_attention_decode(
+                q[:, 0], ck, cv, page_table,
+                cache_positions + 1)[:, None]          # [B, 1, Hq, D]
+        elif cache_positions is not None:
             # Continuous-batching decode (dynamic_context.py analogue):
             # each row appends at ITS OWN position; causality MUST come
             # from the caller's per-row attention_mask — fail fast if it
@@ -169,7 +196,9 @@ def attention_forward(
     # multiplies it back inside the fused softmax). We always softmax in
     # fp32, so no scaling is needed — the flag is accepted for config parity
     # and intentionally has no effect on the math.
-    if ctx is not None and ctx.cp > 1 and kv_cache is None:
+    if paged_out is not None:
+        attn_out = paged_out
+    elif ctx is not None and ctx.cp > 1 and kv_cache is None:
         # Context-parallel attention over the cp axis (seq sharded).
         from megatronapp_tpu.ops.context_parallel import (
             context_attention, zigzag_active,

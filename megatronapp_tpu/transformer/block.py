@@ -69,8 +69,13 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                   rope_cos=None, rope_sin=None, attention_mask=None,
                   layer_id=None, kv_cache=None, cache_index=None,
                   cache_positions=None, ctx=None,
-                  zigzag: bool = False, segment_ids=None):
-    """One transformer layer. x: [B,S,H] → ((out, new_cache), aux_losses)."""
+                  zigzag: bool = False, segment_ids=None,
+                  page_table=None, active=None):
+    """One transformer layer. x: [B,S,H] → ((out, new_cache), aux_losses).
+
+    page_table/active: paged-KV decode (inference/paged_cache.py) —
+    kv_cache is then the per-layer block pool and each batch row appends
+    at its own page-table position (see attention.py / mla.py)."""
     residual = x
     h = apply_norm(cfg.normalization, x, p["ln1_scale"], p.get("ln1_bias"),
                    cfg.layernorm_epsilon)
@@ -87,7 +92,8 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
             attn_out, new_cache = mla_forward(
                 p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
                 layer_id=layer_id, ctx=ctx, kv_cache=kv_cache,
-                cache_index=cache_index, cache_positions=cache_positions)
+                cache_index=cache_index, cache_positions=cache_positions,
+                page_table=page_table, active=active)
         else:
             attn_out = mla_forward(
                 p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
@@ -98,7 +104,8 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
             p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
             kv_cache=kv_cache, cache_index=cache_index,
             cache_positions=cache_positions, layer_id=layer_id,
-            ctx=ctx, zigzag=zigzag, segment_ids=segment_ids)
+            ctx=ctx, zigzag=zigzag, segment_ids=segment_ids,
+            page_table=page_table, active=active)
     # Tag for the 'selective_attn' remat policy (a no-op otherwise).
     attn_out = checkpoint_name(attn_out, "attn_out")
     x = residual + attn_out.astype(residual.dtype)

@@ -71,7 +71,7 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                 rope_cos=None, rope_sin=None,
                 attention_mask: Optional[jnp.ndarray] = None,
                 layer_id=None, ctx=None, kv_cache=None, cache_index=None,
-                cache_positions=None):
+                cache_positions=None, page_table=None, active=None):
     """kv_cache: optional (latent_cache [B, Smax, kv_lora_rank],
     kpe_cache [B, Smax, dpe]) — the COMPRESSED decode cache (the latent +
     shared roped key; reference MLA's defining cache shape). Returns
@@ -126,7 +126,33 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                 "MLA decode with a KV cache under context parallelism is "
                 "not supported (each shard would attend only local KV)")
         c_lat, c_pe = kv_cache
-        if cache_positions is not None:
+        if page_table is not None:
+            # Paged continuous-batching decode: kv_cache is the shared
+            # latent/k_pe block pool ([num_blocks, block_size, klat/dpe],
+            # inference/paged_cache.py). Each row appends at its own
+            # (block, offset); the latent run is then GATHERED back to a
+            # contiguous [B, max_blocks*bs, .] layout because the kv_up
+            # reconstitution below needs dense rows — rows past a slot's
+            # length are garbage, so the caller's per-row mask over the
+            # gathered run is mandatory.
+            from megatronapp_tpu.ops.pallas.paged_attention import (
+                append_token_pages,
+            )
+            if attention_mask is None:
+                raise ValueError(
+                    "paged MLA decode requires an explicit per-row "
+                    "attention_mask over the gathered page run; see "
+                    "inference/dynamic_engine.py's paged decode")
+            if active is None:
+                active = jnp.ones((b,), bool)
+            c_lat = append_token_pages(
+                c_lat, latent[:, 0].astype(c_lat.dtype), page_table,
+                cache_positions, active)
+            c_pe = append_token_pages(
+                c_pe, k_pe[:, 0].astype(c_pe.dtype), page_table,
+                cache_positions, active)
+            mask_type = AttnMaskType.bidirectional
+        elif cache_positions is not None:
             # Continuous-batching decode: per-row append positions.
             # Causality MUST come from the caller's per-row mask — the
             # scalar-offset causal mask cannot express per-row history
@@ -151,7 +177,14 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                 c_pe, k_pe.astype(c_pe.dtype), cache_index, axis=1)
             q_offset = cache_index
         new_cache = (c_lat, c_pe)
-        latent, k_pe = c_lat.astype(dt), c_pe.astype(dt)
+        if page_table is not None:
+            from megatronapp_tpu.ops.pallas.paged_attention import (
+                gather_pages_batched,
+            )
+            latent = gather_pages_batched(c_lat, page_table).astype(dt)
+            k_pe = gather_pages_batched(c_pe, page_table).astype(dt)
+        else:
+            latent, k_pe = c_lat.astype(dt), c_pe.astype(dt)
         s_kv = latent.shape[1]
 
     kv_up = (latent @ p["kv_up"].astype(dt)).reshape(b, s_kv, nq, dqk + dv)

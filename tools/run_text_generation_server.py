@@ -40,11 +40,12 @@ def main():
     ap.add_argument("--port", type=int, default=5000)
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--max-seq-len", type=int, default=None)
-    ap.add_argument("--engine", choices=["static", "dynamic", "mamba"],
-                    default="static",
-                    help="mamba = recurrent-state decode for pure-M "
-                         "presets (reference mamba server tool)")
-    ap.add_argument("--max-batch", type=int, default=4)
+    # Serving flags shared with the main parser (config/arguments.py
+    # add_serving_args — single source of truth): --engine, --max-batch,
+    # --paged-kv-cache, --kv-block-size, --num-kv-blocks,
+    # --no-prefix-caching.
+    from megatronapp_tpu.config.arguments import add_serving_args
+    add_serving_args(ap)
     args = ap.parse_args()
 
     cfg = PRESETS[args.preset]()
@@ -87,7 +88,11 @@ def main():
     if getattr(args, "engine", "static") == "dynamic":
         engine = DynamicInferenceEngine(
             params, cfg, tokenizer=tok, max_batch=args.max_batch,
-            max_seq_len=args.max_seq_len)
+            max_seq_len=args.max_seq_len, paged=args.paged_kv_cache,
+            block_size=args.kv_block_size, num_blocks=args.num_kv_blocks,
+            enable_prefix_caching=args.prefix_caching)
+        print(f"serving continuous batching on {args.host}:{args.port} "
+              f"(paged={args.paged_kv_cache})")
         TextGenerationServer(engine, args.host, args.port).run()
         return
     engine = StaticInferenceEngine(params, cfg, tokenizer=tok,
