@@ -123,9 +123,19 @@ def _paged_kv_hook():
     return r if r.get("decode") else None
 
 
+def _spec_decode_hook():
+    """Speculative-vs-plain serving A/B (tools/spec_decode_benchmark.py)
+    on the CPU backend — acceptance rate and tokens/step per proposer
+    tracked round over round like the other hooks."""
+    if os.environ.get("BENCH_SPEC_DECODE", "1") != "1":
+        return None
+    r = _run_child("--spec-decode", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("ngram") else None
+
+
 def _attach_overlap_hooks(res):
-    """Attach the tp-overlap, cp/a2a, and paged-kv A/B results to a
-    round record."""
+    """Attach the tp-overlap, cp/a2a, paged-kv, and spec-decode A/B
+    results to a round record."""
     tpo = _tp_overlap_hook()
     if tpo:
         res.setdefault("extra", {})["tp_overlap"] = tpo
@@ -135,6 +145,9 @@ def _attach_overlap_hooks(res):
     pkv = _paged_kv_hook()
     if pkv:
         res.setdefault("extra", {})["paged_kv"] = pkv
+    spd = _spec_decode_hook()
+    if spd:
+        res.setdefault("extra", {})["spec_decode"] = spd
     return res
 
 
@@ -203,6 +216,7 @@ def parent_main(local_only: bool = False):
     tpo = _tp_overlap_hook()
     cpa = _cp_a2a_hook()
     pkv = _paged_kv_hook()
+    spd = _spec_decode_hook()
     last = _load_last_good()
     if last is not None:
         # Top-level `stale` so the consumer can verifiably distinguish this
@@ -225,6 +239,8 @@ def parent_main(local_only: bool = False):
             last["extra"]["cp_a2a"] = cpa
         if pkv:
             last["extra"]["paged_kv"] = pkv
+        if spd:
+            last["extra"]["spec_decode"] = spd
         print(json.dumps(last))
         return
     if cpu:
@@ -237,6 +253,8 @@ def parent_main(local_only: bool = False):
             cpu.setdefault("extra", {})["cp_a2a"] = cpa
         if pkv:
             cpu.setdefault("extra", {})["paged_kv"] = pkv
+        if spd:
+            cpu.setdefault("extra", {})["spec_decode"] = spd
         print(json.dumps(cpu))
         return
     print(json.dumps({
@@ -341,6 +359,13 @@ def paged_kv_main():
     from tools.paged_kv_benchmark import run
     print(json.dumps(run(max_batch=4, block_size=8, max_new=6,
                          n_requests=6, prefix_len=48)))
+
+
+def spec_decode_main():
+    """speculative-vs-plain serving A/B child (CPU env set by parent)."""
+    from tools.spec_decode_benchmark import run
+    print(json.dumps(run(n_requests=4, motif_len=12, repeats=4,
+                         max_new=24, spec_k=4)))
 
 
 def probe_main():
@@ -467,5 +492,7 @@ if __name__ == "__main__":
         cp_a2a_main()
     elif "--paged-kv" in sys.argv:
         paged_kv_main()
+    elif "--spec-decode" in sys.argv:
+        spec_decode_main()
     else:
         parent_main(local_only="--local" in sys.argv)

@@ -53,6 +53,27 @@ def add_serving_args(ap: argparse.ArgumentParser):
     g.add_argument("--no-prefix-caching", action="store_false",
                    dest="prefix_caching",
                    help="disable refcounted shared-prefix block reuse")
+    g.add_argument("--spec-method", default="none",
+                   choices=["none", "draft", "mtp", "ngram"],
+                   help="speculative decoding over the paged engine "
+                        "(inference/speculative.py; needs --engine "
+                        "dynamic --paged-kv-cache): draft = small draft "
+                        "model (--draft-model), mtp = self-draft through "
+                        "the model's MTP heads, ngram = model-free "
+                        "prompt lookup. Greedy output is bit-identical "
+                        "to plain decode; sampling preserves the target "
+                        "distribution exactly")
+    g.add_argument("--spec-k", type=int, default=4,
+                   help="max draft tokens verified per round (the "
+                        "verify step runs K+1 ragged queries through "
+                        "the multi-query paged-attention kernel)")
+    g.add_argument("--draft-model", default=None,
+                   help="models/presets.py preset for --spec-method "
+                        "draft (must share the target vocab/tokenizer)")
+    g.add_argument("--draft-load-dir", default=None,
+                   help="checkpoint dir for the draft model (otherwise "
+                        "randomly initialized — only useful for "
+                        "plumbing tests)")
     return g
 
 

@@ -28,6 +28,18 @@ TPU-first: all shapes static; the decode step is ONE jit for all slots
 length-bucketed jits, and sampling is ONE batched on-device jit per step
 (per-request streams stay reproducible via fold_in key chains —
 PRNGKey(seed) ∘ request_id ∘ step — independent of batch composition).
+
+Speculative decoding (ISSUE 4, inference/speculative.py): with
+``spec_method`` set ("draft"/"mtp"/"ngram") on a paged engine, every
+decode round proposes up to spec_k draft tokens per request, verifies
+them in ONE batched multi-query forward (`_paged_multiquery_step`, the
+unified prefill/decode primitive of arXiv 2604.15464), and exact
+rejection sampling keeps greedy streams bit-identical to plain decode
+and sampled streams distributed exactly like the target model. Rejected
+tokens' KV is rolled back (PagedKVCache.rewind). The same multi-query
+step prefills the uncached prompt tail in fixed-size chunks, so prefill
+traces once per chunk shape instead of once per (bucket, cached-length)
+pair.
 """
 
 from __future__ import annotations
@@ -67,6 +79,9 @@ class Request:
     slot: int = -1
     generated: list = dataclasses.field(default_factory=list)
     finished: bool = False
+    # Speculative-decoding stats (spec_method engines):
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     @property
     def tokens(self) -> np.ndarray:
@@ -160,6 +175,57 @@ def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
     return logits, new_pages
 
 
+def _paged_multiquery_step(params, tokens, pages, page_table, starts,
+                           q_lens, active, cfg: TransformerConfig,
+                           max_seq_len: int):
+    """Ragged multi-token step against the paged pool — the UNIFIED
+    prefill/decode primitive (speculative verify + chunked prefill).
+
+    tokens [B, S]; starts [B] per-row append positions; q_lens [B] valid
+    token counts in [1, S] (rows past a row's count are padding whose
+    outputs are garbage); active [B] bool. Row b's token i lands at
+    position starts[b] + i and attends the paged context plus the new
+    tail causally. Returns (logits [B, S, V], hidden [B, S, H] pre-head,
+    new pages) — hidden feeds the MTP self-draft proposer."""
+    b, s = tokens.shape
+    positions = starts[:, None] + jnp.arange(s)[None, :]       # [B, S]
+    positions = jnp.minimum(positions, max_seq_len - 1)
+    h = gpt_embed(params, tokens, cfg, position_ids=positions)
+    cos_full, sin_full = gpt_rope_tables(cfg, max_seq_len)
+    if cos_full is not None:
+        cos = jnp.take(cos_full, positions, axis=0)            # [B,S,half]
+        sin = jnp.take(sin_full, positions, axis=0)
+    else:
+        cos = sin = None
+
+    if cfg.multi_latent_attention:
+        # MLA gathers the latent run dense (mla.py paged path): build the
+        # per-(query, kv) causal mask over the gathered [MB*bs] layout.
+        mb, bs = page_table.shape[1], pages[0].shape[2]
+        kv_pos = jnp.arange(mb * bs)
+        attend = kv_pos[None, None, :] <= positions[:, :, None]
+        mask = attend[:, None]                                 # [B,1,S,K]
+    else:
+        mask = None          # the multi-query ragged kernel masks itself
+
+    pa, pb = pages
+
+    def body(carry, layer_in):
+        hh = carry
+        layer_p, a_l, b_l, lid = layer_in
+        (hh, new_cache), _ = layer_forward(
+            layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
+            kv_cache=(a_l, b_l), cache_index=None,
+            cache_positions=starts, page_table=page_table, active=active,
+            chunk_counts=q_lens)
+        return hh, new_cache
+
+    h, new_pages = jax.lax.scan(
+        body, h, (params["block"], pa, pb, jnp.arange(cfg.num_layers)))
+    logits = gpt_head(params, h, cfg)
+    return logits, h, new_pages
+
+
 def _request_keys(seeds, rids, steps):
     """Per-row PRNG keys: PRNGKey(seed) ∘ fold_in(request_id) ∘
     fold_in(step). The previous additive scheme
@@ -171,13 +237,13 @@ def _request_keys(seeds, rids, steps):
     return jax.vmap(one)(seeds, rids, steps)
 
 
-def _sample_batched(logits, seeds, rids, steps, temps, top_ks, top_ps,
-                    greedys):
-    """Batched on-device sampling, one jit for all slots (replaces the
-    per-request device_get loop). Per-row params; rows mirror
-    engine.sample_logits semantics exactly: temperature → top-k →
-    top-p → categorical, greedy bypasses all. logits [B,V] → [B]."""
-    keys = _request_keys(seeds, rids, steps)
+def _warp_logits(logits, temps, top_ks, top_ps):
+    """Per-row temperature → top-k → top-p filtering ([N, V] → [N, V],
+    filtered entries at -1e30). Single source of truth for the sampling
+    semantics: `_sample_batched` (plain decode) and the speculative
+    rejection-sampling verifier (inference/speculative.py) both warp
+    through here, so speculation preserves the target distribution wrt
+    the EXACT sampler plain decode uses."""
     v = logits.shape[-1]
     x = logits / jnp.maximum(temps[:, None], 1e-6)
     sorted_desc = jnp.sort(x, axis=-1)[:, ::-1]
@@ -189,7 +255,17 @@ def _sample_batched(logits, seeds, rids, steps, temps, top_ks, top_ps,
     cum = jnp.cumsum(probs, axis=-1)
     cutoff_idx = jnp.sum(cum < top_ps[:, None], axis=-1)
     cutoff = jnp.take_along_axis(sorted2, cutoff_idx[:, None], axis=-1)
-    x = jnp.where((top_ps[:, None] > 0.0) & (x < cutoff), -1e30, x)
+    return jnp.where((top_ps[:, None] > 0.0) & (x < cutoff), -1e30, x)
+
+
+def _sample_batched(logits, seeds, rids, steps, temps, top_ks, top_ps,
+                    greedys):
+    """Batched on-device sampling, one jit for all slots (replaces the
+    per-request device_get loop). Per-row params; rows mirror
+    engine.sample_logits semantics exactly: temperature → top-k →
+    top-p → categorical, greedy bypasses all. logits [B,V] → [B]."""
+    keys = _request_keys(seeds, rids, steps)
+    x = _warp_logits(logits, temps, top_ks, top_ps)
     sampled = jax.vmap(jax.random.categorical)(keys, x)
     return jnp.where(greedys, jnp.argmax(logits, axis=-1),
                      sampled).astype(jnp.int32)
@@ -206,6 +282,13 @@ class DynamicInferenceEngine:
     block_size/num_blocks size the pool (num_blocks defaults to dense
     capacity — pass less to run oversubscribed with preemption), and
     enable_prefix_caching turns shared-prefix block reuse on/off.
+
+    spec_method ("draft"/"mtp"/"ngram", paged only) turns on speculative
+    decoding with up to spec_k drafts per round (see module docstring);
+    "draft" additionally needs draft_params/draft_cfg (a small model
+    sharing the target vocab, e.g. from models/presets.py). When the
+    requested proposer is unavailable (no MTP heads, no draft model) the
+    engine warns and falls back to plain decode.
     """
 
     def __init__(self, params, cfg: TransformerConfig, tokenizer=None,
@@ -213,7 +296,10 @@ class DynamicInferenceEngine:
                  prefill_buckets: Tuple[int, ...] = (32, 128, 512),
                  paged: bool = False, block_size: int = 16,
                  num_blocks: Optional[int] = None,
-                 enable_prefix_caching: bool = True):
+                 enable_prefix_caching: bool = True,
+                 spec_method: Optional[str] = None, spec_k: int = 4,
+                 draft_params=None, draft_cfg=None,
+                 prefill_chunk: int = 32):
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -222,6 +308,7 @@ class DynamicInferenceEngine:
         self.prefill_buckets = tuple(
             b for b in sorted(prefill_buckets) if b <= self.max_seq_len
         ) or (self.max_seq_len,)
+        self.prefill_chunk = min(prefill_chunk, self.max_seq_len)
 
         self.paged = paged
         if paged:
@@ -240,6 +327,35 @@ class DynamicInferenceEngine:
         self.requests: Dict[int, Request] = {}
         self._aborted: List[Request] = []   # aborted mid-admission
         self._ids = itertools.count()
+
+        # Speculative decoding (inference/speculative.py).
+        self.spec_method: Optional[str] = None
+        self.spec_k = int(spec_k)
+        self.proposer = None
+        self.spec_stats = {"rounds": 0, "proposed": 0, "accepted": 0,
+                           "emitted_tokens": 0, "model_steps": 0}
+        # Pre-head hidden state at each slot's last verified position —
+        # feeds the MTP self-draft proposer.
+        self._h_last = np.zeros((max_batch, cfg.hidden_size), np.float32)
+        self._h_valid = np.zeros((max_batch,), bool)
+        if spec_method and spec_method != "none":
+            if not paged:
+                raise ValueError(
+                    "speculative decoding runs over the paged-KV engine "
+                    "(multi-token append + rollback need the block pool) "
+                    "— pass paged=True")
+            from megatronapp_tpu.inference.speculative import make_proposer
+            self.proposer = make_proposer(spec_method, self,
+                                          draft_params=draft_params,
+                                          draft_cfg=draft_cfg)
+            if self.proposer is not None:
+                self.spec_method = spec_method
+
+        # Trace counter for the unified multi-query step (chunked prefill
+        # + speculative verify): increments ONLY when jax re-traces, so
+        # tests can assert chunked prefill stops retracing per
+        # (bucket, cached-length) pair.
+        self.mq_traces = 0
         self._build_jits()
 
     def _build_jits(self):
@@ -256,12 +372,27 @@ class DynamicInferenceEngine:
                 lambda p, t, pages, tbl, l, a: _paged_decode_step(
                     p, t, pages, tbl, l, a, cfg, msl),
                 donate_argnums=(2,))
+
+            def _mq_traced(p, t, pages, tbl, starts, qlens, act):
+                # Python side-effect: runs only while TRACING.
+                self.mq_traces += 1
+                return _paged_multiquery_step(p, t, pages, tbl, starts,
+                                              qlens, act, cfg, msl)
+
+            self._mq_step = jax.jit(_mq_traced, donate_argnums=(2,))
             from megatronapp_tpu.ops.pallas.paged_attention import (
                 gather_prefix_pages, write_prompt_pages,
             )
             self._write_pages = jax.jit(write_prompt_pages)
             self._gather_prefix = jax.jit(gather_prefix_pages,
                                           static_argnums=(2,))
+            if self.spec_method:
+                from megatronapp_tpu.inference.speculative import (
+                    build_verify_sampler,
+                )
+                self._verify_sample = build_verify_sampler(
+                    point_mass=self.proposer.point_mass)
+                self.proposer.reset_compilation()
         else:
             self._decode = jax.jit(
                 lambda p, t, c, l, a: _decode_step(p, t, c, l, a, cfg))
@@ -350,9 +481,19 @@ class DynamicInferenceEngine:
                                       int(self.lengths[slot]))
                 except Exception:  # noqa: BLE001 — best-effort reclaim
                     pass
-            self.slots[slot] = None
-            self.lengths[slot] = 0
+            self._free_slot(slot)
             self.requests.pop(req.request_id, None)
+
+    def _free_slot(self, slot: int):
+        """Clear every per-slot engine resource (request ref, length,
+        proposer state, MTP hidden) — the ONE place to extend when a new
+        per-slot resource is added; pool blocks are released by the
+        caller (release semantics differ per path)."""
+        self.slots[slot] = None
+        self.lengths[slot] = 0
+        self._h_valid[slot] = False
+        if self.proposer is not None:
+            self.proposer.on_release(slot)
 
     @property
     def has_work(self) -> bool:
@@ -392,32 +533,77 @@ class DynamicInferenceEngine:
         # NEXT token, exactly like a fresh admission.
         tokens = req.tokens
         p_len = len(tokens)
-        bucket = next((b for b in self.prefill_buckets if b >= p_len),
-                      self.max_seq_len)
-        if bucket < p_len:
-            raise AssertionError(
-                f"no prefill bucket covers length {p_len} (buckets "
-                f"{self.prefill_buckets}, max_seq_len {self.max_seq_len})")
-        if self.paged:
-            logits_last = self._paged_prefill(req, tokens, p_len, bucket,
-                                              plan)
+        if self.paged and not self.cfg.multi_latent_attention:
+            # Chunked prefill through the unified multi-query step: ONE
+            # trace per chunk shape instead of one per
+            # (bucket, cached-length) pair, and prefix-cache hits are
+            # attended directly through the page table (no dense gather).
+            logits_last = self._paged_prefill_chunked(req, tokens, p_len,
+                                                      plan)
         else:
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :p_len] = tokens
-            tmp_cache = init_kv_cache(self.cfg, 1, bucket)
-            logits, tmp_cache = self._prefill(
-                self.params, jnp.asarray(padded), tmp_cache, 0)
-            # Scatter the kv rows into this slot of the shared cache.
-            slot = req.slot
-            self.cache = tuple(
-                c.at[:, slot, :bucket].set(t[:, 0]) for c, t in
-                zip(self.cache, tmp_cache))
-            logits_last = logits[0, p_len - 1]
+            bucket = next((b for b in self.prefill_buckets if b >= p_len),
+                          self.max_seq_len)
+            if bucket < p_len:
+                raise AssertionError(
+                    f"no prefill bucket covers length {p_len} (buckets "
+                    f"{self.prefill_buckets}, max_seq_len "
+                    f"{self.max_seq_len})")
+            if self.paged:
+                logits_last = self._paged_prefill(req, tokens, p_len,
+                                                  bucket, plan)
+            else:
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :p_len] = tokens
+                tmp_cache = init_kv_cache(self.cfg, 1, bucket)
+                logits, tmp_cache = self._prefill(
+                    self.params, jnp.asarray(padded), tmp_cache, 0)
+                # Scatter the kv rows into this slot of the shared cache.
+                slot = req.slot
+                self.cache = tuple(
+                    c.at[:, slot, :bucket].set(t[:, 0]) for c, t in
+                    zip(self.cache, tmp_cache))
+                logits_last = logits[0, p_len - 1]
         self.lengths[req.slot] = p_len
         # First generated token comes from the last PROMPT position.
         logits_last = mask_padded_vocab(logits_last, self.cfg)
         tok = self._sample(logits_last[None], req)
         self._record_token(req, int(tok[0]))
+        if self.proposer is not None:
+            self.proposer.on_admit(req.slot, req)
+
+    def _paged_prefill_chunked(self, req: Request, tokens, p_len: int,
+                               plan) -> jnp.ndarray:
+        """Prefill the uncached prompt tail in fixed-size chunks against
+        the page table (the ROADMAP chunked-prefill follow-up): each
+        chunk is one `_mq_step` call at shape [1, prefill_chunk], so the
+        compiler sees ONE program for every (prompt length, cached
+        length) combination. Returns the last prompt position's logits
+        [V] and records the pre-head hidden for the MTP proposer."""
+        assert plan is not None
+        slot = req.slot
+        pool = self.pool
+        cached = plan.cached_tokens
+        c = self.prefill_chunk
+        table_row = jnp.asarray(pool.page_table[slot][None])     # [1, MB]
+        pos, count = cached, 0
+        logits = hid = None
+        while pos < p_len:
+            count = min(c, p_len - pos)
+            chunk = np.zeros((1, c), np.int32)
+            chunk[0, :count] = tokens[pos:pos + count]
+            logits, hid, self.pool.pages = self._mq_step(
+                self.params, jnp.asarray(chunk), self.pool.pages,
+                table_row, jnp.asarray([pos], jnp.int32),
+                jnp.asarray([count], jnp.int32), jnp.ones((1,), bool))
+            pos += count
+        # Register the prompt's full blocks so concurrent same-prefix
+        # requests hit them immediately.
+        pool.register_prefix(slot, np.asarray(tokens), p_len)
+        if self.proposer is not None and self.proposer.needs_hidden:
+            self._h_last[slot] = np.asarray(
+                jax.device_get(hid[0, count - 1]), np.float32)
+            self._h_valid[slot] = True
+        return logits[0, count - 1]
 
     def _paged_prefill(self, req: Request, tokens, p_len: int, bucket: int,
                        plan) -> jnp.ndarray:
@@ -469,30 +655,39 @@ class DynamicInferenceEngine:
             jnp.asarray([s.greedy], bool))
         return jax.device_get(tok)
 
-    def _sample_all(self, logits) -> np.ndarray:
-        """Batched on-device sampling for every slot (inactive rows get
-        default params; their tokens are ignored). ONE device round-trip
-        per decode step instead of one per request."""
+    def _sampling_rows(self) -> Dict[str, np.ndarray]:
+        """Per-slot sampling parameters + key-chain inputs for every
+        non-finished slot (inactive rows keep neutral defaults; their
+        outputs are ignored). Single source for the plain sampler, the
+        speculative verifier, and the draft proposer — one place to
+        thread a future sampling field through."""
         b = self.max_batch
-        seeds = np.zeros(b, np.int32)
-        rids = np.zeros(b, np.int32)
-        steps = np.zeros(b, np.int32)
-        temps = np.ones(b, np.float32)
-        top_ks = np.zeros(b, np.int32)
-        top_ps = np.zeros(b, np.float32)
-        greedys = np.zeros(b, bool)
+        rows = {"seeds": np.zeros(b, np.int32),
+                "rids": np.zeros(b, np.int32),
+                "steps": np.zeros(b, np.int32),
+                "temps": np.ones(b, np.float32),
+                "top_ks": np.zeros(b, np.int32),
+                "top_ps": np.zeros(b, np.float32),
+                "greedys": np.zeros(b, bool)}
         for i, r in enumerate(self.slots):
             if r is None or r.finished:
                 continue
             s = r.sampling
-            seeds[i], rids[i], steps[i] = s.seed, r.request_id, \
-                len(r.generated)
-            temps[i], top_ks[i], top_ps[i], greedys[i] = (
-                s.temperature, s.top_k, s.top_p, s.greedy)
+            rows["seeds"][i], rows["rids"][i] = s.seed, r.request_id
+            rows["steps"][i] = len(r.generated)
+            rows["temps"][i], rows["top_ks"][i] = s.temperature, s.top_k
+            rows["top_ps"][i], rows["greedys"][i] = s.top_p, s.greedy
+        return rows
+
+    def _sample_all(self, logits) -> np.ndarray:
+        """Batched on-device sampling for every slot. ONE device
+        round-trip per decode step instead of one per request."""
+        r = self._sampling_rows()
         toks = self._sample_b(
-            logits, jnp.asarray(seeds), jnp.asarray(rids),
-            jnp.asarray(steps), jnp.asarray(temps), jnp.asarray(top_ks),
-            jnp.asarray(top_ps), jnp.asarray(greedys))
+            logits, jnp.asarray(r["seeds"]), jnp.asarray(r["rids"]),
+            jnp.asarray(r["steps"]), jnp.asarray(r["temps"]),
+            jnp.asarray(r["top_ks"]), jnp.asarray(r["top_ps"]),
+            jnp.asarray(r["greedys"]))
         return np.asarray(jax.device_get(toks))
 
     def _record_token(self, req: Request, tok: int):
@@ -510,8 +705,7 @@ class DynamicInferenceEngine:
         slot = req.slot
         self.pool.release(slot, np.asarray(req.tokens),
                           int(self.lengths[slot]), preempted=True)
-        self.slots[slot] = None
-        self.lengths[slot] = 0
+        self._free_slot(slot)
         req.slot = -1
         self.waiting.appendleft(req)
         out.append(req)
@@ -548,13 +742,13 @@ class DynamicInferenceEngine:
                     # only the written rows.
                     self.pool.release(slot, np.asarray(req.tokens),
                                       int(self.lengths[slot]))
-                self.slots[slot] = None
-                self.lengths[slot] = 0
+                self._free_slot(slot)
         return done
 
     # ---- main loop --------------------------------------------------------
     def step(self) -> Dict[str, List]:
-        """Admit → decode one token for all active slots → retire.
+        """Admit → decode (one token, or a speculate+verify round) for
+        all active slots → retire.
 
         Returns {"admitted": [ids], "tokens": [(id, tok)], "finished":
         [ids], "preempted": [ids]} for this step."""
@@ -571,33 +765,138 @@ class DynamicInferenceEngine:
         active = [r for r in self.slots
                   if r is not None and not r.finished]
         if active:
-            active_np = np.array(
-                [self.slots[i] is not None and not self.slots[i].finished
-                 for i in range(self.max_batch)])
-            active_mask = jnp.asarray(active_np)
-            lengths = jnp.asarray(self.lengths)
-            if self.paged:
-                logits, self.pool.pages = self._decode(
-                    self.params, jnp.asarray(self.last_tokens),
-                    self.pool.pages, jnp.asarray(self.pool.page_table),
-                    lengths, active_mask)
+            if self.spec_method:
+                self._spec_round(active, events)
             else:
-                logits, self.cache = self._decode(
-                    self.params, jnp.asarray(self.last_tokens), self.cache,
-                    lengths, active_mask)
-            # The decode wrote each active row's kv at lengths[slot].
-            self.lengths += active_np.astype(np.int32)
-            logits = mask_padded_vocab(logits, self.cfg)
-            toks = self._sample_all(logits)
-            for req in active:
-                tok = int(toks[req.slot])
-                self._record_token(req, tok)
-                events["tokens"].append((req.request_id, tok))
+                self._plain_round(active, events)
 
         events["finished"] = [r.request_id for r in self._retire()]
         events["finished"] += [r.request_id for r in self._aborted]
         self._aborted = []
         return events
+
+    def _plain_round(self, active: List[Request], events: Dict):
+        """One-token decode for every active slot (non-speculative)."""
+        active_np = np.array(
+            [self.slots[i] is not None and not self.slots[i].finished
+             for i in range(self.max_batch)])
+        active_mask = jnp.asarray(active_np)
+        lengths = jnp.asarray(self.lengths)
+        if self.paged:
+            logits, self.pool.pages = self._decode(
+                self.params, jnp.asarray(self.last_tokens),
+                self.pool.pages, jnp.asarray(self.pool.page_table),
+                lengths, active_mask)
+        else:
+            logits, self.cache = self._decode(
+                self.params, jnp.asarray(self.last_tokens), self.cache,
+                lengths, active_mask)
+        # The decode wrote each active row's kv at lengths[slot].
+        self.lengths += active_np.astype(np.int32)
+        logits = mask_padded_vocab(logits, self.cfg)
+        toks = self._sample_all(logits)
+        self.spec_stats["model_steps"] += 1
+        self.spec_stats["emitted_tokens"] += len(active)
+        for req in active:
+            tok = int(toks[req.slot])
+            self._record_token(req, tok)
+            events["tokens"].append((req.request_id, tok))
+
+    def _spec_round(self, active: List[Request], events: Dict):
+        """One speculate+verify round: propose up to spec_k drafts per
+        slot, verify all of them in ONE batched multi-query forward, and
+        accept by exact rejection sampling (greedy: bit-identical argmax
+        chain; sampled: target distribution preserved). Rejected tokens'
+        KV is rolled back via PagedKVCache.rewind."""
+        b, k = self.max_batch, self.spec_k
+
+        # Opportunistic capacity for the speculative tail: span-1 is
+        # already guaranteed by _ensure_decode_capacity; under pressure
+        # speculation SHRINKS instead of preempting.
+        k_caps = np.zeros((b,), np.int32)
+        for req in active:
+            slot = req.slot
+            length = int(self.lengths[slot])
+            want = min(k, req.max_new_tokens - len(req.generated) - 1,
+                       self.max_seq_len - 1 - length)
+            if want > 0:
+                k_caps[slot] = self.pool.extend_capacity(
+                    slot, length + 1, want)
+
+        drafts, counts, q_probs = self.proposer.propose(k_caps)
+        if not counts.any():
+            # Nothing proposed anywhere (e.g. n-gram on non-repetitive
+            # text): the (K+1)-wide verify would pay ~K+1× decode cost
+            # to emit one token per row — take the plain 1-token step
+            # instead (streams are identical by construction). Drop the
+            # over-granted spec blocks first, keeping the one covering
+            # this step's append position.
+            for req in active:
+                self.pool.rewind(req.slot,
+                                 int(self.lengths[req.slot]) + 1)
+            self._plain_round(active, events)
+            return
+
+        q_lens = np.ones((b,), np.int32)
+        tokens = np.zeros((b, k + 1), np.int32)
+        active_np = np.zeros((b,), bool)
+        for req in active:
+            slot = req.slot
+            active_np[slot] = True
+            tokens[slot, 0] = self.last_tokens[slot, 0]
+            n = int(counts[slot])
+            tokens[slot, 1:1 + n] = drafts[slot, :n]
+            q_lens[slot] = 1 + n
+        rows = self._sampling_rows()
+
+        logits, hidden, self.pool.pages = self._mq_step(
+            self.params, jnp.asarray(tokens), self.pool.pages,
+            jnp.asarray(self.pool.page_table), jnp.asarray(self.lengths),
+            jnp.asarray(q_lens), jnp.asarray(active_np))
+        logits = mask_padded_vocab(logits, self.cfg)
+        accepts, out_toks = self._verify_sample(
+            logits, jnp.asarray(drafts), jnp.asarray(q_lens), q_probs,
+            jnp.asarray(rows["seeds"]), jnp.asarray(rows["rids"]),
+            jnp.asarray(rows["steps"]), jnp.asarray(rows["temps"]),
+            jnp.asarray(rows["top_ks"]), jnp.asarray(rows["top_ps"]),
+            jnp.asarray(rows["greedys"]))
+        accepts = np.asarray(jax.device_get(accepts))
+        out_toks = np.asarray(jax.device_get(out_toks))
+        h_sel = None
+        if self.proposer.needs_hidden:
+            h_sel = np.asarray(jax.device_get(jnp.take_along_axis(
+                hidden, jnp.asarray(accepts)[:, None, None], axis=1)[:, 0]),
+                np.float32)
+
+        self.spec_stats["rounds"] += 1
+        self.spec_stats["model_steps"] += 1
+        for req in active:
+            slot = req.slot
+            n = int(counts[slot])
+            a = min(int(accepts[slot]), n)
+            emitted = [int(t) for t in drafts[slot, :a]]
+            emitted.append(int(out_toks[slot]))
+            len_before = int(self.lengths[slot])
+            m = 0
+            for tok in emitted:
+                self._record_token(req, tok)
+                events["tokens"].append((req.request_id, tok))
+                m += 1
+                if req.finished:
+                    break   # eod/budget: drop the rest of the window
+            # Valid KV = [last_token, accepted drafts] — rewind the
+            # written-but-rejected tail (and over-granted blocks).
+            self.lengths[slot] = len_before + m
+            self.pool.rewind(slot, len_before + m)
+            if h_sel is not None:
+                self._h_last[slot] = h_sel[slot]
+                self._h_valid[slot] = True
+            req.spec_proposed += n
+            req.spec_accepted += a
+            self.spec_stats["proposed"] += n
+            self.spec_stats["accepted"] += a
+            self.spec_stats["emitted_tokens"] += m
+            self.proposer.on_verified(slot, a)
 
     def run_to_completion(self,
                           token_callback: Optional[Callable] = None
@@ -617,6 +916,49 @@ class DynamicInferenceEngine:
             results[rid] = req.tokens
             self.requests.pop(rid, None)
         return results
+
+    # ---- observability ----------------------------------------------------
+    def stats_snapshot(self) -> Dict:
+        """JSON-ready serving stats (the server's GET /stats payload):
+        pool occupancy, prefix-cache hit rate, speculative acceptance,
+        active batch size — serving is observable without log scraping."""
+        out = {
+            "engine": "dynamic",
+            "paged": self.paged,
+            "max_batch": self.max_batch,
+            "active": sum(1 for r in self.slots if r is not None),
+            "waiting": len(self.waiting),
+            "multiquery_traces": self.mq_traces,
+        }
+        if self.paged:
+            pool = self.pool
+            st = dict(pool.stats)
+            seen = st["prefix_hit_tokens"] + st["prefill_tokens"]
+            out["pool"] = {
+                "num_blocks": pool.num_blocks,
+                "block_size": pool.block_size,
+                "blocks_in_use": pool.blocks_in_use(),
+                "blocks_free": pool.free_blocks(),
+                "blocks_evictable": pool.evictable_blocks(),
+                "prefix_hit_rate": (
+                    round(st["prefix_hit_tokens"] / seen, 4) if seen
+                    else 0.0),
+                **st,
+            }
+        if self.spec_method:
+            ss = dict(self.spec_stats)
+            out["speculative"] = {
+                "method": self.spec_method,
+                "k": self.spec_k,
+                "acceptance_rate": (
+                    round(ss["accepted"] / ss["proposed"], 4)
+                    if ss["proposed"] else 0.0),
+                "tokens_per_step": (
+                    round(ss["emitted_tokens"] / ss["model_steps"], 4)
+                    if ss["model_steps"] else 0.0),
+                **ss,
+            }
+        return out
 
     def generate_text(self, prompts, max_new_tokens: int,
                       sampling: Optional[SamplingParams] = None,

@@ -102,6 +102,12 @@ class PagedKVCache:
     def available_blocks(self) -> int:
         return len(self._free) + len(self._lru)
 
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def evictable_blocks(self) -> int:
+        return len(self._lru)
+
     def refcount(self, block: int) -> int:
         return int(self._refcount[block])
 
@@ -228,6 +234,73 @@ class PagedKVCache:
         owned.append(blk)
         self.page_table[slot, idx] = blk
         self._note_usage()
+        return True
+
+    def extend_capacity(self, slot: int, position: int, span: int) -> int:
+        """Best-effort growth for a multi-token (speculative) append:
+        allocate blocks so `slot` covers positions
+        [position, position + span), WITHOUT preempting anyone. Returns
+        the span actually covered (>= 0); the caller shrinks its
+        speculation to fit. Partially-granted blocks stay owned — a
+        later rewind() or release() returns them."""
+        granted = 0
+        for p in range(position, position + span):
+            if p >= self.max_seq_len:
+                break
+            if not self.ensure_capacity(slot, p):
+                break
+            granted += 1
+        return granted
+
+    def rewind(self, slot: int, valid_len: int):
+        """Roll back a slot to `valid_len` written positions: release the
+        tail blocks past ceil(valid_len / block_size) — the rejected-
+        speculation path (and the cleanup for over-granted
+        extend_capacity blocks). Only privately-owned tail blocks may be
+        dropped; a refcounted/hashed block here would mean speculation
+        wrote into a shared prefix block (never legal — CoW guarantees
+        the writable tail is private), so that asserts rather than
+        corrupting the prefix cache. Rewinding never splits a block:
+        KV rows past valid_len inside the kept tail block are simply
+        overwritten by the next append."""
+        keep = cdiv(max(valid_len, 1), self.block_size)
+        owned = self._slot_blocks[slot]
+        while len(owned) > keep:
+            blk = owned.pop()
+            assert self._refcount[blk] == 1 and blk not in self._hash_of, (
+                f"rewind would drop shared/hashed block {blk} "
+                f"(rc={int(self._refcount[blk])}) — speculative tail "
+                "blocks must be private")
+            self.page_table[slot, len(owned)] = 0
+            self._release_block(blk)
+
+    def audit(self):
+        """Consistency check (tests): every block is exactly one of
+        free / LRU-evictable / slot-referenced, and each block's
+        refcount equals the number of slot page-table references to it.
+        Raises AssertionError on double-free, leak, or refcount skew."""
+        nb = self.num_blocks
+        refs = np.zeros((nb,), np.int64)
+        for blocks in self._slot_blocks:
+            for blk in blocks:
+                refs[blk] += 1
+        assert np.array_equal(refs, self._refcount), (
+            f"refcount skew: table={self._refcount.tolist()} "
+            f"actual={refs.tolist()}")
+        free = set(self._free)
+        assert len(free) == len(self._free), (
+            "duplicate block on the free list (double-free)")
+        lru = set(self._lru)
+        held = {b for b in range(nb) if refs[b] > 0}
+        assert not (free & lru) and not (free & held) and not (lru & held), (
+            "block in two states: "
+            f"free∩lru={free & lru} free∩held={free & held} "
+            f"lru∩held={lru & held}")
+        assert len(free) + len(lru) + len(held) == nb, (
+            f"leaked blocks: free={len(free)} lru={len(lru)} "
+            f"held={len(held)} != {nb}")
+        for blk in lru:
+            assert blk in self._hash_of, f"unhashed block {blk} on LRU"
         return True
 
     def register_prefix(self, slot: int, tokens: np.ndarray, valid_len: int):

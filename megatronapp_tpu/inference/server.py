@@ -16,6 +16,11 @@ serialized path (their caches are per-generation).
 REST:  PUT /api  {"prompts": [...], "tokens_to_generate": N,
                   "temperature": f, "top_k": i, "top_p": f, "greedy": b}
        → {"text": [...], "segments": [...]}
+       GET /stats → serving observability without log scraping: engine
+       type, active batch size / waiting queue, paged-pool occupancy
+       (blocks in use / free / evictable, prefix-cache hit rate,
+       preemptions), and speculative-decoding acceptance rate +
+       tokens/step (DynamicInferenceEngine.stats_snapshot).
 WS:    /ws — client sends the same JSON; server streams
        {"type": "token", "step": i, "token": id, "text": str} per token
        then {"type": "done", "text": full}.
@@ -451,11 +456,31 @@ class TextGenerationServer:
         return ws
 
     # ------------------------------------------------------------------
+    def stats_snapshot(self) -> dict:
+        """Serving stats for GET /stats. Dynamic engines report their
+        full snapshot (pool / speculation / batch occupancy); static and
+        mamba engines report what exists for them."""
+        eng = self.engine
+        if hasattr(eng, "stats_snapshot"):
+            out = eng.stats_snapshot()
+        else:
+            out = {"engine": type(eng).__name__.replace(
+                "InferenceEngine", "").lower()}
+        if self._driver is not None:
+            out["driver_max_active"] = self._driver.max_active
+        return out
+
+    async def handle_stats(self, request):
+        from aiohttp import web
+        return web.json_response(self.stats_snapshot())
+
+    # ------------------------------------------------------------------
     def build_app(self):
         from aiohttp import web
         app = web.Application()
         app.router.add_put("/api", self.handle_api)
         app.router.add_post("/api", self.handle_api)
+        app.router.add_get("/stats", self.handle_stats)
         app.router.add_get("/ws", self.handle_ws)
         return app
 

@@ -153,6 +153,181 @@ def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray,
       q, k_pages, v_pages)
 
 
+# ---------------------------------------------------------------------------
+# Multi-query ragged kernel (speculative verify + chunked prefill)
+# ---------------------------------------------------------------------------
+
+
+def _multiquery_kernel(table_ref, lens_ref, qlens_ref, q_ref, k_ref, v_ref,
+                       o_ref, acc, m_scr, l_scr, *, scale, block_size,
+                       num_blocks_seq, hkv, group, s_q):
+    """Grid (B, max_blocks_per_seq): per-request ragged q_len ∈ [1, S_q]
+    queries against the page table — the multi-query generalization of
+    `_decode_kernel` (arXiv 2604.15464's unified prefill/decode
+    primitive). Local query i sits at absolute position
+    kv_len - q_len + i and attends kv positions <= that (causal within
+    the new tail, full attention to the context); padded query rows
+    (i >= q_len) compute garbage over the valid range and are discarded
+    by the caller. At q_len == 1 the math reduces to the decode kernel's
+    exact block/accumulator order."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    hq = hkv * group
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    kv_len = lens_ref[b]
+    q_len = qlens_ref[b]
+    q_start = kv_len - q_len          # absolute position of local query 0
+
+    @pl.when(j * block_size < kv_len)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale      # [S_q, Hq, D]
+        k = k_ref[0]                                  # [bs, Hkv, D]
+        v = v_ref[0]
+        d = q.shape[-1]
+        # [Hkv, S_q*group, D] with inner index i = s*group + g (so row
+        # i's query position is i // group after unfolding back through
+        # the [S_q, Hq] layout below).
+        q3 = jnp.transpose(q.reshape(s_q, hkv, group, d),
+                           (1, 0, 2, 3)).reshape(hkv, s_q * group, d)
+        k3 = jnp.swapaxes(k, 0, 1)                    # [Hkv, bs, D]
+        v3 = jnp.swapaxes(v, 0, 1)
+        s = jax.lax.dot_general(                      # [Hkv, S_q*g, bs]
+            q3.astype(k3.dtype), k3,
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1)[0]
+        row_q = jax.lax.broadcasted_iota(
+            jnp.int32, (s_q * group, 1), 0)[:, 0] // group
+        abs_q = q_start + row_q                        # [S_q*group]
+        valid = ((pos[None, :] <= abs_q[:, None])
+                 & (pos[None, :] < kv_len))            # [S_q*g, bs]
+        s = jnp.where(valid[None], s, _NEG_INF)
+        # [S_q*Hq, bs] with row = s*hq + h (h = kvh*group + g).
+        s2 = jnp.transpose(
+            s.reshape(hkv, s_q, group, block_size),
+            (1, 0, 2, 3)).reshape(s_q * hq, block_size)
+        valid2 = jnp.transpose(
+            jnp.broadcast_to(valid.reshape(1, s_q, group, block_size),
+                             (hkv, s_q, group, block_size)),
+            (1, 0, 2, 3)).reshape(s_q * hq, block_size)
+
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s2, axis=1))
+        m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+        p = jnp.exp(s2 - m_safe[:, None])
+        p = jnp.where(valid2, p, 0.0)
+        corr = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+        corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
+        l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=1)
+        p3 = jnp.transpose(
+            p.reshape(s_q, hkv, group, block_size),
+            (1, 0, 2, 3)).reshape(hkv, s_q * group, block_size)
+        pv = jax.lax.dot_general(                      # [Hkv, S_q*g, D]
+            p3.astype(v3.dtype), v3,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        pv2 = jnp.transpose(
+            pv.reshape(hkv, s_q, group, d),
+            (1, 0, 2, 3)).reshape(s_q * hq, d)
+        acc[:] = acc[:] * corr[:, None] + pv2
+        m_scr[:, 0] = m_new
+
+    @pl.when(j == num_blocks_seq - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, 0], 1e-20)
+        a = acc[:]
+        o_ref[0] = (a / l[:, None]).reshape(
+            s_q, hq, a.shape[-1]).astype(o_ref.dtype)
+
+
+def paged_attention_multiquery(q: jnp.ndarray, k_pages: jnp.ndarray,
+                               v_pages: jnp.ndarray,
+                               page_table: jnp.ndarray,
+                               kv_lens: jnp.ndarray, q_lens: jnp.ndarray,
+                               softmax_scale: Optional[float] = None
+                               ) -> jnp.ndarray:
+    """Ragged multi-query paged attention (speculative verify / chunked
+    prefill).
+
+    q [B, S_q, Hq, D] — per-request the first q_lens[b] rows are real
+    queries at absolute positions kv_lens[b]-q_lens[b] .. kv_lens[b]-1
+    (their K/V must already be written into the pages); the rest are
+    padding whose outputs are garbage and must be discarded. kv_lens [B]
+    counts ALL valid kv positions including the new tail (>= q_lens >=
+    1). Returns [B, S_q, Hq, D]."""
+    b, s_q, hq, d = q.shape
+    nb, bs, hkv, _ = k_pages.shape
+    mb = page_table.shape[1]
+    group = hq // hkv
+    if softmax_scale is None:
+        softmax_scale = 1.0 / (d ** 0.5)
+
+    kernel = functools.partial(
+        _multiquery_kernel, scale=float(softmax_scale), block_size=bs,
+        num_blocks_seq=mb, hkv=hkv, group=group, s_q=s_q)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((1, s_q, hq, d),
+                         lambda b_, j, t, l, ql: (b_, 0, 0, 0)),
+            pl.BlockSpec((1, bs, hkv, d),
+                         lambda b_, j, t, l, ql: (t[b_, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, hkv, d),
+                         lambda b_, j, t, l, ql: (t[b_, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, s_q, hq, d),
+                               lambda b_, j, t, l, ql: (b_, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((s_q * hq, d), jnp.float32),
+            pltpu.VMEM((s_q * hq, 1), jnp.float32),
+            pltpu.VMEM((s_q * hq, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, s_q, hq, d), q.dtype),
+        interpret=_interpret(),
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      q_lens.astype(jnp.int32), q, k_pages, v_pages)
+
+
+def paged_attention_multiquery_reference(
+        q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+        page_table: jnp.ndarray, kv_lens: jnp.ndarray, q_lens: jnp.ndarray,
+        softmax_scale: Optional[float] = None) -> jnp.ndarray:
+    """Pure-jnp oracle for the multi-query kernel (gathers dense,
+    masks per-(query, kv) causally)."""
+    b, s_q, hq, d = q.shape
+    nb, bs, hkv, _ = k_pages.shape
+    mb = page_table.shape[1]
+    group = hq // hkv
+    if softmax_scale is None:
+        softmax_scale = 1.0 / (d ** 0.5)
+    k = k_pages[page_table].reshape(b, mb * bs, hkv, d)
+    v = v_pages[page_table].reshape(b, mb * bs, hkv, d)
+    k = jnp.repeat(k, group, axis=2)
+    v = jnp.repeat(v, group, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * softmax_scale
+    pos = jnp.arange(mb * bs)
+    abs_q = (kv_lens - q_lens)[:, None] + jnp.arange(s_q)[None, :]  # [B,Sq]
+    mask = ((pos[None, None, :] <= abs_q[:, :, None])
+            & (pos[None, None, :] < kv_lens[:, None, None]))
+    s = jnp.where(mask[:, :, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def paged_attention_reference(q: jnp.ndarray, k_pages: jnp.ndarray,
                               v_pages: jnp.ndarray, page_table: jnp.ndarray,
                               kv_lens: jnp.ndarray,
@@ -216,6 +391,31 @@ def append_token_pages(pages: jnp.ndarray, vals: jnp.ndarray,
                                  axis=1)[:, 0]
     blocks = jnp.where(active, blocks, nb)
     return pages.at[blocks, positions % bs].set(vals, mode="drop")
+
+
+def append_chunk_pages(pages: jnp.ndarray, vals: jnp.ndarray,
+                       page_table: jnp.ndarray, starts: jnp.ndarray,
+                       counts: jnp.ndarray, active: jnp.ndarray
+                       ) -> jnp.ndarray:
+    """Write a ragged multi-token run per slot (speculative verify /
+    chunked prefill): row b's token i lands at absolute position
+    starts[b] + i for i < counts[b]; padding rows and inactive slots are
+    dropped, never clamped onto live blocks.
+
+    pages [num_blocks, block_size, ...]; vals [B, S, ...]; starts/counts
+    [B] int32; active [B] bool. counts[b] == 1 reduces to
+    append_token_pages."""
+    nb, bs = pages.shape[0], pages.shape[1]
+    b, s = vals.shape[0], vals.shape[1]
+    mb = page_table.shape[1]
+    pos = starts[:, None] + jnp.arange(s)[None, :]           # [B, S]
+    blocks = jnp.take_along_axis(
+        page_table, jnp.clip(pos // bs, 0, mb - 1), axis=1)  # [B, S]
+    valid = (jnp.arange(s)[None, :] < counts[:, None]) & active[:, None]
+    blocks = jnp.where(valid, blocks, nb)
+    flat = lambda x: x.reshape((b * s,) + x.shape[2:])  # noqa: E731
+    return pages.at[flat(blocks), flat(pos % bs)].set(flat(vals),
+                                                      mode="drop")
 
 
 def gather_prefix_pages(pages: jnp.ndarray, table_row: jnp.ndarray,

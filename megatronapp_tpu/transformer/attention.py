@@ -73,6 +73,7 @@ def attention_forward(
     segment_ids: Optional[jnp.ndarray] = None,
     page_table: Optional[jnp.ndarray] = None,
     active: Optional[jnp.ndarray] = None,
+    chunk_counts: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """x: [B, S, H] → [B, S, H]. Returns (out, new_kv_cache).
 
@@ -83,6 +84,12 @@ def attention_forward(
     kernel, which masks by per-row kv length (no caller mask needed).
     active: [B] bool — inactive rows' writes are dropped (their page
     tables may reference blocks re-allocated to other requests).
+    chunk_counts: [B] int32 — multi-token paged append (speculative
+    verify / chunked prefill): row b's first chunk_counts[b] positions
+    are real tokens starting at cache_positions[b]; attention runs
+    through the multi-query ragged kernel (causal within the new tail,
+    full attention to the paged context). Rows past a row's count are
+    padding whose outputs are garbage (callers discard them).
 
     zigzag: the CALLER laid the sequence out in zigzag cp order (model-side
     permutation, models/gpt.py) — required before the zigzag ring kernel may
@@ -151,7 +158,25 @@ def attention_forward(
     mask_type = cfg.attn_mask_type
     if kv_cache is not None:
         ck, cv = kv_cache
-        if page_table is not None:
+        if page_table is not None and (s > 1 or chunk_counts is not None):
+            # Multi-token paged append (speculative verify / chunked
+            # prefill): write the ragged chunk then attend through the
+            # multi-query kernel.
+            from megatronapp_tpu.ops.pallas.paged_attention import (
+                append_chunk_pages, paged_attention_multiquery,
+            )
+            if active is None:
+                active = jnp.ones((b,), bool)
+            counts = (chunk_counts if chunk_counts is not None
+                      else jnp.full((b,), s, jnp.int32))
+            ck = append_chunk_pages(ck, k, page_table, cache_positions,
+                                    counts, active)
+            cv = append_chunk_pages(cv, v, page_table, cache_positions,
+                                    counts, active)
+            new_cache = (ck, cv)
+            paged_out = paged_attention_multiquery(
+                q, ck, cv, page_table, cache_positions + counts, counts)
+        elif page_table is not None:
             # Paged continuous-batching decode: kv_cache is the shared
             # block pool; cache_positions[b] is row b's append position.
             from megatronapp_tpu.ops.pallas.paged_attention import (

@@ -70,7 +70,7 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                   layer_id=None, kv_cache=None, cache_index=None,
                   cache_positions=None, ctx=None,
                   zigzag: bool = False, segment_ids=None,
-                  page_table=None, active=None):
+                  page_table=None, active=None, chunk_counts=None):
     """One transformer layer. x: [B,S,H] → ((out, new_cache), aux_losses).
 
     page_table/active: paged-KV decode (inference/paged_cache.py) —
@@ -93,7 +93,8 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                 p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
                 layer_id=layer_id, ctx=ctx, kv_cache=kv_cache,
                 cache_index=cache_index, cache_positions=cache_positions,
-                page_table=page_table, active=active)
+                page_table=page_table, active=active,
+                chunk_counts=chunk_counts)
         else:
             attn_out = mla_forward(
                 p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
@@ -105,7 +106,8 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
             kv_cache=kv_cache, cache_index=cache_index,
             cache_positions=cache_positions, layer_id=layer_id,
             ctx=ctx, zigzag=zigzag, segment_ids=segment_ids,
-            page_table=page_table, active=active)
+            page_table=page_table, active=active,
+            chunk_counts=chunk_counts)
     # Tag for the 'selective_attn' remat policy (a no-op otherwise).
     attn_out = checkpoint_name(attn_out, "attn_out")
     x = residual + attn_out.astype(residual.dtype)

@@ -71,7 +71,8 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                 rope_cos=None, rope_sin=None,
                 attention_mask: Optional[jnp.ndarray] = None,
                 layer_id=None, ctx=None, kv_cache=None, cache_index=None,
-                cache_positions=None, page_table=None, active=None):
+                cache_positions=None, page_table=None, active=None,
+                chunk_counts=None):
     """kv_cache: optional (latent_cache [B, Smax, kv_lora_rank],
     kpe_cache [B, Smax, dpe]) — the COMPRESSED decode cache (the latent +
     shared roped key; reference MLA's defining cache shape). Returns
@@ -136,7 +137,7 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
             # length are garbage, so the caller's per-row mask over the
             # gathered run is mandatory.
             from megatronapp_tpu.ops.pallas.paged_attention import (
-                append_token_pages,
+                append_chunk_pages, append_token_pages,
             )
             if attention_mask is None:
                 raise ValueError(
@@ -145,12 +146,26 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                     "inference/dynamic_engine.py's paged decode")
             if active is None:
                 active = jnp.ones((b,), bool)
-            c_lat = append_token_pages(
-                c_lat, latent[:, 0].astype(c_lat.dtype), page_table,
-                cache_positions, active)
-            c_pe = append_token_pages(
-                c_pe, k_pe[:, 0].astype(c_pe.dtype), page_table,
-                cache_positions, active)
+            if s > 1 or chunk_counts is not None:
+                # Multi-token paged append (speculative verify / chunked
+                # prefill): ragged per-row chunk starting at
+                # cache_positions; the caller's mask must be per-(query,
+                # kv) causal over the gathered run ([B, 1, S, MB*bs]).
+                counts = (chunk_counts if chunk_counts is not None
+                          else jnp.full((b,), s, jnp.int32))
+                c_lat = append_chunk_pages(
+                    c_lat, latent.astype(c_lat.dtype), page_table,
+                    cache_positions, counts, active)
+                c_pe = append_chunk_pages(
+                    c_pe, k_pe.astype(c_pe.dtype), page_table,
+                    cache_positions, counts, active)
+            else:
+                c_lat = append_token_pages(
+                    c_lat, latent[:, 0].astype(c_lat.dtype), page_table,
+                    cache_positions, active)
+                c_pe = append_token_pages(
+                    c_pe, k_pe[:, 0].astype(c_pe.dtype), page_table,
+                    cache_positions, active)
             mask_type = AttnMaskType.bidirectional
         elif cache_positions is not None:
             # Continuous-batching decode: per-row append positions.

@@ -86,13 +86,38 @@ def main():
         TextGenerationServer(engine, args.host, args.port).run()
         return
     if getattr(args, "engine", "static") == "dynamic":
+        draft_params = draft_cfg = None
+        if args.spec_method == "draft":
+            if args.draft_model is None:
+                raise SystemExit("--spec-method draft needs --draft-model "
+                                 "(a models/presets.py preset)")
+            draft_cfg = PRESETS[args.draft_model]()
+            draft_params, _ = init_gpt_params(jax.random.PRNGKey(1),
+                                              draft_cfg)
+            if args.draft_load_dir:
+                mngr = CheckpointManager(args.draft_load_dir)
+                state = mngr.restore({"step": 0, "params": draft_params,
+                                      "opt_state": {}})
+                if state is not None:
+                    draft_params = state["params"]
+                    print(f"loaded draft checkpoint step {state['step']}")
+                mngr.close()
+            else:
+                print("WARNING: draft model is randomly initialized "
+                      "(--draft-load-dir not given) — acceptance will be "
+                      "poor; outputs stay exact either way")
         engine = DynamicInferenceEngine(
             params, cfg, tokenizer=tok, max_batch=args.max_batch,
             max_seq_len=args.max_seq_len, paged=args.paged_kv_cache,
             block_size=args.kv_block_size, num_blocks=args.num_kv_blocks,
-            enable_prefix_caching=args.prefix_caching)
+            enable_prefix_caching=args.prefix_caching,
+            spec_method=(None if args.spec_method == "none"
+                         else args.spec_method),
+            spec_k=args.spec_k, draft_params=draft_params,
+            draft_cfg=draft_cfg)
         print(f"serving continuous batching on {args.host}:{args.port} "
-              f"(paged={args.paged_kv_cache})")
+              f"(paged={args.paged_kv_cache}, "
+              f"spec={engine.spec_method or 'off'})")
         TextGenerationServer(engine, args.host, args.port).run()
         return
     engine = StaticInferenceEngine(params, cfg, tokenizer=tok,
