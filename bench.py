@@ -133,15 +133,28 @@ def _spec_decode_hook():
     return r if r.get("ngram") else None
 
 
+def _pp_tp_hook():
+    """tp-sharded-vs-replicated pipeline stage body A/B
+    (tools/pp_tp_benchmark.py) on the CPU mesh — fwd/fwd+bwd speedup and
+    the parity pins tracked round over round like the other hooks."""
+    if os.environ.get("BENCH_PP_TP", "1") != "1":
+        return None
+    r = _run_child("--pp-tp", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("fwd") else None
+
+
 def _attach_overlap_hooks(res):
-    """Attach the tp-overlap, cp/a2a, paged-kv, and spec-decode A/B
-    results to a round record."""
+    """Attach the tp-overlap, cp/a2a, pp×tp, paged-kv, and spec-decode
+    A/B results to a round record."""
     tpo = _tp_overlap_hook()
     if tpo:
         res.setdefault("extra", {})["tp_overlap"] = tpo
     cpa = _cp_a2a_hook()
     if cpa:
         res.setdefault("extra", {})["cp_a2a"] = cpa
+    ppt = _pp_tp_hook()
+    if ppt:
+        res.setdefault("extra", {})["pp_tp_overlap"] = ppt
     pkv = _paged_kv_hook()
     if pkv:
         res.setdefault("extra", {})["paged_kv"] = pkv
@@ -215,6 +228,7 @@ def parent_main(local_only: bool = False):
     cpu = _cpu_fallback_record(history)
     tpo = _tp_overlap_hook()
     cpa = _cp_a2a_hook()
+    ppt = _pp_tp_hook()
     pkv = _paged_kv_hook()
     spd = _spec_decode_hook()
     last = _load_last_good()
@@ -237,6 +251,8 @@ def parent_main(local_only: bool = False):
             last["extra"]["tp_overlap"] = tpo
         if cpa:
             last["extra"]["cp_a2a"] = cpa
+        if ppt:
+            last["extra"]["pp_tp_overlap"] = ppt
         if pkv:
             last["extra"]["paged_kv"] = pkv
         if spd:
@@ -251,6 +267,8 @@ def parent_main(local_only: bool = False):
             cpu.setdefault("extra", {})["tp_overlap"] = tpo
         if cpa:
             cpu.setdefault("extra", {})["cp_a2a"] = cpa
+        if ppt:
+            cpu.setdefault("extra", {})["pp_tp_overlap"] = ppt
         if pkv:
             cpu.setdefault("extra", {})["paged_kv"] = pkv
         if spd:
@@ -352,6 +370,14 @@ def cp_a2a_main():
     from tools.cp_a2a_benchmark import run
     print(json.dumps(run(cp=4, ep=4, batch=2, seq=256, heads=8, kv_heads=4,
                          head_dim=32, iters=5, warmup=1)))
+
+
+def pp_tp_main():
+    """tp-sharded pipeline stage body A/B child (CPU mesh env set by the
+    parent)."""
+    from tools.pp_tp_benchmark import run
+    print(json.dumps(run(tp=2, pp=2, batch=2, seq=64, hidden=128,
+                         layers=4, microbatches=4, iters=9, warmup=2)))
 
 
 def paged_kv_main():
@@ -490,6 +516,8 @@ if __name__ == "__main__":
         tp_overlap_main()
     elif "--cp-a2a" in sys.argv:
         cp_a2a_main()
+    elif "--pp-tp" in sys.argv:
+        pp_tp_main()
     elif "--paged-kv" in sys.argv:
         paged_kv_main()
     elif "--spec-decode" in sys.argv:

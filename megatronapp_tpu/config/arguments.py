@@ -152,6 +152,20 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
                    help="overlap tensor-parallel collectives with the "
                         "dependent GEMMs via manual ring all-gather / "
                         "reduce-scatter matmuls (parallel/overlap.py)")
+    g.add_argument("--no-tp-sharded-stage", action="store_false",
+                   dest="tp_sharded_stage",
+                   help="disable the tp-SHARDED pipeline stage body "
+                        "(parallel/pipeline.py tp_shard) and fall back "
+                        "to tp-replicated stage compute — the A/B "
+                        "baseline; only meaningful with pp>1 x tp>1")
+    g.add_argument("--sharded-init", action="store_true",
+                   help="initialize the train state direct-to-shards "
+                        "(params never materialize unsharded — for "
+                        "giant-model runs whose replicated init would "
+                        "OOM a device); the default two-stage "
+                        "replicated-then-reshard init is the one whose "
+                        "seeded values are mesh-independent "
+                        "(training/train_state.py)")
     g.add_argument("--no-cp-comm-overlap", action="store_false",
                    dest="cp_comm_overlap",
                    help="disable the latency-hiding ring-attention path "
@@ -400,6 +414,7 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
             "max_position_embeddings": "max_position_embeddings",
             "init_method_std": "init_method_std",
             "tp_comm_overlap": "tp_comm_overlap",
+            "tp_sharded_stage": "tp_sharded_stage",
         }
         for flag, field in flag_to_field.items():
             val = getattr(args, flag)
@@ -457,6 +472,7 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
                 if args.hierarchical_context_parallel_sizes else 2),
             remat_policy=args.recompute_granularity,
             tp_comm_overlap=args.tp_comm_overlap,
+            tp_sharded_stage=args.tp_sharded_stage,
             cp_comm_overlap=args.cp_comm_overlap,
             moe_comm_overlap=args.moe_comm_overlap,
             attention_impl=args.attention_impl,
@@ -507,9 +523,71 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
     if args.seq_length > model.max_position_embeddings:
         raise ValueError("--seq-length exceeds --max-position-embeddings")
 
+    # --tp-comm-overlap divisibility (fail at parse time with a clear
+    # message instead of a shard_map trace failure / silent GSPMD
+    # fallback deep inside the first step): the ring primitives shard the
+    # projection output/input dims — and, inside a pp>1 manual pipeline,
+    # whole heads and the sequence — evenly over tp.
+    tp = args.tensor_model_parallel_size
+    if model.tp_comm_overlap and tp > 1:
+        def _reject(what, dim):
+            raise ValueError(
+                f"--tp-comm-overlap: {what} ({dim}) is not divisible by "
+                f"--tensor-model-parallel-size ({tp}); pick divisible "
+                "sizes or drop the flag")
+        if model.hidden_size % tp:
+            _reject("--hidden-size", model.hidden_size)
+        if not model.is_moe or model.moe_layer_freq > 1:
+            if model.ffn_hidden_size % tp:
+                _reject("--ffn-hidden-size (fc1/fc2 shard dim)",
+                        model.ffn_hidden_size)
+        # The tp-sharded stage body only runs when pp>1, cp==1 and the
+        # kill switch is off (tp_stage_eligible); with cp>1 the pipeline
+        # keeps the tp-replicated body, so its stricter whole-head /
+        # sequence divisibility rules must not reject those configs.
+        tp_stage_candidate = (args.pipeline_model_parallel_size > 1
+                              and model.tp_sharded_stage
+                              and args.context_parallel_size <= 1)
+        if tp_stage_candidate and args.seq_length % tp:
+            raise ValueError(
+                "--tp-comm-overlap with pp>1 runs the tp-SHARDED "
+                "pipeline stage body, which shards the sequence over tp: "
+                f"--seq-length ({args.seq_length}) must divide by tp "
+                f"({tp}) — or pass --no-tp-sharded-stage for the "
+                "replicated baseline")
+        if model.multi_latent_attention:
+            # Dense MLA never routes through the GSPMD overlap rings
+            # (only its MLP does — covered by the ffn check above); only
+            # the pp>1 tp-SHARDED stage body slices whole MLA heads.
+            if tp_stage_candidate and model.num_attention_heads % tp:
+                raise ValueError(
+                    "--tp-comm-overlap with pp>1 runs the tp-SHARDED "
+                    "pipeline stage body, which slices WHOLE MLA heads: "
+                    f"--num-attention-heads ({model.num_attention_heads})"
+                    f" must divide by tp ({tp}) — or pass "
+                    "--no-tp-sharded-stage for the replicated baseline")
+        else:
+            d = model.head_dim
+            if (model.num_attention_heads * d) % tp:
+                _reject("QKV projection dim (heads*head_dim)",
+                        model.num_attention_heads * d)
+            if (2 * model.num_query_groups * d) % tp:
+                _reject("KV projection dim (2*num-query-groups*head_dim)",
+                        2 * model.num_query_groups * d)
+            if tp_stage_candidate and (model.num_attention_heads % tp
+                                       or model.num_query_groups % tp):
+                raise ValueError(
+                    "--tp-comm-overlap with pp>1 runs the tp-SHARDED "
+                    "pipeline stage body, which slices WHOLE heads: "
+                    f"--num-attention-heads ({model.num_attention_heads}) "
+                    f"and --num-query-groups ({model.num_query_groups}) "
+                    f"must both divide by tp ({tp}) — or pass "
+                    "--no-tp-sharded-stage for the replicated baseline")
+
     training = TrainingConfig(
         rampup_batch_size=(tuple(args.rampup_batch_size)
                            if args.rampup_batch_size else None),
+        sharded_init=args.sharded_init,
         metrics_jsonl=args.metrics_jsonl,
         tensorboard_dir=args.tensorboard_dir,
         rerun_mode=args.rerun_mode,

@@ -50,6 +50,12 @@ class TrainingConfig:
     # "<start> <increment> <samples>"): grow the global batch from start to
     # global_batch_size over the first `samples` consumed samples.
     rampup_batch_size: Optional[tuple] = None
+    # Direct-to-shards state init (--sharded-init): params/optimizer
+    # state never materialize unsharded — for giant-model runs whose
+    # replicated init would OOM a device. Off by default: the two-stage
+    # replicated-then-reshard init is the one whose seeded values are
+    # provably mesh-independent on this jax build (train_state.py).
+    sharded_init: bool = False
     # NaN/spike guard (reference rerun_state_machine result validation).
     check_for_nan_in_loss: bool = True
     loss_spike_factor: float = 10.0

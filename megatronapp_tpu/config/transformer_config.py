@@ -175,6 +175,18 @@ class TransformerConfig:
     # region, indivisible projection dims) silently keep the GSPMD path.
     tp_comm_overlap: bool = False
 
+    # tp-SHARDED stage bodies inside the full-manual pp pipeline
+    # (parallel/pipeline.py tp_shard + overlap.py tp_stage_eligible):
+    # activations shard over tp along the sequence between stages and the
+    # stage projections run the manual ring primitives on per-shard weight
+    # slices — tp× fewer stage FLOPs and tp× smaller pp hops than the
+    # tp-replicated body. On by default wherever eligible (cp == 1,
+    # divisible S/heads/ffn); this is the A/B kill-switch
+    # (--no-tp-sharded-stage) forcing the replicated baseline.
+    # tp_comm_overlap picks ring (True) vs bulk (False) collectives
+    # INSIDE the sharded body.
+    tp_sharded_stage: bool = True
+
     # Flash/dense crossover for 'auto' (PERF.md lever #2): at short
     # sequences the O(S^2) dense backward is FASTER on this chip than
     # the flash backward kernels at D=64 (measured 8x at S=1024 —

@@ -352,27 +352,39 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
     unit_layers = (cfg.moe_layer_freq
                    if cfg.is_moe and cfg.moe_layer_freq > 1 else 1)
 
+    # tp-sharded stage body (parallel/overlap.py tp_stage_eligible): the
+    # manual pipeline region shards activations over tp along the seq dim
+    # and the stage body runs the ring-overlapped projections — tp× fewer
+    # stage FLOPs instead of the tp-replicated redundant compute.
+    from megatronapp_tpu.parallel.overlap import tp_stage_eligible
+    tp_shard = positions is None and tp_stage_eligible(cfg, ctx, s)
+
     def stage_fn(chunk_params, x, layer_offset):
         layer_offset = layer_offset * unit_layers
         cos_l, sin_l = cos, sin
         from megatronapp_tpu.config.parallel_config import CP_AXIS
         from megatronapp_tpu.parallel.collectives import current_manual_axes
-        if CP_AXIS in current_manual_axes() and cos is not None:
+        if (not tp_shard and CP_AXIS in current_manual_axes()
+                and cos is not None):
             # Inside the pipeline body the cp axis is manual: x carries the
             # local S/cp sequence block — slice the rope tables to match.
             # (In the pp==1 fallback stage_fn runs outside any manual
-            # region and x carries the full sequence — no slicing.)
+            # region and x carries the full sequence — no slicing. Under
+            # tp_shard attention re-gathers the full sequence through its
+            # rings, so the FULL tables are the right ones there too.)
             s_loc = x.shape[1]
             start = jax.lax.axis_index(CP_AXIS) * s_loc
             cos_l = jax.lax.dynamic_slice_in_dim(cos, start, s_loc)
             sin_l = jax.lax.dynamic_slice_in_dim(sin, start, s_loc)
         return block_forward(chunk_params, x, cfg, cos_l, sin_l, None,
                              layer_offset=layer_offset, ctx=ctx,
-                             zigzag=positions is not None)
+                             zigzag=positions is not None,
+                             tp_sharded=tp_shard)
 
     out_mb, aux = spmd_pipeline(
         stage_fn, p["block"], h, ctx, num_microbatches=m, vpp=vpp,
-        compute_dtype=cfg.compute_dtype, order_policy=order_policy)
+        compute_dtype=cfg.compute_dtype, order_policy=order_policy,
+        tp_shard=tp_shard)
     # Aux losses are summed over the M microbatches inside the pipeline;
     # normalize to per-microbatch scale to match the non-pipelined path.
     aux = aux / m

@@ -13,6 +13,7 @@ builds on the compat wrappers here.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Tuple
 
@@ -102,6 +103,56 @@ def current_manual_axes() -> Tuple[str, ...]:
         return ()
 
 
+def ambient_manual(*axes: str) -> bool:
+    """True iff every named mesh axis is Manual in the ambient context —
+    the shared detection gate for code that must switch between GSPMD
+    wrappers (outside any manual region) and ambient ring bodies (inside
+    the full-manual pipeline/cp regions, where a nested shard_map or a
+    GSPMD collective would abort this XLA:CPU build)."""
+    manual = current_manual_axes()
+    return all(a in manual for a in axes)
+
+
+def all_gather_seq(x: jnp.ndarray, axis_name: str, axis: int = 1):
+    """Tiled all-gather of a manually-sharded axis inside an ambient
+    manual region ([..., S/n, ...] → [..., S, ...], rank-major order —
+    matching the contiguous seq-chunk layout the tp/cp rings use).
+
+    The audited home for the bulk (non-overlapped) gathers of the
+    tp-sharded pipeline stage body: small side tensors (MLA's shared
+    rope key) and the ``tp_comm_overlap=False`` bulk fallback both route
+    through here rather than sprinkling raw lax.all_gather calls."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+# Python-level attrs merged into every ring_span record while active —
+# lets an enclosing region (the pp pipeline's tp-sharded stage body) tag
+# the spans its inner rings emit without threading arguments through
+# every ring body. Trace-time state: the tag captures at trace time like
+# the enabled check itself.
+_SPAN_TAGS: dict = {}
+
+
+@contextlib.contextmanager
+def span_tags(**tags):
+    """Tag all ring_span records emitted while tracing under this context
+    (e.g. ``span_tags(region="pp-stage")`` around the pipeline stage body
+    marks the in-pipeline tp rings apart from top-level tp overlap).
+
+    Scope caveat: custom_vjp BACKWARD ring bodies are traced during
+    transposition — outside any forward-side ``with`` — so only
+    forward-pass spans carry the tag (same jax-0.4.x boundary as the
+    "pp hop spans appear on forward/eval only" scan-linearization
+    note)."""
+    global _SPAN_TAGS
+    prev = _SPAN_TAGS
+    _SPAN_TAGS = {**prev, **tags}
+    try:
+        yield
+    finally:
+        _SPAN_TAGS = prev
+
+
 def ring_span(name: str, ph: str, dep, axis_name: str, *, step=None,
               **attrs):
     """Per-hop MegaScan record from inside a jitted manual ring body.
@@ -129,6 +180,8 @@ def ring_span(name: str, ph: str, dep, axis_name: str, *, step=None,
     tracer = get_tracer()
     if not (tracer.enabled and callbacks_supported()):
         return
+    if _SPAN_TAGS:
+        attrs = {**_SPAN_TAGS, **attrs}
 
     rank = lax.axis_index(axis_name)
     tid = jnp.zeros((), jnp.int32)

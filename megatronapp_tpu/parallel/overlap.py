@@ -128,13 +128,19 @@ def _ag_mm_body(tp, op_name, xl, wls):
     return tuple(ys)
 
 
-def _ag_mm_bwd_body(tp, xl, wls, dyls):
+def _ag_mm_bwd_body(tp, xl, wls, dyls, reduce_batch=True):
     """Fused backward ring for all_gather_matmul.
 
     xl [b, S/tp, H], wls: tuple of [H, N_j/tp], dyls: matching cotangents
     [b, S, N_j/tp]. One ring pass of x chunks accumulates EVERY weight's
     wgrad; the dgrad is the symmetric matmul-reduce-scatter of the summed
-    dy_j @ w_j^T. Returns (dx_local [b, S/tp, H], tuple of dw_j)."""
+    dy_j @ w_j^T. Returns (dx_local [b, S/tp, H], tuple of dw_j).
+
+    reduce_batch=False (the ambient-manual pipeline path): skip the
+    (dp, ep) wgrad psum — there the weights are replicated INPUTS of the
+    enclosing shard_map, whose transpose already psums their cotangents
+    over every unmentioned axis; an explicit psum here would double-count
+    (collectives.shard_map_compat autodiff note)."""
     me = lax.axis_index(TP_AXIS)
     b, sc, h = xl.shape
     perm = _ring_perm(tp)
@@ -170,7 +176,8 @@ def _ag_mm_bwd_body(tp, xl, wls, dyls):
     # replicated there, so their grads must be reduced across the batch
     # shards — the all-reduce GSPMD would have inserted for us. fp32
     # reduction (bf16 manual all-reduces crash XLA:CPU — README).
-    dws = [lax.psum(dw, (DP_AXIS, EP_AXIS)) for dw in dws]
+    if reduce_batch:
+        dws = [lax.psum(dw, (DP_AXIS, EP_AXIS)) for dw in dws]
     return (dx.astype(xl.dtype),
             tuple(dw.astype(wl.dtype) for dw, wl in zip(dws, wls)))
 
@@ -243,7 +250,7 @@ def _mm_rs_rings(tp, yls, wls, op_name="matmul-reduce-scatter"):
     return acc
 
 
-def _mm_rs_bwd_body(tp, yl, wl, dol):
+def _mm_rs_bwd_body(tp, yl, wl, dol, reduce_batch=True):
     """Fused backward ring for matmul_reduce_scatter.
 
     yl [b, S, N/tp], wl [N/tp, H], dol [b, S/tp, H] (this rank's cotangent
@@ -277,8 +284,10 @@ def _mm_rs_bwd_body(tp, yl, wl, dol):
             _mark(OVERLAP_PERMUTE_EVENT, "E", nxt, op=op, step=step)
             chunk = nxt
     # Weight grad: reduce across the manual (dp, ep) batch shards (see
-    # _ag_mm_bwd_body) — fp32 before the cast.
-    dw = lax.psum(dw, (DP_AXIS, EP_AXIS))
+    # _ag_mm_bwd_body) — fp32 before the cast. Skipped on the ambient
+    # pipeline path, where the enclosing shard_map's transpose owns it.
+    if reduce_batch:
+        dw = lax.psum(dw, (DP_AXIS, EP_AXIS))
     return dy.astype(yl.dtype), dw.astype(wl.dtype)
 
 
@@ -308,6 +317,150 @@ def _mm_rs_bwd(mesh, res, dout):
 
 
 _mm_rs.defvjp(_mm_rs_fwd, _mm_rs_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Ambient-manual variants: the same fused rings, callable from INSIDE an
+# existing full-manual shard_map (the pp pipeline stage body). No shard_map
+# wrapper (nested shard_maps are unsupported on this jax build) and no
+# (dp, ep) wgrad psum (the enclosing region's transpose owns that
+# reduction for replicated params). ``overlap=False`` swaps the latency-
+# hiding ring forward for bulk collectives (one tiled all-gather / an
+# unfused reduce-scatter ring) — the A/B baseline — while keeping the
+# fused ring backward, which is correct either way.
+# ---------------------------------------------------------------------------
+
+
+def _bulk_ag_mm(tp, xl, wls):
+    """Bulk forward: one tiled all-gather of x, then the plain GEMMs
+    (exposed comm — the tp_comm_overlap=False baseline)."""
+    from megatronapp_tpu.parallel.collectives import all_gather_seq
+    x_full = all_gather_seq(xl, TP_AXIS, axis=1)
+    return tuple(x_full @ wl for wl in wls)
+
+
+def _bulk_mm_rs(tp, yls, wls):
+    """Bulk forward: full partial product first, then an unfused
+    reduce-scatter ring over the seq chunks (no GEMM to hide hops under)."""
+    me = lax.axis_index(TP_AXIS)
+    full = None
+    for yl, wl in zip(yls, wls):
+        full = yl @ wl if full is None else full + yl @ wl
+    sc = full.shape[1] // tp
+    perm = _ring_perm(tp)
+
+    def chunk(c):
+        return lax.dynamic_slice_in_dim(full, c * sc, sc, axis=1)
+
+    acc = chunk((me + 1) % tp)
+    for step in range(1, tp):
+        acc = lax.ppermute(acc, TP_AXIS, perm) + chunk((me + 1 + step) % tp)
+    return acc
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ag_mm_ambient(tp, overlap, x, ws):
+    return _ag_mm_ambient_fwd(tp, overlap, x, ws)[0]
+
+
+def _ag_mm_ambient_fwd(tp, overlap, x, ws):
+    if overlap:
+        ys = _ag_mm_body(tp, "all-gather-matmul", x, ws)
+    else:
+        ys = _bulk_ag_mm(tp, x, ws)
+    return ys, (x, ws)
+
+
+def _ag_mm_ambient_bwd(tp, overlap, res, dys):
+    x, ws = res
+    dx, dws = _ag_mm_bwd_body(tp, x, ws, dys, reduce_batch=False)
+    return dx, dws
+
+
+_ag_mm_ambient.defvjp(_ag_mm_ambient_fwd, _ag_mm_ambient_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _mm_rs_ambient(tp, overlap, y, w):
+    return _mm_rs_ambient_fwd(tp, overlap, y, w)[0]
+
+
+def _mm_rs_ambient_fwd(tp, overlap, y, w):
+    if overlap:
+        out = _mm_rs_rings(tp, (y,), (w,))
+    else:
+        out = _bulk_mm_rs(tp, (y,), (w,))
+    return out, (y, w)
+
+
+def _mm_rs_ambient_bwd(tp, overlap, res, dout):
+    y, w = res
+    dy, dw = _mm_rs_bwd_body(tp, y, w, dout, reduce_batch=False)
+    return dy, dw
+
+
+_mm_rs_ambient.defvjp(_mm_rs_ambient_fwd, _mm_rs_ambient_bwd)
+
+
+def all_gather_matmul_manual(x, w, tp, overlap=True):
+    """Column-parallel matmul from inside an ambient full-manual region.
+
+    x: [b, S/tp, H] — this shard's seq chunk of the tp-sharded residual
+    stream; w: [H, N/tp] — this shard's output slice (or a tuple sharing
+    ONE ring all-gather of x, the fused-QKV case). Returns [b, S, N/tp]
+    per weight: full sequence, local output shard. The caller guarantees
+    the ambient region is manual over tp (and that S divided evenly when
+    the stream was sharded — tp_stage_eligible)."""
+    fused = isinstance(w, (tuple, list))
+    ws = tuple(w) if fused else (w,)
+    ys = _ag_mm_ambient(tp, overlap, x, ws)
+    return ys if fused else ys[0]
+
+
+def matmul_reduce_scatter_manual(y, w, tp, overlap=True):
+    """Row-parallel matmul from inside an ambient full-manual region.
+
+    y: [b, S, N/tp] (full seq, local inner shard); w: [N/tp, H] — this
+    shard's row slice. Returns [b, S/tp, H]: the fully-reduced local seq
+    chunk of the tp-sharded residual stream."""
+    return _mm_rs_ambient(tp, overlap, y, w)
+
+
+def tp_stage_eligible(cfg, ctx, seq_len: int) -> bool:
+    """Whether the full-manual pipeline may run its stage body tp-SHARDED
+    (activations [mb, S/tp, H] between stages, projections through the
+    ambient rings above) instead of tp-replicated.
+
+    Requirements: tp > 1 inside a pp > 1 manual region with cp == 1 (seq
+    is the tp shard dim), the kill-switch ``cfg.tp_sharded_stage`` on,
+    S % tp == 0, whole heads per shard (nq — and nkv for GQA — divisible
+    by tp; the manual path slices head groups, unlike the GSPMD-overlap
+    path which only needs flat dims), and dense-MLP ffn divisible by tp
+    (gate/value halves shard separately for gated activations). MoE
+    layers dispatch locally per shard (any expert count); heterogeneous
+    stacks are excluded (the pipeline rejects them anyway)."""
+    if ctx is None or ctx.tp <= 1 or ctx.pp <= 1 or ctx.cp > 1:
+        return False
+    # FBD abstract half-meshes keep the proven tp-replicated body (same
+    # exclusion as tp_overlap_eligible: abstract-mesh manual collectives
+    # over tp are unvalidated there).
+    if getattr(ctx, "abstract_collectives", False):
+        return False
+    if not getattr(cfg, "tp_sharded_stage", True):
+        return False
+    if getattr(cfg, "hetero_block_specs", None):
+        return False
+    tp = ctx.tp
+    if seq_len % tp:
+        return False
+    if cfg.num_attention_heads % tp:
+        return False
+    if not cfg.multi_latent_attention and cfg.num_query_groups % tp:
+        return False
+    has_dense_mlp = (not cfg.is_moe) or cfg.moe_layer_freq > 1
+    if has_dense_mlp and cfg.ffn_hidden_size % tp:
+        return False
+    return True
 
 
 # ---------------------------------------------------------------------------

@@ -20,10 +20,17 @@ lower ppermute/axis_index through an SPMD path XLA:CPU aborts on
 (parallel/overlap.py design notes), and nested shard_maps are unsupported
 — so the body owns EVERY axis. The microbatch dim threads over (dp, ep)
 when it divides evenly, sequence over cp (attention dispatches to the cp
-ring impls directly via the ambient-manual check), and tp rides replicated
-inside the body (each tp rank redundantly computes the stage; the tp-GSPMD
-sharding of the old partial-auto region needed exactly the partial-auto
-mode this build aborts on). Stage hand-offs emit per-step
+ring impls directly via the ambient-manual check). tp has two modes:
+``tp_shard=True`` (cp == 1 layouts passing overlap.tp_stage_eligible)
+shards the activations along the SEQUENCE over tp between stages —
+[mb, S/tp, H] residual streams, tp× smaller pp ppermute hops, stage
+bodies running the parallel/overlap.py ring all-gather-matmul /
+matmul-reduce-scatter primitives on per-shard weight slices (tp× fewer
+stage FLOPs, collectives hidden under the GEMM chunks). Otherwise tp
+rides replicated inside the body (each tp rank redundantly computes the
+stage — kept for ineligible layouts; the tp-GSPMD sharding of the old
+partial-auto region needed exactly the partial-auto mode this build
+aborts on). Stage hand-offs emit per-step
 ``pp-overlap-permute`` MegaScan spans so the schedule's comm is visible in
 the merged trace.
 
@@ -51,13 +58,13 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from megatronapp_tpu.config.parallel_config import (
-    CP_AXIS, DP_AXIS, EP_AXIS, PP_AXIS,
+    CP_AXIS, DP_AXIS, EP_AXIS, PP_AXIS, TP_AXIS,
 )
 from megatronapp_tpu.parallel.mesh import MeshContext
 
 
 from megatronapp_tpu.parallel.collectives import (
-    pvary, ring_span, shard_map_compat, zeros_like_vma,
+    pvary, ring_span, shard_map_compat, span_tags, zeros_like_vma,
 )
 
 # MegaScan span name for the stage→stage ring hop (tracer GRANULARITY
@@ -97,6 +104,7 @@ def spmd_pipeline(
     compute_dtype=jnp.bfloat16,
     order_policy: str = "dfc",
     aux_mb: Any = None,
+    tp_shard: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Run the pipelined layer stack.
 
@@ -127,6 +135,12 @@ def spmd_pipeline(
     pipe_params: [pp, vpp, Lc, ...] pytree (leading axis sharded over pp).
     h_mb: [M, mb, S, H] microbatched hidden states (e.g. embeddings) — must
     be fp32 when pp > 1 (cast to compute_dtype happens inside; see body).
+    tp_shard: run the stage body tp-SHARDED — activations enter/leave the
+    region with the sequence dim sharded over tp ([mb, S/tp, H] inside),
+    stage_fn must thread tp_sharded=True into the transformer stack, and
+    params gain a real tp entry in the grad-axes bookkeeping (each shard
+    contributes a slice-local partial wgrad the transpose psums). Caller
+    gates on overlap.tp_stage_eligible (cp == 1, divisible S/heads/ffn).
     Returns (out_mb [M, mb, S, H] from the last stage, summed aux losses).
     """
     pp = ctx.pp
@@ -183,7 +197,7 @@ def spmd_pipeline(
             out, aux = spmd_pipeline(
                 shifted, chunk_params, h, ctx, M, vpp=1,
                 compute_dtype=compute_dtype, order_policy="dfc",
-                aux_mb=aux_mb)
+                aux_mb=aux_mb, tp_shard=tp_shard)
             aux_total = aux_total + aux
             h = out.astype(jnp.float32)
         return out, aux_total
@@ -217,10 +231,13 @@ def spmd_pipeline(
         # Params enter replicated over the token-splitting axes (cp seq
         # chunks; (dp, ep) microbatch shards) but every shard contributes a
         # partial wgrad: pvary's backward is the single fp32 psum per param
-        # that IS the data-parallel/cp grad reduction. tp needs no entry —
-        # it computes redundantly, so per-tp-shard cotangents are already
-        # complete.
-        grad_axes = (batch_axes or ()) + ((CP_AXIS,) if cp > 1 else ())
+        # that IS the data-parallel/cp grad reduction. With the tp-sharded
+        # stage body tp is a REAL entry too: each shard's wgrad covers only
+        # its weight slice / seq chunk, and the psum assembles the full
+        # grad. Replicated-tp bodies need no entry — they compute
+        # redundantly, so per-tp-shard cotangents are already complete.
+        grad_axes = (batch_axes or ()) + ((CP_AXIS,) if cp > 1 else ()) \
+            + ((TP_AXIS,) if tp_shard else ())
         if grad_axes:
             params_s = jax.tree.map(
                 lambda p: pvary(p, grad_axes), params_s)
@@ -253,13 +270,17 @@ def spmd_pipeline(
                                                        keepdims=False),
                 params_s)
             layer_offset = (chunk * pp + stage) * layers_per_chunk
-            if aux_mb_in:
-                aux_m = jax.tree.map(
-                    lambda a: jax.lax.dynamic_index_in_dim(
-                        a, m_safe, keepdims=False), aux_mb_in)
-                y, a = stage_fn(chunk_params, x, layer_offset, aux_m)
-            else:
-                y, a = stage_fn(chunk_params, x, layer_offset)
+            # Tag every ring span the stage body emits (the tp-sharded
+            # body's tp-overlap-* rings) so in-pipeline hops are
+            # distinguishable from top-level tp overlap in merged traces.
+            with span_tags(region="pp-stage"):
+                if aux_mb_in:
+                    aux_m = jax.tree.map(
+                        lambda a: jax.lax.dynamic_index_in_dim(
+                            a, m_safe, keepdims=False), aux_mb_in)
+                    y, a = stage_fn(chunk_params, x, layer_offset, aux_m)
+                else:
+                    y, a = stage_fn(chunk_params, x, layer_offset)
             aux = aux + jnp.where(active, a, 0.0)
 
             # Last stage, last chunk → collect output.
@@ -295,7 +316,18 @@ def spmd_pipeline(
         aux = jax.lax.psum(aux, red_axes) / denom
         return outputs[None], aux[None]
 
-    cp_spec = CP_AXIS if cp > 1 else None
+    if tp_shard and cp > 1:
+        raise ValueError("tp_shard requires cp == 1 (the sequence is the "
+                         "tp shard dim); gate callers on tp_stage_eligible")
+    if tp_shard and aux_mb:
+        raise NotImplementedError(
+            "tp_shard does not compose with per-microbatch aux inputs "
+            "(packed sequences) yet — callers keep tp-replicated there")
+    # With the tp-sharded stage body the seq dim shards over tp at the
+    # region boundary: each shard receives/returns its [.., S/tp, H]
+    # chunk, the transpose delivers REAL per-shard output cotangents,
+    # and the pp ring hops inside carry tp× less data.
+    cp_spec = (CP_AXIS if cp > 1 else (TP_AXIS if tp_shard else None))
     h_spec = P(None, batch_axes, cp_spec)
     out_spec = P(PP_AXIS, None, batch_axes, cp_spec)
     aux_mb = {} if aux_mb is None else aux_mb
@@ -308,6 +340,8 @@ def spmd_pipeline(
         return P(*dims[:a.ndim])
 
     aux_specs = jax.tree.map(_aux_spec, aux_mb)
+    # manual-ok: this call CREATES the pipeline's manual region (the one
+    # the stage-body modules execute inside) — it is not nested
     sm = jax.jit(shard_map_compat(
         body, ctx.shard_map_mesh,
         in_specs=(P(PP_AXIS), h_spec, aux_specs),

@@ -139,7 +139,8 @@ def pretrain_gpt(
         return init_gpt_params(rng, model_cfg, pp=ctx.pp, vpp=vpp)
 
     state, shardings, params_axes = setup_train_state(
-        rng, params_and_axes, optimizer, ctx)
+        rng, params_and_axes, optimizer, ctx,
+        sharded_init=train_cfg.sharded_init)
 
     # Checkpointing: restore from load_dir (or save_dir when resuming the
     # same run), save only to save_dir — reference --load/--save semantics
@@ -637,7 +638,7 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
         state, shardings, _ = setup_train_state(
             rng,
             lambda k: init_gpt_params(k, model_cfg, pp=bwd_ctx.pp, vpp=vpp),
-            optimizer, bwd_ctx)
+            optimizer, bwd_ctx, sharded_init=train_cfg.sharded_init)
 
     if bwd_ctx.pp > 1:
         # Pipelined loss on each half-mesh: the executor feeds the WHOLE

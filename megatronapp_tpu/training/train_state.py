@@ -12,6 +12,7 @@ the reduce-scatter/all-gather the reference implements by hand
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Callable, Tuple
 
 import jax
@@ -54,12 +55,29 @@ def pick_rules(ctx: MeshContext):
 
 
 def setup_train_state(rng, params_and_axes_fn: Callable, optimizer,
-                      ctx: MeshContext, rules=None) -> Tuple[Any, Any, Any]:
-    """jit-init the full state directly into its shardings (params never
-    materialize unsharded — parity with the reference's per-rank init).
+                      ctx: MeshContext, rules=None,
+                      sharded_init: bool = False) -> Tuple[Any, Any, Any]:
+    """Initialize the full train state into its shardings.
 
     params_and_axes_fn(rng) -> (params, logical_axes). Returns
     (state, state_shardings, params_axes).
+
+    sharded_init=False (default): two-stage init — jit with fully
+    REPLICATED out_shardings (every device runs the identical init
+    program, so seeded values are provably mesh-independent), then a
+    jitted identity resharding into the target shardings. Root cause
+    (cp×pp parity work): with sharded out_shardings, GSPMD partitions the
+    stacked threefry draws of the layer-stack init, and on this jax
+    0.4.x/XLA:CPU build the cp×pp mesh then produced DIFFERENT param
+    values than a single device (~0.09 max leaf diff, the cp2×pp2
+    train-loss drift) while every other tested mesh matched. Both stages
+    are computation-based (no host transfers), so multi-process meshes
+    work unchanged.
+
+    sharded_init=True: the old direct-to-shards init (params never
+    materialize unsharded — the reference's per-rank init analogue) for
+    memory-constrained giant-model runs; values are then only guaranteed
+    mesh-independent on meshes validated by the init-parity tests.
     """
     rules = rules or pick_rules(ctx)
     # Logical axes are config-static python data; capture them during an
@@ -84,5 +102,23 @@ def setup_train_state(rng, params_and_axes_fn: Callable, optimizer,
     axes = state_logical_axes(params_axes, state_struct["opt_state"])
     shardings = tree_logical_to_sharding(axes, ctx.mesh, rules)
     with ctx.mesh:
-        state = jax.jit(_init, out_shardings=shardings)(rng)
+        if sharded_init:
+            state = jax.jit(_init, out_shardings=shardings)(rng)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = jax.tree.map(
+                lambda _: NamedSharding(ctx.mesh, PartitionSpec()),
+                shardings)
+            state = jax.jit(_init, out_shardings=rep)(rng)
+            # Donate the replicated copy so backends with donation
+            # support free its buffers as the reshard consumes them
+            # (peak init memory ~1x sharded state instead of
+            # replicated + sharded). CPU lacks donation and warns;
+            # expected, so silence just that warning.
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable")
+                state = jax.jit(lambda s: s, out_shardings=shardings,
+                                donate_argnums=0)(state)
     return state, shardings, params_axes

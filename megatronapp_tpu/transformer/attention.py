@@ -74,6 +74,7 @@ def attention_forward(
     page_table: Optional[jnp.ndarray] = None,
     active: Optional[jnp.ndarray] = None,
     chunk_counts: Optional[jnp.ndarray] = None,
+    tp_sharded: bool = False,
 ) -> jnp.ndarray:
     """x: [B, S, H] → [B, S, H]. Returns (out, new_kv_cache).
 
@@ -98,7 +99,16 @@ def attention_forward(
     segment_ids: [B, S] packed-sequence map; the flash kernel masks
     in-block (O(S) memory), the reference impl builds the dense
     block-diagonal mask, and the cp impls thread segments through their
-    collectives."""
+    collectives.
+
+    tp_sharded: the caller (the pp pipeline stage body) runs inside an
+    ambient FULL-MANUAL region with the residual stream tp-sharded along
+    the sequence: x is this shard's [B, S/tp, H] chunk. QKV then runs as
+    one fused ring all-gather-matmul over per-shard HEAD slices (q, k and
+    v sliced separately so each shard owns matched GQA groups), attention
+    runs on the full sequence with nq/tp local heads, and the out-proj
+    ring reduce-scatters back to the local seq chunk
+    (parallel/overlap.py *_manual; tp_stage_eligible gates callers)."""
     b, s, h = x.shape
     d = cfg.head_dim
     nq, nkv = cfg.num_attention_heads, cfg.num_query_groups
@@ -119,14 +129,79 @@ def attention_forward(
     # GQA head counts indivisible by tp still work when nq*d / 2*nkv*d do.
     # (kv_cache = decode: S∈{1,prefill} matmuls are tiny and latency-bound,
     # the ring would be pure overhead — keep GSPMD there.)
-    overlap = (kv_cache is None
+    overlap = (kv_cache is None and not tp_sharded
                and tp_overlap_eligible(cfg, ctx, nq * d, 2 * nkv * d,
                                        batch=b))
     q_kernel = _dist.apply("weight", p["q_kernel"], layer_id)
     kv_kernel = _dist.apply("weight", p["kv_kernel"], layer_id)
+    if tp_sharded:
+        # Ambient-manual tp-sharded stage body: see docstring. Local head
+        # counts; s stays the LOCAL seq chunk length, sf the full length.
+        if (kv_cache is not None or attention_mask is not None
+                or segment_ids is not None or zigzag):
+            raise NotImplementedError(
+                "tp-sharded stage body supports the plain training path "
+                "only (no kv cache / explicit mask / packing / zigzag) — "
+                "tp_stage_eligible callers gate these off")
+        from jax import lax
+        from megatronapp_tpu.config.parallel_config import TP_AXIS
+        from megatronapp_tpu.parallel.overlap import (
+            all_gather_matmul_manual, matmul_reduce_scatter_manual,
+        )
+        tp = ctx.tp
+        me = lax.axis_index(TP_AXIS)
+        nql, nkvl = nq // tp, nkv // tp
+        dt = cfg.compute_dtype
+        qw = lax.dynamic_slice_in_dim(q_kernel.astype(dt),
+                                      me * nql * d, nql * d, axis=1)
+        kw = lax.dynamic_slice_in_dim(kv_kernel.astype(dt),
+                                      me * nkvl * d, nkvl * d, axis=1)
+        vw = lax.dynamic_slice_in_dim(kv_kernel.astype(dt),
+                                      nkv * d + me * nkvl * d, nkvl * d,
+                                      axis=1)
+        ov = bool(getattr(cfg, "tp_comm_overlap", False))
+        q, k, v = all_gather_matmul_manual(x, (qw, kw, vw), tp, ov)
+        if "q_bias" in p:
+            qb = p["q_bias"].astype(dt)
+            kvb = p["kv_bias"].astype(dt)
+            q = q + lax.dynamic_slice_in_dim(qb, me * nql * d, nql * d)
+            k = k + lax.dynamic_slice_in_dim(kvb, me * nkvl * d, nkvl * d)
+            v = v + lax.dynamic_slice_in_dim(
+                kvb, nkv * d + me * nkvl * d, nkvl * d)
+        sf = s * tp
+        q = q.reshape(b, sf, nql, d)
+        k = k.reshape(b, sf, nkvl, d)
+        v = v.reshape(b, sf, nkvl, d)
+        q = scope_capture("qkv_q", q, layer_id)
+        k = scope_capture("qkv_k", k, layer_id)
+        v = scope_capture("qkv_v", v, layer_id)
+        if cfg.qk_layernorm:
+            q = rms_norm(q, p["q_ln_scale"], cfg.layernorm_epsilon)
+            k = rms_norm(k, p["k_ln_scale"], cfg.layernorm_epsilon)
+        if rope_cos is not None:
+            # Full-length tables: q/k carry the FULL sequence post-ring.
+            q = rotary.apply_rope(q, rope_cos, rope_sin)
+            k = rotary.apply_rope(k, rope_cos, rope_sin)
+        attn_out = dot_product_attention(
+            q, k, v, mask_type=cfg.attn_mask_type, attention_mask=None,
+            softmax_scale=None,
+            softmax_in_fp32=cfg.attention_softmax_in_fp32,
+            layer_id=layer_id)
+        attn_out = scope_capture("context", attn_out, layer_id)
+        out_kernel = _dist.apply("weight", p["out_kernel"],
+                                 layer_id).astype(dt)
+        ow = lax.dynamic_slice_in_dim(out_kernel, me * nql * d, nql * d,
+                                      axis=0)
+        out = matmul_reduce_scatter_manual(
+            attn_out.reshape(b, sf, nql * d), ow, tp, ov)
+        if "out_bias" in p:
+            out = out + p["out_bias"].astype(dt)
+        return out, None
     if overlap:
         # Fused call: one ring all-gather of x feeds both column-parallel
         # projections (two calls would move x around the ring twice).
+        # manual-ok: overlap gated by tp_overlap_eligible (False inside
+        # ambient manual regions; the pipeline takes tp_sharded above)
         q, kv = all_gather_matmul(
             x, (q_kernel.astype(cfg.compute_dtype),
                 kv_kernel.astype(cfg.compute_dtype)), ctx.shard_map_mesh)
@@ -235,6 +310,8 @@ def attention_forward(
                 "context_parallel=1 or drop the mask")
         comm = ("p2p_zigzag" if zigzag and zigzag_active(cfg, ctx)
                 else cfg.cp_comm_type)
+        # manual-ok: context_attention detects the ambient manual cp axis
+        # and runs its ring bodies directly there (no nested shard_map)
         attn_out = context_attention(
             q, k, v, ctx.shard_map_mesh, comm,
             causal=cfg.attn_mask_type == AttnMaskType.causal,
@@ -301,6 +378,7 @@ def attention_forward(
                 spec = P((DP_AXIS, EP_AXIS), None, TP_AXIS, None)
                 seg_spec = P((DP_AXIS, EP_AXIS), None)
                 if segment_ids is None:
+                    # manual-ok: use_flash requires `not in_manual` above
                     flash = jax.jit(shard_map_compat(
                         lambda q_, k_, v_: flash_attention(
                             q_, k_, v_, causal=causal,
@@ -311,6 +389,7 @@ def attention_forward(
                         out_specs=spec))
                     attn_out = flash(q, k, v)
                 else:
+                    # manual-ok: use_flash requires `not in_manual` above
                     flash = jax.jit(shard_map_compat(
                         lambda q_, k_, v_, s_: flash_attention(
                             q_, k_, v_, causal=causal,
@@ -341,6 +420,7 @@ def attention_forward(
     out_kernel = _dist.apply("weight", p["out_kernel"], layer_id)
     out_kernel = out_kernel.astype(cfg.compute_dtype)
     if overlap:
+        # manual-ok: same tp_overlap_eligible gate as the QKV ring above
         out = matmul_reduce_scatter(attn_out.reshape(b, s, nq * d),
                                     out_kernel, ctx.shard_map_mesh)
     else:

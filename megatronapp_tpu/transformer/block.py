@@ -70,12 +70,17 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                   layer_id=None, kv_cache=None, cache_index=None,
                   cache_positions=None, ctx=None,
                   zigzag: bool = False, segment_ids=None,
-                  page_table=None, active=None, chunk_counts=None):
+                  page_table=None, active=None, chunk_counts=None,
+                  tp_sharded: bool = False):
     """One transformer layer. x: [B,S,H] → ((out, new_cache), aux_losses).
 
     page_table/active: paged-KV decode (inference/paged_cache.py) —
     kv_cache is then the per-layer block pool and each batch row appends
-    at its own page-table position (see attention.py / mla.py)."""
+    at its own page-table position (see attention.py / mla.py).
+
+    tp_sharded: ambient-manual tp-sharded stage body (pp pipeline) — x is
+    the local [B, S/tp, H] seq chunk; norms/residuals run on it directly
+    (elementwise over seq) and the sublayers take their ring paths."""
     residual = x
     h = apply_norm(cfg.normalization, x, p["ln1_scale"], p.get("ln1_bias"),
                    cfg.layernorm_epsilon)
@@ -98,7 +103,7 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
         else:
             attn_out = mla_forward(
                 p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
-                layer_id=layer_id, ctx=ctx)
+                layer_id=layer_id, ctx=ctx, tp_sharded=tp_sharded)
             new_cache = None
     else:
         attn_out, new_cache = attention_forward(
@@ -107,7 +112,7 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
             cache_positions=cache_positions, layer_id=layer_id,
             ctx=ctx, zigzag=zigzag, segment_ids=segment_ids,
             page_table=page_table, active=active,
-            chunk_counts=chunk_counts)
+            chunk_counts=chunk_counts, tp_sharded=tp_sharded)
     # Tag for the 'selective_attn' remat policy (a no-op otherwise).
     attn_out = checkpoint_name(attn_out, "attn_out")
     x = residual + attn_out.astype(residual.dtype)
@@ -118,9 +123,10 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     aux = None
     if "moe" in p:
         mlp_out, aux = moe_forward(p["moe"], h, cfg, layer_id=layer_id,
-                                   ctx=ctx)
+                                   ctx=ctx, tp_sharded=tp_sharded)
     else:
-        mlp_out = mlp_forward(p["mlp"], h, cfg, layer_id=layer_id, ctx=ctx)
+        mlp_out = mlp_forward(p["mlp"], h, cfg, layer_id=layer_id, ctx=ctx,
+                              tp_sharded=tp_sharded)
     x = residual + mlp_out.astype(residual.dtype)
     # MegaScope 'system' perturbation + capture site between layers
     # (transformer_block.py:542-544).
@@ -203,8 +209,11 @@ def init_block_params(rng, cfg: TransformerConfig, num_layers: int = None):
 def block_forward(stacked_p, x: jnp.ndarray, cfg: TransformerConfig,
                   rope_cos=None, rope_sin=None, attention_mask=None,
                   layer_offset: int = 0, ctx=None, zigzag: bool = False,
-                  segment_ids=None):
-    """Run all stacked layers via lax.scan. Returns (x, moe_aux_sum)."""
+                  segment_ids=None, tp_sharded: bool = False):
+    """Run all stacked layers via lax.scan. Returns (x, moe_aux_sum).
+
+    tp_sharded: thread the ambient-manual tp-sharded stage-body path
+    through every layer (pp pipeline; see layer_forward)."""
     if getattr(cfg, "hetero_block_specs", None):
         if segment_ids is not None or zigzag:
             raise NotImplementedError(
@@ -222,7 +231,7 @@ def block_forward(stacked_p, x: jnp.ndarray, cfg: TransformerConfig,
         (h2, _), aux = layer_forward(
             layer_p, h, cfg, rope_cos, rope_sin, attention_mask,
             layer_id=lid, ctx=ctx, zigzag=zigzag,
-            segment_ids=segment_ids)
+            segment_ids=segment_ids, tp_sharded=tp_sharded)
         return h2, (aux if aux is not None
                     else jnp.zeros((), jnp.float32))
 

@@ -72,7 +72,7 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                 attention_mask: Optional[jnp.ndarray] = None,
                 layer_id=None, ctx=None, kv_cache=None, cache_index=None,
                 cache_positions=None, page_table=None, active=None,
-                chunk_counts=None):
+                chunk_counts=None, tp_sharded: bool = False):
     """kv_cache: optional (latent_cache [B, Smax, kv_lora_rank],
     kpe_cache [B, Smax, dpe]) — the COMPRESSED decode cache (the latent +
     shared roped key; reference MLA's defining cache shape). Returns
@@ -85,9 +85,18 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
 
     Decode recomputes k_nope/v from the cached latent via kv_up each step
     (the storage-optimal variant; weight absorption into q is a further
-    flop optimization)."""
+    flop optimization).
+
+    tp_sharded: ambient-manual tp-sharded stage body (see
+    transformer/attention.py docstring) — training path only."""
     from megatronapp_tpu.scope.disturbance import get_disturbance
     from megatronapp_tpu.scope.hooks import scope_capture
+    if tp_sharded:
+        if kv_cache is not None or attention_mask is not None:
+            raise NotImplementedError(
+                "tp-sharded MLA supports the plain training path only")
+        return _mla_forward_tp_sharded(p, x, cfg, rope_cos, rope_sin,
+                                       layer_id, ctx)
     _dist = get_disturbance()
 
     b, s, h = x.shape
@@ -235,6 +244,7 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
         if attention_mask is not None:
             raise NotImplementedError(
                 "MLA + explicit attention mask under cp is unsupported")
+        # manual-ok: context_attention detects the ambient manual cp axis
         out = context_attention(
             q_full, k_full, v, ctx.shard_map_mesh, cfg.cp_comm_type,
             causal=cfg.attn_mask_type == AttnMaskType.causal,
@@ -251,3 +261,102 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     out = out.reshape(b, s, nq * dv) @ _dist.apply(
         "weight", p["out_kernel"], layer_id).astype(dt)
     return (out, new_cache) if kv_cache is not None else out
+
+
+def _mla_forward_tp_sharded(p, x, cfg: TransformerConfig, rope_cos,
+                            rope_sin, layer_id, ctx):
+    """MLA with a tp-sharded residual stream inside the ambient full-manual
+    pipeline stage body (training path, no cache).
+
+    x: [B, S/tp, H] local seq chunk. The low-rank DOWN projections (q_down,
+    kv_down) have small replicated-output widths: each shard computes them
+    on its LOCAL rows only (FLOPs still cut tp×; wgrads are per-seq-chunk
+    partials the enclosing transpose psums). The UP projections carry the
+    head structure: q_up / kv_up run as ring all-gather-matmuls over
+    per-shard head slices, producing full-sequence activations with nq/tp
+    local heads. The tiny shared rope key k_pe is gathered explicitly
+    (collectives.all_gather_seq) and roped with full tables; the out-proj
+    ring reduce-scatters back to the local chunk."""
+    from jax import lax
+    from megatronapp_tpu.config.parallel_config import TP_AXIS
+    from megatronapp_tpu.parallel.collectives import all_gather_seq
+    from megatronapp_tpu.parallel.overlap import (
+        all_gather_matmul_manual, matmul_reduce_scatter_manual,
+    )
+    from megatronapp_tpu.scope.disturbance import get_disturbance
+    from megatronapp_tpu.scope.hooks import scope_capture
+    from megatronapp_tpu.config.transformer_config import (
+        PositionEmbeddingKind,
+    )
+    _dist = get_disturbance()
+
+    b, s, h = x.shape
+    nq = cfg.num_attention_heads
+    dqk, dpe, dv = cfg.qk_head_dim, cfg.qk_pos_emb_head_dim, cfg.v_head_dim
+    klat = cfg.kv_lora_rank
+    dt = cfg.compute_dtype
+    tp = ctx.tp
+    me = lax.axis_index(TP_AXIS)
+    ov = bool(getattr(cfg, "tp_comm_overlap", False))
+    nql = nq // tp
+    x = x.astype(dt)
+    sf = s * tp
+
+    dq = dqk + dpe
+    if "q_proj" in p:
+        qw = lax.dynamic_slice_in_dim(
+            _dist.apply("weight", p["q_proj"], layer_id).astype(dt),
+            me * nql * dq, nql * dq, axis=1)
+        q = all_gather_matmul_manual(x, qw, tp, ov)      # [B, Sf, nql*dq]
+    else:
+        q_lat = x @ p["q_down"].astype(dt)               # local rows
+        q_lat = rms_norm(q_lat, p["q_ln_scale"], cfg.layernorm_epsilon)
+        quw = lax.dynamic_slice_in_dim(p["q_up"].astype(dt),
+                                       me * nql * dq, nql * dq, axis=1)
+        q = all_gather_matmul_manual(q_lat, quw, tp, ov)
+    q = q.reshape(b, sf, nql, dq)
+    q_nope, q_pe = q[..., :dqk], q[..., dqk:]
+
+    kv = x @ _dist.apply("weight", p["kv_down"],
+                         layer_id).astype(dt)            # [B, S/tp, klat+dpe]
+    latent, k_pe = kv[..., :klat], kv[..., klat:]
+    latent = rms_norm(latent, p["kv_ln_scale"], cfg.layernorm_epsilon)
+
+    # kv_up rides a ring all-gather of the latent seq chunks; the shared
+    # rope key gathers explicitly (dpe-wide — negligible traffic).
+    kuw = lax.dynamic_slice_in_dim(p["kv_up"].astype(dt),
+                                   me * nql * (dqk + dv),
+                                   nql * (dqk + dv), axis=1)
+    kv_up = all_gather_matmul_manual(latent, kuw, tp, ov)
+    kv_up = kv_up.reshape(b, sf, nql, dqk + dv)
+    k_nope, v = kv_up[..., :dqk], kv_up[..., dqk:]
+    k_pe = all_gather_seq(k_pe, TP_AXIS, axis=1)         # [B, Sf, dpe]
+
+    if rope_cos is not None:
+        q_pe = rotary.apply_rope(q_pe, rope_cos, rope_sin)
+        k_pe = rotary.apply_rope(k_pe[:, :, None, :], rope_cos,
+                                 rope_sin)[:, :, 0]
+    k_pe = jnp.broadcast_to(k_pe[:, :, None, :], (b, sf, nql, dpe))
+
+    if cfg.position_embedding == PositionEmbeddingKind.yarn:
+        m = rotary.yarn_mscale(cfg.rope_scaling_factor,
+                               cfg.yarn_mscale_coeff)
+        q_nope = q_nope * m
+        k_nope = k_nope * m
+
+    q_full = jnp.concatenate([q_nope, q_pe], axis=-1)
+    k_full = jnp.concatenate([k_nope, k_pe], axis=-1)
+    q_full = scope_capture("qkv_q", q_full, layer_id)
+    k_full = scope_capture("qkv_k", k_full, layer_id)
+    v = scope_capture("qkv_v", v, layer_id)
+    scale = 1.0 / jnp.sqrt(jnp.float32(dqk + dpe))
+    out = dot_product_attention(
+        q_full, k_full, v, mask_type=cfg.attn_mask_type,
+        attention_mask=None, softmax_scale=scale,
+        softmax_in_fp32=cfg.attention_softmax_in_fp32)
+    out = scope_capture("context", out, layer_id)
+    ow = lax.dynamic_slice_in_dim(
+        _dist.apply("weight", p["out_kernel"], layer_id).astype(dt),
+        me * nql * dv, nql * dv, axis=0)
+    return matmul_reduce_scatter_manual(out.reshape(b, sf, nql * dv), ow,
+                                        tp, ov)

@@ -43,11 +43,17 @@ def init_mlp_params(rng, cfg: TransformerConfig, out_std: float,
 
 
 def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
-                ctx=None):
+                ctx=None, tp_sharded: bool = False):
     from megatronapp_tpu.scope.disturbance import get_disturbance
     from megatronapp_tpu.parallel.overlap import (
         all_gather_matmul, matmul_reduce_scatter, tp_overlap_eligible,
     )
+    if tp_sharded:
+        # Ambient-manual tp-sharded stage body (pp pipeline): x is this
+        # shard's [b, S/tp, H] seq chunk; fc1 runs as a ring all-gather-
+        # matmul on a local column slice, fc2 as a matmul-reduce-scatter
+        # on the matching row slice (parallel/overlap.py *_manual).
+        return _mlp_forward_tp_sharded(p, x, cfg, layer_id, ctx)
     _dist = get_disturbance()
     # Latency-hiding tp path (--tp-comm-overlap): fc1 column-parallel via
     # ring all-gather-matmul, fc2 row-parallel via matmul-reduce-scatter.
@@ -60,6 +66,8 @@ def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
     fc1_kernel = _dist.apply("weight", p["fc1_kernel"], layer_id)
     fc1_kernel = fc1_kernel.astype(cfg.compute_dtype)
     if overlap:
+        # manual-ok: overlap gated by tp_overlap_eligible (False inside
+        # ambient manual regions; the pipeline takes the tp_sharded path)
         y = all_gather_matmul(x, fc1_kernel, ctx.shard_map_mesh)
     else:
         y = x @ fc1_kernel
@@ -77,10 +85,79 @@ def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
     fc2_kernel = _dist.apply("weight", p["fc2_kernel"], layer_id)
     fc2_kernel = fc2_kernel.astype(cfg.compute_dtype)
     if overlap:
+        # manual-ok: same tp_overlap_eligible gate as fc1 above
         out = matmul_reduce_scatter(y, fc2_kernel, ctx.shard_map_mesh)
     else:
         out = y @ fc2_kernel
     if "fc2_bias" in p:
         out = out + p["fc2_bias"].astype(cfg.compute_dtype)
+    out = scope_capture("mlp2", out, layer_id)
+    return out
+
+
+def _mlp_forward_tp_sharded(p, x: jnp.ndarray, cfg: TransformerConfig,
+                            layer_id, ctx):
+    """MLP with a tp-SHARDED residual stream inside an ambient full-manual
+    region (the pp pipeline stage body).
+
+    Weights enter replicated (pipeline in_specs mention only pp) and each
+    tp shard slices its column/row block locally — the slice transpose
+    scatters the local wgrad into a zero full-size cotangent, which the
+    enclosing shard_map's transpose psums across tp into the full grad
+    (pipeline.py grad-axes bookkeeping). Gated activations shard the gate
+    and value halves SEPARATELY so each shard owns matching (gate, value)
+    column pairs — a contiguous slice of the packed [gate | value] fc1
+    would hand shard 0 only gate columns."""
+    from jax import lax
+    from megatronapp_tpu.config.parallel_config import TP_AXIS
+    from megatronapp_tpu.parallel.overlap import (
+        all_gather_matmul_manual, matmul_reduce_scatter_manual,
+    )
+    from megatronapp_tpu.scope.disturbance import get_disturbance
+    _dist = get_disturbance()
+    tp = ctx.tp
+    me = lax.axis_index(TP_AXIS)
+    overlap = bool(getattr(cfg, "tp_comm_overlap", False))
+    dt = cfg.compute_dtype
+    x = x.astype(dt)
+    fc1_kernel = _dist.apply("weight", p["fc1_kernel"], layer_id).astype(dt)
+    gated = is_gated(cfg.activation)
+    f = p["fc2_kernel"].shape[0]
+    fl = f // tp
+
+    def colslice(w, start):
+        return lax.dynamic_slice_in_dim(w, start, fl, axis=1)
+
+    if gated:
+        wg = colslice(fc1_kernel, me * fl)
+        wv = colslice(fc1_kernel, f + me * fl)
+        yg, yv = all_gather_matmul_manual(x, (wg, wv), tp, overlap)
+        if "fc1_bias" in p:
+            b1 = p["fc1_bias"].astype(dt)
+            yg = yg + lax.dynamic_slice_in_dim(b1, me * fl, fl)
+            yv = yv + lax.dynamic_slice_in_dim(b1, f + me * fl, fl)
+        # Repack this shard's halves into the baseline's [gate | value]
+        # layout so 'mlp1' captures both halves and 'calculation' draws
+        # ONE disturbance per (site, layer), like every other path.
+        y = jnp.concatenate([yg, yv], axis=-1)
+        y = scope_capture("mlp1", y, layer_id)
+        y = _dist.apply("calculation", y, layer_id)
+        yg, yv = jnp.split(y, 2, axis=-1)
+        y = apply_activation(cfg.activation, yv, yg)
+    else:
+        w1 = colslice(fc1_kernel, me * fl)
+        y = all_gather_matmul_manual(x, w1, tp, overlap)
+        if "fc1_bias" in p:
+            y = y + lax.dynamic_slice_in_dim(p["fc1_bias"].astype(dt),
+                                             me * fl, fl)
+        y = scope_capture("mlp1", y, layer_id)
+        y = _dist.apply("calculation", y, layer_id)
+        y = apply_activation(cfg.activation, y)
+
+    fc2_kernel = _dist.apply("weight", p["fc2_kernel"], layer_id).astype(dt)
+    w2 = lax.dynamic_slice_in_dim(fc2_kernel, me * fl, fl, axis=0)
+    out = matmul_reduce_scatter_manual(y, w2, tp, overlap)
+    if "fc2_bias" in p:
+        out = out + p["fc2_bias"].astype(dt)
     out = scope_capture("mlp2", out, layer_id)
     return out

@@ -148,7 +148,8 @@ def _dropless_experts(p, x_flat, topk_idx, topk_probs,
 
 
 def moe_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
-                ctx=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                ctx=None, tp_sharded: bool = False
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """x: [B,S,H] → ([B,S,H], aux_loss scalar).
 
     ctx with ep > 1 selects the explicit all-to-all dispatch
@@ -157,7 +158,14 @@ def moe_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
     (core/transformer/moe/token_dispatcher.py). Without it, XLA's SPMD
     partitioner faces token-sharded ⇄ expert-sharded layout transitions
     it can only solve by full rematerialization (replicate + repartition
-    — the 'Involuntary full rematerialization' warnings)."""
+    — the 'Involuntary full rematerialization' warnings).
+
+    tp_sharded: the ambient manual region (pp pipeline stage body) runs
+    with the residual stream tp-SHARDED along the sequence — x is this
+    shard's [B, S/tp, H] chunk, each shard routes only its local tokens
+    (FLOPs cut tp×), and tp joins the token-splitting axes of the router
+    aux-stat pmean so the load-balance loss still matches the global
+    router exactly."""
     b, s, h = x.shape
     t = b * s
     e = cfg.num_moe_experts
@@ -193,10 +201,16 @@ def moe_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
     manual = current_manual_axes()
     if manual:
         from megatronapp_tpu.config.parallel_config import (
-            CP_AXIS, DP_AXIS, EP_AXIS,
+            CP_AXIS, DP_AXIS, EP_AXIS, TP_AXIS,
         )
         token_axes = tuple(a for a in (DP_AXIS, EP_AXIS, CP_AXIS)
                            if a in manual)
+        if tp_sharded:
+            # tp-sharded stage body: the sequence (hence tokens) splits
+            # over tp too — without this entry each shard's aux loss
+            # would combine LOCAL routing stats nonlinearly and drift
+            # from the global router.
+            token_axes = token_axes + (TP_AXIS,)
         if token_axes:
             stats_mean = lambda st: jax.lax.pmean(st, token_axes)  # noqa: E731
     topk_idx, topk_probs, aux = _router(p, x_flat, cfg,
@@ -375,6 +389,7 @@ def _a2a_expert_forward(p, x: jnp.ndarray, cfg: TransformerConfig, ctx
     from jax.sharding import PartitionSpec as P
     batch_axes = (DP_AXIS, EP_AXIS)
     x_spec = P(batch_axes, CP_AXIS if cp > 1 else None, None)
+    # manual-ok: _a2a_expert_forward is gated on `not current_manual_axes()`
     sm = shard_map_compat(
         body, ctx.shard_map_mesh,
         in_specs=(P(), P(EP_AXIS), P(EP_AXIS), x_spec),

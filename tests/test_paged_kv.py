@@ -367,35 +367,96 @@ class TestSamplingRNG:
         keys = np.asarray(_request_keys(seeds, rids, steps))
         assert not np.array_equal(keys[0], keys[1])
 
+    def test_sampler_streams_bitwise_reproducible(self):
+        """The seeded sampler is bitwise-deterministic and
+        batch-composition independent on FIXED logits: each row's draw
+        depends only on (seed, rid, step) — not on which other rows
+        share the batch, their order, or the batch size. This is the RNG
+        half of the old end-to-end seeded-stream test, pinned at the
+        boundary where determinism actually holds (see
+        test_seeded_runs_reproducible for why the engine half is
+        greedy)."""
+        from megatronapp_tpu.inference.dynamic_engine import _sample_batched
+        rng = np.random.default_rng(11)
+        logits = jnp.asarray(rng.normal(size=(3, 128)), jnp.float32)
+        seeds = jnp.asarray([123, 123, 7], jnp.int32)
+        rids = jnp.asarray([0, 1, 2], jnp.int32)
+        steps = jnp.asarray([0, 4, 2], jnp.int32)
+        temps = jnp.full((3,), 0.8, jnp.float32)
+        top_ks = jnp.full((3,), 20, jnp.int32)
+        top_ps = jnp.zeros((3,), jnp.float32)
+        greedys = jnp.zeros((3,), bool)
+
+        def sample(order):
+            o = jnp.asarray(order)
+            out = _sample_batched(logits[o], seeds[o], rids[o], steps[o],
+                                  temps, top_ks, top_ps, greedys)
+            return np.asarray(out)[np.argsort(order)].tolist()
+
+        base = sample([0, 1, 2])
+        assert base == sample([0, 1, 2])       # reproducible
+        assert base == sample([2, 0, 1])       # row-order independent
+        # Batch-size independence: each row alone draws the same token.
+        for i in range(3):
+            solo = _sample_batched(
+                logits[i:i + 1], seeds[i:i + 1], rids[i:i + 1],
+                steps[i:i + 1], temps[:1], top_ks[:1], top_ps[:1],
+                greedys[:1])
+            assert int(solo[0]) == base[i]
+        # Same (seed, step), different rid → distinct draw (the fold_in
+        # chain separates requests sharing a seed).
+        same = jnp.asarray([5, 5], jnp.int32)
+        two = _sample_batched(
+            jnp.tile(logits[:1], (2, 1)), same,
+            jnp.asarray([0, 1], jnp.int32), jnp.zeros((2,), jnp.int32),
+            temps[:2], top_ks[:2], top_ps[:2], greedys[:2])
+        assert int(two[0]) != int(two[1])
+
     def test_seeded_runs_reproducible(self):
-        """Same seeds → identical sampled streams across engine runs
-        (both backends), independent of batch composition."""
+        """Same request params → identical streams across engine runs
+        (both backends), independent of batch composition.
+
+        Streams are compared GREEDY. The historical flake here compared
+        sampled streams end-to-end, which couples the test to bitwise
+        logit stability across FRESH COMPILES of the step function — and
+        this XLA:CPU build does not provide that under load (measured:
+        rare single-token flips at Gumbel near-ties, same config, same
+        seed). No sampler-side tie-break can absorb that: for any
+        quantization grid the flip probability stays proportional to the
+        logit jitter (a jittered value near a grid boundary still
+        crosses it). Greedy streams only flip when the top-2 logit gap
+        is below the jitter (~1e-6 vs O(0.1) gaps here), and the seeded
+        RNG chain itself is pinned bitwise on fixed logits by
+        test_sampler_streams_bitwise_reproducible."""
         cfg = _gqa_cfg()
         params, _ = init_gpt_params(jax.random.PRNGKey(3), cfg)
         rng = np.random.default_rng(7)
         prompts = [rng.integers(0, 128, n).astype(np.int32)
                    for n in (5, 9)]
-        sampling = SamplingParams(temperature=0.8, top_k=20, seed=123)
+        greedy = SamplingParams(greedy=True)
 
-        def run(paged, max_batch):
-            eng = DynamicInferenceEngine(
+        def make(paged, max_batch):
+            return DynamicInferenceEngine(
                 params, cfg, max_batch=max_batch, max_seq_len=48,
                 prefill_buckets=(16,), paged=paged, block_size=8)
-            ids = [eng.add_request(p, 5, sampling) for p in prompts]
+
+        def run(eng):
+            ids = [eng.add_request(p, 5, greedy) for p in prompts]
             res = eng.run_to_completion()
             return [res[r].tolist() for r in ids]
 
-        a = run(False, 2)
-        assert a == run(False, 2)          # reproducible
-        assert a == run(False, 1)          # batch-composition independent
-        assert a == run(True, 2)           # backend independent
-        # Same prompt+seed but different request ids → distinct streams.
-        eng = DynamicInferenceEngine(
-            params, cfg, max_batch=2, max_seq_len=48,
-            prefill_buckets=(16,), paged=True, block_size=8)
-        i1 = eng.add_request(prompts[0], 5, sampling)
-        i2 = eng.add_request(prompts[0], 5, sampling)
-        res = eng.run_to_completion()
+        dense = make(False, 2)
+        a = run(dense)
+        assert a == run(dense)             # engine fully resets between runs
+        assert a == run(make(False, 1))    # batch-composition independent
+        paged = make(True, 2)
+        assert a == run(paged)             # backend independent, fresh engine
+        # Same prompt+seed but different request ids → distinct sampled
+        # streams (an inequality — robust to logit jitter).
+        sampling = SamplingParams(temperature=0.8, top_k=20, seed=123)
+        i1 = paged.add_request(prompts[0], 5, sampling)
+        i2 = paged.add_request(prompts[0], 5, sampling)
+        res = paged.run_to_completion()
         assert res[i1].tolist() != res[i2].tolist()
 
 

@@ -1,0 +1,420 @@
+"""tp-SHARDED pipeline stage bodies (ISSUE 5).
+
+Loss + grad parity of the full-manual pp pipeline running tp-sharded
+stage bodies (ring projections from parallel/overlap.py *_manual inside
+the ambient manual region) against the dense single-mesh reference —
+overlap on and off, across dense/GQA/gated/MoE/MLA layer types — plus
+2-step training parity (tp2 x pp2 and the tp2 x pp2 x dp2 DRYRUN), the
+mesh-independent seeded-init pin, eligibility fallbacks, the
+no-auto-collective check_vma gate, and the pp x tp A/B benchmark smoke.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.parallel_config import ParallelConfig
+from megatronapp_tpu.config.training_config import (
+    OptimizerConfig, TrainingConfig,
+)
+from megatronapp_tpu.config.transformer_config import (
+    ActivationKind, TransformerConfig,
+)
+from megatronapp_tpu.models.gpt import (
+    gpt_loss, gpt_pipeline_loss, init_gpt_params,
+)
+from megatronapp_tpu.parallel.mesh import build_mesh
+from megatronapp_tpu.parallel.overlap import tp_stage_eligible
+from megatronapp_tpu.parallel.pipeline import reshape_params_for_pipeline
+
+ATOL = 1e-5
+
+
+def _cfg(**kw):
+    d = dict(num_layers=4, hidden_size=64, num_attention_heads=4,
+             vocab_size=128, max_position_embeddings=64,
+             remat_policy="none", compute_dtype=jnp.float32,
+             tp_comm_overlap=True)
+    d.update(kw)
+    return TransformerConfig(**d)
+
+
+def _mesh(devices8, pp=2, tp=2, dp=1):
+    par = ParallelConfig(pipeline_parallel=pp, tensor_parallel=tp,
+                         data_parallel=dp)
+    return build_mesh(par, devices=devices8[:pp * tp * dp])
+
+
+def _data(M=4, mb=2, s=16, vocab=128):
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (M, mb, s), 0,
+                                vocab)
+    return tokens, jnp.roll(tokens, -1, axis=-1)
+
+
+def _pipeline_vs_dense(cfg, ctx, pp=2, vpp=1, M=4, mb=2, s=16):
+    rng = jax.random.PRNGKey(0)
+    p_flat, _ = init_gpt_params(rng, cfg)
+    p_pipe, _ = init_gpt_params(rng, cfg, pp=pp, vpp=vpp)
+    tokens, labels = _data(M, mb, s, cfg.vocab_size)
+    ref = float(jnp.mean(jnp.stack([
+        gpt_loss(p_flat, tokens[i], labels[i], None, cfg)[0]
+        for i in range(M)])))
+    with ctx.mesh:
+        loss, _ = jax.jit(lambda p, t, l: gpt_pipeline_loss(
+            p, t, l, None, cfg, ctx, vpp=vpp))(p_pipe, tokens, labels)
+    return float(loss), ref
+
+
+class TestTpShardedForward:
+    @pytest.mark.parametrize("overlap", [True, False])
+    def test_tp2_pp2_matches_dense(self, devices8, overlap):
+        """Ring (overlap) and bulk (no-overlap) tp-sharded stage bodies
+        both match the dense reference to 1e-5."""
+        cfg = _cfg(tp_comm_overlap=overlap)
+        ctx = _mesh(devices8)
+        assert tp_stage_eligible(cfg, ctx, 16)
+        loss, ref = _pipeline_vs_dense(cfg, ctx)
+        assert abs(loss - ref) < ATOL
+
+    def test_tp4_pp2_gqa_gated_qkln(self, devices8):
+        """tp=4 with GQA (nkv=4 -> 1 kv head/shard), swiglu gated fc1
+        (gate/value halves shard separately), qkv bias + qk layernorm."""
+        cfg = _cfg(activation=ActivationKind.swiglu, ffn_hidden_size=192,
+                   add_qkv_bias=True, qk_layernorm=True)
+        ctx = _mesh(devices8, pp=2, tp=4)
+        assert tp_stage_eligible(cfg, ctx, 16)
+        loss, ref = _pipeline_vs_dense(cfg, ctx)
+        assert abs(loss - ref) < ATOL
+
+    def test_moe_router_stats_stay_global(self, devices8):
+        """MoE layers route only local tokens per tp shard — the aux loss
+        must still equal the global router's (tp joins the stats pmean)."""
+        cfg = _cfg(num_moe_experts=4, moe_router_topk=2,
+                   moe_aux_loss_coeff=0.01, moe_z_loss_coeff=0.001)
+        ctx = _mesh(devices8)
+        loss, ref = _pipeline_vs_dense(cfg, ctx)
+        assert abs(loss - ref) < 2e-5
+
+    def test_mla_with_and_without_qlora(self, devices8):
+        for qlr in (None, 24):
+            cfg = _cfg(multi_latent_attention=True, q_lora_rank=qlr,
+                       kv_lora_rank=32, qk_head_dim=16,
+                       qk_pos_emb_head_dim=8, v_head_dim=16)
+            ctx = _mesh(devices8)
+            assert tp_stage_eligible(cfg, ctx, 16)
+            loss, ref = _pipeline_vs_dense(cfg, ctx)
+            assert abs(loss - ref) < ATOL, f"q_lora_rank={qlr}"
+
+    def test_vpp2_interleaved(self, devices8):
+        cfg = _cfg(num_layers=8)
+        ctx = _mesh(devices8)
+        loss, ref = _pipeline_vs_dense(cfg, ctx, vpp=2)
+        assert abs(loss - ref) < ATOL
+
+    def test_kill_switch_replicated_baseline(self, devices8):
+        """--no-tp-sharded-stage keeps the replicated body and still
+        matches (the A/B baseline the benchmark compares against)."""
+        cfg = _cfg(tp_sharded_stage=False)
+        ctx = _mesh(devices8)
+        assert not tp_stage_eligible(cfg, ctx, 16)
+        loss, ref = _pipeline_vs_dense(cfg, ctx)
+        assert abs(loss - ref) < ATOL
+
+    def test_ineligible_layouts_fall_back_and_match(self, devices8):
+        """Indivisible seq (S % tp != 0) silently keeps the replicated
+        body — correct, just redundant."""
+        cfg = _cfg()
+        ctx = _mesh(devices8)
+        assert not tp_stage_eligible(cfg, ctx, 15)
+        loss, ref = _pipeline_vs_dense(cfg, ctx, s=15)
+        assert abs(loss - ref) < ATOL
+
+    def test_fbd_abstract_mesh_ineligible(self, devices8):
+        """FBD half-meshes (abstract_collectives=True) keep the proven
+        tp-replicated body — same exclusion as tp_overlap_eligible."""
+        cfg = _cfg()
+        ctx = _mesh(devices8)
+        assert tp_stage_eligible(cfg, ctx, 16)
+        ctx.abstract_collectives = True
+        assert not tp_stage_eligible(cfg, ctx, 16)
+
+
+class TestTpShardedGrads:
+    @pytest.mark.parametrize("overlap", [True, False])
+    def test_tp2_pp2_grads_match_dense(self, devices8, overlap):
+        """Full grad parity through the tp-sharded stage body: the
+        slice-local partial wgrads must assemble through the enclosing
+        shard_map transpose's tp psum (the new grad-axes entry)."""
+        cfg = _cfg(tp_comm_overlap=overlap)
+        pp, M, mb, s = 2, 4, 1, 16
+        ctx = _mesh(devices8)
+        rng = jax.random.PRNGKey(0)
+        p_flat, _ = init_gpt_params(rng, cfg)
+        p_pipe, _ = init_gpt_params(rng, cfg, pp=pp)
+        tokens, labels = _data(M, mb, s)
+
+        def dense_loss(p):
+            return jnp.mean(jnp.stack([
+                gpt_loss(p, tokens[i], labels[i], None, cfg)[0]
+                for i in range(M)]))
+
+        g_dense = jax.grad(dense_loss)(p_flat)
+        with ctx.mesh:
+            g_pipe = jax.jit(jax.grad(
+                lambda p: gpt_pipeline_loss(p, tokens, labels, None, cfg,
+                                            ctx)[0]))(p_pipe)
+        np.testing.assert_allclose(
+            np.asarray(g_dense["embedding"]["word"]),
+            np.asarray(g_pipe["embedding"]["word"]), atol=2e-4)
+        g_dense_block = reshape_params_for_pipeline(
+            g_dense["block"], pp=pp, vpp=1)
+        for leaf_d, leaf_p in zip(jax.tree.leaves(g_dense_block),
+                                  jax.tree.leaves(g_pipe["block"])):
+            np.testing.assert_allclose(np.asarray(leaf_d),
+                                       np.asarray(leaf_p), atol=2e-4)
+
+
+class TestTpShardedTraining:
+    def _train(self, cfg, par, devices, iters=2):
+        from tests.test_training import learnable_batches
+        from megatronapp_tpu.training.train import pretrain_gpt
+        ctx = build_mesh(par, devices=devices)
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                               seq_length=32, train_iters=iters,
+                               log_interval=1)
+        res = pretrain_gpt(cfg, par, train,
+                           OptimizerConfig(lr=1e-3, lr_decay_iters=iters),
+                           ctx=ctx,
+                           batch_iter=learnable_batches(32, 128, 8))
+        return res.losses
+
+    @pytest.mark.parametrize("overlap", [True, False])
+    def test_tp2_pp2_two_step_losses_match_single(self, devices8, overlap):
+        cfg_kw = dict(num_layers=4, hidden_size=64, num_attention_heads=4,
+                      vocab_size=128, max_position_embeddings=64,
+                      compute_dtype=jnp.float32, tp_comm_overlap=overlap)
+        ref = self._train(TransformerConfig(**cfg_kw), ParallelConfig(),
+                          devices8[:1])
+        got = self._train(TransformerConfig(**cfg_kw),
+                          ParallelConfig(pipeline_parallel=2,
+                                         tensor_parallel=2), devices8[:4])
+        np.testing.assert_allclose(got, ref, atol=ATOL)
+
+    def test_tp2_pp2_dp2_dryrun_two_step(self, devices8):
+        """Full 3D tp2 x pp2 x dp2 DRYRUN on the 8-device CPU mesh: the
+        tp-sharded stage body composes with the (dp, ep) microbatch
+        threading and dp grad reduction."""
+        cfg_kw = dict(num_layers=4, hidden_size=64, num_attention_heads=4,
+                      vocab_size=128, max_position_embeddings=64,
+                      compute_dtype=jnp.float32, tp_comm_overlap=True)
+        ref = self._train(TransformerConfig(**cfg_kw), ParallelConfig(),
+                          devices8[:1])
+        got = self._train(TransformerConfig(**cfg_kw),
+                          ParallelConfig(pipeline_parallel=2,
+                                         tensor_parallel=2,
+                                         data_parallel=2), devices8[:8])
+        np.testing.assert_allclose(got, ref, atol=ATOL)
+
+
+class TestMeshIndependentInit:
+    def test_seeded_init_matches_eager_on_cp_pp_mesh(self, devices8):
+        """Pin for the cp x pp init drift: setup_train_state's seeded
+        values must equal the eager single-device init on EVERY mesh.
+        Before the two-stage (replicated -> reshard) init, GSPMD
+        partitioning of the stacked threefry draws made the cp2 x pp2
+        mesh produce different kernels (~0.09 max leaf diff) — the
+        cp x pp train-loss drift vs single-device."""
+        from megatronapp_tpu.training.optimizer import get_optimizer
+        from megatronapp_tpu.training.train_state import setup_train_state
+        cfg = _cfg()
+        eager, _ = init_gpt_params(jax.random.PRNGKey(1234), cfg, pp=2)
+        opt = get_optimizer(OptimizerConfig(lr=1e-3), 10)
+        for par, nd in [
+                (ParallelConfig(pipeline_parallel=2, context_parallel=2), 4),
+                (ParallelConfig(pipeline_parallel=2, tensor_parallel=2), 4)]:
+            ctx = build_mesh(par, devices=devices8[:nd])
+            state, _, _ = setup_train_state(
+                jax.random.PRNGKey(1234),
+                lambda k: init_gpt_params(k, cfg, pp=2), opt, ctx)
+            for a, b in zip(jax.tree.leaves(state["params"]),
+                            jax.tree.leaves(eager)):
+                np.testing.assert_allclose(
+                    jax.device_get(a), np.asarray(b), atol=1e-7)
+
+
+class TestStageSpanTags:
+    def test_in_pipeline_ring_spans_carry_region_tag(self, devices8,
+                                                     tmp_path):
+        """Forward tp-overlap-* spans emitted from inside the pipeline
+        stage body are tagged region="pp-stage" (collectives.span_tags),
+        so merged traces can tell in-pipeline rings from top-level tp
+        overlap. (Backward-ring spans trace during transposition —
+        outside the tag context — and stay untagged; same jax-0.4.x
+        boundary as pp hop spans appearing forward-only.)"""
+        from megatronapp_tpu.trace.tracer import get_tracer
+        cfg = _cfg(num_layers=2)
+        ctx = _mesh(devices8)
+        rng = jax.random.PRNGKey(0)
+        p_pipe, _ = init_gpt_params(rng, cfg, pp=2)
+        tokens, labels = _data()
+        tracer = get_tracer()
+        tracer.configure(enabled=True, trace_dir=str(tmp_path), interval=1,
+                         continuous_iterations=1, granularity="full",
+                         mesh_ctx=ctx)
+        try:
+            tracer.iteration_begin(0)
+            with ctx.mesh:
+                loss, _ = jax.jit(lambda p, t, l: gpt_pipeline_loss(
+                    p, t, l, None, cfg, ctx))(p_pipe, tokens, labels)
+                jax.block_until_ready(loss)
+            jax.effects_barrier()
+            tracer.iteration_end(0, fence=loss)
+            recs = tracer.drain()
+        finally:
+            tracer.enabled = False
+        tp_spans = [r for r in recs if r["name"].startswith("tp-overlap")]
+        assert tp_spans, "tp-sharded stage body emitted no ring spans"
+        assert all(r["args"].get("region") == "pp-stage"
+                   for r in tp_spans)
+
+
+class TestParseTimeValidation:
+    """--tp-comm-overlap divisibility is rejected at parse time with a
+    clear message instead of a shard_map trace failure mid-step."""
+
+    def _parse(self, *extra):
+        from megatronapp_tpu.config.arguments import (
+            build_parser, configs_from_args,
+        )
+        args = build_parser().parse_args([
+            "--num-layers", "4", "--hidden-size", "66",
+            "--num-attention-heads", "6", "--seq-length", "32",
+            "--micro-batch-size", "1", "--global-batch-size", "1",
+            "--train-iters", "1", *extra])
+        return configs_from_args(args)
+
+    def test_indivisible_hidden_rejected(self):
+        with pytest.raises(ValueError, match="hidden-size.*not divisible"):
+            self._parse("--tensor-model-parallel-size", "4",
+                        "--tp-comm-overlap")
+
+    def test_indivisible_heads_with_pp_rejected(self):
+        # hidden 66 % 2 == 0 and heads*d = 66 % 2 == 0, but WHOLE heads
+        # (6 q / 3 kv groups... num_query_groups defaults to heads) do
+        # not split over tp=4 — only the pp>1 tp-sharded body needs that.
+        with pytest.raises(ValueError, match="WHOLE heads"):
+            self._parse("--hidden-size", "96",
+                        "--num-attention-heads", "6",
+                        "--num-query-groups", "2",
+                        "--tensor-model-parallel-size", "4",
+                        "--pipeline-model-parallel-size", "2",
+                        "--tp-comm-overlap")
+
+    def test_no_tp_sharded_stage_downgrades_cleanly(self):
+        model, _, _, _ = self._parse(
+            "--hidden-size", "96", "--num-attention-heads", "6",
+            "--num-query-groups", "2",
+            "--tensor-model-parallel-size", "4",
+            "--pipeline-model-parallel-size", "2",
+            "--tp-comm-overlap", "--no-tp-sharded-stage")
+        assert model.tp_comm_overlap and not model.tp_sharded_stage
+
+    def test_mla_heads_only_gated_under_pp_tp_shard(self):
+        """Dense MLA never routes through the GSPMD overlap rings, so
+        indivisible heads are fine at pp=1 — only the pp>1 tp-sharded
+        stage body slices whole MLA heads."""
+        mla = ["--multi-latent-attention", "--kv-lora-rank", "32",
+               "--qk-head-dim", "16", "--qk-pos-emb-head-dim", "8",
+               "--v-head-dim", "16", "--hidden-size", "96",
+               "--num-attention-heads", "6",
+               "--tensor-model-parallel-size", "4", "--tp-comm-overlap"]
+        model, _, _, _ = self._parse(*mla)          # pp=1: accepted
+        assert model.tp_comm_overlap
+        with pytest.raises(ValueError, match="WHOLE MLA heads"):
+            self._parse(*mla, "--pipeline-model-parallel-size", "2")
+        model, _, _, _ = self._parse(               # escape hatch
+            *mla, "--pipeline-model-parallel-size", "2",
+            "--no-tp-sharded-stage")
+        assert not model.tp_sharded_stage
+
+    def test_divisible_combo_passes(self):
+        model, _, _, _ = self._parse(
+            "--hidden-size", "64", "--num-attention-heads", "4",
+            "--tensor-model-parallel-size", "2",
+            "--pipeline-model-parallel-size", "2",
+            "--tp-comm-overlap")
+        assert model.tp_comm_overlap and model.tp_sharded_stage
+
+    def test_indivisible_seq_with_pp_rejected(self):
+        """The tp-sharded stage body shards the SEQUENCE over tp; an
+        indivisible --seq-length must fail at parse time like the head
+        checks do, not silently downgrade to the replicated body."""
+        bad = ["--hidden-size", "64", "--num-attention-heads", "4",
+               "--seq-length", "33",
+               "--tensor-model-parallel-size", "2",
+               "--pipeline-model-parallel-size", "2",
+               "--tp-comm-overlap"]
+        with pytest.raises(ValueError, match="shards the sequence"):
+            self._parse(*bad)
+        model, _, _, _ = self._parse(*bad, "--no-tp-sharded-stage")
+        assert model.tp_comm_overlap and not model.tp_sharded_stage
+
+
+class TestCheckVmaManualRegions:
+    def test_no_unaudited_gspmd_in_manual_region_modules(self):
+        from tools.check_vma import find_manual_region_violations
+        assert find_manual_region_violations() == [], (
+            "GSPMD construct inside a manual-region module without a "
+            "`manual-ok:` audit note — auto-collectives abort inside the "
+            "full-manual pipeline; guard on current_manual_axes and "
+            "annotate the guard")
+
+    def test_gate_catches_unannotated_construct(self, tmp_path):
+        """The gate actually fires: an unannotated nested shard_map in a
+        stage-body module is reported."""
+        import tools.check_vma as cv
+        mod_dir = tmp_path / "megatronapp_tpu" / "transformer"
+        mod_dir.mkdir(parents=True)
+        bad = mod_dir / "mlp.py"
+        bad.write_text("y = shard_map_compat(body, mesh)\n"
+                       "# manual-ok: guarded\n"
+                       "z = shard_map_compat(body, mesh)  # manual-ok: g\n")
+        old = cv.MANUAL_REGION_MODULES
+        cv.MANUAL_REGION_MODULES = ("megatronapp_tpu/transformer/mlp.py",)
+        try:
+            hits = cv.find_manual_region_violations(root=str(tmp_path))
+        finally:
+            cv.MANUAL_REGION_MODULES = old
+        assert [(h[0], h[1]) for h in hits] == [
+            ("megatronapp_tpu/transformer/mlp.py", 1)]
+
+
+class TestPpTpBenchmark:
+    def test_benchmark_reports_both_paths(self, devices8):
+        from tools.pp_tp_benchmark import run
+        # iters=3: the paired-ratio median is a true median, so a single
+        # scheduling burst on one round cannot drag the wall gate below
+        # threshold on the shared CI host.
+        res = run(tp=2, pp=2, batch=2, seq=64, hidden=128, layers=4,
+                  microbatches=4, iters=3, warmup=1, include_train=False)
+        assert res["sharded_eligible"]
+        assert res["fwd"]["replicated_ms"] > 0
+        assert res["fwd"]["sharded_ms"] > 0
+        # The DETERMINISTIC gate: tp2 must halve the per-device stage
+        # work in the compiled step (XLA cost model; ~1.99x measured —
+        # the pipeline's non-stage remainder keeps it under 2.0).
+        assert res["fwd"]["flops_ratio"] is not None
+        assert res["fwd"]["flops_ratio"] > 1.8
+        assert res["fwd_bwd"]["flops_ratio"] > 1.8
+        # Wall clock: the fwd+bwd step wins consistently on the CI host
+        # (1.55-1.9x observed). Pure-fwd at these tiny shapes is
+        # collective-sync dominated (the whole 45 MFLOP/device cut is
+        # ~5 ms of compute inside a ~100 ms step) and swings 0.6x-1.8x
+        # with invisible-neighbor noise — recorded, not asserted.
+        assert res["fwd_bwd"]["speedup"] > 1.1
+        assert res["loss_max_abs_diff"] < ATOL
+        assert res["logits_max_abs_diff"] < ATOL

@@ -16,9 +16,11 @@ Runs in tier-1 via tests/test_tp_overlap.py and standalone:
     python tools/check_vma.py          # exit 1 + report on violations
 """
 
+import io
 import os
 import re
 import sys
+import tokenize
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -48,15 +50,118 @@ APPROVED = {
 
 SCAN_DIRS = ("megatronapp_tpu",)
 
+# ---------------------------------------------------------------------------
+# Gate 2: no auto-collective may sneak into manual pipeline regions.
+#
+# The transformer stage-body modules execute INSIDE the full-manual pp/cp
+# pipeline shard_map (ISSUE 5 tp-sharded stage bodies). In there, any
+# GSPMD construct — a nested shard_map, a with_sharding_constraint, the
+# mesh-taking overlap wrappers — lowers through the partial-auto SPMD
+# path this XLA:CPU build aborts on (parallel/overlap.py design notes),
+# or silently replicates. Every region-creating / GSPMD-only call in
+# these modules must therefore be guarded by an ambient-manual check and
+# carry a `manual-ok:` annotation (on the call line or the line above)
+# naming the guard; unannotated calls fail tier-1.
+# ---------------------------------------------------------------------------
+
+MANUAL_REGION_MODULES = (
+    "megatronapp_tpu/transformer/block.py",
+    "megatronapp_tpu/transformer/mlp.py",
+    "megatronapp_tpu/transformer/attention.py",
+    "megatronapp_tpu/transformer/mla.py",
+    "megatronapp_tpu/transformer/moe.py",
+    "megatronapp_tpu/parallel/pipeline.py",
+)
+
+GSPMD_RE = re.compile(
+    r"\b(shard_map_compat\(|jax\.shard_map\b|with_sharding_constraint\b"
+    r"|NamedSharding\(|jax\.device_put\b|all_gather_matmul\("
+    r"|matmul_reduce_scatter\()")
+
+_ANNOT = "manual-ok:"
+
+
+def _strip_comments_and_strings(src: str, strip_comments: bool = True):
+    """Blank out comment and string-literal spans (tokenize-based) so the
+    gate regexes only ever see executable code: a docstring mentioning
+    ``all_gather_matmul(x, w)`` can't trip a phantom violation, and a
+    ``#`` inside an f-string can't truncate a real call out of view.
+    With ``strip_comments=False`` only strings are blanked — for reading
+    audit annotations out of real comments without a string containing
+    '# manual-ok:' spoofing one. Line count and positions are preserved.
+    Falls back to naive ``#`` splitting if the file doesn't tokenize
+    (syntax error mid-edit)."""
+    lines = src.splitlines(True)
+    buf = [list(l) for l in lines]
+
+    def blank(start, end):
+        (srow, scol), (erow, ecol) = start, end
+        for row in range(srow, erow + 1):
+            line = buf[row - 1]
+            a = scol if row == srow else 0
+            b = ecol if row == erow else len(line)
+            for c in range(a, min(b, len(line))):
+                if line[c] not in "\r\n":
+                    line[c] = " "
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if (tok.type == tokenize.COMMENT and strip_comments) \
+                    or tok.type == tokenize.STRING \
+                    or tokenize.tok_name[tok.type].startswith("FSTRING"):
+                blank(tok.start, tok.end)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        if strip_comments:
+            return [l.split("#", 1)[0] for l in lines]
+        return lines
+    return ["".join(l) for l in buf]
+
+
+def find_manual_region_violations(root: str = REPO_ROOT):
+    """Return [(relpath, lineno, snippet), ...] for GSPMD constructs in
+    the manual stage-body modules lacking a `manual-ok:` audit note."""
+    out = []
+    for rel in MANUAL_REGION_MODULES:
+        path = os.path.join(root, rel)
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        lines = src.splitlines(True)
+        code_lines = _strip_comments_and_strings(src)
+        # String-blanked but comment-kept: annotations are only read out
+        # of REAL comments ('# manual-ok:' inside a string can't spoof).
+        note_lines = _strip_comments_and_strings(src, strip_comments=False)
+        for i, raw in enumerate(lines, 1):
+            # The *_manual ambient primitives are the approved in-region
+            # spellings; GSPMD_RE requires '(' right after the bare name,
+            # so they never match.
+            code = code_lines[i - 1]
+            if not GSPMD_RE.search(code):
+                continue
+            noted = note_lines[i - 1]
+            here = noted.split("#", 1)[1] if "#" in noted else ""
+            annotated = _ANNOT in here
+            # Walk the contiguous comment block directly above the call.
+            j = i - 2
+            while not annotated and j >= 0:
+                stripped = note_lines[j].strip()
+                if not stripped.startswith("#"):
+                    break
+                annotated = _ANNOT in stripped
+                j -= 1
+            if annotated:
+                continue
+            out.append((rel, i, raw.strip()))
+    return out
+
 
 def _code_lines(path):
-    """Yield (lineno, line) with comments stripped; skips docstring-only
-    mentions conservatively by requiring a call-shaped `lax.<name>` (the
-    regex matches the identifier — docstrings citing ``psum`` without the
-    lax. prefix never trip it)."""
+    """Yield (lineno, line) with comments and string literals stripped
+    (see _strip_comments_and_strings) — docstrings citing collectives
+    never trip the gate, strings can't hide code."""
     with open(path, encoding="utf-8") as f:
-        for i, line in enumerate(f, 1):
-            yield i, line.split("#", 1)[0]
+        src = f.read()
+    for i, line in enumerate(_strip_comments_and_strings(src), 1):
+        yield i, line
 
 
 def find_violations(root: str = REPO_ROOT):
@@ -81,15 +186,26 @@ def find_violations(root: str = REPO_ROOT):
 
 def main():
     violations = find_violations()
-    if not violations:
+    region = find_manual_region_violations()
+    if not violations and not region:
         print("check_vma: OK — all raw manual collectives live in "
-              f"{len(APPROVED)} approved modules")
+              f"{len(APPROVED)} approved modules; no unaudited GSPMD "
+              f"construct in {len(MANUAL_REGION_MODULES)} manual-region "
+              "modules")
         return 0
-    print("check_vma: raw manual collectives outside the approved "
-          "modules (route through parallel/collectives.py or "
-          "parallel/overlap.py, or audit + allowlist):")
-    for rel, lineno, line in violations:
-        print(f"  {rel}:{lineno}: {line}")
+    if violations:
+        print("check_vma: raw manual collectives outside the approved "
+              "modules (route through parallel/collectives.py or "
+              "parallel/overlap.py, or audit + allowlist):")
+        for rel, lineno, line in violations:
+            print(f"  {rel}:{lineno}: {line}")
+    if region:
+        print("check_vma: GSPMD constructs inside manual-region modules "
+              "without a `manual-ok:` audit note (auto-collectives abort "
+              "inside the full-manual pipeline — guard on "
+              "current_manual_axes and annotate the guard):")
+        for rel, lineno, line in region:
+            print(f"  {rel}:{lineno}: {line}")
     return 1
 
 
