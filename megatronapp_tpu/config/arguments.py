@@ -271,6 +271,43 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     g.add_argument("--log-straggler", action="store_true")
     g.add_argument("--run-workload-inspector-server", action="store_true")
     g.add_argument("--workload-inspector-port", type=int, default=0)
+    # Graceful exit + heartbeat + local checkpoints (ISSUE 6; reference
+    # --exit-signal-handler / ft_integration / non_persistent ckpts).
+    g.add_argument("--exit-signal-handler", action="store_true",
+                   help="SIGTERM finishes the in-flight step, force-"
+                        "saves an emergency checkpoint (durable + local "
+                        "when configured) with resumable side state, "
+                        "and exits cleanly; the exit decision is agreed "
+                        "across processes")
+    g.add_argument("--exit-signal-handler-sigint", action="store_true",
+                   help="additionally catch SIGINT (^C) — implies "
+                        "--exit-signal-handler")
+    g.add_argument("--heartbeat-dir", default=None, metavar="DIR",
+                   help="write heartbeat.json (section + timestamp, "
+                        "atomic) for an external supervisor "
+                        "(ft_integration.read_heartbeat); also enables "
+                        "the in-process section-timeout watchdog")
+    g.add_argument("--ft-timeouts", default=None,
+                   metavar="SETUP,STEP,CKPT",
+                   help="heartbeat section timeouts in seconds (three "
+                        "comma-separated positive numbers, e.g. "
+                        "'600,180,600'); enables the watchdog even "
+                        "without --heartbeat-dir")
+    g.add_argument("--simulated-fault", default=None, metavar="KIND:DELAY",
+                   help="FT drill: schedule a simulated fault after "
+                        "DELAY seconds — 'hang' wedges the train loop "
+                        "(watchdog/supervisor must catch it), 'exit' "
+                        "hard-kills the process (exit code 42)")
+    g.add_argument("--non-persistent-save-interval", type=int,
+                   default=None, metavar="N",
+                   help="fast latest-only local checkpoint every N "
+                        "steps (LocalCheckpointManager .npz, atomic "
+                        "rename) — cheap enough for small N; restore "
+                        "prefers the freshest of (local, durable)")
+    g.add_argument("--non-persistent-ckpt-dir", default=None,
+                   metavar="DIR",
+                   help="directory for the local checkpoints (default: "
+                        "<--save>/non_persistent)")
 
     add_serving_args(ap)   # paged KV serving flags (ISSUE 3)
 
@@ -392,6 +429,69 @@ def _hetero_json(args):
         with open(path) as f:
             return f.read()
     return None
+
+
+def _parse_ft_timeouts(s: Optional[str]) -> Optional[tuple]:
+    """--ft-timeouts 'SETUP,STEP,CKPT' → (float, float, float), each > 0."""
+    if s is None:
+        return None
+    parts = str(s).split(",")
+    try:
+        vals = tuple(float(p) for p in parts)
+    except ValueError:
+        vals = ()
+    if len(vals) != 3 or any(v <= 0 for v in vals):
+        raise ValueError(
+            f"--ft-timeouts expects three positive comma-separated "
+            f"seconds 'SETUP,STEP,CKPT' (e.g. '600,180,600'), got {s!r}")
+    return vals
+
+
+def _parse_simulated_fault(s: Optional[str]) -> Optional[tuple]:
+    """--simulated-fault 'KIND:DELAY' → (kind, float delay >= 0)."""
+    if s is None:
+        return None
+    kind, sep, delay_s = str(s).partition(":")
+    try:
+        delay = float(delay_s) if sep else -1.0
+    except ValueError:
+        delay = -1.0
+    if kind not in ("hang", "exit") or delay < 0:
+        raise ValueError(
+            f"--simulated-fault expects 'KIND:DELAY' with KIND in "
+            f"(hang, exit) and DELAY >= 0 seconds, got {s!r}")
+    return kind, delay
+
+
+def _validate_ft_args(args) -> dict:
+    """Parse + validate the fault-tolerance flags; returns the
+    TrainingConfig field values (clear errors at startup, not a stack
+    trace hours into a run)."""
+    ft_timeouts = _parse_ft_timeouts(args.ft_timeouts)
+    simulated_fault = _parse_simulated_fault(args.simulated_fault)
+    npsi = args.non_persistent_save_interval
+    if npsi is not None and npsi <= 0:
+        raise ValueError(
+            f"--non-persistent-save-interval must be a positive step "
+            f"count, got {npsi}")
+    # The default-location policy (<--save>/non_persistent) lives in
+    # TrainingConfig.resolved_non_persistent_dir — here we only reject
+    # configs it cannot resolve, at parse time.
+    if npsi and not (args.non_persistent_ckpt_dir or args.save):
+        raise ValueError(
+            "--non-persistent-save-interval needs a directory: pass "
+            "--non-persistent-ckpt-dir or --save (the default is "
+            "<--save>/non_persistent)")
+    return dict(
+        exit_signal_handler=(args.exit_signal_handler
+                             or args.exit_signal_handler_sigint),
+        exit_signal_handler_sigint=args.exit_signal_handler_sigint,
+        heartbeat_dir=args.heartbeat_dir,
+        ft_timeouts=ft_timeouts,
+        simulated_fault=simulated_fault,
+        non_persistent_save_interval=npsi,
+        non_persistent_ckpt_dir=args.non_persistent_ckpt_dir,
+    )
 
 
 def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
@@ -588,6 +688,7 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
         rampup_batch_size=(tuple(args.rampup_batch_size)
                            if args.rampup_batch_size else None),
         sharded_init=args.sharded_init,
+        **_validate_ft_args(args),
         metrics_jsonl=args.metrics_jsonl,
         tensorboard_dir=args.tensorboard_dir,
         rerun_mode=args.rerun_mode,

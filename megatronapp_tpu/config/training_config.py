@@ -63,6 +63,45 @@ class TrainingConfig:
     # arguments.py:1795-1812): 'disabled' | 'validate_results'.
     rerun_mode: str = "validate_results"
     error_injection_rate: float = 0.0
+    # Graceful-exit signal handler (reference --exit-signal-handler /
+    # dist_signal_handler.py): SIGTERM finishes the in-flight step,
+    # force-saves an emergency checkpoint + side state, and exits
+    # cleanly; the exit decision is agreed across processes
+    # (training/signals.py should_exit). sigint additionally catches ^C.
+    exit_signal_handler: bool = False
+    exit_signal_handler_sigint: bool = False
+    # Heartbeat monitor with section timeouts (reference ft_integration:
+    # --heartbeat-dir writes heartbeat.json for an external supervisor;
+    # ft_timeouts = (setup, step, checkpointing) seconds for the
+    # in-process watchdog). Enabled when either is set.
+    heartbeat_dir: Optional[str] = None
+    ft_timeouts: Optional[tuple] = None
+    # FT drill fault: ("hang"|"exit", delay_s) — reference
+    # maybe_setup_simulated_fault. 'exit' hard-kills the process after
+    # delay; 'hang' wedges the train loop (the heartbeat watchdog and
+    # the external supervisor must catch it).
+    simulated_fault: Optional[tuple] = None
+    # Fast non-persistent local checkpoints (reference
+    # --non-persistent-save-interval / --non-persistent-ckpt-dir,
+    # LocalCheckpointManager): latest-only .npz every N steps for fast
+    # preemption restarts, independent of the durable Orbax saves.
+    # Restore prefers the freshest of (local, durable).
+    non_persistent_save_interval: Optional[int] = None
+    non_persistent_ckpt_dir: Optional[str] = None
+
+    def resolved_non_persistent_dir(self) -> Optional[str]:
+        """Where the local checkpoints live: the explicit dir, else
+        <save_dir>/non_persistent when local saves are enabled, else
+        None. The ONE home of the default-location policy (parse-time
+        validation in config/arguments.py and the train loop both use
+        it)."""
+        if self.non_persistent_ckpt_dir:
+            return self.non_persistent_ckpt_dir
+        if self.non_persistent_save_interval and self.save_dir:
+            import os
+            return os.path.join(self.save_dir, "non_persistent")
+        return None
+
     # Host-side straggler detector (reference --log-straggler).
     log_straggler: bool = False
     # Workload-inspector HTTP server (reference

@@ -62,19 +62,30 @@ from megatronapp_tpu.models.gpt import gpt_embed, gpt_head, gpt_rope_tables
 from megatronapp_tpu.transformer.block import layer_forward
 
 
+class DeadlineExceeded(RuntimeError):
+    """A request's deadline passed: rejected at admission, or aborted
+    mid-flight by the engine/stepper (its pool blocks are reclaimed on
+    the retire path like any finished request)."""
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request (reference inference_request.py analogue).
 
     priority: lower = more important; the paged backend preempts the
     highest (priority, request_id) running request when the block pool
-    is exhausted."""
+    is exhausted.
+
+    deadline_s: absolute time.monotonic() deadline; overdue requests are
+    aborted by step()'s expiry sweep (event key "expired") and their
+    cache/pool resources reclaimed."""
     request_id: int
     prompt: np.ndarray                  # [P] int32
     max_new_tokens: int
     sampling: SamplingParams
     eod_id: Optional[int] = None
     priority: int = 0
+    deadline_s: Optional[float] = None
     # Filled by the engine:
     slot: int = -1
     generated: list = dataclasses.field(default_factory=list)
@@ -408,7 +419,12 @@ class DynamicInferenceEngine:
     def add_request(self, prompt_tokens, max_new_tokens: int,
                     sampling: Optional[SamplingParams] = None,
                     eod_id: Optional[int] = None,
-                    priority: int = 0) -> int:
+                    priority: int = 0,
+                    deadline_s: Optional[float] = None) -> int:
+        import time as _time
+        if deadline_s is not None and _time.monotonic() >= deadline_s:
+            raise DeadlineExceeded(
+                "request deadline already expired at admission")
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError(
@@ -429,7 +445,7 @@ class DynamicInferenceEngine:
                     f"only {self.pool.num_blocks}")
         req = Request(next(self._ids), prompt, max_new_tokens,
                       sampling or SamplingParams(), eod_id=eod_id,
-                      priority=priority)
+                      priority=priority, deadline_s=deadline_s)
         self.waiting.append(req)
         self.requests[req.request_id] = req
         return req.request_id
@@ -460,6 +476,52 @@ class DynamicInferenceEngine:
             req.finished = True
             return "running"
         return None
+
+    def expire_overdue(self, now: Optional[float] = None) -> List[int]:
+        """Abort every request whose deadline passed (per-request SLO
+        enforcement): waiting ones leave the queue immediately; running
+        ones are marked finished, so the SAME step's retire pass
+        releases their slot and pool blocks. Returns the expired request
+        ids — step() reports them under events["expired"] so the server
+        driver can hand each a clean deadline error frame."""
+        import time as _time
+        if now is None:
+            now = _time.monotonic()
+        expired: List[int] = []
+
+        def overdue(r: Request) -> bool:
+            return (r.deadline_s is not None and not r.finished
+                    and now >= r.deadline_s)
+
+        # Snapshot the waiting deque tolerantly: the sweep runs on the
+        # stepper thread while submit() may append concurrently (deque
+        # iteration raises RuntimeError on mutation). Expiry is
+        # re-checked every step, so skipping one contended sweep is
+        # harmless — turning the race into a step failure is not.
+        for _ in range(4):
+            try:
+                overdue_waiting = [r for r in self.waiting if overdue(r)]
+                break
+            except RuntimeError:
+                continue
+        else:
+            overdue_waiting = []
+        for req in overdue_waiting:
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                # cancel()/abort_request on the driver thread removed it
+                # between the snapshot and here (same race guard as
+                # abort_request) — it is already being retired.
+                continue
+            req.finished = True
+            self._aborted.append(req)    # finish event fires this step
+            expired.append(req.request_id)
+        for req in self.slots:
+            if req is not None and overdue(req):
+                req.finished = True      # retired (blocks released) below
+                expired.append(req.request_id)
+        return expired
 
     def abort_all(self):
         """Drop ALL queued and running requests (server error recovery).
@@ -751,12 +813,15 @@ class DynamicInferenceEngine:
         all active slots → retire.
 
         Returns {"admitted": [ids], "tokens": [(id, tok)], "finished":
-        [ids], "preempted": [ids]} for this step."""
+        [ids], "preempted": [ids], "expired": [ids]} for this step
+        (expired ⊆ finished: deadline-overdue requests aborted by this
+        step's expiry sweep)."""
+        expired = self.expire_overdue()
         admitted = self._admit()
         events = {"admitted": [r.request_id for r in admitted],
                   "tokens": [(r.request_id, r.generated[-1])
                              for r in admitted],
-                  "finished": [], "preempted": []}
+                  "finished": [], "preempted": [], "expired": expired}
 
         if self.paged:
             events["preempted"] = [

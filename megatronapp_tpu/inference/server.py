@@ -40,10 +40,13 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from typing import Optional
+from megatronapp_tpu.inference.dynamic_engine import DeadlineExceeded
 from megatronapp_tpu.inference.engine import (
     SamplingParams, StaticInferenceEngine,
 )
+from megatronapp_tpu.utils import chaos
 
 
 class _ClientGone(Exception):
@@ -60,29 +63,64 @@ class DynamicBatchingDriver:
     generated token. cancel() aborts a request (waiting requests complete
     immediately; running ones retire on the next step, releasing their
     cache). The stepper is a daemon thread started on first submit and
-    parks on a condition variable whenever the engine has no work."""
+    parks on a condition variable whenever the engine has no work.
 
-    def __init__(self, engine):
+    Self-healing (ISSUE 6): per-request deadlines (submit timeout_s —
+    expired work is rejected at admission, overdue in-flight work is
+    aborted by the engine's expiry sweep and surfaces DeadlineExceeded);
+    a stepper watchdog (a failing engine.step broadcasts clean error
+    frames, reclaims the pool via abort_all, counts a restart, and backs
+    off exponentially on consecutive failures so a persistent fault
+    can't spin the thread hot); GET /healthz reports liveness, restart
+    count, and pool pressure."""
+
+    def __init__(self, engine, crash_backoff_base: float = 0.25,
+                 crash_backoff_cap: float = 5.0):
         self.engine = engine
         self._cv = threading.Condition()
         self._subs = {}     # rid -> {"cb": fn|None, "done": Event}
         self._errors = {}   # rid -> Exception from a failed step
         self._thread = None
         self.max_active = 0   # high-water concurrently-active slots
+        # Watchdog / restart accounting.
+        self.restarts = 0             # step failures survived
+        self.thread_restarts = 0      # stepper threads found dead
+        self.consecutive_failures = 0
+        self.deadline_expired = 0     # requests aborted past deadline
+        self.crash_backoff_base = crash_backoff_base
+        self.crash_backoff_cap = crash_backoff_cap
 
     def _ensure_thread(self):
         if self._thread is None or not self._thread.is_alive():
+            if self._thread is not None:
+                # A dead stepper thread (BaseException escape) is a
+                # restart-worthy event — account for it in /healthz.
+                self.thread_restarts += 1
             self._thread = threading.Thread(
                 target=self._loop, name="dynamic-engine-stepper",
                 daemon=True)
             self._thread.start()
 
     def submit(self, prompt_ids, max_new_tokens, sampling, eod_id=None,
-               token_cb=None, priority: int = 0):
+               token_cb=None, priority: int = 0,
+               timeout_s: Optional[float] = None):
+        """timeout_s: per-request deadline in seconds from now. Already-
+        expired work (timeout_s <= 0) is rejected at admission with
+        DeadlineExceeded — a clean error frame instead of queueing work
+        the client has given up on."""
+        deadline = None
+        if timeout_s is not None:
+            if timeout_s <= 0:
+                self.deadline_expired += 1
+                raise DeadlineExceeded(
+                    "request deadline expired at admission "
+                    f"(timeout_s={timeout_s})")
+            deadline = time.monotonic() + timeout_s
         with self._cv:
             rid = self.engine.add_request(prompt_ids, max_new_tokens,
                                           sampling, eod_id=eod_id,
-                                          priority=priority)
+                                          priority=priority,
+                                          deadline_s=deadline)
             done = threading.Event()
             self._subs[rid] = {"cb": token_cb, "done": done}
             self._ensure_thread()
@@ -104,6 +142,12 @@ class DynamicBatchingDriver:
         stepper-side error if the request's step failed."""
         err = self._errors.pop(rid, None)
         if err is not None:
+            # The request is dead either way: drop its engine-side
+            # record too. The step-failure path already popped it via
+            # abort_all (pop is a no-op then), but deadline-expired
+            # requests are only RETIRED by the step — without this pop
+            # every expiry would leak one Request in engine.requests.
+            self.engine.pop_request(rid)
             raise err
         req = self.engine.pop_request(rid)
         return None if req is None else req.tokens
@@ -114,8 +158,12 @@ class DynamicBatchingDriver:
                 while not self.engine.has_work:
                     self._cv.wait()
             try:
+                chaos.fire("stepper-step")
                 ev = self.engine.step()
+                self.consecutive_failures = 0
             except Exception as e:  # noqa: BLE001 — broadcast & reset
+                self.restarts += 1
+                self.consecutive_failures += 1
                 with self._cv:
                     for rid, sub in self._subs.items():
                         self._errors[rid] = e
@@ -127,10 +175,26 @@ class DynamicBatchingDriver:
                     # releases paged pool blocks too — clearing slots by
                     # hand would leak them and poison every later admit.
                     self.engine.abort_all()
+                # Crash-loop backoff: repeated step failures (a wedged
+                # compile cache, a persistent device fault) sleep
+                # exponentially instead of spinning hot; one success
+                # resets the clock.
+                time.sleep(min(self.crash_backoff_cap,
+                               self.crash_backoff_base *
+                               2 ** (self.consecutive_failures - 1)))
                 continue
             self.max_active = max(self.max_active, sum(
                 1 for r in self.engine.slots if r is not None))
             with self._cv:
+                # Deadline-expired requests get a clean error frame
+                # BEFORE the generic finished handling pops their sub
+                # (their pool blocks were reclaimed by the step's retire
+                # pass).
+                for rid in ev.get("expired", ()):
+                    if rid in self._subs:
+                        self.deadline_expired += 1
+                        self._errors[rid] = DeadlineExceeded(
+                            f"request {rid} aborted: deadline exceeded")
                 for rid, tok in ev["tokens"]:
                     sub = self._subs.get(rid)
                     if sub and sub["cb"] is not None:
@@ -142,6 +206,19 @@ class DynamicBatchingDriver:
                     sub = self._subs.pop(rid, None)
                     if sub:
                         sub["done"].set()
+
+    def stats(self) -> dict:
+        """Stepper health for GET /healthz."""
+        return {
+            "started": self._thread is not None,
+            "alive": self._thread is not None and self._thread.is_alive(),
+            "restarts": self.restarts,
+            "thread_restarts": self.thread_restarts,
+            "consecutive_failures": self.consecutive_failures,
+            "deadline_expired": self.deadline_expired,
+            "subscribers": len(self._subs),
+            "max_active": self.max_active,
+        }
 
 
 
@@ -179,10 +256,12 @@ class TextGenerationServer:
     # ------------------------------------------------------------------
     def _submit_and_wait(self, prompts, n, sampling,
                          cancel: Optional[threading.Event] = None,
-                         token_cb=None):
+                         token_cb=None, timeout_s: Optional[float] = None):
         """Driver path (dynamic engine): submit every prompt into the
         shared batch, wait for completion, detokenize. token_cb(rid, tok)
-        streams tokens of the FIRST prompt (WS contract)."""
+        streams tokens of the FIRST prompt (WS contract). timeout_s:
+        per-request deadline (expired work is rejected/aborted with a
+        clean error surfaced through the normal error paths)."""
         import numpy as np
         tok = self.engine.tokenizer
         assert tok is not None, "tokenizer required"
@@ -192,22 +271,35 @@ class TextGenerationServer:
             ids = np.asarray(tok.tokenize(prompt), np.int32)
             rid, done = self._driver.submit(
                 ids, n, sampling, eod_id=eod,
-                token_cb=token_cb if i == 0 else None)
+                token_cb=token_cb if i == 0 else None,
+                timeout_s=timeout_s)
             subs.append((ids, rid, done))
         texts = []
+        first_err = None
         for ids, rid, done in subs:
             while not done.wait(timeout=0.1):
                 if cancel is not None and cancel.is_set():
                     self._driver.cancel(rid)
                     done.wait(timeout=60)   # retires on the next step
                     break
-            toks = self._driver.result_tokens(rid)
+            try:
+                toks = self._driver.result_tokens(rid)
+            except Exception as e:  # noqa: BLE001 — re-raised after drain
+                # Drain EVERY rid before surfacing the error: bailing on
+                # the first failed prompt would leave the later prompts'
+                # results/errors in the driver and engine forever (each
+                # timed-out multi-prompt call would leak them all).
+                if first_err is None:
+                    first_err = e
+                continue
             if cancel is not None and cancel.is_set():
                 raise _ClientGone()
             new_ids = [] if toks is None else toks[len(ids):].tolist()
             if eod is not None and eod in new_ids:
                 new_ids = new_ids[: new_ids.index(eod)]
             texts.append(tok.detokenize(new_ids))
+        if first_err is not None:
+            raise first_err
         return texts
 
     # ------------------------------------------------------------------
@@ -218,13 +310,16 @@ class TextGenerationServer:
             prompts = req["prompts"]
             n = int(req.get("tokens_to_generate", 64))
             sampling = _sampling_from_request(req)
+            timeout_s = req.get("timeout_s")
+            timeout_s = None if timeout_s is None else float(timeout_s)
             loop = asyncio.get_running_loop()
 
             def run_api():
                 if self._driver is not None:
                     # Continuous batching: concurrent /api calls share
                     # the decode batch instead of queueing on the lock.
-                    return self._submit_and_wait(prompts, n, sampling)
+                    return self._submit_and_wait(prompts, n, sampling,
+                                                 timeout_s=timeout_s)
                 with self._gen_lock:
                     return self.engine.generate_text(prompts, n, sampling)
 
@@ -335,7 +430,10 @@ class TextGenerationServer:
 
                     return self._submit_and_wait(
                         prompts[:1], n, sampling, cancel=cancel,
-                        token_cb=driver_cb)
+                        token_cb=driver_cb,
+                        timeout_s=(float(req["timeout_s"])
+                                   if req.get("timeout_s") is not None
+                                   else None))
                 # Capture hooks are thread-local and baked in at trace
                 # time: activate in THIS worker thread and re-trace the
                 # engine around the toggle. The lock serializes against
@@ -475,12 +573,59 @@ class TextGenerationServer:
         return web.json_response(self.stats_snapshot())
 
     # ------------------------------------------------------------------
+    def health_snapshot(self) -> dict:
+        """GET /healthz payload: stepper liveness + restart accounting
+        (DynamicBatchingDriver watchdog) and pool pressure, so an
+        external orchestrator can probe the server without scraping
+        logs. status: 'ok' (healthy / static engine), 'degraded'
+        (stepper currently failing steps but self-healing), 'unhealthy'
+        (stepper thread dead — probe should restart the server)."""
+        out = {"status": "ok",
+               "engine": type(self.engine).__name__.replace(
+                   "InferenceEngine", "").lower()}
+        if self._driver is not None:
+            st = self._driver.stats()
+            out["stepper"] = st
+            out["restarts"] = st["restarts"] + st["thread_restarts"]
+            eng = self.engine
+            out["active"] = sum(1 for r in eng.slots if r is not None)
+            out["waiting"] = len(eng.waiting)
+            pool_stats = (eng.stats_snapshot().get("pool")
+                          if hasattr(eng, "stats_snapshot") else None)
+            if pool_stats is not None:
+                # One source of truth for the pool fields (the engine's
+                # /stats payload); only the pressure ratio is derived
+                # here.
+                pool_stats["pressure"] = round(
+                    pool_stats["blocks_in_use"] / pool_stats["num_blocks"],
+                    4)
+                out["pool"] = pool_stats
+            if st["started"] and not st["alive"]:
+                out["status"] = "unhealthy"
+            elif st["consecutive_failures"] > 0 and self.engine.has_work:
+                # Degraded = actively struggling. After a crash drains
+                # the queue (abort_all) the stepper is parked with
+                # nothing to fail on — an idle server must not stay
+                # 'degraded' forever and get pulled from rotation; the
+                # restart counters still record that it happened.
+                out["status"] = "degraded"
+        return out
+
+    async def handle_healthz(self, request):
+        from aiohttp import web
+        payload = self.health_snapshot()
+        return web.json_response(
+            payload, status=503 if payload["status"] == "unhealthy"
+            else 200)
+
+    # ------------------------------------------------------------------
     def build_app(self):
         from aiohttp import web
         app = web.Application()
         app.router.add_put("/api", self.handle_api)
         app.router.add_post("/api", self.handle_api)
         app.router.add_get("/stats", self.handle_stats)
+        app.router.add_get("/healthz", self.handle_healthz)
         app.router.add_get("/ws", self.handle_ws)
         return app
 

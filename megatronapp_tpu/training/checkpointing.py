@@ -11,12 +11,36 @@ overlaps writes with training (reference --async-save).
 
 from __future__ import annotations
 
+import json
+import logging
 import os
-from typing import Any, Optional
+import time
+import zipfile
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+from megatronapp_tpu.utils import chaos
+
+logger = logging.getLogger("megatronapp_tpu.checkpointing")
+
+
+def _any_process_failed(local_fail: bool) -> bool:
+    """Cluster-agreed failure flag (True when ANY process failed).
+
+    Orbax save/restore are collectives under multi-host: a rank that
+    retries (or walks back to a previous step) ALONE enters a barrier
+    no other rank will join and wedges the job — the same invariant as
+    the layout consistency check below and DistSignalHandler.should_exit.
+    Every retry/walk-back decision therefore all-gathers the local
+    failure flag first, so the ranks move to the next attempt together
+    (a rank whose own attempt succeeded discards it and rejoins).
+    Thin module-level wrapper over signals.any_process_flag (one shared
+    all-gather primitive) so tests can fake the agreement here."""
+    from megatronapp_tpu.training.signals import any_process_flag
+    return any_process_flag(local_fail)
 
 
 def _relayout_leaf(x: np.ndarray, target_shape: tuple,
@@ -105,7 +129,8 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, save_interval: Optional[int] = None,
-                 max_to_keep: int = 3, async_save: bool = True):
+                 max_to_keep: int = 3, async_save: bool = True,
+                 save_retries: int = 2, retry_backoff_s: float = 0.5):
         directory = os.path.abspath(directory)
         os.makedirs(directory, exist_ok=True)
         options = ocp.CheckpointManagerOptions(
@@ -115,6 +140,8 @@ class CheckpointManager:
         )
         self._mngr = ocp.CheckpointManager(directory, options=options)
         self._layout_path = os.path.join(directory, "layout.json")
+        self.save_retries = save_retries
+        self.retry_backoff_s = retry_backoff_s
 
     def save(self, step: int, state: Any, force: bool = False,
              layout: Optional[dict] = None) -> bool:
@@ -124,7 +151,6 @@ class CheckpointManager:
         shape guessing (reference resharding.py records the source
         parallelism the same way). A run directory holds one layout."""
         if layout is not None:
-            import json
             # The consistency check runs on EVERY process: if only rank 0
             # raised, the other ranks would enter the collective save and
             # hang waiting for it (multi-host checkpoint dirs are shared
@@ -141,18 +167,67 @@ class CheckpointManager:
                 with open(tmp, "w") as f:
                     json.dump(dict(layout), f)
                 os.replace(tmp, self._layout_path)
-        return self._mngr.save(
-            step, args=ocp.args.StandardSave(state), force=force)
+        # Bounded retry with backoff: a transient write failure (flaky
+        # shared filesystem, a surfaced async-save error from a previous
+        # step) must not kill a multi-hour run when the next attempt
+        # would succeed. Persistent failures still raise after the last
+        # attempt — silently dropping checkpoints would be worse. The
+        # retry decision is agreed across processes (_any_process_failed)
+        # and an agreed retry overwrites (force=True): a rank whose own
+        # attempt succeeded still holds a possibly-partial collective
+        # step and must rewrite it with the others.
+        last_err = None
+        retrying = False
+        for attempt in range(self.save_retries + 1):
+            try:
+                chaos.fire("checkpoint-save")
+                if retrying and step in self._mngr.all_steps():
+                    # This rank's previous attempt landed (another
+                    # rank's failed): the collective step is suspect —
+                    # drop it so the rewrite isn't refused (orbax
+                    # force=True does not overwrite on 0.7.x). Settle
+                    # the async finalize first: deleting a step whose
+                    # save is still in flight kills the finalize thread
+                    # and poisons the next wait().
+                    try:
+                        self._mngr.wait_until_finished()
+                    except Exception:  # noqa: BLE001 — it failed anyway
+                        pass
+                    self._mngr.delete(step)
+                result = self._mngr.save(
+                    step, args=ocp.args.StandardSave(state),
+                    force=force or retrying)
+                err = None
+            except Exception as e:  # noqa: BLE001 — retried, then re-raised
+                result, err = None, e
+            if not _any_process_failed(err is not None):
+                return result
+            last_err = err or last_err
+            if attempt >= self.save_retries:
+                break
+            retrying = True
+            delay = self.retry_backoff_s * (2 ** attempt)
+            logger.warning(
+                "checkpoint save at step %d failed%s; retry %d/%d in "
+                "%.2fs", step,
+                (f" ({type(err).__name__}: {err})" if err is not None
+                 else " on another process"),
+                attempt + 1, self.save_retries, delay)
+            time.sleep(delay)
+        if last_err is not None:
+            raise last_err
+        raise RuntimeError(
+            f"checkpoint save at step {step} failed on another process "
+            f"after {self.save_retries + 1} attempts")
 
     def _read_layout(self) -> Optional[dict]:
         if not os.path.exists(self._layout_path):
             return None
-        import json
         with open(self._layout_path) as f:
             return json.load(f)
 
     def restore(self, state_struct: Any, step: Optional[int] = None,
-                layout: Optional[dict] = None) -> Any:
+                layout: Optional[dict] = None, fallback: bool = True) -> Any:
         """Restore into the shardings of `state_struct`.
 
         Mesh-only layout changes (tp/dp/fsdp degree) reshard natively:
@@ -165,11 +240,50 @@ class CheckpointManager:
         relayouted host-side (metadata-driven when the saved dir has a
         layout.json and the caller passes its own `layout`; shape-driven
         fallback otherwise — see _relayout_leaf), and device_put into
-        the target shardings."""
-        if step is None:
-            step = self._mngr.latest_step()
-        if step is None:
+        the target shardings.
+
+        Corrupt/partial-step fallback (ISSUE 6): with `step=None` and
+        `fallback=True`, a step that fails to restore (truncated array
+        files from a crash mid-write, a half-deleted dir) is logged and
+        skipped, walking BACK to the previous saved step instead of
+        killing the resume — a preempted run restarts from the freshest
+        intact checkpoint. An explicit `step` restores exactly that step
+        (no walk-back). Raises the last error only when every saved step
+        fails."""
+        if step is not None:
+            return self._restore_at(step, state_struct, layout)
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if not steps:
             return None
+        last_err: Optional[Exception] = None
+        for s in steps:
+            try:
+                out = self._restore_at(s, state_struct, layout)
+                err = None
+            except Exception as e:  # noqa: BLE001 — log + walk back
+                if not fallback:
+                    raise
+                out, err = None, e
+            # Walk-back is agreed across processes: restore is a
+            # collective, so when ANY rank fails the step, every rank
+            # discards it and moves to the previous step together (one
+            # rank walking back alone would deadlock the others).
+            if not _any_process_failed(err is not None):
+                return out
+            last_err = err or last_err
+            logger.warning(
+                "checkpoint step %d failed to restore%s; falling back "
+                "to the previous saved step", s,
+                (f" ({type(err).__name__}: {err})" if err is not None
+                 else " on another process"))
+        if last_err is not None:
+            raise last_err
+        raise RuntimeError(
+            "every saved checkpoint step failed to restore on some "
+            "process")
+
+    def _restore_at(self, step: int, state_struct: Any,
+                    layout: Optional[dict] = None) -> Any:
         abstract = jax.tree.map(
             lambda x: (ocp.utils.to_shape_dtype_struct(x)
                        if hasattr(x, "dtype") else x),
@@ -180,14 +294,19 @@ class CheckpointManager:
             # the item handler yet; read the tree metadata directly.
             with ocp.StandardCheckpointer() as ck:
                 meta = ck.metadata(os.path.join(
-                    self._mngr.directory, str(step), "default"
-                )).item_metadata
+                    self._mngr.directory, str(step), "default"))
+            # Newer orbax wraps the tree (CheckpointMetadata
+            # .item_metadata); 0.7.x returns the tree itself.
+            meta = getattr(meta, "item_metadata", meta)
+        # Same version split for the manager path: newer orbax returns
+        # an object carrying .tree, 0.7.x the metadata tree directly.
+        saved_tree = getattr(meta, "tree", meta)
         # The metadata tree flattens containers differently (optax
         # namedtuples become lists), but leaf ORDER is isomorphic to the
         # target structure — compare/rebuild leaf-wise on the target
         # treedef.
         target_leaves, treedef = jax.tree.flatten(abstract)
-        saved_leaves = jax.tree.leaves(meta.tree)
+        saved_leaves = jax.tree.leaves(saved_tree)
         if len(saved_leaves) != len(target_leaves):
             # Structural change (different model/optimizer): let the
             # plain restore produce its descriptive error.
@@ -260,17 +379,56 @@ class LocalCheckpointManager:
     clique member's copy over the shared/local filesystem.
     """
 
+    # npz read failures a truncated/partial file can produce (a crash
+    # mid-save leaves a short zip; a crash mid-rename can leave either).
+    _CORRUPT_ERRS = (OSError, ValueError, KeyError, EOFError,
+                     zipfile.BadZipFile)
+
     def __init__(self, directory: str):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._path = os.path.join(
             self.directory, f"local_ckpt_p{jax.process_index()}.npz")
+        # A crash between np.savez and os.replace leaves a .tmp behind;
+        # it is by definition incomplete — drop it so it can never be
+        # mistaken for a checkpoint.
+        for leftover in (self._path + ".tmp", self._path + ".tmp.npz"):
+            if os.path.exists(leftover):
+                logger.warning(
+                    "local checkpoint: dropping leftover partial file %s",
+                    leftover)
+                os.unlink(leftover)
 
-    def save(self, step: int, state: Any):
+    @staticmethod
+    def _to_serializable(x: np.ndarray) -> Tuple[np.ndarray, Optional[str]]:
+        """np.savez silently degrades extension dtypes (ml_dtypes
+        bfloat16 & friends, numpy kind 'V') to raw void on load — the
+        bytes survive but the dtype is lost and jax.device_put rejects
+        the result. Store such leaves as a same-width uint VIEW plus the
+        dtype name in a sidecar (applied back on restore)."""
+        if x.dtype.kind != "V":
+            return x, None
+        uint = np.dtype(f"u{x.dtype.itemsize}")
+        return x.view(uint), x.dtype.name
+
+    def save(self, step: int, state: Any, extra: Optional[Dict] = None):
+        """extra: small JSON-able side state (consumed samples, rerun
+        state machine) persisted inside the npz alongside the leaves."""
+        chaos.fire("local-checkpoint-save")
         leaves, treedef = jax.tree.flatten(jax.device_get(state))
-        payload = {f"leaf_{i}": np.asarray(x)
-                   for i, x in enumerate(leaves)}
+        payload, dtypes = {}, {}
+        for i, x in enumerate(leaves):
+            arr, name = self._to_serializable(np.asarray(x))
+            payload[f"leaf_{i}"] = arr
+            if name is not None:
+                dtypes[str(i)] = name
         payload["__step__"] = np.asarray(step)
+        if dtypes:
+            payload["__dtypes__"] = np.frombuffer(
+                json.dumps(dtypes).encode(), np.uint8)
+        if extra is not None:
+            payload["__extra__"] = np.frombuffer(
+                json.dumps(extra).encode(), np.uint8)
         tmp = self._path + ".tmp"
         np.savez(tmp, **payload)
         # np.savez appends .npz to names without it.
@@ -281,19 +439,101 @@ class LocalCheckpointManager:
     def latest_step(self) -> Optional[int]:
         if not os.path.exists(self._path):
             return None
-        with np.load(self._path) as z:
-            return int(z["__step__"])
+        try:
+            with np.load(self._path) as z:
+                return int(z["__step__"])
+        except self._CORRUPT_ERRS as e:
+            logger.warning(
+                "local checkpoint %s is corrupt/partial (%s: %s); "
+                "ignoring it", self._path, type(e).__name__, e)
+            return None
 
-    def restore(self, state_struct: Any) -> Optional[Any]:
-        """Restore into the structure (and shardings) of state_struct."""
+    def restore(self, state_struct: Any,
+                return_extra: bool = False) -> Optional[Any]:
+        """Restore into the structure (and shardings) of state_struct.
+        A corrupt/partial file (truncated write, interrupted rename) is
+        logged and treated as missing — the caller falls back to the
+        durable checkpoint instead of crashing the restart path."""
         if not os.path.exists(self._path):
             return None
         leaves, treedef = jax.tree.flatten(state_struct)
-        with np.load(self._path) as z:
-            new_leaves = [z[f"leaf_{i}"] for i in range(len(leaves))]
-        restored = jax.tree.unflatten(treedef, new_leaves)
-        leaf_shardings = [getattr(x, "sharding", None) for x in leaves]
-        if all(s is not None for s in leaf_shardings):
-            restored = jax.device_put(
-                restored, jax.tree.unflatten(treedef, leaf_shardings))
-        return restored
+        try:
+            with np.load(self._path) as z:
+                new_leaves = [z[f"leaf_{i}"] for i in range(len(leaves))]
+                dtypes = (json.loads(bytes(z["__dtypes__"]))
+                          if "__dtypes__" in z else {})
+                extra = (json.loads(bytes(z["__extra__"]))
+                         if "__extra__" in z else None)
+        except self._CORRUPT_ERRS as e:
+            logger.warning(
+                "local checkpoint %s failed to load (%s: %s); "
+                "ignoring it", self._path, type(e).__name__, e)
+            return None
+        try:
+            for i, name in dtypes.items():
+                new_leaves[int(i)] = new_leaves[int(i)].view(np.dtype(name))
+            restored = jax.tree.unflatten(treedef, new_leaves)
+            leaf_shardings = [getattr(x, "sharding", None) for x in leaves]
+            if all(s is not None for s in leaf_shardings):
+                restored = jax.device_put(
+                    restored, jax.tree.unflatten(treedef, leaf_shardings))
+        except Exception as e:  # noqa: BLE001 — stale layout → durable path
+            # A local checkpoint from a different parallel layout (leaf
+            # shapes/shardings no longer match state_struct) is STALE,
+            # not fatal: the durable restore path relayouts natively —
+            # degrade to it instead of killing the restart.
+            logger.warning(
+                "local checkpoint %s incompatible with the current "
+                "state layout (%s: %s); ignoring it", self._path,
+                type(e).__name__, e)
+            return None
+        return (restored, extra) if return_extra else restored
+
+
+# ---- resumable side-state (consumed samples, rerun state machine) --------
+
+def write_side_state(directory: str, step: int, payload: Dict) -> None:
+    """Persist JSON side-state next to a durable checkpoint step (the
+    model/optimizer pytree lives in Orbax; the HOST-side training
+    bookkeeping — consumed samples = the data-stream position including
+    any _RowBuffer carry-over, rerun-state-machine state_dict — rides in
+    a per-step sidecar so a resume replays the exact stream position and
+    fault-classification statistics). Rank-0 write, atomic rename."""
+    if jax.process_index() != 0:
+        return
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"side_state_{step}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"step": step, **payload}, f)
+    os.replace(tmp, path)
+    # GC sidecars whose checkpoint step is gone (Orbax prunes step dirs
+    # to max_to_keep; without this a long run leaks one JSON per save).
+    # The just-written step is always kept — its (async) step dir may
+    # not exist yet.
+    import glob
+    import re
+    for old in glob.glob(os.path.join(directory, "side_state_*.json")):
+        m = re.fullmatch(r"side_state_(\d+)\.json", os.path.basename(old))
+        if m and int(m.group(1)) != step and \
+                not os.path.isdir(os.path.join(directory, m.group(1))):
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+
+
+def read_side_state(directory: str, step: int) -> Optional[Dict]:
+    """Side-state for a checkpoint step; None when absent or unreadable
+    (pre-side-state checkpoints resume through the derivation fallback
+    in training/train.py)."""
+    path = os.path.join(directory, f"side_state_{step}.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        logger.warning("side state %s unreadable (%s: %s); ignoring",
+                       path, type(e).__name__, e)
+        return None

@@ -33,6 +33,13 @@ class FTConfig:
     checkpointing_timeout: float = 600.0
     check_interval: float = 5.0
     heartbeat_dir: Optional[str] = None
+    # Floor between heartbeat FILE writes: beat() fires every training
+    # iteration, but sub-second steps must not hammer the (often
+    # shared) filesystem with a write+rename per step — the in-memory
+    # watchdog timestamp still updates on every beat, and supervisors
+    # read staleness at tens-of-seconds granularity. Section changes
+    # always write (they are rare and meaningful).
+    heartbeat_write_interval: float = 1.0
 
 
 class HeartbeatMonitor:
@@ -44,6 +51,7 @@ class HeartbeatMonitor:
         self.on_timeout = on_timeout or self._default_on_timeout
         self._section = "setup"
         self._last_beat = time.monotonic()
+        self._last_write = 0.0   # monotonic time of the last file write
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -60,7 +68,10 @@ class HeartbeatMonitor:
     def beat(self):
         with self._lock:
             self._last_beat = time.monotonic()
-        self._write_heartbeat()
+            throttled = (time.monotonic() - self._last_write
+                         < self.cfg.heartbeat_write_interval)
+        if not throttled:
+            self._write_heartbeat()
 
     def _timeout_for(self, section: str) -> float:
         return {"setup": self.cfg.setup_timeout,
@@ -102,6 +113,7 @@ class HeartbeatMonitor:
         with self._lock:
             payload = {"section": self._section, "ts": time.time(),
                        "pid": os.getpid()}
+            self._last_write = time.monotonic()
         with open(tmp, "w") as f:
             json.dump(payload, f)
         os.replace(tmp, path)

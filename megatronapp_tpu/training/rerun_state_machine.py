@@ -24,6 +24,8 @@ import enum
 import math
 from typing import Any, Callable, Dict, Optional
 
+from megatronapp_tpu.utils import chaos
+
 
 class RerunDiagnostic(enum.Enum):
     """Classification of a validation failure (reference diagnostics)."""
@@ -56,6 +58,11 @@ class RerunStateMachine:
                 self._step * self.error_injection_rate >= self._injected + 1:
             self._injected += 1
             loss = float("nan")  # injected fault for pipeline testing
+        if chaos.should_fire("step-nan"):
+            # Chaos-harness variant of the same injection point: armable
+            # deterministically (nth validation) instead of rate-based.
+            self._injected += 1
+            loss = float("nan")
         if self.mode == "disabled":
             return True, loss
         if not math.isfinite(loss):

@@ -1,8 +1,17 @@
 """Signal-based graceful exit.
 
 Parity with /root/reference/megatron/training/dist_signal_handler.py
-(--exit-signal-handler): install a SIGTERM/SIGINT handler that flips a flag;
-the train loop checks it each iteration, checkpoints, and exits cleanly.
+(--exit-signal-handler): install a SIGTERM (optionally SIGINT) handler
+that flips a flag; the train loop checks it each iteration, finishes the
+in-flight step, force-saves an emergency checkpoint, and exits cleanly.
+
+Multi-host safety (reference DistSignalHandler.signals_received does an
+all_gather of the flag): the EXIT DECISION must be agreed across
+processes — the emergency save is a collective, so one rank entering it
+while the others keep training deadlocks the job. `should_exit()`
+all-gathers the local flag and exits when ANY rank received the signal
+(max-reduce semantics), so a preemption notice delivered to a single
+host still drains the whole job.
 """
 
 from __future__ import annotations
@@ -11,12 +20,42 @@ import signal
 import threading
 from typing import Iterable
 
+import numpy as np
+
+
+def any_process_flag(local: bool) -> bool:
+    """Cluster-agreed boolean: True when ANY process's local flag is
+    set (all-gather MAX). The shared primitive behind every collective
+    go/no-go decision — graceful exit (should_exit), checkpoint save
+    retry and restore walk-back (training/checkpointing.py) — where one
+    rank acting alone on local information would enter (or skip) a
+    collective the others don't, deadlocking the job. Collective under
+    multi-host: every rank must call it at the same point. Plain local
+    check on a single process."""
+    import jax
+    if jax.process_count() <= 1:
+        return local
+    from jax.experimental import multihost_utils
+    flags = np.asarray(multihost_utils.process_allgather(
+        np.asarray([local])))
+    return bool(flags.any())
+
 
 class DistSignalHandler:
     def __init__(self, signals: Iterable[int] = (signal.SIGTERM,)):
         self._signals = tuple(signals)
         self._received = threading.Event()
         self._prev = {}
+
+    @classmethod
+    def for_config(cls, sigint: bool = False) -> "DistSignalHandler":
+        """Handler for the train loop: SIGTERM always (the preemption
+        notice), SIGINT opt-in (--exit-signal-handler-sigint — lets an
+        interactive ^C drain through the same emergency-save path)."""
+        sigs = [signal.SIGTERM]
+        if sigint:
+            sigs.append(signal.SIGINT)
+        return cls(sigs)
 
     def __enter__(self):
         for sig in self._signals:
@@ -32,4 +71,12 @@ class DistSignalHandler:
         self._received.set()
 
     def signals_received(self) -> bool:
+        """This process's local flag (no collective)."""
         return self._received.is_set()
+
+    def should_exit(self) -> bool:
+        """Cluster-agreed exit decision: True when ANY process received
+        an exit signal. Collective under multi-host (every rank must
+        call it at the same point each iteration — the train loop does);
+        plain local check on a single process."""
+        return any_process_flag(self._received.is_set())

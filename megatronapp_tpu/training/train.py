@@ -8,7 +8,9 @@ save/resume, MegaScan tracing hooks, NaN-skip accounting.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import threading
 import time
 from typing import Any, Callable, Dict, Iterator, Optional
 
@@ -26,7 +28,10 @@ from megatronapp_tpu.models.gpt import (
     gpt_loss, gpt_pipeline_loss, init_gpt_params,
 )
 from megatronapp_tpu.parallel.mesh import MeshContext, build_mesh
-from megatronapp_tpu.training.checkpointing import CheckpointManager
+from megatronapp_tpu.training.checkpointing import (
+    CheckpointManager, LocalCheckpointManager, read_side_state,
+    write_side_state,
+)
 from megatronapp_tpu.training.optimizer import get_optimizer
 from megatronapp_tpu.training.train_state import setup_train_state
 from megatronapp_tpu.training.train_step import (
@@ -42,6 +47,42 @@ class TrainResult:
     losses: list
     tokens_per_sec: float
     step_time_ms: float
+    # Graceful signal exit fired (SIGTERM drained via emergency save).
+    interrupted: bool = False
+    # Data-stream position at exit (samples consumed incl. any resume).
+    consumed_samples: int = 0
+
+
+@contextlib.contextmanager
+def _signal_exit_context(train_cfg: TrainingConfig, log_fn):
+    """Install the graceful-exit signal handler for the duration of the
+    train loop (--exit-signal-handler). Python restricts signal.signal
+    to the main thread — off-main callers (e.g. a driver thread in
+    tests) run without it rather than crashing."""
+    if not train_cfg.exit_signal_handler:
+        yield None
+        return
+    if threading.current_thread() is not threading.main_thread():
+        log_fn("signals: --exit-signal-handler requires the main "
+               "thread; running without a signal handler")
+        yield None
+        return
+    from megatronapp_tpu.training.signals import DistSignalHandler
+    with DistSignalHandler.for_config(
+            sigint=train_cfg.exit_signal_handler_sigint) as handler:
+        yield handler
+
+
+def _emergency_side_state(step: int, consumed: int, rerun
+                          ) -> Dict[str, Any]:
+    """Resumable host-side bookkeeping persisted with every checkpoint:
+    `consumed` is the exact data-stream position (the _RowBuffer's
+    carry-over rows were fetched but NOT consumed, so recreating the
+    stream at `consumed` via batch_iter_factory replays them — no
+    samples dropped or double-consumed); `rerun` pins the fault-
+    classification statistics (EMA, step/injection counters)."""
+    return {"step": int(step), "consumed": int(consumed),
+            "rerun": rerun.state_dict()}
 
 
 def reshape_global_batch(batch: Dict[str, np.ndarray], num_micro: int
@@ -115,9 +156,59 @@ def pretrain_gpt(
 ) -> TrainResult:
     """End-to-end GPT pretraining loop. Returns final state + stats."""
     if parallel_cfg.forward_backward_disaggregating:
+        # The FBD executor path has no resilience wiring yet (ROADMAP
+        # follow-up) — say so loudly instead of silently dropping the
+        # protection the operator asked for.
+        if (train_cfg.exit_signal_handler or train_cfg.heartbeat_dir
+                or train_cfg.ft_timeouts
+                or train_cfg.non_persistent_save_interval
+                or train_cfg.simulated_fault):
+            log_fn("WARNING: fault-tolerance flags (--exit-signal-"
+                   "handler/--heartbeat-dir/--ft-timeouts/--non-"
+                   "persistent-save-interval/--simulated-fault) are "
+                   "NOT wired into the forward_backward_disaggregating "
+                   "path yet — running without them")
         return _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg,
                                  opt_cfg, batch_iter, log_fn,
                                  batch_iter_factory=batch_iter_factory)
+
+    # --- resilience wiring (ISSUE 6) ----------------------------------
+    # Heartbeat monitor with section timeouts (training/ft_integration):
+    # sections setup → step → checkpointing around the loop below; the
+    # on-disk heartbeat lets an external supervisor (read_heartbeat)
+    # catch a wedged process even when the in-process watchdog is hung
+    # with it.
+    ft = None
+    if train_cfg.heartbeat_dir or train_cfg.ft_timeouts:
+        from megatronapp_tpu.training.ft_integration import (
+            FTConfig, HeartbeatMonitor,
+        )
+        ft_cfg = FTConfig(heartbeat_dir=train_cfg.heartbeat_dir)
+        if train_cfg.ft_timeouts:
+            (ft_cfg.setup_timeout, ft_cfg.step_timeout,
+             ft_cfg.checkpointing_timeout) = train_cfg.ft_timeouts
+            ft_cfg.check_interval = min(5.0,
+                                        min(train_cfg.ft_timeouts) / 2)
+
+        def _on_timeout(section, idle):
+            log_fn(f"ft: section {section!r} hung for {idle:.0f}s "
+                   "(timeout exceeded) — rank appears wedged")
+
+        ft = HeartbeatMonitor(ft_cfg, on_timeout=_on_timeout).start()
+        ft.start_section("setup")
+    # Simulated fault for FT drills (--simulated-fault KIND:DELAY):
+    # 'exit' hard-kills the process after DELAY (inside ft_integration);
+    # 'hang' sets this event and the loop wedges on it — the watchdog /
+    # external supervisor must catch and recover.
+    sim_hang = threading.Event()
+    if train_cfg.simulated_fault:
+        from megatronapp_tpu.training.ft_integration import (
+            maybe_setup_simulated_fault,
+        )
+        kind, delay = train_cfg.simulated_fault
+        maybe_setup_simulated_fault(kind, delay, target=sim_hang.set)
+        log_fn(f"ft: simulated fault {kind!r} armed (fires in {delay}s)")
+
     if ctx is None:
         ctx = build_mesh(parallel_cfg)
     dp_total = ctx.dp * ctx.ep
@@ -155,26 +246,97 @@ def pretrain_gpt(
     if train_cfg.save_dir:
         ckpt = CheckpointManager(train_cfg.save_dir,
                                  save_interval=train_cfg.save_interval)
+    # Fast non-persistent local checkpoints (LocalCheckpointManager,
+    # --non-persistent-save-interval): latest-only .npz saved every few
+    # steps for quick preemption restarts, independent of the durable
+    # Orbax saves.
+    local_ckpt = None
+    if (train_cfg.non_persistent_save_interval
+            or train_cfg.non_persistent_ckpt_dir):
+        np_dir = train_cfg.resolved_non_persistent_dir()
+        if np_dir is None:
+            log_fn("local checkpoints disabled: pass "
+                   "--non-persistent-ckpt-dir or --save")
+        else:
+            local_ckpt = LocalCheckpointManager(np_dir)
     restore_dir = train_cfg.load_dir or train_cfg.save_dir
+    loader = None
     if restore_dir:
         if train_cfg.load_dir and train_cfg.load_dir != train_cfg.save_dir:
             loader = CheckpointManager(train_cfg.load_dir)
         else:
             loader = ckpt
-        restored = (loader.restore(state, layout=ckpt_layout)
-                    if loader is not None else None)
+    # Restore prefers the FRESHEST of (local, durable); a tie goes to
+    # the local copy (one flat read vs a tensorstore restore). A
+    # corrupt/partial local file degrades to the durable path, and a
+    # corrupt durable step walks back to the previous saved step
+    # (CheckpointManager.restore fallback).
+    side_state = None
+    restored = None
+    local_step = local_ckpt.latest_step if local_ckpt is not None else None
+    durable_step = loader.latest_step if loader is not None else None
+    # The restore paths are collectives under multi-host: every rank
+    # must take the SAME one (one rank entering the durable restore
+    # alone wedges the job — same invariant as the emergency-save
+    # agreement). Local wins only when EVERY rank prefers it, and a
+    # local-restore failure on ANY rank sends every rank to the durable
+    # path together. (Ranks whose local files sit at different steps
+    # would still diverge — per-rank local saves happen at the same
+    # iterations, so differing steps imply a torn save, which shows up
+    # as a corrupt/missing file and fails this agreement.)
+    from megatronapp_tpu.training.signals import any_process_flag
+    want_local = (local_step is not None
+                  and (durable_step is None or local_step >= durable_step))
+    if not any_process_flag(not want_local):
+        out = local_ckpt.restore(state, return_extra=True)
+        usable = out is not None
+        if jax.process_count() > 1:
+            # Bool agreement alone is step-BLIND: a rank whose earlier
+            # local save failed (best-effort warn-and-continue) holds a
+            # valid-but-STALE file, and with no durable checkpoint to
+            # outvote it the ranks would restore divergent steps.
+            # Gather the actual restored step (every rank participates
+            # — -1 for a failed local restore) and require unanimity.
+            from jax.experimental import multihost_utils
+            mine = (int(jax.device_get(out[0]["step"])) if usable
+                    else -1)
+            steps_all = np.asarray(multihost_utils.process_allgather(
+                np.asarray([mine])))
+            usable = bool((steps_all == steps_all.flat[0]).all()
+                          and steps_all.flat[0] >= 0)
+        if any_process_flag(not usable):
+            if out is not None:
+                log_fn("local checkpoint unusable or stale on another "
+                       "process; using the durable path")
+        else:
+            restored, side_state = out
+            log_fn(f"restoring from local checkpoint (step {local_step})")
+    if restored is None and loader is not None:
+        restored = loader.restore(state, layout=ckpt_layout)
         if restored is not None:
-            state = restored
-            start_step = int(jax.device_get(state["step"]))
-            log_fn(f"resumed from checkpoint at step {start_step}")
-        if loader is not None and loader is not ckpt:
-            loader.close()
+            side_state = read_side_state(
+                restore_dir, int(jax.device_get(restored["step"])))
+    if restored is not None:
+        state = restored
+        start_step = int(jax.device_get(state["step"]))
+        log_fn(f"resumed from checkpoint at step {start_step}")
+    if loader is not None and loader is not ckpt:
+        loader.close()
+    if side_state is not None and \
+            int(side_state.get("step", -1)) != start_step:
+        side_state = None    # sidecar from a different step: stale
 
     # Consumed-samples bookkeeping honors the rampup schedule on resume
     # (reference consumed_train_samples accumulates ACTUAL batch sizes).
-    consumed = 0
-    for _ in range(start_step):
-        consumed += batch_calc.get(consumed)[0]
+    # The checkpoint's side-state is authoritative when present (exact
+    # stream position incl. _RowBuffer carry-over); the O(start_step)
+    # schedule replay only runs for pre-side-state checkpoints.
+    if side_state is not None and "consumed" in side_state:
+        consumed = int(side_state["consumed"])
+    else:
+        consumed = 0
+        for _ in range(start_step):
+            consumed += batch_calc.get(consumed)[0]
     if batch_iter is None:
         # Fast-forward the data stream past already-consumed samples on
         # resume (reference consumed_train_samples bookkeeping) — via the
@@ -409,6 +571,12 @@ def pretrain_gpt(
     rerun.mode = train_cfg.rerun_mode
     rerun.loss_spike_factor = train_cfg.loss_spike_factor
     rerun.error_injection_rate = train_cfg.error_injection_rate
+    if side_state is not None and side_state.get("rerun"):
+        # Resume the fault-classification statistics exactly (EMA, step
+        # and injection counters); mode stays with THIS run's config.
+        sd = dict(side_state["rerun"])
+        sd.pop("mode", None)
+        rerun.load_state_dict(sd)
     straggler = get_straggler_detector()
     if train_cfg.log_straggler:
         straggler.enable()
@@ -436,8 +604,28 @@ def pretrain_gpt(
 
     last_sync_iter = start_step
     rows = _RowBuffer(batch_iter)
-    with ctx.mesh:
+    interrupted = False
+    # Exit-signal sync cadence: should_exit() is a host-level collective
+    # under multi-host (process_allgather) — running it every iteration
+    # would put a blocking sync point in the hot loop for an event that
+    # happens at most once. All ranks share the same schedule, so the
+    # agreement still holds; a preemption notice drains within 8 steps.
+    # Single-process keeps the cheap every-step local check.
+    exit_sync_every = 1 if jax.process_count() <= 1 else 8
+    if ft is not None:
+        ft.start_section("step")
+    with _signal_exit_context(train_cfg, log_fn) as sig, ctx.mesh:
         for it in range(start_step, train_cfg.train_iters):
+            if ft is not None:
+                ft.beat()
+            if sim_hang.is_set():
+                # FT drill: wedge the step section — heartbeats stop,
+                # the watchdog flags the hang, and the external
+                # supervisor (read_heartbeat) sees a stale file.
+                log_fn(f"ft: simulated hang at iteration {it + 1} — "
+                       "wedging the step section")
+                while True:          # pragma: no cover — drill only
+                    time.sleep(3600)
             tracer.iteration_begin(it)
             cur_gbs, cur_micro = batch_calc.get(consumed)
             # Rampup consumes exactly cur_gbs rows from the stream (each
@@ -565,14 +753,77 @@ def pretrain_gpt(
 
             if ckpt is not None and train_cfg.save_interval and \
                     (it + 1) % train_cfg.save_interval == 0:
+                if ft is not None:
+                    ft.start_section("checkpointing")
                 t_save = time.perf_counter()
                 ckpt.save(it + 1, jax.device_get(state),
                           layout=ckpt_layout)
+                write_side_state(
+                    train_cfg.save_dir, it + 1,
+                    _emergency_side_state(it + 1, consumed, rerun))
                 save_dt = time.perf_counter() - t_save
                 e2e.on_save_checkpoint(save_dt)
                 # Save dispatch time is reported under save_checkpoint_*,
                 # not the next train window.
                 window_start += save_dt
+                if ft is not None:
+                    ft.start_section("step")
+
+            if local_ckpt is not None and \
+                    train_cfg.non_persistent_save_interval and \
+                    (it + 1) % train_cfg.non_persistent_save_interval == 0:
+                if ft is not None:
+                    ft.start_section("checkpointing")
+                try:
+                    local_ckpt.save(
+                        it + 1, jax.device_get(state),
+                        extra=_emergency_side_state(it + 1, consumed,
+                                                    rerun))
+                except Exception as e:  # noqa: BLE001 — best-effort path
+                    log_fn(f"local checkpoint save failed at step "
+                           f"{it + 1} ({type(e).__name__}: {e}); "
+                           "continuing — local checkpoints are "
+                           "best-effort")
+                if ft is not None:
+                    ft.start_section("step")
+
+            # Graceful signal exit (--exit-signal-handler): the in-
+            # flight step above already finished; agree the decision
+            # across processes (one rank must never enter the collective
+            # emergency save alone), force-save durable + local
+            # checkpoints with resumable side state, and exit cleanly.
+            if sig is not None and (it + 1) % exit_sync_every == 0 \
+                    and sig.should_exit():
+                log_fn(f"signal: exit requested — emergency checkpoint "
+                       f"at iteration {it + 1}")
+                if ft is not None:
+                    ft.start_section("checkpointing")
+                t_save = time.perf_counter()
+                side = _emergency_side_state(it + 1, consumed, rerun)
+                if ckpt is not None:
+                    # A SIGTERM landing on a save-interval boundary
+                    # already has this step on disk — re-saving would
+                    # DELETE the just-written good checkpoint to rewrite
+                    # it (orbax refuses same-step saves) right inside
+                    # the preemption grace window.
+                    if ckpt.latest_step != it + 1:
+                        ckpt.save(it + 1, jax.device_get(state),
+                                  force=True, layout=ckpt_layout)
+                    write_side_state(train_cfg.save_dir, it + 1, side)
+                if local_ckpt is not None:
+                    try:
+                        local_ckpt.save(it + 1, jax.device_get(state),
+                                        extra=side)
+                    except Exception as e:  # noqa: BLE001 — best-effort
+                        log_fn(f"local emergency save failed "
+                               f"({type(e).__name__}: {e})")
+                if ckpt is not None:
+                    ckpt.wait()   # durability before exit
+                log_fn(f"signal: emergency save done in "
+                       f"{time.perf_counter() - t_save:.2f}s; exiting "
+                       "cleanly")
+                interrupted = True
+                break
 
             if train_cfg.exit_interval and \
                     (it + 1) % train_cfg.exit_interval == 0:
@@ -581,10 +832,17 @@ def pretrain_gpt(
     if ckpt is not None:
         final_step = int(jax.device_get(state["step"]))
         if train_cfg.save_interval and ckpt.latest_step != final_step:
+            if ft is not None:
+                ft.start_section("checkpointing")
             ckpt.save(final_step, jax.device_get(state), force=True,
                       layout=ckpt_layout)
+            write_side_state(
+                train_cfg.save_dir, final_step,
+                _emergency_side_state(final_step, consumed, rerun))
         ckpt.wait()
         ckpt.close()
+    if ft is not None:
+        ft.stop()
     if train_cfg.trace:
         tracer.finalize()
     if inspector is not None:
@@ -601,7 +859,9 @@ def pretrain_gpt(
 
     return TrainResult(state=state, losses=losses,
                        tokens_per_sec=tokens_per_sec,
-                       step_time_ms=step_time_ms)
+                       step_time_ms=step_time_ms,
+                       interrupted=interrupted,
+                       consumed_samples=consumed)
 
 
 def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
@@ -760,4 +1020,5 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
     return TrainResult(state=executor.state, losses=losses,
                        tokens_per_sec=tokens / max(dt, 1e-9),
                        step_time_ms=dt / max(
-                           train_cfg.train_iters - start_step, 1) * 1e3)
+                           train_cfg.train_iters - start_step, 1) * 1e3,
+                       consumed_samples=consumed)

@@ -1,0 +1,149 @@
+"""Chaos fault-injection registry (ISSUE 6).
+
+A small registry of NAMED fault-injection sites threaded through the
+hot paths that matter for resilience drills. Production code calls
+``fire(site)`` (raising sites) or ``should_fire(site)`` (boolean sites)
+at the injection point; tests ``arm()`` a site to make it misbehave a
+bounded number of times. The reference achieves the same ends with
+scattered mechanisms (RerunErrorInjector's --error-injection-rate,
+ft_integration's maybe_setup_simulated_fault); this registry gives them
+one front door and makes "every failure mode has a drill" testable.
+
+Design constraints:
+
+- **Zero-cost when disabled.** The disabled path is a single truthiness
+  check of a module-level dict (``if not _ARMED: return``) — no lookup,
+  no lock, no allocation — so the sites can live inside the train step
+  loop and the serving stepper without a measurable step-time change.
+- **Bounded.** An armed fault fires ``times`` times (after skipping the
+  first ``after`` hits) and then disarms itself: drills test recovery,
+  not permanent outage.
+- **Subprocess-friendly.** ``MEGATRON_CHAOS="site[:times[:after]],..."``
+  arms sites at import time, so subprocess drills (SIGTERM + resume,
+  crash-loop) need no code hooks in the child.
+
+Sites (each must be exercised by at least one test —
+tests/test_resilience.py pins this registry against its drill list):
+
+- ``checkpoint-save``        durable (Orbax) checkpoint write fails —
+                             exercises CheckpointManager's bounded
+                             retry-with-backoff.
+- ``local-checkpoint-save``  fast local .npz checkpoint write fails —
+                             exercises the train loop's warn-and-continue
+                             (local checkpoints are best-effort).
+- ``step-nan``               the step's loss is replaced with NaN at the
+                             validation point — same injection point as
+                             --error-injection-rate (the rerun state
+                             machine), armable deterministically.
+- ``stepper-step``           the serving stepper thread's engine.step()
+                             raises — exercises the DynamicBatchingDriver
+                             watchdog (error frames, pool reclaim,
+                             crash-loop backoff, restart accounting).
+
+Simulated whole-process faults (hang / exit) are flag-driven rather than
+registry-driven: --simulated-fault KIND:DELAY routes through
+training/ft_integration.maybe_setup_simulated_fault.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Dict, Optional
+
+SITES = (
+    "checkpoint-save",
+    "local-checkpoint-save",
+    "step-nan",
+    "stepper-step",
+)
+
+
+class ChaosFault(RuntimeError):
+    """The exception raised by an armed raising site."""
+
+
+@dataclasses.dataclass
+class _Fault:
+    times: int = 1      # remaining fires (then auto-disarm)
+    after: int = 0      # skip this many hits before the first fire
+    hits: int = 0
+
+
+_ARMED: Dict[str, _Fault] = {}
+_LOCK = threading.Lock()
+
+
+def arm(site: str, times: int = 1, after: int = 0) -> None:
+    """Arm `site` to fire `times` times, skipping the first `after`
+    hits. Raising sites raise ChaosFault; boolean sites return True."""
+    if site not in SITES:
+        raise ValueError(f"unknown chaos site {site!r}; known: {SITES}")
+    if times < 1 or after < 0:
+        raise ValueError("times must be >= 1 and after >= 0")
+    with _LOCK:
+        _ARMED[site] = _Fault(times=times, after=after)
+
+
+def disarm(site: Optional[str] = None) -> None:
+    """Disarm one site (or all when site is None)."""
+    with _LOCK:
+        if site is None:
+            _ARMED.clear()
+        else:
+            _ARMED.pop(site, None)
+
+
+def active() -> bool:
+    return bool(_ARMED)
+
+
+def _consume(site: str) -> bool:
+    with _LOCK:
+        f = _ARMED.get(site)
+        if f is None:
+            return False
+        f.hits += 1
+        if f.hits <= f.after:
+            return False
+        f.times -= 1
+        if f.times <= 0:
+            del _ARMED[site]
+        return True
+
+
+def should_fire(site: str) -> bool:
+    """Boolean sites (e.g. step-nan): True when the armed fault fires.
+    The disabled path is one dict truthiness check."""
+    if not _ARMED:
+        return False
+    return _consume(site)
+
+
+def fire(site: str) -> None:
+    """Raising sites (e.g. checkpoint-save): raises ChaosFault when the
+    armed fault fires. The disabled path is one dict truthiness check."""
+    if not _ARMED:
+        return
+    if _consume(site):
+        raise ChaosFault(f"chaos: injected fault at site {site!r}")
+
+
+def configure_from_env(value: Optional[str] = None) -> None:
+    """Arm sites from a spec string "site[:times[:after]],..." —
+    defaults to the MEGATRON_CHAOS environment variable, so subprocess
+    drills arm the child without code hooks."""
+    spec = value if value is not None else os.environ.get("MEGATRON_CHAOS")
+    if not spec:
+        return
+    for part in spec.split(","):
+        fields = part.strip().split(":")
+        if not fields[0]:
+            continue
+        times = int(fields[1]) if len(fields) > 1 else 1
+        after = int(fields[2]) if len(fields) > 2 else 0
+        arm(fields[0], times=times, after=after)
+
+
+configure_from_env()

@@ -31,6 +31,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: chaos fault-injection drills (tests/test_resilience.py) "
+        "— subprocess SIGTERM/hang/exit drills and fault-site exercises")
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()
