@@ -143,9 +143,20 @@ def _pp_tp_hook():
     return r if r.get("fwd") else None
 
 
+def _dist_opt_hook():
+    """ZeRO-1 distributed optimizer A/B (tools/dist_opt_benchmark.py) on
+    a dp2 CPU mesh — per-rank m/v state bytes, step-time ratio vs the
+    replicated baseline, and fp32/bf16-moments loss parity tracked round
+    over round like the other hooks."""
+    if os.environ.get("BENCH_DIST_OPT", "1") != "1":
+        return None
+    r = _run_child("--dist-opt", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("memory") else None
+
+
 def _attach_overlap_hooks(res):
-    """Attach the tp-overlap, cp/a2a, pp×tp, paged-kv, and spec-decode
-    A/B results to a round record."""
+    """Attach the tp-overlap, cp/a2a, pp×tp, dist-opt, paged-kv, and
+    spec-decode A/B results to a round record."""
     tpo = _tp_overlap_hook()
     if tpo:
         res.setdefault("extra", {})["tp_overlap"] = tpo
@@ -155,6 +166,9 @@ def _attach_overlap_hooks(res):
     ppt = _pp_tp_hook()
     if ppt:
         res.setdefault("extra", {})["pp_tp_overlap"] = ppt
+    dop = _dist_opt_hook()
+    if dop:
+        res.setdefault("extra", {})["dist_opt"] = dop
     pkv = _paged_kv_hook()
     if pkv:
         res.setdefault("extra", {})["paged_kv"] = pkv
@@ -229,6 +243,7 @@ def parent_main(local_only: bool = False):
     tpo = _tp_overlap_hook()
     cpa = _cp_a2a_hook()
     ppt = _pp_tp_hook()
+    dop = _dist_opt_hook()
     pkv = _paged_kv_hook()
     spd = _spec_decode_hook()
     last = _load_last_good()
@@ -253,6 +268,8 @@ def parent_main(local_only: bool = False):
             last["extra"]["cp_a2a"] = cpa
         if ppt:
             last["extra"]["pp_tp_overlap"] = ppt
+        if dop:
+            last["extra"]["dist_opt"] = dop
         if pkv:
             last["extra"]["paged_kv"] = pkv
         if spd:
@@ -269,6 +286,8 @@ def parent_main(local_only: bool = False):
             cpu.setdefault("extra", {})["cp_a2a"] = cpa
         if ppt:
             cpu.setdefault("extra", {})["pp_tp_overlap"] = ppt
+        if dop:
+            cpu.setdefault("extra", {})["dist_opt"] = dop
         if pkv:
             cpu.setdefault("extra", {})["paged_kv"] = pkv
         if spd:
@@ -378,6 +397,16 @@ def pp_tp_main():
     from tools.pp_tp_benchmark import run
     print(json.dumps(run(tp=2, pp=2, batch=2, seq=64, hidden=128,
                          layers=4, microbatches=4, iters=9, warmup=2)))
+
+
+def dist_opt_main():
+    """ZeRO-1 distributed-optimizer A/B child (CPU mesh env set by the
+    parent). hidden 256 / seq 32: the weight update is the subsystem
+    under test — keep its share of the step large enough that the
+    sharded-vs-replicated ratio is signal, not scheduler noise."""
+    from tools.dist_opt_benchmark import run
+    print(json.dumps(run(dp=2, batch=2, seq=32, hidden=256, layers=2,
+                         iters=9, warmup=2, train_steps=6)))
 
 
 def paged_kv_main():
@@ -518,6 +547,8 @@ if __name__ == "__main__":
         cp_a2a_main()
     elif "--pp-tp" in sys.argv:
         pp_tp_main()
+    elif "--dist-opt" in sys.argv:
+        dist_opt_main()
     elif "--paged-kv" in sys.argv:
         paged_kv_main()
     elif "--spec-decode" in sys.argv:

@@ -30,6 +30,22 @@ class OptimizerConfig:
     # bf16 grad all-reduce (reference --accumulate-allreduce-grads-in-fp32
     # inverse); we accumulate in fp32 by default.
     grad_reduce_in_fp32: bool = True
+    # ZeRO-1 distributed-optimizer mixed precision (reference
+    # --main-params-dtype / --exp-avg-dtype / --exp-avg-sq-dtype,
+    # precision-aware DistributedOptimizer): dtype of the fp32
+    # master-weight shard (kept only when params are lower precision)
+    # and of the stored Adam moments — update math stays fp32.
+    # 'fp32' | 'bf16' (and the long spellings); validated at parse time.
+    main_params_dtype: str = "fp32"
+    exp_avg_dtype: str = "fp32"
+    exp_avg_sq_dtype: str = "fp32"
+    # Collectives of the ZeRO-1 weight update: 'gspmd' lets XLA insert
+    # the grad slice / param all-gather from the dp-sharded state layout
+    # (arXiv 2004.13336); 'ring' runs the update full-manual with the
+    # latency-hiding ring all-gather from parallel/overlap.py; 'bulk'
+    # full-manual with one tiled all-gather (the A/B baseline ring is
+    # measured against).
+    dist_opt_comm: str = "gspmd"
 
 
 @dataclasses.dataclass

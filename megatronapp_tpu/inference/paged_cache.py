@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.utils import chaos
 
 
 def cdiv(a: int, b: int) -> int:
@@ -119,6 +120,10 @@ class PagedKVCache:
         if self._free:
             return self._free.popleft()
         if self._lru:
+            # Chaos site fires BEFORE the eviction mutates anything, so
+            # an injected fault leaves the allocator consistent and the
+            # caller's rollback (admit/_rollback) owns the cleanup.
+            chaos.fire("paged-evict")
             blk, _ = self._lru.popitem(last=False)   # least recently used
             key = self._hash_of.pop(blk, None)
             if key is not None and self._table.get(key) == blk:
@@ -141,6 +146,9 @@ class PagedKVCache:
                 self._free.append(blk)
 
     def _copy_block(self, src: int, dst: int):
+        # Chaos site fires before the copy: pages/stats untouched, the
+        # caller's rollback returns src's ref and dst to the pool.
+        chaos.fire("paged-cow")
         self.pages = tuple(p.at[:, dst].set(p[:, src]) for p in self.pages)
         self.stats["cow_copies"] += 1
 
@@ -188,22 +196,35 @@ class PagedKVCache:
             self._acquire_cached(blk)
         fresh_needed = need_total - len(hits) + (1 if cow else 0)
         fresh: List[int] = []
-        for _ in range(fresh_needed):
-            blk = self._take_free()
-            if blk is None:
-                for b in fresh:
-                    self._refcount[b] = 0
-                    self._free.append(b)
-                for b in hits:
-                    self._release_block(b)
-                return None
-            self._refcount[blk] = 1
-            fresh.append(blk)
+
+        def _rollback():
+            for b in fresh:
+                self._refcount[b] = 0
+                self._free.append(b)
+            for b in hits:
+                self._release_block(b)
+
+        # Exception-safe allocation: _take_free (eviction) and
+        # _copy_block (CoW) are fault-injection sites — a failure there
+        # must return every acquired ref/block, not leak them (the
+        # paged-evict / paged-cow drills audit() exactly this).
+        try:
+            for _ in range(fresh_needed):
+                blk = self._take_free()
+                if blk is None:
+                    _rollback()
+                    return None
+                self._refcount[blk] = 1
+                fresh.append(blk)
+            if cow:
+                src = hits[-1]
+                dst = fresh[0]
+                self._copy_block(src, dst)
+        except Exception:
+            _rollback()
+            raise
 
         if cow:
-            src = hits[-1]
-            dst = fresh[0]
-            self._copy_block(src, dst)
             self._release_block(src)
             blocks = hits[:-1] + [dst] + fresh[1:]
         else:

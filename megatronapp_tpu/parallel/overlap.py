@@ -69,6 +69,10 @@ from megatronapp_tpu.parallel.collectives import (
 # MegaScan span names (trace/tracer.py GRANULARITY_EVENTS 'collective').
 OVERLAP_COMPUTE_EVENT = "tp-overlap-compute"
 OVERLAP_PERMUTE_EVENT = "tp-overlap-permute"
+# The generic ring (ring_all_gather) serves the ZeRO-1 dp param return —
+# its spans must not book into the tp-overlap category (one permute
+# event name per axis domain, like cp-overlap-*/pp-overlap-*).
+DP_OVERLAP_PERMUTE_EVENT = "dp-overlap-permute"
 
 # Activation batch dims shard over (dp, ep) — mesh.py batch_spec.
 _BATCH = (DP_AXIS, EP_AXIS)
@@ -461,6 +465,45 @@ def tp_stage_eligible(cfg, ctx, seq_len: int) -> bool:
     if has_dense_mlp and cfg.ffn_hidden_size % tp:
         return False
     return True
+
+
+# ---------------------------------------------------------------------------
+# Generic ring all-gather: the ZeRO-1 distributed optimizer's param-return
+# path (training/distributed_optimizer.py manual_apply) rings updated
+# param shards around the dp axis the same way the tp rings move sequence
+# chunks — each hop is issued before the chunk lands in the accumulator so
+# hops ride under the writes (TPU async collectives; serial on XLA:CPU).
+# ---------------------------------------------------------------------------
+
+def ring_all_gather(x, axis_name: str, n: int, axis: int = 0,
+                    op_name: str = "ring-allgather"):
+    """[..., D/n, ...] shard → full [..., D, ...] via an n-hop ppermute
+    ring over ``axis_name``, rank-major chunk order (identical layout to
+    ``lax.all_gather(..., tiled=True)``). Callable from any full-manual
+    region whose mesh binds ``axis_name``; n == 1 is a no-op."""
+    if n == 1:
+        return x
+    me = lax.axis_index(axis_name)
+    chunk_len = x.shape[axis]
+    shape = list(x.shape)
+    shape[axis] = chunk_len * n
+    out = zeros_like_vma(tuple(shape), x.dtype, x)
+    perm = [(r, (r - 1) % n) for r in range(n)]
+    chunk = x
+    for step in range(n):
+        nxt = None
+        if step + 1 < n:
+            ring_span(DP_OVERLAP_PERMUTE_EVENT, "B", chunk, axis_name,
+                      op=op_name, step=step)
+            nxt = lax.ppermute(chunk, axis_name, perm)
+        owner = (me + step) % n     # global chunk index currently held
+        out = lax.dynamic_update_slice_in_dim(out, chunk,
+                                              owner * chunk_len, axis)
+        if nxt is not None:
+            ring_span(DP_OVERLAP_PERMUTE_EVENT, "E", nxt, axis_name,
+                      op=op_name, step=step)
+            chunk = nxt
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -62,8 +62,11 @@ DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
 )
 
 # FSDP variant: shard the residual/hidden dim of weights across dp as well
-# (reference custom_fsdp / --use-distributed-optimizer param sharding,
-# core/distributed/custom_fsdp/fully_sharded_data_parallel.py).
+# (reference custom_fsdp,
+# core/distributed/custom_fsdp/fully_sharded_data_parallel.py). ZeRO-1
+# (--use-distributed-optimizer) is NOT this: params keep DEFAULT_RULES and
+# only the optimizer-state pytree gains a dp shard dim, via the regex spec
+# map in training/distributed_optimizer.py.
 FSDP_RULES: Tuple[Tuple[str, Any], ...] = tuple(
     (name, (DP_AXIS,) if name == "embed" else axis)
     for name, axis in DEFAULT_RULES

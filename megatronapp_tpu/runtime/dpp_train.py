@@ -332,8 +332,15 @@ def make_dpp_train_step(optimizer, opt_cfg, cfg, devices, train_iters: int,
         def do_update(_):
             updates, new_opt = optimizer.update(
                 grads, state["opt_state"], params)
-            new_params = jax.tree.map(
-                lambda p, u: (p + u.astype(p.dtype)), params, updates)
+            if hasattr(optimizer, "apply_updates"):
+                # ZeRO-1 wrapper with master weights: params are the
+                # rounded image of the fp32 master shard (same contract
+                # as train_step's GSPMD/manual paths).
+                new_params = optimizer.apply_updates(params, updates,
+                                                     new_opt)
+            else:
+                new_params = jax.tree.map(
+                    lambda p, u: (p + u.astype(p.dtype)), params, updates)
             return new_params, new_opt
 
         def skip(_):

@@ -309,9 +309,22 @@ class CheckpointManager:
         saved_leaves = jax.tree.leaves(saved_tree)
         if len(saved_leaves) != len(target_leaves):
             # Structural change (different model/optimizer): let the
-            # plain restore produce its descriptive error.
-            return self._mngr.restore(
-                step, args=ocp.args.StandardRestore(abstract))
+            # plain restore try, but wrap its failure with the one
+            # migration a user is likely to hit — the ZeRO-1 optimizer
+            # state layout (dict {count, mu, nu[, master]}) differs from
+            # the optax chain tuples older checkpoints hold.
+            try:
+                return self._mngr.restore(
+                    step, args=ocp.args.StandardRestore(abstract))
+            except Exception as e:
+                raise RuntimeError(
+                    f"checkpoint step {step} holds a different state "
+                    f"STRUCTURE ({len(saved_leaves)} leaves saved, "
+                    f"{len(target_leaves)} expected). If this run dir "
+                    "predates the ZeRO-1 distributed optimizer, the "
+                    "opt_state layout changed — resume with "
+                    "--no-use-distributed-optimizer to match the old "
+                    "layout, or start a fresh --save dir") from e
         mismatch = any(
             hasattr(t, "shape") and tuple(s.shape) != tuple(t.shape)
             for s, t in zip(saved_leaves, target_leaves))

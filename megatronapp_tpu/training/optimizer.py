@@ -67,8 +67,35 @@ def _weight_decay_mask(params):
 
 
 def get_optimizer(cfg: OptimizerConfig, train_iters: int,
-                  schedule: Optional[optax.Schedule] = None
-                  ) -> optax.GradientTransformation:
+                  schedule: Optional[optax.Schedule] = None,
+                  distributed: bool = False):
+    """distributed=True returns the ZeRO-1 DistributedOptimizer wrapper
+    (training/distributed_optimizer.py): same optax-transform arithmetic,
+    dict-shaped state whose m/v/master leaves setup_train_state shards
+    over dp, mixed-precision state dtypes from cfg. The plain chain below
+    is the replicated baseline (and what non-ZeRO paths — FBD, tools,
+    model families — keep using)."""
+    if distributed:
+        from megatronapp_tpu.training.distributed_optimizer import (
+            DistributedOptimizer,
+        )
+        return DistributedOptimizer(cfg, train_iters, schedule=schedule)
+    # The mixed-precision state knobs only exist on the ZeRO-1 layout;
+    # the plain chain stores fp32 unconditionally. Refuse rather than
+    # silently train with a different precision than the config claims
+    # (the CLI validates the same constraint at parse time — this guard
+    # covers programmatic OptimizerConfig construction).
+    low = [n for n, v in (("exp_avg_dtype", cfg.exp_avg_dtype),
+                          ("exp_avg_sq_dtype", cfg.exp_avg_sq_dtype),
+                          ("main_params_dtype", cfg.main_params_dtype))
+           if str(v).lower() not in ("fp32", "float32")]
+    if low:
+        raise ValueError(
+            f"OptimizerConfig {', '.join(low)} != fp32 requires the "
+            "ZeRO-1 distributed-optimizer wrapper, but this code path "
+            "builds the replicated optax chain (plain DP, FSDP, FBD, or "
+            "a direct get_optimizer(distributed=False) call), which "
+            "stores fp32 state only — use fp32 state dtypes here")
     sched = schedule or lr_schedule(cfg, train_iters)
     chain = []
     if cfg.clip_grad:

@@ -85,6 +85,73 @@ def _emergency_side_state(step: int, consumed: int, rerun
             "rerun": rerun.state_dict()}
 
 
+class _CheckpointScribe:
+    """ONE home for the train loop's four checkpoint moments (ROADMAP
+    cleanup item): interval durable, interval local, emergency (signal
+    exit), and final. Every path shares the same plumbing — the heartbeat
+    'checkpointing' section bracketing, device_get + layout on the
+    durable save, the side-state payload (exact stream position incl.
+    _RowBuffer carry-over + rerun statistics), and best-effort semantics
+    for the local copy — so threading new state layouts (the dp-sharded
+    ZeRO-1 optimizer state) through checkpointing touches one place."""
+
+    def __init__(self, ckpt, local_ckpt, train_cfg: TrainingConfig,
+                 layout, ft, rerun, log_fn):
+        self.ckpt = ckpt
+        self.local_ckpt = local_ckpt
+        self.cfg = train_cfg
+        self.layout = layout
+        self.ft = ft
+        self.rerun = rerun
+        self.log_fn = log_fn
+
+    @contextlib.contextmanager
+    def section(self):
+        """Bracket a save in the heartbeat 'checkpointing' section so the
+        watchdog applies the checkpoint timeout, then return to 'step'."""
+        if self.ft is not None:
+            self.ft.start_section("checkpointing")
+        try:
+            yield
+        finally:
+            if self.ft is not None:
+                self.ft.start_section("step")
+
+    def _side(self, step: int, consumed: int) -> Dict[str, Any]:
+        return _emergency_side_state(step, consumed, self.rerun)
+
+    def save_durable(self, step: int, state, consumed: int,
+                     force: bool = False,
+                     skip_if_current: bool = False) -> None:
+        """Durable Orbax save + side-state sidecar. skip_if_current: a
+        step already on disk is left alone — orbax rewrites same-step
+        saves by delete-then-write, which inside a preemption grace
+        window would drop the just-written good checkpoint. The side
+        state is (re)written either way: it is an atomic sidecar."""
+        if self.ckpt is None:
+            return
+        if not (skip_if_current and self.ckpt.latest_step == step):
+            self.ckpt.save(step, jax.device_get(state), force=force,
+                           layout=self.layout)
+        write_side_state(self.cfg.save_dir, step,
+                         self._side(step, consumed))
+
+    def save_local(self, step: int, state, consumed: int,
+                   what: str = "local checkpoint") -> None:
+        """Best-effort local .npz with the side state riding as extra —
+        warn-and-continue on failure (local checkpoints are an
+        optimization, never worth killing the run)."""
+        if self.local_ckpt is None:
+            return
+        try:
+            self.local_ckpt.save(step, jax.device_get(state),
+                                 extra=self._side(step, consumed))
+        except Exception as e:  # noqa: BLE001 — best-effort path
+            self.log_fn(f"{what} save failed at step {step} "
+                        f"({type(e).__name__}: {e}); continuing — "
+                        "local checkpoints are best-effort")
+
+
 def reshape_global_batch(batch: Dict[str, np.ndarray], num_micro: int
                          ) -> Dict[str, np.ndarray]:
     """[global_batch, seq] → [num_micro, global_batch/num_micro, seq]."""
@@ -223,7 +290,13 @@ def pretrain_gpt(
     _validate_schedule_stages(batch_calc, ctx.pp, vpp,
                               parallel_cfg.pipeline_order_policy)
 
-    optimizer = get_optimizer(opt_cfg, train_cfg.train_iters)
+    # ZeRO-1 distributed optimizer (--use-distributed-optimizer): the
+    # wrapper dp-shards m/v/master state; fsdp keeps the param-sharding
+    # rules instead (the two compose poorly — fsdp already owns dp).
+    optimizer = get_optimizer(
+        opt_cfg, train_cfg.train_iters,
+        distributed=(parallel_cfg.distributed_optimizer
+                     and not parallel_cfg.fsdp))
     rng = jax.random.PRNGKey(train_cfg.seed)
 
     def params_and_axes(rng):
@@ -403,6 +476,14 @@ def pretrain_gpt(
         log_fn(f"dpp: dynamic runtime active (pp={ctx.pp}, dp={ctx.dp}, "
                f"vpp={vpp}, "
                f"policy={parallel_cfg.pipeline_order_policy})")
+        if getattr(opt_cfg, "dist_opt_comm", "gspmd") in ("ring", "bulk") \
+                and getattr(optimizer, "zero1", False):
+            # The host-driven step has no manual-update hook; say so
+            # instead of letting an A/B silently measure the wrong mode
+            # (same loud-fallback policy as the FBD path).
+            log_fn(f"dpp: --dist-opt-comm {opt_cfg.dist_opt_comm} is not "
+                   "wired into the host-driven runtime — the ZeRO-1 "
+                   "update runs in gspmd mode here")
     else:
         step_fn = make_train_step(
             loss_fn, optimizer, opt_cfg, ctx, shardings,
@@ -587,6 +668,8 @@ def pretrain_gpt(
         port = inspector.start(train_cfg.workload_inspector_port)
         log_fn(f"workload inspector: http://127.0.0.1:{port}/status")
 
+    scribe = _CheckpointScribe(ckpt, local_ckpt, train_cfg, ckpt_layout,
+                               ft, rerun, log_fn)
     losses = []
     window_tokens = 0
     window_start = time.perf_counter()
@@ -753,39 +836,20 @@ def pretrain_gpt(
 
             if ckpt is not None and train_cfg.save_interval and \
                     (it + 1) % train_cfg.save_interval == 0:
-                if ft is not None:
-                    ft.start_section("checkpointing")
-                t_save = time.perf_counter()
-                ckpt.save(it + 1, jax.device_get(state),
-                          layout=ckpt_layout)
-                write_side_state(
-                    train_cfg.save_dir, it + 1,
-                    _emergency_side_state(it + 1, consumed, rerun))
-                save_dt = time.perf_counter() - t_save
+                with scribe.section():
+                    t_save = time.perf_counter()
+                    scribe.save_durable(it + 1, state, consumed)
+                    save_dt = time.perf_counter() - t_save
                 e2e.on_save_checkpoint(save_dt)
                 # Save dispatch time is reported under save_checkpoint_*,
                 # not the next train window.
                 window_start += save_dt
-                if ft is not None:
-                    ft.start_section("step")
 
             if local_ckpt is not None and \
                     train_cfg.non_persistent_save_interval and \
                     (it + 1) % train_cfg.non_persistent_save_interval == 0:
-                if ft is not None:
-                    ft.start_section("checkpointing")
-                try:
-                    local_ckpt.save(
-                        it + 1, jax.device_get(state),
-                        extra=_emergency_side_state(it + 1, consumed,
-                                                    rerun))
-                except Exception as e:  # noqa: BLE001 — best-effort path
-                    log_fn(f"local checkpoint save failed at step "
-                           f"{it + 1} ({type(e).__name__}: {e}); "
-                           "continuing — local checkpoints are "
-                           "best-effort")
-                if ft is not None:
-                    ft.start_section("step")
+                with scribe.section():
+                    scribe.save_local(it + 1, state, consumed)
 
             # Graceful signal exit (--exit-signal-handler): the in-
             # flight step above already finished; agree the decision
@@ -796,29 +860,16 @@ def pretrain_gpt(
                     and sig.should_exit():
                 log_fn(f"signal: exit requested — emergency checkpoint "
                        f"at iteration {it + 1}")
-                if ft is not None:
-                    ft.start_section("checkpointing")
-                t_save = time.perf_counter()
-                side = _emergency_side_state(it + 1, consumed, rerun)
-                if ckpt is not None:
+                with scribe.section():
+                    t_save = time.perf_counter()
                     # A SIGTERM landing on a save-interval boundary
-                    # already has this step on disk — re-saving would
-                    # DELETE the just-written good checkpoint to rewrite
-                    # it (orbax refuses same-step saves) right inside
-                    # the preemption grace window.
-                    if ckpt.latest_step != it + 1:
-                        ckpt.save(it + 1, jax.device_get(state),
-                                  force=True, layout=ckpt_layout)
-                    write_side_state(train_cfg.save_dir, it + 1, side)
-                if local_ckpt is not None:
-                    try:
-                        local_ckpt.save(it + 1, jax.device_get(state),
-                                        extra=side)
-                    except Exception as e:  # noqa: BLE001 — best-effort
-                        log_fn(f"local emergency save failed "
-                               f"({type(e).__name__}: {e})")
-                if ckpt is not None:
-                    ckpt.wait()   # durability before exit
+                    # already has this step on disk (skip_if_current).
+                    scribe.save_durable(it + 1, state, consumed,
+                                        force=True, skip_if_current=True)
+                    scribe.save_local(it + 1, state, consumed,
+                                      what="local emergency")
+                    if ckpt is not None:
+                        ckpt.wait()   # durability before exit
                 log_fn(f"signal: emergency save done in "
                        f"{time.perf_counter() - t_save:.2f}s; exiting "
                        "cleanly")
@@ -832,13 +883,9 @@ def pretrain_gpt(
     if ckpt is not None:
         final_step = int(jax.device_get(state["step"]))
         if train_cfg.save_interval and ckpt.latest_step != final_step:
-            if ft is not None:
-                ft.start_section("checkpointing")
-            ckpt.save(final_step, jax.device_get(state), force=True,
-                      layout=ckpt_layout)
-            write_side_state(
-                train_cfg.save_dir, final_step,
-                _emergency_side_state(final_step, consumed, rerun))
+            with scribe.section():
+                scribe.save_durable(final_step, state, consumed,
+                                    force=True)
         ckpt.wait()
         ckpt.close()
     if ft is not None:
@@ -882,6 +929,13 @@ def _pretrain_gpt_fbd(model_cfg, parallel_cfg, train_cfg, opt_cfg,
     fwd_ctx, bwd_ctx = split_fbd_meshes(parallel_cfg)
     log_fn(f"FBD: forward mesh {dict(fwd_ctx.mesh.shape)} | backward mesh "
            f"{dict(bwd_ctx.mesh.shape)}")
+    if parallel_cfg.distributed_optimizer:
+        # The executor ships state between half-meshes with its own
+        # shardings; the ZeRO-1 wrapper is not validated there yet
+        # (ROADMAP follow-up) — the legacy dp-sharded-param rules apply.
+        log_fn("FBD: ZeRO-1 distributed optimizer is not wired into the "
+               "forward_backward_disaggregating path; using the legacy "
+               "dp-sharded-param (fsdp-style) state rules")
     # Batch-size rampup composes: the executor's microbatch loop takes any
     # M (non-pipelined — no recompiles; pipelined — one compile per ramp
     # stage, same bound as the main path).

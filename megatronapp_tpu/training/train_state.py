@@ -1,13 +1,14 @@
 """Train state container + sharded initialization.
 
 The state pytree is {'step', 'params', 'opt_state'}; optimizer state leaves
-inherit the corresponding parameter's sharding. ZeRO-1 semantics of the
-reference DistributedOptimizer (/root/reference/megatron/core/optimizer/
-distrib_optimizer.py:80) fall out of the rules: with
-ParallelConfig.distributed_optimizer the 'embed' axis of params and adam
-moments is sharded over dp — "shard optimizer state over DP" with XLA doing
-the reduce-scatter/all-gather the reference implements by hand
-(distrib_optimizer.py grad reduce-scatter + param all-gather).
+inherit the corresponding parameter's sharding as a BASE layout. ZeRO-1
+semantics of the reference DistributedOptimizer
+(/root/reference/megatron/core/optimizer/distrib_optimizer.py:80) come from
+the DistributedOptimizer wrapper (training/distributed_optimizer.py): params
+stay dp-replicated while the m/v/master state leaves get an extra dp shard
+dim from its regex spec map — XLA then emits the grad reduce-scatter /
+param all-gather the reference implements by hand. FSDP_RULES remain the
+param-sharding variant ('embed' over dp for params AND state).
 """
 
 from __future__ import annotations
@@ -48,9 +49,22 @@ def state_logical_axes(params_axes, opt_state_struct) -> Any:
     return {"step": (), "params": params_axes, "opt_state": opt_axes}
 
 
-def pick_rules(ctx: MeshContext):
-    return (FSDP_RULES if (ctx.parallel.fsdp or
-                           ctx.parallel.distributed_optimizer)
+def pick_rules(ctx: MeshContext, optimizer=None):
+    """Param sharding rules for the run.
+
+    fsdp: FSDP_RULES — params (and state) shard their 'embed' axis over
+    dp. ZeRO-1 (a DistributedOptimizer instance): params stay replicated
+    over dp (DEFAULT_RULES) and only the optimizer STATE shards — the
+    dp placement comes from zero1_state_shardings below, not the logical
+    rules. Legacy: ParallelConfig.distributed_optimizer with a plain
+    optax chain keeps the old FSDP_RULES interpretation so direct
+    setup_train_state callers that never wire the wrapper (FBD, tools,
+    model families) behave exactly as before."""
+    if ctx.parallel.fsdp:
+        return FSDP_RULES
+    if getattr(optimizer, "zero1", False):
+        return DEFAULT_RULES
+    return (FSDP_RULES if ctx.parallel.distributed_optimizer
             else DEFAULT_RULES)
 
 
@@ -79,7 +93,7 @@ def setup_train_state(rng, params_and_axes_fn: Callable, optimizer,
     memory-constrained giant-model runs; values are then only guaranteed
     mesh-independent on meshes validated by the init-parity tests.
     """
-    rules = rules or pick_rules(ctx)
+    rules = rules or pick_rules(ctx, optimizer)
     # Logical axes are config-static python data; capture them during an
     # abstract trace (no device arrays are materialized).
     captured = {}
@@ -101,6 +115,19 @@ def setup_train_state(rng, params_and_axes_fn: Callable, optimizer,
     state_struct = jax.eval_shape(_init, rng)
     axes = state_logical_axes(params_axes, state_struct["opt_state"])
     shardings = tree_logical_to_sharding(axes, ctx.mesh, rules)
+    if getattr(optimizer, "zero1", False) and \
+            getattr(optimizer, "shard_state", True):
+        # ZeRO-1: the m/v/master leaves additionally shard over the dp
+        # group — the regex spec map owns the per-leaf dim choice
+        # (training/distributed_optimizer.py). Params keep their
+        # dp-replicated rules; the jitted step's in/out shardings then
+        # make XLA slice grads into the update and all-gather the
+        # updated params (arXiv 2004.13336 semantics).
+        from megatronapp_tpu.training.distributed_optimizer import (
+            zero1_state_shardings,
+        )
+        shardings["opt_state"] = zero1_state_shardings(
+            shardings["opt_state"], state_struct["opt_state"], ctx)
     with ctx.mesh:
         if sharded_init:
             state = jax.jit(_init, out_shardings=shardings)(rng)

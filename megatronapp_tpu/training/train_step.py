@@ -93,6 +93,24 @@ def make_train_step(
     forward_backward_no_pipelining, schedules.py:618).
     """
     sched = lr_schedule(opt_cfg, train_iters)
+    # ZeRO-1 manual update path (--dist-opt-comm ring|bulk): the weight
+    # update runs inside one full-manual shard_map with the updated
+    # params returned through the overlap.py ring all-gather (ring) or a
+    # tiled bulk gather. Default 'gspmd' leaves the collectives to XLA's
+    # sharding propagation over the dp-sharded state layout.
+    zero1_manual = (getattr(optimizer, "zero1", False)
+                    and getattr(optimizer, "shard_state", True)
+                    and getattr(opt_cfg, "dist_opt_comm", "gspmd")
+                    in ("ring", "bulk")
+                    and ctx.dp * ctx.ep > 1
+                    and not getattr(ctx, "abstract_collectives", False))
+    zero1_plan = None
+    if zero1_manual:
+        from megatronapp_tpu.training.distributed_optimizer import (
+            shard_plan,
+        )
+        zero1_plan = shard_plan(state_shardings["params"],
+                                state_shardings["opt_state"])
     if trace_phases:
         # MegaScan schedule-phase spans (trace/tracer.py): 'forward' spans
         # the loss computation; its custom-VJP mirrors emit the 'backward'
@@ -159,10 +177,23 @@ def make_train_step(
         finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
 
         def do_update(_):
+            if zero1_manual:
+                from megatronapp_tpu.training.distributed_optimizer \
+                    import manual_apply
+                return manual_apply(
+                    optimizer, grads, state["opt_state"], params,
+                    state_shardings, ctx.mesh, zero1_plan,
+                    overlap=(opt_cfg.dist_opt_comm == "ring"))
             updates, new_opt = optimizer.update(
                 grads, state["opt_state"], params)
-            new_params = jax.tree.map(
-                lambda p, u: (p + u.astype(p.dtype)), params, updates)
+            if hasattr(optimizer, "apply_updates"):
+                # Master-weight aware (ZeRO-1 mixed precision): params
+                # become the rounded image of the fp32 master shard.
+                new_params = optimizer.apply_updates(params, updates,
+                                                     new_opt)
+            else:
+                new_params = jax.tree.map(
+                    lambda p, u: (p + u.astype(p.dtype)), params, updates)
             return new_params, new_opt
 
         def skip(_):

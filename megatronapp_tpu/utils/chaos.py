@@ -39,6 +39,15 @@ tests/test_resilience.py pins this registry against its drill list):
                              raises — exercises the DynamicBatchingDriver
                              watchdog (error frames, pool reclaim,
                              crash-loop backoff, restart accounting).
+- ``paged-evict``            the paged KV block allocator's LRU eviction
+                             fails (inference/paged_cache.py _take_free)
+                             — exercises admit/ensure_capacity rollback:
+                             no leaked refcounts, audit() passes, the
+                             next request succeeds.
+- ``paged-cow``              the copy-on-write block copy of a fully
+                             cached prompt fails (_copy_block) —
+                             exercises the admit rollback path with
+                             cached-prefix refs already acquired.
 
 Simulated whole-process faults (hang / exit) are flag-driven rather than
 registry-driven: --simulated-fault KIND:DELAY routes through
@@ -57,6 +66,8 @@ SITES = (
     "local-checkpoint-save",
     "step-nan",
     "stepper-step",
+    "paged-evict",
+    "paged-cow",
 )
 
 

@@ -1,0 +1,488 @@
+"""ZeRO-1 distributed optimizer tests (ISSUE 7).
+
+Covers the spec map (regex rules + fallbacks), the dp-sharded state
+layout and its per-rank memory cut, loss parity of every comm mode
+(gspmd / ring / bulk) against the replicated baseline, mixed-precision
+state (bf16 moments, fp32 master shard for low-precision params),
+optimizer-state checkpoint round-trips — same dp bitwise, DIFFERENT dp
+size (reshard on load), and the local .npz emergency path with bf16 m/v
+leaves — plus the SIGTERM emergency-save drill with the distributed
+optimizer on, and parse-time flag validation.
+"""
+
+import json
+import os
+import re
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megatronapp_tpu.config.parallel_config import DP_AXIS, ParallelConfig
+from megatronapp_tpu.config.training_config import (
+    OptimizerConfig, TrainingConfig,
+)
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.models.gpt import init_gpt_params
+from megatronapp_tpu.parallel.mesh import build_mesh
+from megatronapp_tpu.training.distributed_optimizer import (
+    DistributedOptimizer, zero1_partition_spec,
+)
+from megatronapp_tpu.training.optimizer import get_optimizer
+from megatronapp_tpu.training.train import pretrain_gpt
+from megatronapp_tpu.training.train_state import setup_train_state
+from megatronapp_tpu.training.train_step import make_train_step
+
+
+def tiny_model(**kw):
+    d = dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+             vocab_size=128, max_position_embeddings=64,
+             compute_dtype=jnp.float32)
+    d.update(kw)
+    return TransformerConfig(**d)
+
+
+def learnable_batches(seq_length, vocab_size, batch_size, seed=0):
+    rng = np.random.default_rng(seed)
+    while True:
+        start = rng.integers(0, vocab_size, size=(batch_size, 1))
+        ramp = np.arange(seq_length + 1)[None, :]
+        seq = ((start + ramp) % vocab_size).astype(np.int32)
+        tokens, labels = seq[:, :-1], seq[:, 1:]
+        yield {
+            "tokens": tokens,
+            "labels": labels,
+            "loss_mask": np.ones_like(tokens, dtype=np.float32),
+            "position_ids": np.tile(np.arange(seq_length, dtype=np.int32),
+                                    (batch_size, 1)),
+        }
+
+
+def _rank_bytes(tree):
+    """Bytes resident on device 0 across a state subtree."""
+    dev0 = jax.devices()[0]
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        for s in leaf.addressable_shards:
+            if s.device == dev0:
+                total += s.data.size * s.data.dtype.itemsize
+    return total
+
+
+# ---------------------------------------------------------------------------
+class TestSpecMap:
+    """zero1_partition_spec: the match_partition_rules-style regex map
+    that decides which dim of an m/v/master leaf takes the dp shard."""
+
+    def test_scalars_stay_replicated(self):
+        assert zero1_partition_spec("count", P(), (), 4, 1) == P()
+        assert zero1_partition_spec("mu/x", P(None), (1,), 4, 1) == P(None)
+
+    def test_regex_rule_picks_embedding_hidden_dim(self):
+        spec = zero1_partition_spec("mu/embedding/word", P("tp", None),
+                                    (128, 64), 2, 1)
+        assert spec == P("tp", DP_AXIS)
+
+    def test_fallback_first_free_divisible_dim(self):
+        # dim 0 is tp-sharded, dim 1 free and divisible.
+        spec = zero1_partition_spec("mu/block/w", P("tp", None),
+                                    (64, 64), 2, 1)
+        assert spec == P("tp", DP_AXIS)
+        # dim 0 free and divisible → taken first.
+        spec = zero1_partition_spec("mu/block/w", P(None, "tp"),
+                                    (64, 64), 2, 1)
+        assert spec == P(DP_AXIS, "tp")
+
+    def test_indivisible_leaf_stays_replicated(self):
+        spec = zero1_partition_spec("mu/block/b", P(None), (7,), 4, 1)
+        assert spec == P(None)
+
+    def test_fsdp_style_dp_already_used_is_untouched(self):
+        spec = zero1_partition_spec("mu/block/w", P(DP_AXIS, None),
+                                    (64, 64), 2, 1)
+        assert spec == P(DP_AXIS, None)
+
+    def test_ep_joins_the_group_when_free(self):
+        spec = zero1_partition_spec("mu/block/w", P(None, None),
+                                    (8, 64), 2, 2)
+        assert spec == P((DP_AXIS, "ep"), None)
+        # expert leaves already use ep → dp alone.
+        spec = zero1_partition_spec("mu/moe/w", P("ep", None, None),
+                                    (2, 8, 64), 2, 2)
+        assert spec == P("ep", DP_AXIS, None)
+
+    def test_rule_can_pin_replicated(self):
+        spec = zero1_partition_spec(
+            "mu/block/special", P(None), (64,), 2, 1,
+            rules=((r"special", None),))
+        assert spec == P(None)
+
+    def test_dp1_is_a_noop(self):
+        spec = zero1_partition_spec("mu/block/w", P(None), (64,), 1, 1)
+        assert spec == P(None)
+
+
+# ---------------------------------------------------------------------------
+class TestStateLayout:
+    """The wrapper's state layout through setup_train_state: m/v sharded
+    over dp (~1/dp per-rank bytes), params replicated over dp."""
+
+    def _state(self, devices8, n, opt_kw=None, model_kw=None):
+        model = tiny_model(**(model_kw or {}))
+        par = ParallelConfig(data_parallel=n)
+        ctx = build_mesh(par, devices=devices8[:n])
+        opt_cfg = OptimizerConfig(lr=1e-3, **(opt_kw or {}))
+        optimizer = DistributedOptimizer(opt_cfg, 10)
+        state, shardings, _ = setup_train_state(
+            jax.random.PRNGKey(0), lambda k: init_gpt_params(k, model),
+            optimizer, ctx)
+        return state, shardings
+
+    def test_moments_shard_over_dp_params_replicated(self, devices8):
+        state, shardings = self._state(devices8, 4)
+        opt = state["opt_state"]
+        assert sorted(opt) == ["count", "mu", "nu"]  # fp32 params: no master
+        mu_leaves = jax.tree.leaves(opt["mu"])
+        mu_specs = jax.tree.leaves(shardings["opt_state"]["mu"],
+                                   is_leaf=lambda x: hasattr(x, "spec"))
+        full = sum(l.nbytes for l in mu_leaves)
+        # Expected per-rank bytes follow the spec map exactly: sharded
+        # leaves contribute 1/dp, the (rare) leaves with no free
+        # divisible dim stay whole.
+        expect = sum(l.nbytes // (4 if DP_AXIS in str(s.spec) else 1)
+                     for l, s in zip(mu_leaves, mu_specs))
+        assert _rank_bytes(opt["mu"]) == expect
+        # The residue of unshardable leaves is noise: ~1/dp overall.
+        assert expect <= full // 4 + full // 50
+        # A real leaf is sharded (the claim is not vacuous)…
+        assert sum(DP_AXIS in str(s.spec) for s in mu_specs) >= \
+            len(mu_specs) - 1
+        # …and params carry no dp axis — replicated data parallelism.
+        for sh in jax.tree.leaves(
+                shardings["params"],
+                is_leaf=lambda x: hasattr(x, "spec")):
+            assert DP_AXIS not in str(sh.spec)
+
+    def test_bf16_moments_dtypes(self, devices8):
+        state, _ = self._state(devices8, 2,
+                               opt_kw=dict(exp_avg_dtype="bf16",
+                                           exp_avg_sq_dtype="bf16"))
+        opt = state["opt_state"]
+        assert all(l.dtype == jnp.bfloat16
+                   for l in jax.tree.leaves(opt["mu"]))
+        assert all(l.dtype == jnp.bfloat16
+                   for l in jax.tree.leaves(opt["nu"]))
+
+    def test_master_shard_kept_for_low_precision_params(self, devices8):
+        state, shardings = self._state(
+            devices8, 2, model_kw=dict(params_dtype=jnp.bfloat16))
+        opt = state["opt_state"]
+        assert "master" in opt
+        leaves = jax.tree.leaves(opt["master"])
+        assert all(l.dtype == jnp.float32 for l in leaves)
+        # The master shards over dp like the moments.
+        full = sum(l.nbytes for l in leaves)
+        assert _rank_bytes(opt["master"]) == full // 2
+
+
+# ---------------------------------------------------------------------------
+class TestLossParity:
+    """Sharded-vs-replicated training parity, every comm mode."""
+
+    def _run(self, devices8, n, dist, comm="gspmd", par_kw=None,
+             opt_kw=None, iters=5, model_kw=None):
+        model = tiny_model(**(model_kw or {}))
+        par = ParallelConfig(distributed_optimizer=dist, **(par_kw or {}))
+        ctx = build_mesh(par, devices=devices8[:n])
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                               seq_length=32, train_iters=iters,
+                               log_interval=1)
+        opt = OptimizerConfig(lr=1e-3, dist_opt_comm=comm,
+                              **(opt_kw or {}))
+        return pretrain_gpt(model, par, train, opt, ctx=ctx,
+                            batch_iter=learnable_batches(32, 128, 8),
+                            log_fn=lambda m: None)
+
+    @pytest.mark.parametrize("comm", ["gspmd", "ring", "bulk"])
+    def test_dp2_parity_vs_replicated(self, devices8, comm):
+        base = self._run(devices8, 2, dist=False)
+        sharded = self._run(devices8, 2, dist=True, comm=comm)
+        np.testing.assert_allclose(sharded.losses, base.losses, rtol=0,
+                                   atol=1e-6)
+        assert sharded.losses[-1] < sharded.losses[0]
+
+    def test_ring_parity_on_dp2_pp2(self, devices8):
+        kw = dict(par_kw=dict(pipeline_parallel=2), iters=2)
+        base = self._run(devices8, 4, dist=False, **kw)
+        ring = self._run(devices8, 4, dist=True, comm="ring", **kw)
+        np.testing.assert_allclose(ring.losses, base.losses, rtol=0,
+                                   atol=1e-6)
+
+    def test_bf16_moments_sharded_matches_replicated_layout(self,
+                                                            devices8):
+        """bf16 moments change the math vs fp32 (no cross-mode pin);
+        the invariant is sharded == replicated WITHIN the mode."""
+        opt_kw = dict(exp_avg_dtype="bf16", exp_avg_sq_dtype="bf16")
+        sharded = self._run(devices8, 2, dist=True, opt_kw=opt_kw)
+        # Replicated layout, same wrapper arithmetic.
+        model = tiny_model()
+        par = ParallelConfig()
+        ctx = build_mesh(par, devices=devices8[:2])
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                               seq_length=32, train_iters=5,
+                               log_interval=1)
+        opt_cfg = OptimizerConfig(lr=1e-3, **opt_kw)
+        optimizer = DistributedOptimizer(opt_cfg, 5, shard_state=False)
+        state, shardings, _ = setup_train_state(
+            jax.random.PRNGKey(train.seed),
+            lambda k: init_gpt_params(k, model), optimizer, ctx)
+        from megatronapp_tpu.training.train import (
+            gpt_microbatch_loss, reshape_global_batch,
+        )
+        step = make_train_step(gpt_microbatch_loss(model, ctx=ctx),
+                               optimizer, opt_cfg, ctx, shardings, 5)
+        gen = learnable_batches(32, 128, 8)
+        losses = []
+        with ctx.mesh:
+            for _ in range(5):
+                state, metrics = step(
+                    state, reshape_global_batch(next(gen), 2))
+                losses.append(float(jax.device_get(metrics["loss"])))
+        np.testing.assert_allclose(sharded.losses, losses, rtol=0,
+                                   atol=1e-6)
+
+    def test_master_weights_bf16_params_train(self, devices8):
+        """bf16 params + fp32 master shard: training works, params stay
+        the rounded image of the master."""
+        res = self._run(devices8, 2, dist=True, comm="ring",
+                        model_kw=dict(params_dtype=jnp.bfloat16))
+        assert res.losses[-1] < res.losses[0]
+        opt = res.state["opt_state"]
+        assert "master" in opt
+        for p, m in zip(jax.tree.leaves(res.state["params"]),
+                        jax.tree.leaves(opt["master"])):
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(p)),
+                np.asarray(jax.device_get(m)).astype(p.dtype))
+
+
+# ---------------------------------------------------------------------------
+class TestCheckpointRoundTrip:
+    """Sharded optimizer-state checkpoints: bitwise same-dp restore,
+    cross-dp-size restore (reshard on load), and the local .npz
+    emergency path with bf16 m/v leaves."""
+
+    def _make(self, devices8, n, opt_kw=None):
+        model = tiny_model()
+        par = ParallelConfig(data_parallel=n)
+        ctx = build_mesh(par, devices=devices8[:n])
+        opt_cfg = OptimizerConfig(lr=1e-3, **(opt_kw or {}))
+        optimizer = DistributedOptimizer(opt_cfg, 6)
+        state, shardings, _ = setup_train_state(
+            jax.random.PRNGKey(0), lambda k: init_gpt_params(k, model),
+            optimizer, ctx)
+        return ctx, state, shardings
+
+    def _trained_state(self, devices8, n, **opt_kw):
+        model = tiny_model()
+        par = ParallelConfig(data_parallel=n)
+        ctx = build_mesh(par, devices=devices8[:n])
+        train = TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                               seq_length=32, train_iters=2,
+                               log_interval=2)
+        res = pretrain_gpt(model, par, train,
+                           OptimizerConfig(lr=1e-3, **opt_kw), ctx=ctx,
+                           batch_iter=learnable_batches(32, 128, 8),
+                           log_fn=lambda m: None)
+        return res.state
+
+    def test_sharded_state_roundtrip_same_and_different_dp(
+            self, devices8, tmp_path):
+        from megatronapp_tpu.training.checkpointing import (
+            CheckpointManager,
+        )
+        saved = self._trained_state(devices8, 2)
+        mngr = CheckpointManager(str(tmp_path / "ck"), save_interval=1,
+                                 async_save=False)
+        mngr.save(2, jax.device_get(saved),
+                  layout={"pp": 1, "vpp": 1, "num_layers": 2})
+        want = jax.device_get(saved)
+
+        for n in (2, 4, 1):     # same dp bitwise, then reshard on load
+            ctx, struct, _ = self._make(devices8, n)
+            restored = mngr.restore(struct)
+            assert restored is not None
+            got = jax.device_get(restored)
+            for (pa, a), (pb, b) in zip(
+                    jax.tree_util.tree_leaves_with_path(want),
+                    jax.tree_util.tree_leaves_with_path(got)):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b),
+                    err_msg=f"dp={n}: leaf {pa} differs")
+            if n != 2:
+                # The restored state really lives on the new dp layout:
+                # per-rank m/v bytes follow the new mesh (small slack
+                # for the rare leaves with no dp-divisible free dim).
+                mu = restored["opt_state"]["mu"]
+                full = sum(l.nbytes for l in jax.tree.leaves(mu))
+                assert _rank_bytes(mu) <= full // n + full // 50
+        mngr.close()
+
+    def test_local_npz_emergency_path_with_bf16_moments(
+            self, devices8, tmp_path):
+        from megatronapp_tpu.training.checkpointing import (
+            LocalCheckpointManager,
+        )
+        saved = self._trained_state(devices8, 2, exp_avg_dtype="bf16",
+                                    exp_avg_sq_dtype="bf16")
+        assert jax.tree.leaves(
+            saved["opt_state"]["mu"])[0].dtype == jnp.bfloat16
+        lm = LocalCheckpointManager(str(tmp_path / "np"))
+        lm.save(2, jax.device_get(saved), extra={"consumed": 16})
+        assert lm.latest_step == 2
+
+        ctx, struct, _ = self._make(
+            devices8, 2, opt_kw=dict(exp_avg_dtype="bf16",
+                                     exp_avg_sq_dtype="bf16"))
+        out = lm.restore(struct, return_extra=True)
+        assert out is not None
+        restored, extra = out
+        assert extra == {"consumed": 16}
+        for a, b in zip(jax.tree.leaves(jax.device_get(saved)),
+                        jax.tree.leaves(jax.device_get(restored))):
+            assert np.asarray(a).dtype == np.asarray(b).dtype
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # The sharded layout came back too (dp-sharded mu).
+        mu = restored["opt_state"]["mu"]
+        full = sum(l.nbytes for l in jax.tree.leaves(mu))
+        assert _rank_bytes(mu) == full // 2
+
+
+# ---------------------------------------------------------------------------
+class TestSigtermWithDistOpt:
+    """Acceptance: the SIGTERM emergency-save drill passes with
+    --use-distributed-optimizer on (dp2, bf16 moments — the maximally
+    sharded state must survive emergency durable + local saves and
+    resume to the uninterrupted loss curve)."""
+
+    def test_emergency_save_and_resume_dp2(self, devices8, tmp_path):
+        from tests.test_resilience import _reset_rerun
+
+        model = tiny_model(num_layers=1, hidden_size=32,
+                           num_attention_heads=2, vocab_size=64,
+                           max_position_embeddings=32)
+        par = ParallelConfig(data_parallel=2)   # dist-opt default ON
+        ctx = build_mesh(par, devices=devices8[:2])
+        opt = OptimizerConfig(lr=1e-3, lr_decay_iters=6,
+                              exp_avg_dtype="bf16",
+                              exp_avg_sq_dtype="bf16")
+
+        def cfg(it, **kw):
+            return TrainingConfig(micro_batch_size=2, global_batch_size=4,
+                                  seq_length=16, train_iters=it,
+                                  log_interval=1, **kw)
+
+        _reset_rerun()
+        full = pretrain_gpt(model, par, cfg(6), opt, ctx=ctx)
+
+        ckpt_dir, np_dir = str(tmp_path / "ckpt"), str(tmp_path / "np")
+        sent = {"done": False}
+
+        def interrupting_log(msg):
+            if re.match(r"iter\s+3/", msg) and not sent["done"]:
+                sent["done"] = True
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        _reset_rerun()
+        res_a = pretrain_gpt(
+            model, par,
+            cfg(6, save_dir=ckpt_dir, save_interval=10,
+                exit_signal_handler=True,
+                non_persistent_save_interval=2,
+                non_persistent_ckpt_dir=np_dir),
+            opt, ctx=ctx, log_fn=interrupting_log)
+        assert res_a.interrupted and len(res_a.losses) == 3
+        side = json.load(open(os.path.join(ckpt_dir, "side_state_3.json")))
+        assert side["consumed"] == res_a.consumed_samples
+
+        _reset_rerun()
+        res_b = pretrain_gpt(
+            model, par, cfg(6, save_dir=ckpt_dir,
+                            non_persistent_save_interval=2,
+                            non_persistent_ckpt_dir=np_dir),
+            opt, ctx=ctx)
+        np.testing.assert_allclose(res_a.losses + res_b.losses,
+                                   full.losses, rtol=0, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+class TestDistOptArgs:
+    """Parse-time validation of the mixed-precision / comm flags."""
+
+    def _cfgs(self, argv):
+        from megatronapp_tpu.config.arguments import (
+            build_parser, configs_from_args,
+        )
+        return configs_from_args(build_parser().parse_args(argv))
+
+    def test_defaults_land_in_optimizer_config(self):
+        _, par, _, opt = self._cfgs([])
+        assert par.distributed_optimizer
+        assert opt.exp_avg_dtype == "fp32"
+        assert opt.exp_avg_sq_dtype == "fp32"
+        assert opt.main_params_dtype == "fp32"
+        assert opt.dist_opt_comm == "gspmd"
+
+    def test_flags_flow_through(self):
+        _, par, _, opt = self._cfgs(
+            ["--exp-avg-dtype", "bf16", "--exp-avg-sq-dtype", "bf16",
+             "--dist-opt-comm", "ring"])
+        assert opt.exp_avg_dtype == "bf16"
+        assert opt.dist_opt_comm == "ring"
+
+    def test_opt_out_flag(self):
+        _, par, _, _ = self._cfgs(["--no-use-distributed-optimizer"])
+        assert not par.distributed_optimizer
+
+    def test_bad_state_dtype_rejected(self):
+        with pytest.raises(ValueError, match="--exp-avg-dtype"):
+            self._cfgs(["--exp-avg-dtype", "fp16"])
+
+    def test_bf16_moments_require_dist_opt(self):
+        with pytest.raises(ValueError,
+                           match="require --use-distributed-optimizer"):
+            self._cfgs(["--no-use-distributed-optimizer",
+                        "--exp-avg-dtype", "bf16"])
+
+    def test_bf16_master_rejected(self):
+        with pytest.raises(ValueError, match="only fp32 master"):
+            self._cfgs(["--main-params-dtype", "bf16"])
+
+
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestBenchmarkGates:
+    """The acceptance gates on bench.py extra.dist_opt, run at reduced
+    size (slow lane; the tier-1 memory/parity invariants above cover the
+    fast lane)."""
+
+    def test_dist_opt_benchmark_gates(self, devices8):
+        from tools.dist_opt_benchmark import run
+        # The bench-committed update-heavy shapes (hidden 256 / seq 32):
+        # at toy shapes the optimizer is microseconds inside a
+        # noise-dominated step and the wall ratio measures nothing.
+        res = run(dp=2, batch=2, seq=32, hidden=256, layers=2, iters=5,
+                  warmup=1, train_steps=5)
+        assert res["memory"]["ratio"] <= 0.55
+        assert res["memory"]["bf16_ratio"] <= 0.3
+        assert res["parity"]["fp32_max_loss_diff"] <= 1e-6
+        assert res["parity"]["bf16_max_loss_diff"] <= 1e-6
+        # Wall clock on the shared container is noisy; the acceptance
+        # number (<= 1.05x, default mode) is read off the bench record
+        # — gate here with headroom so scheduling jitter cannot flake
+        # the lane.
+        assert res["step"]["ratio"] <= 1.25

@@ -56,9 +56,11 @@ class TestChaosRegistry:
         SITES is exercised by a test in this file (checkpoint-save →
         TestCheckpointSaveRetry, local-checkpoint-save →
         TestLocalCheckpointRobustness, step-nan → TestStepNanInjection,
-        stepper-step → TestServingSelfHealing)."""
+        stepper-step → TestServingSelfHealing, paged-evict/paged-cow →
+        TestPagedAllocatorChaos)."""
         assert chaos.SITES == ("checkpoint-save", "local-checkpoint-save",
-                               "step-nan", "stepper-step")
+                               "step-nan", "stepper-step",
+                               "paged-evict", "paged-cow")
 
     def test_arm_fire_bounded_and_auto_disarm(self):
         chaos.arm("stepper-step", times=2, after=1)
@@ -123,6 +125,72 @@ class TestStepNanInjection:
         assert diag == RerunDiagnostic.PERSISTENT
         ok, _ = rsm.validate(1.0)
         assert ok                        # disarmed again
+
+
+# ---------------------------------------------------------------------------
+class TestPagedAllocatorChaos:
+    """Chaos sites in the paged KV block allocator (ISSUE 7 satellite):
+    an injected fault in LRU eviction or in the copy-on-write block copy
+    must roll the admit back cleanly — audit() passes (no leaked blocks,
+    no refcount skew) and the very next admit succeeds."""
+
+    def _pool(self, num_blocks=4, block_size=4):
+        from megatronapp_tpu.inference.paged_cache import PagedKVCache
+        cfg = TransformerConfig(
+            num_layers=1, hidden_size=16, num_attention_heads=2,
+            num_query_groups=2, vocab_size=64, max_position_embeddings=32,
+            compute_dtype=jnp.float32)
+        return PagedKVCache(cfg, max_batch=2, max_seq_len=16,
+                            num_blocks=num_blocks, block_size=block_size)
+
+    def test_eviction_fault_rolls_back_admit(self):
+        pool = self._pool(num_blocks=4, block_size=4)
+        toks_a = np.arange(16, dtype=np.int32)
+        plan = pool.admit(0, toks_a)            # takes all 4 blocks
+        assert plan is not None
+        pool.release(0, toks_a, 16)             # full blocks → hashed LRU
+        assert pool.evictable_blocks() == 4 and pool.free_blocks() == 0
+
+        toks_b = np.arange(100, 116, dtype=np.int32)
+        chaos.arm("paged-evict", times=1)
+        with pytest.raises(chaos.ChaosFault):
+            pool.admit(0, toks_b)               # needs an eviction
+        pool.audit()                            # nothing leaked
+        assert pool.blocks_in_use() == 0
+        # Recovery: the same admit succeeds once the fault is spent.
+        plan = pool.admit(0, toks_b)
+        assert plan is not None and plan.cached_tokens == 0
+        pool.audit()
+
+    def test_cow_fault_rolls_back_cached_refs(self):
+        pool = self._pool(num_blocks=6, block_size=4)
+        toks = np.arange(16, dtype=np.int32)
+        pool.admit(0, toks)
+        pool.release(0, toks, 16)               # all 4 blocks hittable
+        chaos.arm("paged-cow", times=1)
+        with pytest.raises(chaos.ChaosFault):
+            pool.admit(1, toks)                 # full hit → CoW copy
+        pool.audit()                            # cached refs returned
+        assert pool.blocks_in_use() == 0
+        assert pool.stats["cow_copies"] == 0
+        # Recovery: the CoW admit works and still hits the prefix cache.
+        plan = pool.admit(1, toks)
+        assert plan is not None and plan.cow and plan.cached_tokens == 15
+        pool.audit()
+
+    def test_ensure_capacity_fault_leaves_pool_consistent(self):
+        pool = self._pool(num_blocks=2, block_size=4)
+        toks = np.arange(8, dtype=np.int32)
+        pool.admit(0, toks)                     # owns both blocks
+        pool.release(0, toks, 8)
+        toks_b = np.arange(50, 54, dtype=np.int32)
+        assert pool.admit(0, toks_b) is not None   # evicts one block
+        chaos.arm("paged-evict", times=1)
+        with pytest.raises(chaos.ChaosFault):
+            pool.ensure_capacity(0, 4)          # next block needs eviction
+        pool.audit()
+        assert pool.ensure_capacity(0, 4)       # recovery
+        pool.audit()
 
 
 # ---------------------------------------------------------------------------

@@ -46,6 +46,11 @@ APPROVED = {
     "megatronapp_tpu/ops/cross_entropy.py",      # vocab-parallel CE
     "megatronapp_tpu/parallel/pipeline.py",      # pp schedule ring
     "megatronapp_tpu/transformer/moe.py",        # ep chunked-a2a dispatch
+    # ZeRO-1 manual weight update (ISSUE 7): the dp shard slice + bulk
+    # all-gather fallback of manual_apply; the ring variant routes
+    # through overlap.ring_all_gather. Forward-only region (the update
+    # is never differentiated), audited by the dist-opt parity tests.
+    "megatronapp_tpu/training/distributed_optimizer.py",
 }
 
 SCAN_DIRS = ("megatronapp_tpu",)
@@ -71,6 +76,9 @@ MANUAL_REGION_MODULES = (
     "megatronapp_tpu/transformer/mla.py",
     "megatronapp_tpu/transformer/moe.py",
     "megatronapp_tpu/parallel/pipeline.py",
+    # ISSUE 7: region-creating + GSPMD-layer constructs of the ZeRO-1
+    # distributed optimizer must carry audited `manual-ok:` notes.
+    "megatronapp_tpu/training/distributed_optimizer.py",
 )
 
 GSPMD_RE = re.compile(
