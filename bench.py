@@ -133,6 +133,18 @@ def _spec_decode_hook():
     return r if r.get("ngram") else None
 
 
+def _disagg_hook():
+    """Colocated-vs-disaggregated serving A/B
+    (tools/disagg_benchmark.py) on the CPU sub-meshes — decode p99
+    token-interval under a long in-flight prefill, tokens/s ratio, and
+    the stream-parity pin tracked round over round like the other
+    hooks."""
+    if os.environ.get("BENCH_DISAGG", "1") != "1":
+        return None
+    r = _run_child("--disagg", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("disagg") else None
+
+
 def _pp_tp_hook():
     """tp-sharded-vs-replicated pipeline stage body A/B
     (tools/pp_tp_benchmark.py) on the CPU mesh — fwd/fwd+bwd speedup and
@@ -175,6 +187,9 @@ def _attach_overlap_hooks(res):
     spd = _spec_decode_hook()
     if spd:
         res.setdefault("extra", {})["spec_decode"] = spd
+    dsg = _disagg_hook()
+    if dsg:
+        res.setdefault("extra", {})["disagg"] = dsg
     return res
 
 
@@ -423,6 +438,15 @@ def spec_decode_main():
                          max_new=24, spec_k=4)))
 
 
+def disagg_main():
+    """colocated-vs-disaggregated serving A/B child (CPU env set by the
+    parent; virtual sub-mesh devices set here, pre-jax-import)."""
+    from tools.disagg_benchmark import _ensure_devices, run
+    _ensure_devices(8)
+    print(json.dumps(run(n_short=3, short_new=48, long_len=192,
+                         prefill_chunk=16)))
+
+
 def probe_main():
     """Tiny device op to verify the backend is alive."""
     t0 = time.time()
@@ -553,5 +577,7 @@ if __name__ == "__main__":
         paged_kv_main()
     elif "--spec-decode" in sys.argv:
         spec_decode_main()
+    elif "--disagg" in sys.argv:
+        disagg_main()
     else:
         parent_main(local_only="--local" in sys.argv)

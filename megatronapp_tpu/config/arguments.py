@@ -74,6 +74,34 @@ def add_serving_args(ap: argparse.ArgumentParser):
                    help="checkpoint dir for the draft model (otherwise "
                         "randomly initialized — only useful for "
                         "plumbing tests)")
+    # Disaggregated serving (ISSUE 9, inference/disagg.py).
+    g.add_argument("--serve-disagg", action="store_true",
+                   help="prefill/decode disaggregation: split the "
+                        "devices into a prefill sub-mesh and a decode "
+                        "sub-mesh (2*serve_tp devices total) with KV "
+                        "handoff through the shared block pool — decode "
+                        "token intervals stop being hostage to long "
+                        "prefills (needs --engine dynamic "
+                        "--paged-kv-cache)")
+    g.add_argument("--serve-tp", type=int, default=1,
+                   help="tensor-parallel degree of the serving mesh: "
+                        "the ragged paged-attention kernels run "
+                        "head-sharded over a tp mesh with per-shard KV "
+                        "pools (with --serve-disagg, EACH sub-mesh is "
+                        "this wide)")
+    g.add_argument("--prefill-chunk", type=int, default=32,
+                   help="chunked-prefill chunk size — with "
+                        "--serve-disagg also the prefill-side "
+                        "scheduling quantum (chunks defer when the "
+                        "decode SLO is at risk)")
+    g.add_argument("--disagg-prefill-slots", type=int, default=2,
+                   help="staging page-table rows for in-flight/parked "
+                        "prefills on the prefill sub-mesh")
+    g.add_argument("--decode-slo-ms", type=float, default=None,
+                   help="decode token-interval SLO budget: prefill "
+                        "chunks are preempted when the next chunk "
+                        "would push the interval past this; /stats "
+                        "and /healthz report attainment")
     return g
 
 

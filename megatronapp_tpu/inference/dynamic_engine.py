@@ -68,6 +68,39 @@ class DeadlineExceeded(RuntimeError):
     the retire path like any finished request)."""
 
 
+def validate_admission(prompt_tokens, max_new_tokens: int,
+                       max_seq_len: int, pool=None,
+                       deadline_s=None) -> np.ndarray:
+    """Shared admission validation (single source of truth for the
+    plain engine AND the disaggregated coordinator — the two must
+    accept/reject identically): deadline, non-empty prompt, sequence
+    bound, and pool-capacity bound. Returns the normalized int32
+    prompt."""
+    import time as _time
+    if deadline_s is not None and _time.monotonic() >= deadline_s:
+        raise DeadlineExceeded(
+            "request deadline already expired at admission")
+    prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+    if len(prompt) == 0:
+        raise ValueError(
+            "empty prompt: prefill samples the first token from the "
+            "last PROMPT position, so at least one token (e.g. BOS/"
+            "eod) is required")
+    if len(prompt) + max_new_tokens > max_seq_len:
+        raise ValueError(
+            f"prompt({len(prompt)}) + max_new({max_new_tokens}) exceeds "
+            f"max_seq_len({max_seq_len})")
+    if pool is not None:
+        need = cdiv(len(prompt) + max_new_tokens, pool.block_size)
+        if need > pool.num_blocks:
+            raise ValueError(
+                f"request needs {need} blocks "
+                f"(prompt {len(prompt)} + max_new {max_new_tokens} at "
+                f"block_size {pool.block_size}) but the pool has "
+                f"only {pool.num_blocks}")
+    return prompt
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request (reference inference_request.py analogue).
@@ -142,7 +175,7 @@ def _decode_step(params, tokens, cache, lengths, active,
 
 
 def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
-                       cfg: TransformerConfig, max_seq_len: int):
+                       cfg: TransformerConfig, max_seq_len: int, ctx=None):
     """One-token decode for every slot against the paged block pool.
 
     pages: ([L, NB, bs, Hkv, D], same) K/V pools (MLA: latent + k_pe
@@ -177,7 +210,8 @@ def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
         (hh, new_cache), _ = layer_forward(
             layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
             kv_cache=(a_l, b_l), cache_index=None,
-            cache_positions=lengths, page_table=page_table, active=active)
+            cache_positions=lengths, page_table=page_table, active=active,
+            ctx=ctx)
         return hh, new_cache
 
     h, new_pages = jax.lax.scan(
@@ -188,7 +222,7 @@ def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
 
 def _paged_multiquery_step(params, tokens, pages, page_table, starts,
                            q_lens, active, cfg: TransformerConfig,
-                           max_seq_len: int):
+                           max_seq_len: int, ctx=None):
     """Ragged multi-token step against the paged pool — the UNIFIED
     prefill/decode primitive (speculative verify + chunked prefill).
 
@@ -228,7 +262,7 @@ def _paged_multiquery_step(params, tokens, pages, page_table, starts,
             layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
             kv_cache=(a_l, b_l), cache_index=None,
             cache_positions=starts, page_table=page_table, active=active,
-            chunk_counts=q_lens)
+            chunk_counts=q_lens, ctx=ctx)
         return hh, new_cache
 
     h, new_pages = jax.lax.scan(
@@ -310,7 +344,7 @@ class DynamicInferenceEngine:
                  enable_prefix_caching: bool = True,
                  spec_method: Optional[str] = None, spec_k: int = 4,
                  draft_params=None, draft_cfg=None,
-                 prefill_chunk: int = 32):
+                 prefill_chunk: int = 32, ctx=None, pool=None):
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -320,17 +354,56 @@ class DynamicInferenceEngine:
             b for b in sorted(prefill_buckets) if b <= self.max_seq_len
         ) or (self.max_seq_len,)
         self.prefill_chunk = min(prefill_chunk, self.max_seq_len)
+        # Rolling reload (DynamicBatchingDriver.request_reload): while
+        # True, _admit leaves the waiting queue untouched so running
+        # requests drain and the params swap lands on an empty batch.
+        self.pause_admission = False
 
         self.paged = paged
         if paged:
-            self.pool = PagedKVCache(
+            self.pool = pool if pool is not None else PagedKVCache(
                 cfg, max_batch, self.max_seq_len, num_blocks=num_blocks,
                 block_size=block_size,
                 enable_prefix_caching=enable_prefix_caching)
             self.cache = None
         else:
+            assert pool is None, "pool injection requires paged=True"
             self.pool = None
             self.cache = init_kv_cache(cfg, max_batch, self.max_seq_len)
+
+        # TP serving mesh (ISSUE 9): with a MeshContext whose tp > 1 and
+        # a tp-eligible paged config, params replicate over the mesh and
+        # the pool pages shard on their Hkv dim — the one-jit-per-step
+        # then runs the paged kernels head-sharded (per-shard KV pools,
+        # replicated page tables; see ops/pallas/paged_attention.py).
+        self.ctx = ctx
+        self.tp_paged = False
+        if ctx is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            # manual-ok: engine construction runs outside any manual
+            # region — mesh-level placement of params/pool is GSPMD by
+            # design here.
+            self._params_sharding = NamedSharding(ctx.mesh, P())
+            self.params = jax.device_put(params, self._params_sharding)  # manual-ok: see above
+            if paged:
+                from megatronapp_tpu.config.parallel_config import TP_AXIS
+                from megatronapp_tpu.ops.pallas.paged_attention import (
+                    tp_paged_eligible,
+                )
+                self.tp_paged = tp_paged_eligible(cfg, ctx)
+                # Pages [L, NB, bs, Hkv, D]: shard Hkv when eligible so
+                # each device holds 1/tp of the pool; otherwise just
+                # commit them to this mesh (disagg decode sub-mesh).
+                pages_spec = (P(None, None, None, TP_AXIS, None)
+                              if self.tp_paged else P())
+                # manual-ok: constructor-time placement, no manual region
+                self.pool.place_pages(NamedSharding(ctx.mesh, pages_spec))
+            else:
+                # manual-ok: constructor-time placement, no manual region
+                self.cache = jax.device_put(self.cache,
+                                            self._params_sharding)
+        else:
+            self._params_sharding = None
         self.lengths = np.zeros((max_batch,), np.int32)
         self.last_tokens = np.zeros((max_batch, 1), np.int32)
         self.slots: List[Optional[Request]] = [None] * max_batch
@@ -379,16 +452,22 @@ class DynamicInferenceEngine:
         self._sample_b = jax.jit(_sample_batched)
         if self.paged:
             msl = self.max_seq_len
+            # ctx rides into the step only on a tp-paged mesh (it then
+            # dispatches the head-sharded kernel placement inside
+            # attention_forward); otherwise the trace stays identical to
+            # the single-device engine.
+            step_ctx = self.ctx if self.tp_paged else None
             self._decode = jax.jit(
                 lambda p, t, pages, tbl, l, a: _paged_decode_step(
-                    p, t, pages, tbl, l, a, cfg, msl),
+                    p, t, pages, tbl, l, a, cfg, msl, ctx=step_ctx),
                 donate_argnums=(2,))
 
             def _mq_traced(p, t, pages, tbl, starts, qlens, act):
                 # Python side-effect: runs only while TRACING.
                 self.mq_traces += 1
                 return _paged_multiquery_step(p, t, pages, tbl, starts,
-                                              qlens, act, cfg, msl)
+                                              qlens, act, cfg, msl,
+                                              ctx=step_ctx)
 
             self._mq_step = jax.jit(_mq_traced, donate_argnums=(2,))
             from megatronapp_tpu.ops.pallas.paged_attention import (
@@ -421,28 +500,10 @@ class DynamicInferenceEngine:
                     eod_id: Optional[int] = None,
                     priority: int = 0,
                     deadline_s: Optional[float] = None) -> int:
-        import time as _time
-        if deadline_s is not None and _time.monotonic() >= deadline_s:
-            raise DeadlineExceeded(
-                "request deadline already expired at admission")
-        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
-        if len(prompt) == 0:
-            raise ValueError(
-                "empty prompt: prefill samples the first token from the "
-                "last PROMPT position, so at least one token (e.g. BOS/"
-                "eod) is required")
-        if len(prompt) + max_new_tokens > self.max_seq_len:
-            raise ValueError(
-                f"prompt({len(prompt)}) + max_new({max_new_tokens}) exceeds "
-                f"max_seq_len({self.max_seq_len})")
-        if self.paged:
-            need = cdiv(len(prompt) + max_new_tokens, self.pool.block_size)
-            if need > self.pool.num_blocks:
-                raise ValueError(
-                    f"request needs {need} blocks "
-                    f"(prompt {len(prompt)} + max_new {max_new_tokens} at "
-                    f"block_size {self.pool.block_size}) but the pool has "
-                    f"only {self.pool.num_blocks}")
+        prompt = validate_admission(prompt_tokens, max_new_tokens,
+                                    self.max_seq_len,
+                                    pool=self.pool if self.paged else None,
+                                    deadline_s=deadline_s)
         req = Request(next(self._ids), prompt, max_new_tokens,
                       sampling or SamplingParams(), eod_id=eod_id,
                       priority=priority, deadline_s=deadline_s)
@@ -562,8 +623,54 @@ class DynamicInferenceEngine:
         return bool(self.waiting) or any(
             r is not None for r in self.slots)
 
+    def set_params(self, params):
+        """Install new model params (rolling engine reload). Same pytree
+        structure/shapes as the old ones, so every jit trace stays valid
+        — the driver drains running requests first and swaps on an empty
+        batch, then re-admits the waiting queue against the new
+        weights. The prefix cache is flushed: its blocks hold KV from
+        the OLD weights."""
+        if self._params_sharding is not None:
+            # manual-ok: host-side reload path, no manual region
+            params = jax.device_put(params, self._params_sharding)
+        self.params = params
+        if self.pool is not None:
+            self.pool.flush_prefix_cache()
+
+    def free_decode_slots(self) -> int:
+        return sum(1 for r in self.slots if r is None)
+
+    def drained_for_reload(self) -> bool:
+        """True when a rolling params swap is safe: no occupied slots
+        (waiting requests keep their position and run on new weights)."""
+        return all(r is None for r in self.slots)
+
+    def adopt_request(self, req: Request, src_slot: int, length: int
+                      ) -> int:
+        """Adopt a prefilled request from the disaggregated prefill side
+        (inference/disagg.py): move its pool blocks from staging slot
+        `src_slot` into a free decode slot via the pool's page-table
+        transfer — NO KV copy — and resume decoding at `length` (the
+        prompt KV rows written by prefill; the first generated token was
+        already sampled prefill-side with the identical fold_in chain).
+        Returns the decode slot."""
+        assert self.paged, "adoption requires the paged backend"
+        slot = next(i for i in range(self.max_batch)
+                    if self.slots[i] is None)
+        self.pool.transfer_slot(src_slot, slot)
+        req.slot = slot
+        self.slots[slot] = req
+        self.requests[req.request_id] = req
+        self.lengths[slot] = length
+        self.last_tokens[slot, 0] = req.generated[-1]
+        if self.proposer is not None:
+            self.proposer.on_admit(slot, req)
+        return slot
+
     def _admit(self) -> List[Request]:
         admitted = []
+        if self.pause_admission:
+            return admitted
         for slot in range(self.max_batch):
             if self.slots[slot] is not None or not self.waiting:
                 continue
@@ -850,7 +957,8 @@ class DynamicInferenceEngine:
         if self.paged:
             logits, self.pool.pages = self._decode(
                 self.params, jnp.asarray(self.last_tokens),
-                self.pool.pages, jnp.asarray(self.pool.page_table),
+                self.pool.pages,
+                jnp.asarray(self.pool.page_table[:self.max_batch]),
                 lengths, active_mask)
         else:
             logits, self.cache = self._decode(
@@ -888,6 +996,28 @@ class DynamicInferenceEngine:
                 k_caps[slot] = self.pool.extend_capacity(
                     slot, length + 1, want)
 
+        try:
+            self._spec_round_inner(active, events, k_caps)
+        except Exception:
+            # Leave the pool consistent on ANY mid-round failure (the
+            # "spec-verify" chaos drill): every surviving slot rewinds
+            # to its last VERIFIED length (+1 for this step's guaranteed
+            # append block) — written-but-unaccepted draft KV becomes
+            # stale rows that the retried round overwrites, and the
+            # over-granted speculative tail blocks go back to the pool.
+            # Slots already advanced by this round keep their accepted
+            # tokens (their rewind is a no-op). audit() passes either
+            # way.
+            for req in active:
+                if req.slot >= 0:
+                    self.pool.rewind(req.slot,
+                                     int(self.lengths[req.slot]) + 1)
+            raise
+
+    def _spec_round_inner(self, active: List[Request], events: Dict,
+                          k_caps: np.ndarray):
+        from megatronapp_tpu.utils import chaos
+        b, k = self.max_batch, self.spec_k
         drafts, counts, q_probs = self.proposer.propose(k_caps)
         if not counts.any():
             # Nothing proposed anywhere (e.g. n-gram on non-repetitive
@@ -916,9 +1046,16 @@ class DynamicInferenceEngine:
 
         logits, hidden, self.pool.pages = self._mq_step(
             self.params, jnp.asarray(tokens), self.pool.pages,
-            jnp.asarray(self.pool.page_table), jnp.asarray(self.lengths),
+            jnp.asarray(self.pool.page_table[:self.max_batch]),
+            jnp.asarray(self.lengths),
             jnp.asarray(q_lens), jnp.asarray(active_np))
         logits = mask_padded_vocab(logits, self.cfg)
+        # Chaos site "spec-verify": fires at the WORST point — the
+        # multi-query step already wrote every draft token's KV, nothing
+        # is accepted yet — so the drill proves _spec_round's rollback
+        # (rewind to the last verified length) keeps the pool auditable
+        # and the stream exact.
+        chaos.fire("spec-verify")
         accepts, out_toks = self._verify_sample(
             logits, jnp.asarray(drafts), jnp.asarray(q_lens), q_probs,
             jnp.asarray(rows["seeds"]), jnp.asarray(rows["rids"]),

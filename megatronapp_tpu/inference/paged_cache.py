@@ -55,7 +55,8 @@ class PagedKVCache:
 
     def __init__(self, cfg: TransformerConfig, max_batch: int,
                  max_seq_len: int, num_blocks: Optional[int] = None,
-                 block_size: int = 16, enable_prefix_caching: bool = True):
+                 block_size: int = 16, enable_prefix_caching: bool = True,
+                 extra_slots: int = 0):
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len
@@ -66,6 +67,11 @@ class PagedKVCache:
         self.num_blocks = (num_blocks if num_blocks is not None
                            else max_batch * self.max_blocks_per_seq)
         self.enable_prefix_caching = enable_prefix_caching
+        # extra_slots: staging page-table rows past the engine's decode
+        # slots — the disaggregated prefill side (inference/disagg.py)
+        # admits in-flight prefills there and hands finished ones to a
+        # decode slot via transfer_slot (pure bookkeeping, no KV copy).
+        self.num_slots = max_batch + extra_slots
 
         l = cfg.num_layers
         nb, bs = self.num_blocks, self.block_size
@@ -79,17 +85,29 @@ class PagedKVCache:
             self.pages = (jnp.zeros(shape, cfg.compute_dtype),
                           jnp.zeros(shape, cfg.compute_dtype))
 
-        self.page_table = np.zeros((max_batch, self.max_blocks_per_seq),
+        self.page_table = np.zeros((self.num_slots, self.max_blocks_per_seq),
                                    np.int32)
         self._free: deque = deque(range(nb))
         self._refcount = np.zeros((nb,), np.int32)
         self._table: dict = {}            # prefix hash -> block id
         self._hash_of: dict = {}          # block id -> prefix hash
         self._lru: OrderedDict = OrderedDict()  # rc==0 hashed blocks
-        self._slot_blocks: List[List[int]] = [[] for _ in range(max_batch)]
+        self._slot_blocks: List[List[int]] = [
+            [] for _ in range(self.num_slots)]
         self.stats = {"prefix_hit_tokens": 0, "prefill_tokens": 0,
                       "cow_copies": 0, "evictions": 0, "preemptions": 0,
-                      "peak_blocks_in_use": 0}
+                      "peak_blocks_in_use": 0, "handoff_transfers": 0}
+
+    # ---- placement -------------------------------------------------------
+    def place_pages(self, sharding):
+        """Commit the page pools to an explicit device placement (tp
+        serving mesh: sharded on the Hkv dim so each device holds 1/tp
+        of the pool; disaggregated serving: the decode sub-mesh). Later
+        jnp updates (CoW copy, the engine's scatter/append jits)
+        preserve the committed sharding by propagation."""
+        import jax
+        # manual-ok: host-side pool placement, no manual region
+        self.pages = tuple(jax.device_put(p, sharding) for p in self.pages)
 
     # ---- sizing ----------------------------------------------------------
     @property
@@ -272,6 +290,34 @@ class PagedKVCache:
                 break
             granted += 1
         return granted
+
+    def flush_prefix_cache(self):
+        """Invalidate every cached prefix (rolling engine reload: blocks
+        hold KV computed with the OLD weights — a post-swap request
+        hitting them would decode new-weight logits over old-weight KV).
+        Evictable blocks return to the free list; blocks still
+        referenced by live slots merely lose their hash, so they free
+        (not LRU-park) on release."""
+        self._table.clear()
+        self._hash_of.clear()
+        for blk in self._lru:
+            self._free.append(blk)
+        self._lru.clear()
+
+    def transfer_slot(self, src: int, dst: int):
+        """Move block ownership from slot `src` to slot `dst` (which
+        must be empty): the prefill→decode KV handoff of the
+        disaggregated engine. PURE bookkeeping — the page-table row and
+        the block list move, refcounts and the page DATA are untouched,
+        so adoption never copies KV (the no-dense-copy pin in
+        tests/test_disagg.py)."""
+        assert not self._slot_blocks[dst], (
+            f"transfer_slot: destination slot {dst} still holds blocks")
+        self._slot_blocks[dst] = self._slot_blocks[src]
+        self._slot_blocks[src] = []
+        self.page_table[dst, :] = self.page_table[src, :]
+        self.page_table[src, :] = 0
+        self.stats["handoff_transfers"] += 1
 
     def rewind(self, slot: int, valid_len: int):
         """Roll back a slot to `valid_len` written positions: release the

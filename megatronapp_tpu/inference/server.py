@@ -72,7 +72,15 @@ class DynamicBatchingDriver:
     frames, reclaims the pool via abort_all, counts a restart, and backs
     off exponentially on consecutive failures so a persistent fault
     can't spin the thread hot); GET /healthz reports liveness, restart
-    count, and pool pressure."""
+    count, and pool pressure.
+
+    Rolling engine reload (ISSUE 9): `request_reload(params)` swaps the
+    model weights WITHOUT dropping the in-flight batch — admission
+    pauses, running requests drain to completion, the swap lands on an
+    empty batch (both sub-meshes for a disaggregated engine), and the
+    still-waiting queue is then admitted against the new weights. The
+    returned event fires when the swap is done; /healthz counts
+    `reloads`."""
 
     def __init__(self, engine, crash_backoff_base: float = 0.25,
                  crash_backoff_cap: float = 5.0):
@@ -89,6 +97,9 @@ class DynamicBatchingDriver:
         self.deadline_expired = 0     # requests aborted past deadline
         self.crash_backoff_base = crash_backoff_base
         self.crash_backoff_cap = crash_backoff_cap
+        # Rolling reload state: (params, done_event) or None.
+        self._reload = None
+        self.reloads = 0
 
     def _ensure_thread(self):
         if self._thread is None or not self._thread.is_alive():
@@ -127,6 +138,46 @@ class DynamicBatchingDriver:
             self._cv.notify_all()
         return rid, done
 
+    def request_reload(self, params) -> threading.Event:
+        """Schedule a rolling params swap (checkpoint reload): pauses
+        admission, lets running requests drain, swaps on the empty
+        batch, then resumes admission for the waiting queue. Returns an
+        event that fires once the new weights are live. Thread-safe; a
+        second reload request before the first lands supersedes its
+        params, and BOTH events fire when the (latest) swap lands — a
+        superseded waiter must not block forever."""
+        done = threading.Event()
+        with self._cv:
+            waiters = ([done] if self._reload is None
+                       else self._reload[1] + [done])
+            self._reload = (params, waiters)
+            self._ensure_thread()
+            self._cv.notify_all()
+        return done
+
+    def _maybe_reload_locked(self):
+        """Advance the rolling reload state machine (caller holds _cv):
+        pause admission while a reload is pending; perform the swap the
+        moment the engine is drained of RUNNING work (waiting requests
+        keep their queue position and decode on the new weights)."""
+        if self._reload is None:
+            return
+        self.engine.pause_admission = True
+        drained = (self.engine.drained_for_reload()
+                   if hasattr(self.engine, "drained_for_reload")
+                   else all(r is None for r in self.engine.slots))
+        if not drained:
+            return
+        params, waiters = self._reload
+        try:
+            self.engine.set_params(params)
+        finally:
+            self.engine.pause_admission = False
+            self._reload = None
+        self.reloads += 1
+        for done in waiters:
+            done.set()
+
     def cancel(self, rid):
         with self._cv:
             state = self.engine.abort_request(rid)
@@ -155,8 +206,12 @@ class DynamicBatchingDriver:
     def _loop(self):
         while True:
             with self._cv:
-                while not self.engine.has_work:
+                while not (self.engine.has_work or
+                           self._reload is not None):
                     self._cv.wait()
+                self._maybe_reload_locked()
+                if not self.engine.has_work:
+                    continue
             try:
                 chaos.fire("stepper-step")
                 ev = self.engine.step()
@@ -218,6 +273,8 @@ class DynamicBatchingDriver:
             "deadline_expired": self.deadline_expired,
             "subscribers": len(self._subs),
             "max_active": self.max_active,
+            "reloads": self.reloads,
+            "reload_pending": self._reload is not None,
         }
 
 
@@ -244,13 +301,17 @@ class TextGenerationServer:
         # would cross-contaminate (the reference server serializes with a
         # lock too, text_generation_server.py MegatronServer).
         self._gen_lock = threading.Lock()
-        # Continuous batching for DynamicInferenceEngine: connections
-        # share one engine through a single stepper thread.
+        # Continuous batching for DynamicInferenceEngine (and the
+        # disaggregated coordinator, which exposes the same stepping
+        # surface): connections share one engine through a single
+        # stepper thread.
+        from megatronapp_tpu.inference.disagg import DisaggServingEngine
         from megatronapp_tpu.inference.dynamic_engine import (
             DynamicInferenceEngine,
         )
         self._driver = (DynamicBatchingDriver(engine)
-                        if isinstance(engine, DynamicInferenceEngine)
+                        if isinstance(engine, (DynamicInferenceEngine,
+                                               DisaggServingEngine))
                         else None)
 
     # ------------------------------------------------------------------
@@ -590,8 +651,17 @@ class TextGenerationServer:
             eng = self.engine
             out["active"] = sum(1 for r in eng.slots if r is not None)
             out["waiting"] = len(eng.waiting)
-            pool_stats = (eng.stats_snapshot().get("pool")
-                          if hasattr(eng, "stats_snapshot") else None)
+            snap = (eng.stats_snapshot()
+                    if hasattr(eng, "stats_snapshot") else {})
+            if "disagg" in snap:
+                # Per-queue depth + SLO attainment ride into /healthz so
+                # an orchestrator can rotate on SLO pressure without
+                # scraping /stats.
+                out["disagg"] = {
+                    "queues": snap["disagg"]["queues"],
+                    "slo": snap["disagg"]["slo"],
+                }
+            pool_stats = snap.get("pool")
             if pool_stats is not None:
                 # One source of truth for the pool fields (the engine's
                 # /stats payload); only the pressure ratio is derived

@@ -22,6 +22,17 @@ A pure-jnp `paged_attention_reference` with the same signature is the
 parity oracle for tests, and `write_prompt_pages` /
 `append_token_pages` / `gather_pages*` are the jit-able scatter/gather
 paths that replace the dense engine's host-side cache scatter.
+
+TP sharding (ISSUE 9): GSPMD cannot partition a pallas_call, so — exactly
+like the flash wrapper in transformer/attention.py — the tp-mesh serving
+path places the kernels explicitly with a FULL-MANUAL shard_map over KV
+heads: `paged_attention_decode_tp` / `paged_attention_multiquery_tp` run
+the unmodified kernels on per-shard head slices (q heads and kv heads
+slice contiguously together, so each shard owns matched GQA groups and
+`group` is unchanged), with the page table and kv lengths replicated and
+the K/V pools sharded on their Hkv dim — each device holds 1/tp of the
+block pool and does 1/tp of the attention FLOPs/bytes. Eligibility is
+`tp_paged_eligible` (heads divisible by tp, non-MLA pools).
 """
 
 from __future__ import annotations
@@ -438,3 +449,89 @@ def gather_pages_batched(pages: jnp.ndarray, page_table: jnp.ndarray
     bs = pages.shape[1]
     out = jnp.take(pages, page_table.reshape(-1), axis=0, mode="clip")
     return out.reshape((b, mb * bs) + pages.shape[2:])
+
+
+# ---------------------------------------------------------------------------
+# TP-sharded kernel placement (full-manual shard_map over KV heads)
+# ---------------------------------------------------------------------------
+
+
+def tp_paged_eligible(cfg, ctx) -> bool:
+    """True when the paged kernels may run head-sharded on ctx's tp axis:
+    tp > 1, standard (non-MLA) paged layout, and both head counts divide
+    by tp so each shard owns whole, matched GQA groups (q head h reads kv
+    head h // group — contiguous slicing of BOTH by tp preserves the
+    grouping per shard, the same eligibility rule as the flash
+    wrapper)."""
+    return (ctx is not None and ctx.tp > 1
+            and not cfg.multi_latent_attention
+            and cfg.num_attention_heads % ctx.tp == 0
+            and cfg.num_query_groups % ctx.tp == 0)
+
+
+def _tp_specs(mesh):
+    from jax.sharding import PartitionSpec as P
+    from megatronapp_tpu.config.parallel_config import TP_AXIS
+    head = P(None, TP_AXIS, None)             # q/out [B, Hq, D]
+    pages = P(None, None, TP_AXIS, None)      # pools [NB, bs, Hkv, D]
+    rep2, rep1 = P(None, None), P(None)
+    return head, pages, rep2, rep1
+
+
+def paged_attention_decode_tp(q: jnp.ndarray, k_pages: jnp.ndarray,
+                              v_pages: jnp.ndarray,
+                              page_table: jnp.ndarray,
+                              kv_lens: jnp.ndarray, mesh,
+                              softmax_scale: Optional[float] = None
+                              ) -> jnp.ndarray:
+    """`paged_attention_decode` head-sharded over the tp axis of `mesh`.
+
+    q [B, Hq, D] sharded on heads, pools [NB, bs, Hkv, D] sharded on
+    Hkv, page table + kv lengths replicated; each shard runs the
+    unmodified kernel on its own GQA groups against its 1/tp slice of
+    the block pool. Output is [B, Hq, D] head-sharded (callers gather /
+    constrain as needed)."""
+    from megatronapp_tpu.parallel.collectives import shard_map_compat
+    head, pages, rep2, rep1 = _tp_specs(mesh)
+    if softmax_scale is None:
+        softmax_scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    def body(q_, k_, v_, t_, l_):
+        return paged_attention_decode(q_, k_, v_, t_, l_,
+                                      softmax_scale=softmax_scale)
+
+    # manual-ok: full-manual placement of the pallas decode kernel — the
+    # kernel is purely local per (head, pool) shard, no collectives.
+    return shard_map_compat(
+        body, mesh, in_specs=(head, pages, pages, rep2, rep1),
+        out_specs=head)(q, k_pages, v_pages, page_table, kv_lens)
+
+
+def paged_attention_multiquery_tp(q: jnp.ndarray, k_pages: jnp.ndarray,
+                                  v_pages: jnp.ndarray,
+                                  page_table: jnp.ndarray,
+                                  kv_lens: jnp.ndarray,
+                                  q_lens: jnp.ndarray, mesh,
+                                  softmax_scale: Optional[float] = None
+                                  ) -> jnp.ndarray:
+    """`paged_attention_multiquery` head-sharded over the tp axis of
+    `mesh` (speculative verify / chunked prefill on a tp serving mesh).
+    q [B, S_q, Hq, D] sharded on Hq; pools on Hkv; table/lens/q_lens
+    replicated."""
+    from jax.sharding import PartitionSpec as P
+    from megatronapp_tpu.config.parallel_config import TP_AXIS
+    from megatronapp_tpu.parallel.collectives import shard_map_compat
+    _, pages, rep2, rep1 = _tp_specs(mesh)
+    head4 = P(None, None, TP_AXIS, None)      # q/out [B, S_q, Hq, D]
+    if softmax_scale is None:
+        softmax_scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    def body(q_, k_, v_, t_, l_, ql_):
+        return paged_attention_multiquery(q_, k_, v_, t_, l_, ql_,
+                                          softmax_scale=softmax_scale)
+
+    # manual-ok: full-manual placement of the pallas multi-query kernel —
+    # purely local per (head, pool) shard, no collectives.
+    return shard_map_compat(
+        body, mesh, in_specs=(head4, pages, pages, rep2, rep1, rep1),
+        out_specs=head4)(q, k_pages, v_pages, page_table, kv_lens, q_lens)

@@ -51,22 +51,33 @@ from megatronapp_tpu.config.parallel_config import ParallelConfig
 from megatronapp_tpu.parallel.mesh import MeshContext, build_mesh
 
 
+def build_half_meshes(parallel_a: ParallelConfig, parallel_b: ParallelConfig,
+                      devices) -> Tuple[MeshContext, MeshContext]:
+    """Split a device list into two disjoint half-meshes (first half →
+    parallel_a, second half → parallel_b). The shared sub-mesh
+    construction behind both disaggregation subsystems: MegaFBD's
+    forward/backward split here, and the serving-side prefill/decode
+    split (inference/disagg.py, ISSUE 9)."""
+    n = len(devices)
+    ctx_a = build_mesh(parallel_a, devices=devices[: n // 2])
+    ctx_b = build_mesh(parallel_b, devices=devices[n // 2:])
+    return ctx_a, ctx_b
+
+
 def split_fbd_meshes(parallel: ParallelConfig, devices=None
                      ) -> Tuple[MeshContext, MeshContext]:
     """Split devices into forward/backward halves (DP halved on each —
     reference assert parallel_state.py:453: DP must be even)."""
     if devices is None:
         devices = jax.devices()
-    n = len(devices)
-    dp = parallel.infer_data_parallel(n)
+    dp = parallel.infer_data_parallel(len(devices))
     if dp % 2 != 0:
         raise ValueError(
             f"forward/backward disaggregation requires even data-parallel "
             f"degree (got dp={dp}) — reference parallel_state.py:453")
     half_cfg = dataclasses.replace(parallel, data_parallel=dp // 2,
                                    forward_backward_disaggregating=False)
-    fwd_ctx = build_mesh(half_cfg, devices=devices[: n // 2])
-    bwd_ctx = build_mesh(half_cfg, devices=devices[n // 2:])
+    fwd_ctx, bwd_ctx = build_half_meshes(half_cfg, half_cfg, devices)
     # Abstract-mesh collectives: the fwd pass's pullback must be executable
     # on the twin mesh (see MeshContext.shard_map_mesh).
     fwd_ctx.abstract_collectives = True

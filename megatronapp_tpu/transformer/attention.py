@@ -63,6 +63,20 @@ def init_attention_params(rng, cfg: TransformerConfig, out_std: float):
     return p, ax
 
 
+def _replicate_heads(attn_out: jnp.ndarray, ctx) -> jnp.ndarray:
+    """Gather a head-sharded paged-attention output back to replicated
+    before the out-projection. Keeping the out-proj matmul replicated
+    (instead of a partial-contraction + all-reduce) costs one small
+    [B, S, Hq, D] all-gather per layer but makes the summation order —
+    and therefore the sampled greedy stream — bit-identical to the
+    single-device engine."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    # manual-ok: tp serving path only — callers gate on tp_paged, which
+    # requires no ambient manual axes (GSPMD constraint is legal here).
+    return jax.lax.with_sharding_constraint(
+        attn_out, NamedSharding(ctx.mesh, P()))  # manual-ok: see above
+
+
 def attention_forward(
     p, x: jnp.ndarray, cfg: TransformerConfig,
     rope_cos: Optional[jnp.ndarray] = None,
@@ -233,12 +247,31 @@ def attention_forward(
     mask_type = cfg.attn_mask_type
     if kv_cache is not None:
         ck, cv = kv_cache
+        if page_table is not None:
+            # TP serving mesh (ISSUE 9): head-shard the paged kernels
+            # over ctx's tp axis — the pool is sharded on Hkv (1/tp of
+            # the KV bytes and attention FLOPs per device) and the
+            # kernel is placed with a full-manual shard_map, exactly
+            # like the flash wrapper above. The output is constrained
+            # back to REPLICATED before the out-projection so every
+            # device runs the identical dense matmul — per-request
+            # greedy streams stay bit-identical to the single-device
+            # engine (the tp2 parity pin in tests/test_disagg.py).
+            from megatronapp_tpu.ops.pallas.paged_attention import (
+                tp_paged_eligible,
+            )
+            from megatronapp_tpu.parallel.collectives import (
+                current_manual_axes,
+            )
+            tp_paged = (tp_paged_eligible(cfg, ctx)
+                        and not current_manual_axes())
         if page_table is not None and (s > 1 or chunk_counts is not None):
             # Multi-token paged append (speculative verify / chunked
             # prefill): write the ragged chunk then attend through the
             # multi-query kernel.
             from megatronapp_tpu.ops.pallas.paged_attention import (
                 append_chunk_pages, paged_attention_multiquery,
+                paged_attention_multiquery_tp,
             )
             if active is None:
                 active = jnp.ones((b,), bool)
@@ -249,13 +282,22 @@ def attention_forward(
             cv = append_chunk_pages(cv, v, page_table, cache_positions,
                                     counts, active)
             new_cache = (ck, cv)
-            paged_out = paged_attention_multiquery(
-                q, ck, cv, page_table, cache_positions + counts, counts)
+            if tp_paged:
+                # manual-ok: tp_paged requires no ambient manual axes
+                paged_out = paged_attention_multiquery_tp(
+                    q, ck, cv, page_table, cache_positions + counts,
+                    counts, ctx.shard_map_mesh)
+                paged_out = _replicate_heads(paged_out, ctx)
+            else:
+                paged_out = paged_attention_multiquery(
+                    q, ck, cv, page_table, cache_positions + counts,
+                    counts)
         elif page_table is not None:
             # Paged continuous-batching decode: kv_cache is the shared
             # block pool; cache_positions[b] is row b's append position.
             from megatronapp_tpu.ops.pallas.paged_attention import (
                 append_token_pages, paged_attention_decode,
+                paged_attention_decode_tp,
             )
             if active is None:
                 active = jnp.ones((b,), bool)
@@ -264,9 +306,16 @@ def attention_forward(
             cv = append_token_pages(cv, v[:, 0], page_table,
                                     cache_positions, active)
             new_cache = (ck, cv)
-            paged_out = paged_attention_decode(
-                q[:, 0], ck, cv, page_table,
-                cache_positions + 1)[:, None]          # [B, 1, Hq, D]
+            if tp_paged:
+                # manual-ok: tp_paged requires no ambient manual axes
+                paged_out = paged_attention_decode_tp(
+                    q[:, 0], ck, cv, page_table, cache_positions + 1,
+                    ctx.shard_map_mesh)[:, None]
+                paged_out = _replicate_heads(paged_out, ctx)
+            else:
+                paged_out = paged_attention_decode(
+                    q[:, 0], ck, cv, page_table,
+                    cache_positions + 1)[:, None]      # [B, 1, Hq, D]
         elif cache_positions is not None:
             # Continuous-batching decode (dynamic_context.py analogue):
             # each row appends at ITS OWN position; causality MUST come

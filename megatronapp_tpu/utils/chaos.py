@@ -48,6 +48,14 @@ tests/test_resilience.py pins this registry against its drill list):
                              cached prompt fails (_copy_block) —
                              exercises the admit rollback path with
                              cached-prefix refs already acquired.
+- ``spec-verify``            a speculative verify round fails AFTER the
+                             multi-query step wrote the draft tokens' KV
+                             but before acceptance was applied
+                             (dynamic_engine._spec_round) — exercises
+                             the round's rollback: every slot rewinds to
+                             its last verified length, pool audit()
+                             passes, and the retried round leaves the
+                             emitted stream unchanged.
 
 Simulated whole-process faults (hang / exit) are flag-driven rather than
 registry-driven: --simulated-fault KIND:DELAY routes through
@@ -68,6 +76,7 @@ SITES = (
     "stepper-step",
     "paged-evict",
     "paged-cow",
+    "spec-verify",
 )
 
 

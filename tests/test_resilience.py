@@ -57,10 +57,11 @@ class TestChaosRegistry:
         TestCheckpointSaveRetry, local-checkpoint-save →
         TestLocalCheckpointRobustness, step-nan → TestStepNanInjection,
         stepper-step → TestServingSelfHealing, paged-evict/paged-cow →
-        TestPagedAllocatorChaos)."""
+        TestPagedAllocatorChaos, spec-verify →
+        TestSpeculativeVerifierChaos)."""
         assert chaos.SITES == ("checkpoint-save", "local-checkpoint-save",
                                "step-nan", "stepper-step",
-                               "paged-evict", "paged-cow")
+                               "paged-evict", "paged-cow", "spec-verify")
 
     def test_arm_fire_bounded_and_auto_disarm(self):
         chaos.arm("stepper-step", times=2, after=1)
@@ -191,6 +192,53 @@ class TestPagedAllocatorChaos:
         pool.audit()
         assert pool.ensure_capacity(0, 4)       # recovery
         pool.audit()
+
+
+# ---------------------------------------------------------------------------
+class TestSpeculativeVerifierChaos:
+    """Chaos site in the speculative verifier (ISSUE 9 satellite,
+    closing the carried ROADMAP follow-up): a fault INSIDE a verify
+    round — after the multi-query step wrote every draft token's KV but
+    before acceptance applied — must roll the round back (rewind to the
+    last verified length), keep the pool auditable, and leave the
+    emitted greedy stream bit-identical to an unfaulted run."""
+
+    def test_verify_fault_rewinds_and_stream_exact(self):
+        from megatronapp_tpu.inference.dynamic_engine import (
+            DynamicInferenceEngine,
+        )
+        from megatronapp_tpu.inference.engine import SamplingParams
+        from megatronapp_tpu.models.gpt import init_gpt_params
+        cfg = tiny_model(num_query_groups=2, compute_dtype=jnp.float32,
+                         remat_policy="none")
+        params, _ = init_gpt_params(jax.random.PRNGKey(3), cfg)
+        # Repetitive prompt so the n-gram proposer actually drafts.
+        prompt = np.asarray([5, 6, 7, 5, 6, 7, 5, 6, 7], np.int32)
+
+        def run(fault: bool):
+            eng = DynamicInferenceEngine(
+                params, cfg, max_batch=2, max_seq_len=64,
+                prefill_buckets=(16,), paged=True, block_size=8,
+                spec_method="ngram", spec_k=3, prefill_chunk=8)
+            rid = eng.add_request(prompt, 8, SamplingParams(greedy=True))
+            faults = 0
+            if fault:
+                chaos.arm("spec-verify", times=1)
+            while eng.has_work:
+                try:
+                    eng.step()
+                except chaos.ChaosFault:
+                    faults += 1
+                    eng.pool.audit()     # rollback left no leak/skew
+            eng.pool.audit()
+            res = eng.requests[rid].tokens.tolist()
+            return res, faults
+
+        clean, _ = run(fault=False)
+        faulted, faults = run(fault=True)
+        assert faults == 1, "the armed fault must fire inside a round"
+        assert faulted == clean, (
+            "retried verify round changed the emitted stream")
 
 
 # ---------------------------------------------------------------------------

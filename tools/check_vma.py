@@ -79,6 +79,15 @@ MANUAL_REGION_MODULES = (
     # ISSUE 7: region-creating + GSPMD-layer constructs of the ZeRO-1
     # distributed optimizer must carry audited `manual-ok:` notes.
     "megatronapp_tpu/training/distributed_optimizer.py",
+    # ISSUE 9 (disaggregated serving): the tp-sharded paged-kernel
+    # placement, the serving engine's mesh placement of params/pool, and
+    # the prefill→decode cross-mesh handoff all sit next to (or inside)
+    # jitted paths that also trace under ambient-manual callers — every
+    # region-creating / GSPMD construct must carry an audited note.
+    "megatronapp_tpu/ops/pallas/paged_attention.py",
+    "megatronapp_tpu/inference/dynamic_engine.py",
+    "megatronapp_tpu/inference/disagg.py",
+    "megatronapp_tpu/inference/paged_cache.py",
 )
 
 GSPMD_RE = re.compile(

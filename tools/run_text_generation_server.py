@@ -106,17 +106,52 @@ def main():
                 print("WARNING: draft model is randomly initialized "
                       "(--draft-load-dir not given) — acceptance will be "
                       "poor; outputs stay exact either way")
+        spec = None if args.spec_method == "none" else args.spec_method
+        if args.serve_disagg:
+            if not args.paged_kv_cache:
+                raise SystemExit("--serve-disagg needs --paged-kv-cache "
+                                 "(the KV handoff rides the block pool)")
+            from megatronapp_tpu.inference.disagg import (
+                DisaggServingEngine,
+            )
+            engine = DisaggServingEngine(
+                params, cfg, tokenizer=tok, max_batch=args.max_batch,
+                max_seq_len=args.max_seq_len,
+                block_size=args.kv_block_size,
+                num_blocks=args.num_kv_blocks,
+                enable_prefix_caching=args.prefix_caching,
+                prefill_chunk=args.prefill_chunk,
+                prefill_slots=args.disagg_prefill_slots,
+                decode_slo_ms=args.decode_slo_ms, tp=args.serve_tp,
+                spec_method=spec, spec_k=args.spec_k,
+                draft_params=draft_params, draft_cfg=draft_cfg)
+            print(f"serving DISAGGREGATED on {args.host}:{args.port} "
+                  f"(prefill {engine.prefill_ctx.num_devices}d / decode "
+                  f"{engine.decode_ctx.num_devices}d, tp={args.serve_tp}, "
+                  f"slo={args.decode_slo_ms} ms, "
+                  f"spec={spec or 'off'})")
+            TextGenerationServer(engine, args.host, args.port).run()
+            return
+        tp_ctx = None
+        if args.serve_tp > 1:
+            from megatronapp_tpu.config.parallel_config import (
+                ParallelConfig,
+            )
+            from megatronapp_tpu.parallel.mesh import build_mesh
+            tp_ctx = build_mesh(
+                ParallelConfig(tensor_parallel=args.serve_tp),
+                devices=jax.devices()[:args.serve_tp])
         engine = DynamicInferenceEngine(
             params, cfg, tokenizer=tok, max_batch=args.max_batch,
             max_seq_len=args.max_seq_len, paged=args.paged_kv_cache,
             block_size=args.kv_block_size, num_blocks=args.num_kv_blocks,
             enable_prefix_caching=args.prefix_caching,
-            spec_method=(None if args.spec_method == "none"
-                         else args.spec_method),
+            spec_method=spec,
             spec_k=args.spec_k, draft_params=draft_params,
-            draft_cfg=draft_cfg)
+            draft_cfg=draft_cfg, prefill_chunk=args.prefill_chunk,
+            ctx=tp_ctx)
         print(f"serving continuous batching on {args.host}:{args.port} "
-              f"(paged={args.paged_kv_cache}, "
+              f"(paged={args.paged_kv_cache}, tp={args.serve_tp}, "
               f"spec={engine.spec_method or 'off'})")
         TextGenerationServer(engine, args.host, args.port).run()
         return
