@@ -133,6 +133,17 @@ def _spec_decode_hook():
     return r if r.get("ngram") else None
 
 
+def _kv_quant_hook():
+    """int8-vs-bf16 KV-pool serving A/B (tools/kv_quant_benchmark.py)
+    on the CPU backend — resident pool bytes, sessions-at-capacity,
+    tokens/s, logits parity, and spec-decode acceptance delta tracked
+    round over round like the other hooks."""
+    if os.environ.get("BENCH_KV_QUANT", "1") != "1":
+        return None
+    r = _run_child("--kv-quant", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("memory_decode") else None
+
+
 def _disagg_hook():
     """Colocated-vs-disaggregated serving A/B
     (tools/disagg_benchmark.py) on the CPU sub-meshes — decode p99
@@ -190,6 +201,9 @@ def _attach_overlap_hooks(res):
     dsg = _disagg_hook()
     if dsg:
         res.setdefault("extra", {})["disagg"] = dsg
+    kvq = _kv_quant_hook()
+    if kvq:
+        res.setdefault("extra", {})["kv_quant"] = kvq
     return res
 
 
@@ -261,6 +275,7 @@ def parent_main(local_only: bool = False):
     dop = _dist_opt_hook()
     pkv = _paged_kv_hook()
     spd = _spec_decode_hook()
+    kvq = _kv_quant_hook()
     last = _load_last_good()
     if last is not None:
         # Top-level `stale` so the consumer can verifiably distinguish this
@@ -289,6 +304,8 @@ def parent_main(local_only: bool = False):
             last["extra"]["paged_kv"] = pkv
         if spd:
             last["extra"]["spec_decode"] = spd
+        if kvq:
+            last["extra"]["kv_quant"] = kvq
         print(json.dumps(last))
         return
     if cpu:
@@ -307,6 +324,8 @@ def parent_main(local_only: bool = False):
             cpu.setdefault("extra", {})["paged_kv"] = pkv
         if spd:
             cpu.setdefault("extra", {})["spec_decode"] = spd
+        if kvq:
+            cpu.setdefault("extra", {})["kv_quant"] = kvq
         print(json.dumps(cpu))
         return
     print(json.dumps({
@@ -436,6 +455,13 @@ def spec_decode_main():
     from tools.spec_decode_benchmark import run
     print(json.dumps(run(n_requests=4, motif_len=12, repeats=4,
                          max_new=24, spec_k=4)))
+
+
+def kv_quant_main():
+    """int8-vs-bf16 KV pool A/B child (CPU env set by the parent)."""
+    from tools.kv_quant_benchmark import run
+    print(json.dumps(run(max_batch=4, block_size=8, max_new=6,
+                         spec_k=4)))
 
 
 def disagg_main():
@@ -577,6 +603,8 @@ if __name__ == "__main__":
         paged_kv_main()
     elif "--spec-decode" in sys.argv:
         spec_decode_main()
+    elif "--kv-quant" in sys.argv:
+        kv_quant_main()
     elif "--disagg" in sys.argv:
         disagg_main()
     else:

@@ -53,6 +53,22 @@ def add_serving_args(ap: argparse.ArgumentParser):
     g.add_argument("--no-prefix-caching", action="store_false",
                    dest="prefix_caching",
                    help="disable refcounted shared-prefix block reuse")
+    # Quantized serving (ISSUE 10).
+    g.add_argument("--kv-cache-dtype", choices=["bf16", "int8"],
+                   default="bf16",
+                   help="paged KV-pool storage dtype: int8 stores pages "
+                        "quantized per (row, kv-head) with fp32 scales "
+                        "alongside — ~(D+4)/2D of the bf16 pool bytes, "
+                        "dequantized in-kernel on each DMA'd block "
+                        "(needs --paged-kv-cache; MLA latent pools are "
+                        "bf16-only)")
+    g.add_argument("--quantized-weights", action="store_true",
+                   help="serve from int8 weights kept RESIDENT (per-"
+                        "channel dequant fused at matmul entry, param "
+                        "HBM ~halved) instead of dequantize-on-load; "
+                        "pairs with --load-quantized, otherwise the "
+                        "loaded/initialized params are PTQ-quantized at "
+                        "startup")
     g.add_argument("--spec-method", default="none",
                    choices=["none", "draft", "mtp", "ngram"],
                    help="speculative decoding over the paged engine "
@@ -103,6 +119,32 @@ def add_serving_args(ap: argparse.ArgumentParser):
                         "would push the interval past this; /stats "
                         "and /healthz report attainment")
     return g
+
+
+def validate_serving_args(args, multi_latent_attention: bool = False):
+    """Parse-time validation of the serving flag combinations (single
+    source of truth for every entry point consuming add_serving_args) —
+    reject impossible configs with an actionable message instead of a
+    deep stack trace at engine construction."""
+    if getattr(args, "kv_cache_dtype", "bf16") == "int8":
+        if not getattr(args, "paged_kv_cache", False):
+            raise SystemExit(
+                "--kv-cache-dtype int8 requires --paged-kv-cache (the "
+                "per-block quantization scales live alongside the block "
+                "pool; the dense slot cache has no block structure)")
+        if multi_latent_attention:
+            raise SystemExit(
+                "--kv-cache-dtype int8 is not supported for MLA "
+                "presets: the latent pool is already a compressed "
+                "representation and stays bf16-only for now — drop "
+                "--kv-cache-dtype int8 or pick a non-MLA preset")
+    if (getattr(args, "quantized_weights", False)
+            and getattr(args, "engine", "static") == "mamba"):
+        raise SystemExit(
+            "--quantized-weights supports the gpt engines only: "
+            "mamba_forward does not resolve resident int8 kernels "
+            "(drop the flag, or serve the artifact without it to "
+            "dequantize on load)")
 
 
 def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:

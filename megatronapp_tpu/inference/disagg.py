@@ -88,6 +88,11 @@ def split_serving_meshes(tp: int = 1, devices=None
     return build_half_meshes(par, par, list(devices)[:need])
 
 
+def _split2(four):
+    """((k, v, k_scales, v_scales)) → ((k, v), (k_scales, v_scales))."""
+    return tuple(four[:2]), tuple(four[2:])
+
+
 @dataclasses.dataclass
 class PrefillState:
     """One in-flight (or parked) prefill on the prefill sub-mesh."""
@@ -111,7 +116,7 @@ class PrefillWorker:
         import functools
         from jax.sharding import NamedSharding, PartitionSpec as P
         from megatronapp_tpu.ops.pallas.paged_attention import (
-            gather_prefix_pages, write_prompt_pages,
+            gather_prefix_pages, quantize_kv_rows, write_prompt_pages,
         )
         self.cfg = cfg
         self.pool = pool
@@ -136,15 +141,39 @@ class PrefillWorker:
         # replicated on the decode mesh): the engine's decode jit and
         # this write alternate on the same buffers, and a sharding flip
         # between them would force a retrace every handoff.
-        def _write_both(pk, pv, rk, rv, table_row, start, count):
-            return (write_prompt_pages(pk, rk, table_row, start, count),
-                    write_prompt_pages(pv, rv, table_row, start, count))
-
         # manual-ok: mesh-level placement outside any manual region.
-        self._write = jax.jit(
-            _write_both, donate_argnums=(0, 1),
-            out_shardings=(pool.pages[0].sharding,
-                           pool.pages[1].sharding))
+        if pool.quantized:
+            # int8 pool: rows quantize ON THE PREFILL MESH (one jit) so
+            # the cross-mesh handoff ships int8 rows + fp32 scales —
+            # (D + 4) / (2 D) of the bf16 row bytes — and the fused
+            # scatter commits all four pool tensors.
+            self._quantize = jax.jit(quantize_kv_rows)
+
+            def _write_quant(pk, pv, sk, sv, rk, rv, rsk, rsv,
+                             table_row, start, count):
+                w = write_prompt_pages
+                return (w(pk, rk, table_row, start, count),
+                        w(pv, rv, table_row, start, count),
+                        w(sk, rsk, table_row, start, count),
+                        w(sv, rsv, table_row, start, count))
+
+            self._write = jax.jit(
+                _write_quant, donate_argnums=(0, 1, 2, 3),
+                out_shardings=(pool.pages[0].sharding,
+                               pool.pages[1].sharding,
+                               pool.scales[0].sharding,
+                               pool.scales[1].sharding))
+        else:
+            def _write_both(pk, pv, rk, rv, table_row, start, count):
+                return (write_prompt_pages(pk, rk, table_row, start,
+                                           count),
+                        write_prompt_pages(pv, rv, table_row, start,
+                                           count))
+
+            self._write = jax.jit(
+                _write_both, donate_argnums=(0, 1),
+                out_shardings=(pool.pages[0].sharding,
+                               pool.pages[1].sharding))
         self._gather = jax.jit(gather_prefix_pages, static_argnums=(2,))
         self.stats = {"prefills_started": 0, "prefills_finished": 0,
                       "chunks": 0, "kv_shipped_bytes": 0,
@@ -182,12 +211,25 @@ class PrefillWorker:
             # Prefix hit: gather the cached blocks' KV out of the shared
             # pool once (decode mesh) and seed the temp cache with it —
             # the cached prefix is neither recomputed nor re-shipped.
+            # int8 pools dequantize the gathered rows here (the dense
+            # temp cache on the prefill mesh is compute-dtype).
             nblocks = cdiv(cached, self.pool.block_size)
             table_row = jnp.asarray(self.pool.page_table[pslot])
-            for t, p in zip(tmp_np, self.pool.pages):
-                rows = np.asarray(jax.device_get(
-                    self._gather(p, table_row, nblocks)))[:, :cached]
-                t[:, 0, :cached] = rows
+            if self.pool.quantized:
+                for t, p, sc in zip(tmp_np, self.pool.pages,
+                                    self.pool.scales):
+                    rows = np.asarray(jax.device_get(
+                        self._gather(p, table_row, nblocks)))[:, :cached]
+                    rsc = np.asarray(jax.device_get(
+                        self._gather(sc, table_row,
+                                     nblocks)))[:, :cached]
+                    t[:, 0, :cached] = (rows.astype(np.float32)
+                                        * rsc[..., None])
+            else:
+                for t, p in zip(tmp_np, self.pool.pages):
+                    rows = np.asarray(jax.device_get(
+                        self._gather(p, table_row, nblocks)))[:, :cached]
+                    t[:, 0, :cached] = rows
             self.stats["prefix_hit_tokens"] += cached
         tmp = tuple(
             # manual-ok: temp-cache placement onto the prefill mesh,
@@ -215,20 +257,50 @@ class PrefillWorker:
             self.params, jnp.asarray(padded), state.tmp, state.pos)
         # Ship ONLY this chunk's rows (fixed chunk shape, count-masked
         # padding) to the decode mesh and scatter them page-table-aware
-        # in one fused write.
+        # in one fused write. int8 pools quantize ON THE PREFILL MESH
+        # first, so the handoff ships int8 rows + fp32 scales instead of
+        # bf16 rows (the shipped-bytes accounting below reads the actual
+        # transferred arrays either way).
+        from megatronapp_tpu.utils import chaos
         table_row = jnp.asarray(self.pool.page_table[state.pslot])
         rows = []
         for t in state.tmp:
             r = t[:, 0, state.pos:state.pos + self.chunk]
-            # manual-ok: cross-mesh handoff transfer (prefill → decode),
-            # outside any manual region — the one data movement of the
-            # handoff (block-granular chunk rows, never the pool).
-            rows.append(jax.device_put(r, self._decode_rep))
-            self.stats["kv_shipped_bytes"] += int(
-                r.size) * r.dtype.itemsize
-        self.pool.pages = self._write(
-            self.pool.pages[0], self.pool.pages[1], rows[0], rows[1],
-            table_row, state.pos, c)
+            if self.pool.quantized:
+                r_q, r_s = self._quantize(r)
+                # manual-ok: cross-mesh handoff transfer (prefill →
+                # decode), outside any manual region — the one data
+                # movement of the handoff (quantized chunk rows +
+                # scales, never the pool).
+                rows.append((jax.device_put(r_q, self._decode_rep),
+                             # manual-ok: cross-mesh handoff, see above
+                             jax.device_put(r_s, self._decode_rep)))
+                self.stats["kv_shipped_bytes"] += sum(
+                    int(x.size) * x.dtype.itemsize for x in rows[-1])
+            else:
+                # manual-ok: cross-mesh handoff transfer (prefill →
+                # decode), outside any manual region — the one data
+                # movement of the handoff (block-granular chunk rows,
+                # never the pool).
+                rows.append(jax.device_put(r, self._decode_rep))
+                self.stats["kv_shipped_bytes"] += int(
+                    r.size) * r.dtype.itemsize
+        if self.pool.quantized:
+            # Chaos site "kv-quant-write": fires between quantize and
+            # the page-table commit of the shipped rows — the pool is
+            # untouched, state.pos unchanged, so the retry (or the
+            # release path on abort) leaves the allocator audit-clean.
+            chaos.fire("kv-quant-write")
+            (self.pool.pages,
+             self.pool.scales) = _split2(self._write(
+                 self.pool.pages[0], self.pool.pages[1],
+                 self.pool.scales[0], self.pool.scales[1],
+                 rows[0][0], rows[1][0], rows[0][1], rows[1][1],
+                 table_row, state.pos, c))
+        else:
+            self.pool.pages = self._write(
+                self.pool.pages[0], self.pool.pages[1], rows[0], rows[1],
+                table_row, state.pos, c)
         state.pos += c
         self.stats["chunks"] += 1
         if state.pos < state.p_len:
@@ -284,7 +356,8 @@ class DisaggServingEngine:
                  decode_slo_ms: Optional[float] = None, tp: int = 1,
                  devices=None, spec_method: Optional[str] = None,
                  spec_k: int = 4, draft_params=None, draft_cfg=None,
-                 idle_chunks_per_step: int = 4):
+                 idle_chunks_per_step: int = 4,
+                 kv_cache_dtype: str = "bf16"):
         self.prefill_ctx, self.decode_ctx = split_serving_meshes(
             tp=tp, devices=devices)
         max_seq_len = max_seq_len or cfg.max_position_embeddings
@@ -292,7 +365,7 @@ class DisaggServingEngine:
             cfg, max_batch, max_seq_len, num_blocks=num_blocks,
             block_size=block_size,
             enable_prefix_caching=enable_prefix_caching,
-            extra_slots=prefill_slots)
+            extra_slots=prefill_slots, kv_cache_dtype=kv_cache_dtype)
         self.engine = DynamicInferenceEngine(
             params, cfg, tokenizer=tokenizer, max_batch=max_batch,
             max_seq_len=max_seq_len, prefill_buckets=prefill_buckets,
@@ -685,8 +758,13 @@ class DisaggServingEngine:
             },
             "handoff": {
                 "transfers": self.pool.stats["handoff_transfers"],
+                # Actual transferred bytes (int8 rows + fp32 scales on a
+                # quantized pool — ~(D+4)/2D of the bf16 rows), read off
+                # the shipped arrays, never assumed from the param
+                # dtype.
                 "kv_shipped_bytes":
                     self.worker.stats["kv_shipped_bytes"],
+                "kv_cache_dtype": self.pool.kv_cache_dtype,
                 "dense_copies": 0,     # by construction: transfer_slot
             },
             "prefill_worker": dict(self.worker.stats),

@@ -60,6 +60,7 @@ from megatronapp_tpu.inference.engine import (
 from megatronapp_tpu.inference.paged_cache import PagedKVCache, cdiv
 from megatronapp_tpu.models.gpt import gpt_embed, gpt_head, gpt_rope_tables
 from megatronapp_tpu.transformer.block import layer_forward
+from megatronapp_tpu.utils import chaos
 
 
 class DeadlineExceeded(RuntimeError):
@@ -175,13 +176,17 @@ def _decode_step(params, tokens, cache, lengths, active,
 
 
 def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
-                       cfg: TransformerConfig, max_seq_len: int, ctx=None):
+                       cfg: TransformerConfig, max_seq_len: int, ctx=None,
+                       scales=None):
     """One-token decode for every slot against the paged block pool.
 
     pages: ([L, NB, bs, Hkv, D], same) K/V pools (MLA: latent + k_pe
     pools); page_table [B, max_blocks_per_seq] int32; lengths [B] append
     positions; active [B] bool (inactive rows' writes are dropped and
-    their outputs discarded). Returns (last_logits [B,V], new pages)."""
+    their outputs discarded). scales: ([L, NB, bs, Hkv] fp32, same) for
+    an int8 pool — the step then quantizes the appended rows in-jit and
+    returns the updated scale pools alongside. Returns
+    (last_logits [B,V], new pages[, new scales] as one stacked tuple)."""
     h = gpt_embed(params, tokens, cfg, position_ids=lengths[:, None])
     cos_full, sin_full = gpt_rope_tables(cfg, max_seq_len)
     if cos_full is not None:
@@ -203,26 +208,43 @@ def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
         mask = None      # the ragged kernel masks by per-row kv length
 
     pa, pb = pages
+    lids = jnp.arange(cfg.num_layers)
 
-    def body(carry, layer_in):
-        hh = carry
-        layer_p, a_l, b_l, lid = layer_in
-        (hh, new_cache), _ = layer_forward(
-            layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
-            kv_cache=(a_l, b_l), cache_index=None,
-            cache_positions=lengths, page_table=page_table, active=active,
-            ctx=ctx)
-        return hh, new_cache
+    if scales is None:
+        def body(carry, layer_in):
+            hh = carry
+            layer_p, a_l, b_l, lid = layer_in
+            (hh, new_cache), _ = layer_forward(
+                layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
+                kv_cache=(a_l, b_l), cache_index=None,
+                cache_positions=lengths, page_table=page_table,
+                active=active, ctx=ctx)
+            return hh, new_cache
 
-    h, new_pages = jax.lax.scan(
-        body, h, (params["block"], pa, pb, jnp.arange(cfg.num_layers)))
+        xs = (params["block"], pa, pb, lids)
+    else:
+        sa, sb = scales
+
+        def body(carry, layer_in):
+            hh = carry
+            layer_p, a_l, b_l, sa_l, sb_l, lid = layer_in
+            (hh, new_cache), _ = layer_forward(
+                layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
+                kv_cache=(a_l, b_l), cache_index=None,
+                cache_positions=lengths, page_table=page_table,
+                active=active, ctx=ctx, kv_scales=(sa_l, sb_l))
+            return hh, new_cache
+
+        xs = (params["block"], pa, pb, sa, sb, lids)
+
+    h, new_pages = jax.lax.scan(body, h, xs)
     logits = gpt_head(params, h, cfg)[:, -1]
     return logits, new_pages
 
 
 def _paged_multiquery_step(params, tokens, pages, page_table, starts,
                            q_lens, active, cfg: TransformerConfig,
-                           max_seq_len: int, ctx=None):
+                           max_seq_len: int, ctx=None, scales=None):
     """Ragged multi-token step against the paged pool — the UNIFIED
     prefill/decode primitive (speculative verify + chunked prefill).
 
@@ -254,19 +276,37 @@ def _paged_multiquery_step(params, tokens, pages, page_table, starts,
         mask = None          # the multi-query ragged kernel masks itself
 
     pa, pb = pages
+    lids = jnp.arange(cfg.num_layers)
 
-    def body(carry, layer_in):
-        hh = carry
-        layer_p, a_l, b_l, lid = layer_in
-        (hh, new_cache), _ = layer_forward(
-            layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
-            kv_cache=(a_l, b_l), cache_index=None,
-            cache_positions=starts, page_table=page_table, active=active,
-            chunk_counts=q_lens, ctx=ctx)
-        return hh, new_cache
+    if scales is None:
+        def body(carry, layer_in):
+            hh = carry
+            layer_p, a_l, b_l, lid = layer_in
+            (hh, new_cache), _ = layer_forward(
+                layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
+                kv_cache=(a_l, b_l), cache_index=None,
+                cache_positions=starts, page_table=page_table,
+                active=active, chunk_counts=q_lens, ctx=ctx)
+            return hh, new_cache
 
-    h, new_pages = jax.lax.scan(
-        body, h, (params["block"], pa, pb, jnp.arange(cfg.num_layers)))
+        xs = (params["block"], pa, pb, lids)
+    else:
+        sa, sb = scales
+
+        def body(carry, layer_in):
+            hh = carry
+            layer_p, a_l, b_l, sa_l, sb_l, lid = layer_in
+            (hh, new_cache), _ = layer_forward(
+                layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
+                kv_cache=(a_l, b_l), cache_index=None,
+                cache_positions=starts, page_table=page_table,
+                active=active, chunk_counts=q_lens, ctx=ctx,
+                kv_scales=(sa_l, sb_l))
+            return hh, new_cache
+
+        xs = (params["block"], pa, pb, sa, sb, lids)
+
+    h, new_pages = jax.lax.scan(body, h, xs)
     logits = gpt_head(params, h, cfg)
     return logits, h, new_pages
 
@@ -344,7 +384,8 @@ class DynamicInferenceEngine:
                  enable_prefix_caching: bool = True,
                  spec_method: Optional[str] = None, spec_k: int = 4,
                  draft_params=None, draft_cfg=None,
-                 prefill_chunk: int = 32, ctx=None, pool=None):
+                 prefill_chunk: int = 32, ctx=None, pool=None,
+                 kv_cache_dtype: str = "bf16"):
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -361,13 +402,20 @@ class DynamicInferenceEngine:
 
         self.paged = paged
         if paged:
+            # An injected pool (disagg) carries its own kv_cache_dtype.
             self.pool = pool if pool is not None else PagedKVCache(
                 cfg, max_batch, self.max_seq_len, num_blocks=num_blocks,
                 block_size=block_size,
-                enable_prefix_caching=enable_prefix_caching)
+                enable_prefix_caching=enable_prefix_caching,
+                kv_cache_dtype=kv_cache_dtype)
             self.cache = None
         else:
             assert pool is None, "pool injection requires paged=True"
+            if kv_cache_dtype != "bf16":
+                raise ValueError(
+                    "kv_cache_dtype=int8 requires the paged backend "
+                    "(per-block scales live alongside the block pool) — "
+                    "pass paged=True / --paged-kv-cache")
             self.pool = None
             self.cache = init_kv_cache(cfg, max_batch, self.max_seq_len)
 
@@ -393,11 +441,17 @@ class DynamicInferenceEngine:
                 self.tp_paged = tp_paged_eligible(cfg, ctx)
                 # Pages [L, NB, bs, Hkv, D]: shard Hkv when eligible so
                 # each device holds 1/tp of the pool; otherwise just
-                # commit them to this mesh (disagg decode sub-mesh).
+                # commit them to this mesh (disagg decode sub-mesh). An
+                # int8 pool's scale pools [L, NB, bs, Hkv] shard on the
+                # same Hkv dim (their last).
                 pages_spec = (P(None, None, None, TP_AXIS, None)
                               if self.tp_paged else P())
+                scales_spec = (P(None, None, None, TP_AXIS)
+                               if self.tp_paged else P())
                 # manual-ok: constructor-time placement, no manual region
-                self.pool.place_pages(NamedSharding(ctx.mesh, pages_spec))
+                self.pool.place_pages(
+                    NamedSharding(ctx.mesh, pages_spec),    # manual-ok: see above
+                    NamedSharding(ctx.mesh, scales_spec))   # manual-ok: see above
             else:
                 # manual-ok: constructor-time placement, no manual region
                 self.cache = jax.device_put(self.cache,
@@ -457,19 +511,23 @@ class DynamicInferenceEngine:
             # attention_forward); otherwise the trace stays identical to
             # the single-device engine.
             step_ctx = self.ctx if self.tp_paged else None
+            # `scales` is the int8 pool's fp32 scale-pool pair (None for
+            # bf16 pools — an empty pytree, so the same jit signature
+            # serves both dtypes and donation is a no-op there).
             self._decode = jax.jit(
-                lambda p, t, pages, tbl, l, a: _paged_decode_step(
-                    p, t, pages, tbl, l, a, cfg, msl, ctx=step_ctx),
-                donate_argnums=(2,))
+                lambda p, t, pages, scales, tbl, l, a: _paged_decode_step(
+                    p, t, pages, tbl, l, a, cfg, msl, ctx=step_ctx,
+                    scales=scales),
+                donate_argnums=(2, 3))
 
-            def _mq_traced(p, t, pages, tbl, starts, qlens, act):
+            def _mq_traced(p, t, pages, scales, tbl, starts, qlens, act):
                 # Python side-effect: runs only while TRACING.
                 self.mq_traces += 1
                 return _paged_multiquery_step(p, t, pages, tbl, starts,
                                               qlens, act, cfg, msl,
-                                              ctx=step_ctx)
+                                              ctx=step_ctx, scales=scales)
 
-            self._mq_step = jax.jit(_mq_traced, donate_argnums=(2,))
+            self._mq_step = jax.jit(_mq_traced, donate_argnums=(2, 3))
             from megatronapp_tpu.ops.pallas.paged_attention import (
                 gather_prefix_pages, write_prompt_pages,
             )
@@ -493,6 +551,16 @@ class DynamicInferenceEngine:
         decode/scatter/gather jits too, so toggled capture hooks cannot
         pin stale traces in the paged backend."""
         self._build_jits()
+
+    def _commit_pools(self, new):
+        """Install a step's updated pool arrays: bf16 pools return
+        (k, v); int8 pools return (k, v, k_scales, v_scales) — the scale
+        pools updated by the in-jit quantize ride the same scan."""
+        if self.pool.quantized:
+            self.pool.pages = tuple(new[:2])
+            self.pool.scales = tuple(new[2:])
+        else:
+            self.pool.pages = tuple(new)
 
     # ---- request lifecycle ------------------------------------------------
     def add_request(self, prompt_tokens, max_new_tokens: int,
@@ -692,7 +760,23 @@ class DynamicInferenceEngine:
                     break
             req.slot = slot
             self.slots[slot] = req
-            self._prefill_into_slot(req, plan)
+            try:
+                self._prefill_into_slot(req, plan)
+            except Exception:
+                # Exception-safe rollback (the "kv-quant-write" chaos
+                # drill fires between quantize and page-table commit in
+                # the chunk-scatter path): return every admitted block
+                # (valid_len=0 — partially-written rows are stale data
+                # the retry overwrites, never registered prefixes),
+                # clear the slot, and requeue the request at the head so
+                # a transient fault costs one step. Re-raised for the
+                # stepper watchdog's accounting.
+                if self.paged:
+                    self.pool.release(slot, np.asarray(req.tokens), 0)
+                self._free_slot(slot)
+                req.slot = -1
+                self.waiting.appendleft(req)
+                raise
             admitted.append(req)
         return admitted
 
@@ -760,10 +844,20 @@ class DynamicInferenceEngine:
             count = min(c, p_len - pos)
             chunk = np.zeros((1, c), np.int32)
             chunk[0, :count] = tokens[pos:pos + count]
-            logits, hid, self.pool.pages = self._mq_step(
+            if pool.quantized:
+                # Chaos site "kv-quant-write": fires between staging the
+                # chunk and committing its quantized rows + scales to
+                # the pool — the admit caller (_admit) rolls the slot's
+                # blocks back and requeues the request, so a transient
+                # fault costs one step and audit() stays clean (the
+                # tests/test_resilience.py drill).
+                chaos.fire("kv-quant-write")
+            logits, hid, new = self._mq_step(
                 self.params, jnp.asarray(chunk), self.pool.pages,
+                self.pool.scales,
                 table_row, jnp.asarray([pos], jnp.int32),
                 jnp.asarray([count], jnp.int32), jnp.ones((1,), bool))
+            self._commit_pools(new)
             pos += count
         # Register the prompt's full blocks so concurrent same-prefix
         # requests hit them immediately.
@@ -955,11 +1049,12 @@ class DynamicInferenceEngine:
         active_mask = jnp.asarray(active_np)
         lengths = jnp.asarray(self.lengths)
         if self.paged:
-            logits, self.pool.pages = self._decode(
+            logits, new = self._decode(
                 self.params, jnp.asarray(self.last_tokens),
-                self.pool.pages,
+                self.pool.pages, self.pool.scales,
                 jnp.asarray(self.pool.page_table[:self.max_batch]),
                 lengths, active_mask)
+            self._commit_pools(new)
         else:
             logits, self.cache = self._decode(
                 self.params, jnp.asarray(self.last_tokens), self.cache,
@@ -1016,7 +1111,6 @@ class DynamicInferenceEngine:
 
     def _spec_round_inner(self, active: List[Request], events: Dict,
                           k_caps: np.ndarray):
-        from megatronapp_tpu.utils import chaos
         b, k = self.max_batch, self.spec_k
         drafts, counts, q_probs = self.proposer.propose(k_caps)
         if not counts.any():
@@ -1044,11 +1138,13 @@ class DynamicInferenceEngine:
             q_lens[slot] = 1 + n
         rows = self._sampling_rows()
 
-        logits, hidden, self.pool.pages = self._mq_step(
+        logits, hidden, new = self._mq_step(
             self.params, jnp.asarray(tokens), self.pool.pages,
+            self.pool.scales,
             jnp.asarray(self.pool.page_table[:self.max_batch]),
             jnp.asarray(self.lengths),
             jnp.asarray(q_lens), jnp.asarray(active_np))
+        self._commit_pools(new)
         logits = mask_padded_vocab(logits, self.cfg)
         # Chaos site "spec-verify": fires at the WORST point — the
         # multi-query step already wrote every draft token's KV, nothing
@@ -1136,9 +1232,21 @@ class DynamicInferenceEngine:
             pool = self.pool
             st = dict(pool.stats)
             seen = st["prefix_hit_tokens"] + st["prefill_tokens"]
+            # Byte accounting reads the ADDRESSABLE pool arrays (int8
+            # data + fp32 scales for quantized pools), never a dtype
+            # assumption — /stats and /healthz stay honest when the pool
+            # dtype differs from the param dtype. resident_bytes counts
+            # blocks whose data is live (in use + LRU-parked, still
+            # hittable); pool_bytes_total is the full allocation.
+            bpb = pool.bytes_per_block
+            resident_blocks = pool.num_blocks - pool.free_blocks()
             out["pool"] = {
                 "num_blocks": pool.num_blocks,
                 "block_size": pool.block_size,
+                "kv_cache_dtype": pool.kv_cache_dtype,
+                "bytes_per_block": bpb,
+                "pool_bytes_total": pool.bytes_total,
+                "resident_bytes": resident_blocks * bpb,
                 "blocks_in_use": pool.blocks_in_use(),
                 "blocks_free": pool.free_blocks(),
                 "blocks_evictable": pool.evictable_blocks(),

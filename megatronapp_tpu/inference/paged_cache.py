@@ -22,7 +22,17 @@ All bookkeeping is host-side (numpy/python); the page DATA lives in jnp
 arrays on `self.pages` and is only touched by jit-able scatter/gather
 helpers (ops/pallas/paged_attention.py) plus the small copy-on-write
 block copy here.
-"""
+
+Quantized pools (ISSUE 10, ``kv_cache_dtype="int8"``): pages store int8
+with a per-(row, kv-head) fp32 scale pool [L, NB, bs, Hkv] on
+`self.scales` — rows quantize independently on insert
+(quantize_kv_rows), so every page-table operation here (CoW, refcounts,
+prefix hashing, transfer, rewind) is UNCHANGED: block identity and
+sharing semantics never depend on the storage dtype. Capacity
+accounting (`bytes_total`, `bytes_per_block`) reads the addressable
+arrays, so it is dtype-aware by construction. The MLA latent pool stays
+bf16-only (the latent is already a compressed representation; int8
+rejection is explicit)."""
 
 from __future__ import annotations
 
@@ -56,8 +66,20 @@ class PagedKVCache:
     def __init__(self, cfg: TransformerConfig, max_batch: int,
                  max_seq_len: int, num_blocks: Optional[int] = None,
                  block_size: int = 16, enable_prefix_caching: bool = True,
-                 extra_slots: int = 0):
+                 extra_slots: int = 0, kv_cache_dtype: str = "bf16"):
+        if kv_cache_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'bf16' or 'int8', got "
+                f"{kv_cache_dtype!r}")
+        if kv_cache_dtype == "int8" and cfg.multi_latent_attention:
+            raise ValueError(
+                "int8 KV-cache pages are not supported for MLA: the "
+                "latent pool is already a compressed representation and "
+                "stays bf16-only for now — run with kv_cache_dtype=bf16 "
+                "(or drop --kv-cache-dtype int8)")
         self.cfg = cfg
+        self.kv_cache_dtype = kv_cache_dtype
+        self.quantized = kv_cache_dtype == "int8"
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len
         self.block_size = block_size
@@ -75,6 +97,10 @@ class PagedKVCache:
 
         l = cfg.num_layers
         nb, bs = self.num_blocks, self.block_size
+        # scales: per-(row, kv-head) fp32 quantization scales for int8
+        # pools (None for bf16) — scattered/copied exactly like the data
+        # pools (same leading [L, NB, bs] dims).
+        self.scales: Optional[Tuple[jnp.ndarray, ...]] = None
         if cfg.multi_latent_attention:
             self.pages: Tuple[jnp.ndarray, ...] = (
                 jnp.zeros((l, nb, bs, cfg.kv_lora_rank), cfg.compute_dtype),
@@ -82,8 +108,12 @@ class PagedKVCache:
                           cfg.compute_dtype))
         else:
             shape = (l, nb, bs, cfg.num_query_groups, cfg.head_dim)
-            self.pages = (jnp.zeros(shape, cfg.compute_dtype),
-                          jnp.zeros(shape, cfg.compute_dtype))
+            dt = jnp.int8 if self.quantized else cfg.compute_dtype
+            self.pages = (jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+            if self.quantized:
+                sshape = (l, nb, bs, cfg.num_query_groups)
+                self.scales = (jnp.ones(sshape, jnp.float32),
+                               jnp.ones(sshape, jnp.float32))
 
         self.page_table = np.zeros((self.num_slots, self.max_blocks_per_seq),
                                    np.int32)
@@ -99,20 +129,37 @@ class PagedKVCache:
                       "peak_blocks_in_use": 0, "handoff_transfers": 0}
 
     # ---- placement -------------------------------------------------------
-    def place_pages(self, sharding):
+    def place_pages(self, sharding, scales_sharding=None):
         """Commit the page pools to an explicit device placement (tp
         serving mesh: sharded on the Hkv dim so each device holds 1/tp
-        of the pool; disaggregated serving: the decode sub-mesh). Later
-        jnp updates (CoW copy, the engine's scatter/append jits)
-        preserve the committed sharding by propagation."""
+        of the pool; disaggregated serving: the decode sub-mesh). int8
+        pools place their scale pools alongside (scales_sharding — same
+        mesh, Hkv on the last dim). Later jnp updates (CoW copy, the
+        engine's scatter/append jits) preserve the committed sharding by
+        propagation."""
         import jax
         # manual-ok: host-side pool placement, no manual region
         self.pages = tuple(jax.device_put(p, sharding) for p in self.pages)
+        if self.scales is not None:
+            self.scales = tuple(
+                # manual-ok: host-side pool placement, no manual region
+                jax.device_put(s, scales_sharding or sharding)
+                for s in self.scales)
 
     # ---- sizing ----------------------------------------------------------
+    def _arrays(self):
+        return self.pages + (self.scales or ())
+
     @property
     def bytes_total(self) -> int:
-        return sum(p.size * p.dtype.itemsize for p in self.pages)
+        """Resident pool bytes, dtype-aware: int8 data + fp32 scales for
+        quantized pools, compute-dtype data otherwise — always read off
+        the addressable arrays, never derived from the param dtype."""
+        return sum(p.size * p.dtype.itemsize for p in self._arrays())
+
+    @property
+    def bytes_per_block(self) -> int:
+        return self.bytes_total // self.num_blocks
 
     def blocks_in_use(self) -> int:
         """Blocks with live references (excludes free + evictable)."""
@@ -168,6 +215,11 @@ class PagedKVCache:
         # caller's rollback returns src's ref and dst to the pool.
         chaos.fire("paged-cow")
         self.pages = tuple(p.at[:, dst].set(p[:, src]) for p in self.pages)
+        if self.scales is not None:
+            # Rows quantize independently, so CoW copies scales verbatim
+            # alongside the int8 rows — no re-quantization.
+            self.scales = tuple(s.at[:, dst].set(s[:, src])
+                                for s in self.scales)
         self.stats["cow_copies"] += 1
 
     def _note_usage(self):

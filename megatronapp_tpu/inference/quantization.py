@@ -11,6 +11,16 @@ TPU notes: XLA lowers int8 ops fine, but weight-only PTQ's win on TPU is
 artifact size + host→device transfer (half of bf16, quarter of fp32);
 matmuls stay bf16 after dequant, so accuracy loss is bounded by the
 per-channel rounding error measured here.
+
+Serving-resident int8 (ISSUE 10, ``--quantized-weights``): instead of
+dequantize-on-load, `residentize_params` converts the quantized pytree
+into a jit-able form — each supported matmul kernel becomes a two-leaf
+dict {"qint8": int8, "qscale": fp32} — and the forward passes call
+`resolve_param` at matmul entry, so XLA keeps the int8 weights resident
+in HBM (param bytes ~halved vs bf16) and fuses the per-channel dequant
+into the consuming matmul. Only kernels whose consumers are
+resolve-aware stay resident (RESIDENT_KERNELS); anything else
+dequantizes eagerly so unexpected model families keep working.
 """
 
 from __future__ import annotations
@@ -77,10 +87,27 @@ def is_quantized_leaf(x) -> bool:
     return isinstance(x, dict) and x.get("__quant__") == "int8"
 
 
-def quantize_params(params) -> Tuple[Any, Dict[str, float]]:
+def quantize_params(params, resident_only: bool = False
+                    ) -> Tuple[Any, Dict[str, float]]:
     """Quantize every matmul kernel; returns (pytree with quantized
-    leaves, report {path: max_abs_error})."""
+    leaves, report {path: max_abs_error}).
+
+    resident_only: quantize ONLY the leaves residentize_params will
+    keep int8-resident (startup PTQ for serving — anything else would
+    eat int8 rounding error and then be dequantized eagerly anyway,
+    accuracy loss with zero memory win). Artifact export keeps the
+    default full selection: on-disk size benefits from every quantized
+    kernel even when some dequantize on load."""
     report: Dict[str, float] = {}
+
+    def want(prefix, tree):
+        if not _should_quantize(prefix, tree):
+            return False
+        if not resident_only:
+            return True
+        name = prefix[-1] if prefix else ""
+        return (any(name.endswith(s) for s in RESIDENT_KERNELS)
+                and "moe" not in prefix)
 
     def walk(tree, prefix=()):
         if isinstance(tree, dict):
@@ -91,7 +118,7 @@ def quantize_params(params) -> Tuple[Any, Dict[str, float]]:
         if isinstance(tree, tuple):
             return tuple(walk(v, prefix + (str(i),))
                          for i, v in enumerate(tree))
-        if _should_quantize(prefix, tree):
+        if want(prefix, tree):
             entry = quantize_leaf(tree)
             err = float(np.max(np.abs(
                 dequantize_leaf(entry).astype(np.float32)
@@ -114,6 +141,63 @@ def dequantize_params(tree):
     if isinstance(tree, tuple):
         return tuple(dequantize_params(v) for v in tree)
     return tree
+
+
+# Kernels whose forward-pass consumers call resolve_param at matmul
+# entry (transformer/attention.py, transformer/mlp.py, transformer/
+# mla.py out-proj) and may therefore stay int8-resident for serving.
+# MoE expert stacks are excluded until moe_forward resolves them.
+RESIDENT_KERNELS = ("q_kernel", "kv_kernel", "out_kernel",
+                    "fc1_kernel", "fc2_kernel")
+
+
+def is_resident_leaf(x) -> bool:
+    return (isinstance(x, dict) and "qint8" in x and "qscale" in x
+            and len(x) == 2)
+
+
+def resolve_param(w, dtype=None):
+    """Matmul-entry hook: a resident-quantized leaf dequantizes here
+    (int8 × per-channel fp32 scale — XLA fuses it into the consuming
+    matmul, the int8 buffer is what lives in HBM); plain arrays pass
+    through untouched, so every call site stays dtype/path agnostic."""
+    if is_resident_leaf(w):
+        w = w["qint8"].astype(jnp.float32) * w["qscale"]
+    return w if dtype is None else w.astype(dtype)
+
+
+def residentize_params(tree, _path=()):
+    """Convert a quantize_params pytree into the serving-resident form:
+    RESIDENT_KERNELS leaves become {"qint8", "qscale"} jnp-array pairs
+    (kept int8 in HBM, dequantized at matmul entry by resolve_param);
+    every other quantized leaf dequantizes eagerly. Idempotent on
+    unquantized pytrees."""
+    if is_quantized_leaf(tree):
+        name = _path[-1] if _path else ""
+        if (any(name.endswith(s) for s in RESIDENT_KERNELS)
+                and "moe" not in _path):
+            return {"qint8": jnp.asarray(tree["q"]),
+                    "qscale": jnp.asarray(tree["scale"], jnp.float32)}
+        return jnp.asarray(dequantize_leaf(tree))
+    if isinstance(tree, dict):
+        return {k: residentize_params(v, _path + (k,))
+                for k, v in tree.items()}
+    if isinstance(tree, list):
+        return [residentize_params(v, _path + (str(i),))
+                for i, v in enumerate(tree)]
+    if isinstance(tree, tuple):
+        return tuple(residentize_params(v, _path + (str(i),))
+                     for i, v in enumerate(tree))
+    return tree
+
+
+def resident_nbytes(tree) -> int:
+    """Device bytes of a (possibly residentized) params pytree."""
+    total = 0
+    for _, leaf in _flatten_with_names(tree):
+        if hasattr(leaf, "nbytes"):
+            total += leaf.nbytes
+    return total
 
 
 def quantized_nbytes(tree) -> int:

@@ -33,6 +33,17 @@ slice contiguously together, so each shard owns matched GQA groups and
 the K/V pools sharded on their Hkv dim — each device holds 1/tp of the
 block pool and does 1/tp of the attention FLOPs/bytes. Eligibility is
 `tp_paged_eligible` (heads divisible by tp, non-MLA pools).
+
+Quantized KV (ISSUE 10, `k_scales`/`v_scales`): the pools may be stored
+int8 with a per-(row, kv-head) fp32 scale pool [NB, bs, Hkv] living
+alongside — rows quantize independently on insert (`quantize_kv_rows`),
+so CoW copies, rewind, and stale-row overwrites need no re-scaling.
+Every kernel grows a quantized path: the scale blocks ride the SAME
+scalar-prefetched page-table indirection as the KV blocks (BlockSpec
+index map `t[b, j]`), and each DMA'd int8 block dequantizes in-register
+(one fp32 multiply per row×head) before the online-softmax update — no
+bf16 pool is ever materialized. The jnp references take the same scales
+and are the parity oracle; on CPU everything runs in interpret mode.
 """
 
 from __future__ import annotations
@@ -52,17 +63,46 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def quantize_kv_rows(rows: jnp.ndarray):
+    """Symmetric per-(row, head) int8 quantization of KV rows.
+
+    rows [..., Hkv, D] → (int8 rows [..., Hkv, D], fp32 scales
+    [..., Hkv]). Each (token, head) row quantizes independently over D —
+    inserts never re-scale already-written rows, so partial blocks,
+    copy-on-write copies, and speculative rewinds need no block-level
+    bookkeeping. jit-able; fused into the engine's write-path jits."""
+    r32 = rows.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(r32), axis=-1)
+    scales = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(r32 / scales[..., None]), -127, 127)
+    return q.astype(jnp.int8), scales.astype(jnp.float32)
+
+
+def _dequant_block(k, ks):
+    """[bs, Hkv, D] int8 block × [bs, Hkv] fp32 scales → fp32 block (the
+    in-register dequant of one DMA'd page)."""
+    return k.astype(jnp.float32) * ks[..., None]
+
+
 # ---------------------------------------------------------------------------
 # Decode kernel
 # ---------------------------------------------------------------------------
 
 
-def _decode_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc, m_scr, l_scr, *, scale, block_size, num_blocks_seq,
-                   hkv, group):
+def _decode_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
+                   scale, block_size, num_blocks_seq, hkv, group,
+                   quantized=False):
     """Grid (B, max_blocks_per_seq); block j of slot b is DMA'd from page
     table_ref[b, j]. Online softmax over the ragged valid range
-    [0, lens_ref[b]); fully-out-of-range blocks are skipped whole."""
+    [0, lens_ref[b]); fully-out-of-range blocks are skipped whole.
+
+    quantized: k/v blocks arrive int8 with per-(row, head) fp32 scale
+    blocks (ks_ref/vs_ref, fetched through the same page-table index
+    map); dequant happens in-register on the fetched block."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc, m_scr, l_scr = rest
+    else:
+        o_ref, acc, m_scr, l_scr = rest
     b = pl.program_id(0)
     j = pl.program_id(1)
     hq = hkv * group
@@ -78,8 +118,12 @@ def _decode_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j * block_size < kv_len)
     def _compute():
         q = q_ref[0].astype(jnp.float32) * scale          # [Hq, D]
-        k = k_ref[0]                                      # [bs, Hkv, D]
-        v = v_ref[0]
+        if quantized:
+            k = _dequant_block(k_ref[0], ks_ref[0])       # [bs, Hkv, D]
+            v = _dequant_block(v_ref[0], vs_ref[0])
+        else:
+            k = k_ref[0]                                  # [bs, Hkv, D]
+            v = v_ref[0]
         d = q.shape[-1]
         q3 = q.reshape(hkv, group, d)
         k3 = jnp.swapaxes(k, 0, 1)                        # [Hkv, bs, D]
@@ -119,7 +163,9 @@ def _decode_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray,
                            v_pages: jnp.ndarray, page_table: jnp.ndarray,
                            kv_lens: jnp.ndarray,
-                           softmax_scale: Optional[float] = None
+                           softmax_scale: Optional[float] = None,
+                           k_scales: Optional[jnp.ndarray] = None,
+                           v_scales: Optional[jnp.ndarray] = None
                            ) -> jnp.ndarray:
     """One-token-per-slot ragged paged attention.
 
@@ -127,28 +173,38 @@ def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray,
     page_table [B, max_blocks_per_seq] int32 (entries beyond a slot's
     allocation may be anything in range — they are masked, not read for
     math); kv_lens [B] int32 valid kv positions per slot (>= 1).
+    k_scales/v_scales [num_blocks, block_size, Hkv] fp32: present iff the
+    pools are int8 (quantize_kv_rows layout) — the scale blocks ride the
+    same page-table indirection and dequant runs in-kernel.
     Returns [B, Hq, D]."""
     b, hq, d = q.shape
     nb, bs, hkv, _ = k_pages.shape
     mb = page_table.shape[1]
     group = hq // hkv
+    quantized = k_scales is not None
     if softmax_scale is None:
         softmax_scale = 1.0 / (d ** 0.5)
 
     kernel = functools.partial(
         _decode_kernel, scale=float(softmax_scale), block_size=bs,
-        num_blocks_seq=mb, hkv=hkv, group=group)
+        num_blocks_seq=mb, hkv=hkv, group=group, quantized=quantized)
 
+    kv_spec = pl.BlockSpec((1, bs, hkv, d),
+                           lambda b_, j, t, l: (t[b_, j], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, hq, d), lambda b_, j, t, l: (b_, 0, 0)),
+        kv_spec, kv_spec,
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        sc_spec = pl.BlockSpec((1, bs, hkv),
+                               lambda b_, j, t, l: (t[b_, j], 0, 0))
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, mb),
-        in_specs=[
-            pl.BlockSpec((1, hq, d), lambda b_, j, t, l: (b_, 0, 0)),
-            pl.BlockSpec((1, bs, hkv, d),
-                         lambda b_, j, t, l: (t[b_, j], 0, 0, 0)),
-            pl.BlockSpec((1, bs, hkv, d),
-                         lambda b_, j, t, l: (t[b_, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, hq, d), lambda b_, j, t, l: (b_, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((hq, d), jnp.float32),
@@ -161,7 +217,7 @@ def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray,
         out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
         interpret=_interpret(),
     )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32),
-      q, k_pages, v_pages)
+      *operands)
 
 
 # ---------------------------------------------------------------------------
@@ -170,8 +226,8 @@ def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray,
 
 
 def _multiquery_kernel(table_ref, lens_ref, qlens_ref, q_ref, k_ref, v_ref,
-                       o_ref, acc, m_scr, l_scr, *, scale, block_size,
-                       num_blocks_seq, hkv, group, s_q):
+                       *rest, scale, block_size,
+                       num_blocks_seq, hkv, group, s_q, quantized=False):
     """Grid (B, max_blocks_per_seq): per-request ragged q_len ∈ [1, S_q]
     queries against the page table — the multi-query generalization of
     `_decode_kernel` (arXiv 2604.15464's unified prefill/decode
@@ -180,7 +236,14 @@ def _multiquery_kernel(table_ref, lens_ref, qlens_ref, q_ref, k_ref, v_ref,
     the new tail, full attention to the context); padded query rows
     (i >= q_len) compute garbage over the valid range and are discarded
     by the caller. At q_len == 1 the math reduces to the decode kernel's
-    exact block/accumulator order."""
+    exact block/accumulator order.
+
+    quantized: int8 k/v blocks + per-(row, head) fp32 scale blocks
+    (ks_ref/vs_ref), dequantized in-register like `_decode_kernel`."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc, m_scr, l_scr = rest
+    else:
+        o_ref, acc, m_scr, l_scr = rest
     b = pl.program_id(0)
     j = pl.program_id(1)
     hq = hkv * group
@@ -198,8 +261,12 @@ def _multiquery_kernel(table_ref, lens_ref, qlens_ref, q_ref, k_ref, v_ref,
     @pl.when(j * block_size < kv_len)
     def _compute():
         q = q_ref[0].astype(jnp.float32) * scale      # [S_q, Hq, D]
-        k = k_ref[0]                                  # [bs, Hkv, D]
-        v = v_ref[0]
+        if quantized:
+            k = _dequant_block(k_ref[0], ks_ref[0])   # [bs, Hkv, D]
+            v = _dequant_block(v_ref[0], vs_ref[0])
+        else:
+            k = k_ref[0]                              # [bs, Hkv, D]
+            v = v_ref[0]
         d = q.shape[-1]
         # [Hkv, S_q*group, D] with inner index i = s*group + g (so row
         # i's query position is i // group after unfolding back through
@@ -262,7 +329,9 @@ def paged_attention_multiquery(q: jnp.ndarray, k_pages: jnp.ndarray,
                                v_pages: jnp.ndarray,
                                page_table: jnp.ndarray,
                                kv_lens: jnp.ndarray, q_lens: jnp.ndarray,
-                               softmax_scale: Optional[float] = None
+                               softmax_scale: Optional[float] = None,
+                               k_scales: Optional[jnp.ndarray] = None,
+                               v_scales: Optional[jnp.ndarray] = None
                                ) -> jnp.ndarray:
     """Ragged multi-query paged attention (speculative verify / chunked
     prefill).
@@ -272,29 +341,38 @@ def paged_attention_multiquery(q: jnp.ndarray, k_pages: jnp.ndarray,
     (their K/V must already be written into the pages); the rest are
     padding whose outputs are garbage and must be discarded. kv_lens [B]
     counts ALL valid kv positions including the new tail (>= q_lens >=
-    1). Returns [B, S_q, Hq, D]."""
+    1). k_scales/v_scales [NB, bs, Hkv] fp32 mark int8 pools (see
+    paged_attention_decode). Returns [B, S_q, Hq, D]."""
     b, s_q, hq, d = q.shape
     nb, bs, hkv, _ = k_pages.shape
     mb = page_table.shape[1]
     group = hq // hkv
+    quantized = k_scales is not None
     if softmax_scale is None:
         softmax_scale = 1.0 / (d ** 0.5)
 
     kernel = functools.partial(
         _multiquery_kernel, scale=float(softmax_scale), block_size=bs,
-        num_blocks_seq=mb, hkv=hkv, group=group, s_q=s_q)
+        num_blocks_seq=mb, hkv=hkv, group=group, s_q=s_q,
+        quantized=quantized)
 
+    kv_spec = pl.BlockSpec((1, bs, hkv, d),
+                           lambda b_, j, t, l, ql: (t[b_, j], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, s_q, hq, d),
+                     lambda b_, j, t, l, ql: (b_, 0, 0, 0)),
+        kv_spec, kv_spec,
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        sc_spec = pl.BlockSpec((1, bs, hkv),
+                               lambda b_, j, t, l, ql: (t[b_, j], 0, 0))
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, mb),
-        in_specs=[
-            pl.BlockSpec((1, s_q, hq, d),
-                         lambda b_, j, t, l, ql: (b_, 0, 0, 0)),
-            pl.BlockSpec((1, bs, hkv, d),
-                         lambda b_, j, t, l, ql: (t[b_, j], 0, 0, 0)),
-            pl.BlockSpec((1, bs, hkv, d),
-                         lambda b_, j, t, l, ql: (t[b_, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, s_q, hq, d),
                                lambda b_, j, t, l, ql: (b_, 0, 0, 0)),
         scratch_shapes=[
@@ -308,19 +386,32 @@ def paged_attention_multiquery(q: jnp.ndarray, k_pages: jnp.ndarray,
         out_shape=jax.ShapeDtypeStruct((b, s_q, hq, d), q.dtype),
         interpret=_interpret(),
     )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32),
-      q_lens.astype(jnp.int32), q, k_pages, v_pages)
+      q_lens.astype(jnp.int32), *operands)
+
+
+def dequantize_pages(pages: jnp.ndarray, scales: jnp.ndarray
+                     ) -> jnp.ndarray:
+    """Dense dequant of an int8 pool [..., bs, Hkv, D] with scales
+    [..., bs, Hkv] → fp32 (references, prefix-hit gathers, A/B
+    baselines — NOT the kernel path, which dequantizes per block)."""
+    return pages.astype(jnp.float32) * scales[..., None]
 
 
 def paged_attention_multiquery_reference(
         q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         page_table: jnp.ndarray, kv_lens: jnp.ndarray, q_lens: jnp.ndarray,
-        softmax_scale: Optional[float] = None) -> jnp.ndarray:
+        softmax_scale: Optional[float] = None,
+        k_scales: Optional[jnp.ndarray] = None,
+        v_scales: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Pure-jnp oracle for the multi-query kernel (gathers dense,
-    masks per-(query, kv) causally)."""
+    masks per-(query, kv) causally; int8 pools dequantize dense)."""
     b, s_q, hq, d = q.shape
     nb, bs, hkv, _ = k_pages.shape
     mb = page_table.shape[1]
     group = hq // hkv
+    if k_scales is not None:
+        k_pages = dequantize_pages(k_pages, k_scales)
+        v_pages = dequantize_pages(v_pages, v_scales)
     if softmax_scale is None:
         softmax_scale = 1.0 / (d ** 0.5)
     k = k_pages[page_table].reshape(b, mb * bs, hkv, d)
@@ -342,13 +433,19 @@ def paged_attention_multiquery_reference(
 def paged_attention_reference(q: jnp.ndarray, k_pages: jnp.ndarray,
                               v_pages: jnp.ndarray, page_table: jnp.ndarray,
                               kv_lens: jnp.ndarray,
-                              softmax_scale: Optional[float] = None
+                              softmax_scale: Optional[float] = None,
+                              k_scales: Optional[jnp.ndarray] = None,
+                              v_scales: Optional[jnp.ndarray] = None
                               ) -> jnp.ndarray:
-    """Pure-jnp oracle with the same signature (gathers dense, masks)."""
+    """Pure-jnp oracle with the same signature (gathers dense, masks;
+    int8 pools dequantize dense)."""
     b, hq, d = q.shape
     nb, bs, hkv, _ = k_pages.shape
     mb = page_table.shape[1]
     group = hq // hkv
+    if k_scales is not None:
+        k_pages = dequantize_pages(k_pages, k_scales)
+        v_pages = dequantize_pages(v_pages, v_scales)
     if softmax_scale is None:
         softmax_scale = 1.0 / (d ** 0.5)
     k = k_pages[page_table].reshape(b, mb * bs, hkv, d)
@@ -474,34 +571,54 @@ def _tp_specs(mesh):
     from megatronapp_tpu.config.parallel_config import TP_AXIS
     head = P(None, TP_AXIS, None)             # q/out [B, Hq, D]
     pages = P(None, None, TP_AXIS, None)      # pools [NB, bs, Hkv, D]
+    scales = P(None, None, TP_AXIS)           # scale pools [NB, bs, Hkv]
     rep2, rep1 = P(None, None), P(None)
-    return head, pages, rep2, rep1
+    return head, pages, scales, rep2, rep1
 
 
 def paged_attention_decode_tp(q: jnp.ndarray, k_pages: jnp.ndarray,
                               v_pages: jnp.ndarray,
                               page_table: jnp.ndarray,
                               kv_lens: jnp.ndarray, mesh,
-                              softmax_scale: Optional[float] = None
+                              softmax_scale: Optional[float] = None,
+                              k_scales: Optional[jnp.ndarray] = None,
+                              v_scales: Optional[jnp.ndarray] = None
                               ) -> jnp.ndarray:
     """`paged_attention_decode` head-sharded over the tp axis of `mesh`.
 
     q [B, Hq, D] sharded on heads, pools [NB, bs, Hkv, D] sharded on
     Hkv, page table + kv lengths replicated; each shard runs the
     unmodified kernel on its own GQA groups against its 1/tp slice of
-    the block pool. Output is [B, Hq, D] head-sharded (callers gather /
-    constrain as needed)."""
+    the block pool. int8 pools shard their scale pools on Hkv alongside
+    — a quantized shard owns exactly its heads' rows AND scales. Output
+    is [B, Hq, D] head-sharded (callers gather / constrain as
+    needed)."""
     from megatronapp_tpu.parallel.collectives import shard_map_compat
-    head, pages, rep2, rep1 = _tp_specs(mesh)
+    head, pages, scales, rep2, rep1 = _tp_specs(mesh)
     if softmax_scale is None:
         softmax_scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    # Full-manual placement of the pallas decode kernel — purely local
+    # per (head, pool) shard, no collectives; tp_paged_eligible callers
+    # gate on no ambient manual axes.
+    if k_scales is not None:
+        def body_q(q_, k_, v_, t_, l_, ks_, vs_):
+            return paged_attention_decode(q_, k_, v_, t_, l_,
+                                          softmax_scale=softmax_scale,
+                                          k_scales=ks_, v_scales=vs_)
+
+        # manual-ok: full-manual kernel placement, see note above
+        return shard_map_compat(
+            body_q, mesh,
+            in_specs=(head, pages, pages, rep2, rep1, scales, scales),
+            out_specs=head)(q, k_pages, v_pages, page_table, kv_lens,
+                            k_scales, v_scales)
 
     def body(q_, k_, v_, t_, l_):
         return paged_attention_decode(q_, k_, v_, t_, l_,
                                       softmax_scale=softmax_scale)
 
-    # manual-ok: full-manual placement of the pallas decode kernel — the
-    # kernel is purely local per (head, pool) shard, no collectives.
+    # manual-ok: full-manual kernel placement, see note above
     return shard_map_compat(
         body, mesh, in_specs=(head, pages, pages, rep2, rep1),
         out_specs=head)(q, k_pages, v_pages, page_table, kv_lens)
@@ -512,26 +629,44 @@ def paged_attention_multiquery_tp(q: jnp.ndarray, k_pages: jnp.ndarray,
                                   page_table: jnp.ndarray,
                                   kv_lens: jnp.ndarray,
                                   q_lens: jnp.ndarray, mesh,
-                                  softmax_scale: Optional[float] = None
+                                  softmax_scale: Optional[float] = None,
+                                  k_scales: Optional[jnp.ndarray] = None,
+                                  v_scales: Optional[jnp.ndarray] = None
                                   ) -> jnp.ndarray:
     """`paged_attention_multiquery` head-sharded over the tp axis of
     `mesh` (speculative verify / chunked prefill on a tp serving mesh).
-    q [B, S_q, Hq, D] sharded on Hq; pools on Hkv; table/lens/q_lens
-    replicated."""
+    q [B, S_q, Hq, D] sharded on Hq; pools on Hkv (int8 pools: scale
+    pools sharded alongside); table/lens/q_lens replicated."""
     from jax.sharding import PartitionSpec as P
     from megatronapp_tpu.config.parallel_config import TP_AXIS
     from megatronapp_tpu.parallel.collectives import shard_map_compat
-    _, pages, rep2, rep1 = _tp_specs(mesh)
+    _, pages, scales, rep2, rep1 = _tp_specs(mesh)
     head4 = P(None, None, TP_AXIS, None)      # q/out [B, S_q, Hq, D]
     if softmax_scale is None:
         softmax_scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    # Full-manual placement of the pallas multi-query kernel — purely
+    # local per (head, pool) shard, no collectives; tp_paged_eligible
+    # callers gate on no ambient manual axes.
+    if k_scales is not None:
+        def body_q(q_, k_, v_, t_, l_, ql_, ks_, vs_):
+            return paged_attention_multiquery(q_, k_, v_, t_, l_, ql_,
+                                              softmax_scale=softmax_scale,
+                                              k_scales=ks_, v_scales=vs_)
+
+        # manual-ok: full-manual kernel placement, see note above
+        return shard_map_compat(
+            body_q, mesh,
+            in_specs=(head4, pages, pages, rep2, rep1, rep1, scales,
+                      scales),
+            out_specs=head4)(q, k_pages, v_pages, page_table, kv_lens,
+                             q_lens, k_scales, v_scales)
 
     def body(q_, k_, v_, t_, l_, ql_):
         return paged_attention_multiquery(q_, k_, v_, t_, l_, ql_,
                                           softmax_scale=softmax_scale)
 
-    # manual-ok: full-manual placement of the pallas multi-query kernel —
-    # purely local per (head, pool) shard, no collectives.
+    # manual-ok: full-manual kernel placement, see note above
     return shard_map_compat(
         body, mesh, in_specs=(head4, pages, pages, rep2, rep1, rep1),
         out_specs=head4)(q, k_pages, v_pages, page_table, kv_lens, q_lens)

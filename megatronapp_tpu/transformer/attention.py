@@ -89,6 +89,7 @@ def attention_forward(
     active: Optional[jnp.ndarray] = None,
     chunk_counts: Optional[jnp.ndarray] = None,
     tp_sharded: bool = False,
+    kv_scales=None,
 ) -> jnp.ndarray:
     """x: [B, S, H] → [B, S, H]. Returns (out, new_kv_cache).
 
@@ -105,6 +106,11 @@ def attention_forward(
     through the multi-query ragged kernel (causal within the new tail,
     full attention to the paged context). Rows past a row's count are
     padding whose outputs are garbage (callers discard them).
+    kv_scales: (k_scales, v_scales) fp32 [NB, bs, Hkv] — marks the paged
+    pools as int8 (PagedKVCache kv_cache_dtype="int8"): new rows
+    quantize per (row, head) in this jit before the scatter, the ragged
+    kernels dequantize each DMA'd block in-register, and new_cache grows
+    to (k, v, k_scales, v_scales). Paged paths only.
 
     zigzag: the CALLER laid the sequence out in zigzag cp order (model-side
     permutation, models/gpt.py) — required before the zigzag ring kernel may
@@ -146,8 +152,14 @@ def attention_forward(
     overlap = (kv_cache is None and not tp_sharded
                and tp_overlap_eligible(cfg, ctx, nq * d, 2 * nkv * d,
                                        batch=b))
-    q_kernel = _dist.apply("weight", p["q_kernel"], layer_id)
-    kv_kernel = _dist.apply("weight", p["kv_kernel"], layer_id)
+    # Serving-resident int8 weights (inference/quantization.py
+    # residentize_params): resolve_param dequantizes at matmul entry —
+    # int8 stays in HBM, XLA fuses the per-channel scale multiply.
+    from megatronapp_tpu.inference.quantization import resolve_param
+    q_kernel = _dist.apply("weight", resolve_param(p["q_kernel"]),
+                           layer_id)
+    kv_kernel = _dist.apply("weight", resolve_param(p["kv_kernel"]),
+                            layer_id)
     if tp_sharded:
         # Ambient-manual tp-sharded stage body: see docstring. Local head
         # counts; s stays the LOCAL seq chunk length, sf the full length.
@@ -202,7 +214,7 @@ def attention_forward(
             softmax_in_fp32=cfg.attention_softmax_in_fp32,
             layer_id=layer_id)
         attn_out = scope_capture("context", attn_out, layer_id)
-        out_kernel = _dist.apply("weight", p["out_kernel"],
+        out_kernel = _dist.apply("weight", resolve_param(p["out_kernel"]),
                                  layer_id).astype(dt)
         ow = lax.dynamic_slice_in_dim(out_kernel, me * nql * d, nql * d,
                                       axis=0)
@@ -243,6 +255,7 @@ def attention_forward(
         k = rotary.apply_rope(k, rope_cos, rope_sin)
 
     new_cache = None
+    new_scales = None
     paged_out = None
     mask_type = cfg.attn_mask_type
     if kv_cache is not None:
@@ -271,51 +284,87 @@ def attention_forward(
             # multi-query kernel.
             from megatronapp_tpu.ops.pallas.paged_attention import (
                 append_chunk_pages, paged_attention_multiquery,
-                paged_attention_multiquery_tp,
+                paged_attention_multiquery_tp, quantize_kv_rows,
             )
             if active is None:
                 active = jnp.ones((b,), bool)
             counts = (chunk_counts if chunk_counts is not None
                       else jnp.full((b,), s, jnp.int32))
-            ck = append_chunk_pages(ck, k, page_table, cache_positions,
-                                    counts, active)
-            cv = append_chunk_pages(cv, v, page_table, cache_positions,
-                                    counts, active)
+            if kv_scales is not None:
+                # int8 pool: quantize the new rows per (row, head) right
+                # here — ONE fused jit covers quantize + scatter +
+                # attend — and scatter the scales through the same page
+                # table.
+                cks, cvs = kv_scales
+                k_q, k_s = quantize_kv_rows(k)
+                v_q, v_s = quantize_kv_rows(v)
+                ck = append_chunk_pages(ck, k_q, page_table,
+                                        cache_positions, counts, active)
+                cv = append_chunk_pages(cv, v_q, page_table,
+                                        cache_positions, counts, active)
+                cks = append_chunk_pages(cks, k_s, page_table,
+                                         cache_positions, counts, active)
+                cvs = append_chunk_pages(cvs, v_s, page_table,
+                                         cache_positions, counts, active)
+                new_scales = (cks, cvs)
+                sc_kw = {"k_scales": cks, "v_scales": cvs}
+            else:
+                ck = append_chunk_pages(ck, k, page_table,
+                                        cache_positions, counts, active)
+                cv = append_chunk_pages(cv, v, page_table,
+                                        cache_positions, counts, active)
+                sc_kw = {}
             new_cache = (ck, cv)
             if tp_paged:
                 # manual-ok: tp_paged requires no ambient manual axes
                 paged_out = paged_attention_multiquery_tp(
                     q, ck, cv, page_table, cache_positions + counts,
-                    counts, ctx.shard_map_mesh)
+                    counts, ctx.shard_map_mesh, **sc_kw)
                 paged_out = _replicate_heads(paged_out, ctx)
             else:
                 paged_out = paged_attention_multiquery(
                     q, ck, cv, page_table, cache_positions + counts,
-                    counts)
+                    counts, **sc_kw)
         elif page_table is not None:
             # Paged continuous-batching decode: kv_cache is the shared
             # block pool; cache_positions[b] is row b's append position.
             from megatronapp_tpu.ops.pallas.paged_attention import (
                 append_token_pages, paged_attention_decode,
-                paged_attention_decode_tp,
+                paged_attention_decode_tp, quantize_kv_rows,
             )
             if active is None:
                 active = jnp.ones((b,), bool)
-            ck = append_token_pages(ck, k[:, 0], page_table,
-                                    cache_positions, active)
-            cv = append_token_pages(cv, v[:, 0], page_table,
-                                    cache_positions, active)
+            if kv_scales is not None:
+                cks, cvs = kv_scales
+                k_q, k_s = quantize_kv_rows(k[:, 0])
+                v_q, v_s = quantize_kv_rows(v[:, 0])
+                ck = append_token_pages(ck, k_q, page_table,
+                                        cache_positions, active)
+                cv = append_token_pages(cv, v_q, page_table,
+                                        cache_positions, active)
+                cks = append_token_pages(cks, k_s, page_table,
+                                         cache_positions, active)
+                cvs = append_token_pages(cvs, v_s, page_table,
+                                         cache_positions, active)
+                new_scales = (cks, cvs)
+                sc_kw = {"k_scales": cks, "v_scales": cvs}
+            else:
+                ck = append_token_pages(ck, k[:, 0], page_table,
+                                        cache_positions, active)
+                cv = append_token_pages(cv, v[:, 0], page_table,
+                                        cache_positions, active)
+                sc_kw = {}
             new_cache = (ck, cv)
             if tp_paged:
                 # manual-ok: tp_paged requires no ambient manual axes
                 paged_out = paged_attention_decode_tp(
                     q[:, 0], ck, cv, page_table, cache_positions + 1,
-                    ctx.shard_map_mesh)[:, None]
+                    ctx.shard_map_mesh, **sc_kw)[:, None]
                 paged_out = _replicate_heads(paged_out, ctx)
             else:
                 paged_out = paged_attention_decode(
                     q[:, 0], ck, cv, page_table,
-                    cache_positions + 1)[:, None]      # [B, 1, Hq, D]
+                    cache_positions + 1, **sc_kw)[:, None]  # [B,1,Hq,D]
         elif cache_positions is not None:
             # Continuous-batching decode (dynamic_context.py analogue):
             # each row appends at ITS OWN position; causality MUST come
@@ -338,7 +387,10 @@ def attention_forward(
                                                      axis=1)
             q_offset = cache_index
         k, v = ck, cv
-        new_cache = (ck, cv)
+        # Quantized paged paths return the scale pools alongside so the
+        # engine's lax.scan carries all four updated pools per layer.
+        new_cache = ((ck, cv) if new_scales is None
+                     else (ck, cv) + new_scales)
 
     # Note: the reference's apply_query_key_layer_scaling is numerically
     # neutral (it divides QK by layer_number for fp16 range safety and
@@ -466,7 +518,8 @@ def attention_forward(
                 q_offset=q_offset, layer_id=layer_id)
     attn_out = scope_capture("context", attn_out, layer_id)
 
-    out_kernel = _dist.apply("weight", p["out_kernel"], layer_id)
+    out_kernel = _dist.apply("weight", resolve_param(p["out_kernel"]),
+                             layer_id)
     out_kernel = out_kernel.astype(cfg.compute_dtype)
     if overlap:
         # manual-ok: same tp_overlap_eligible gate as the QKV ring above

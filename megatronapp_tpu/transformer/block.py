@@ -71,12 +71,15 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                   cache_positions=None, ctx=None,
                   zigzag: bool = False, segment_ids=None,
                   page_table=None, active=None, chunk_counts=None,
-                  tp_sharded: bool = False):
+                  tp_sharded: bool = False, kv_scales=None):
     """One transformer layer. x: [B,S,H] → ((out, new_cache), aux_losses).
 
     page_table/active: paged-KV decode (inference/paged_cache.py) —
     kv_cache is then the per-layer block pool and each batch row appends
     at its own page-table position (see attention.py / mla.py).
+    kv_scales: per-layer fp32 scale pools marking an int8 paged pool
+    (see attention.py); new_cache then carries four pools. Non-MLA only
+    — the MLA latent pool is bf16-only (PagedKVCache rejects int8+MLA).
 
     tp_sharded: ambient-manual tp-sharded stage body (pp pipeline) — x is
     the local [B, S/tp, H] seq chunk; norms/residuals run on it directly
@@ -93,6 +96,11 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                         == segment_ids[:, None, None, :])
             attention_mask = (seg_mask if attention_mask is None
                               else attention_mask & seg_mask)
+        if kv_scales is not None:
+            raise NotImplementedError(
+                "int8 KV pages are not supported for MLA (latent pool "
+                "is bf16-only); PagedKVCache rejects this at "
+                "construction")
         if kv_cache is not None:
             attn_out, new_cache = mla_forward(
                 p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
@@ -112,7 +120,8 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
             cache_positions=cache_positions, layer_id=layer_id,
             ctx=ctx, zigzag=zigzag, segment_ids=segment_ids,
             page_table=page_table, active=active,
-            chunk_counts=chunk_counts, tp_sharded=tp_sharded)
+            chunk_counts=chunk_counts, tp_sharded=tp_sharded,
+            kv_scales=kv_scales)
     # Tag for the 'selective_attn' remat policy (a no-op otherwise).
     attn_out = checkpoint_name(attn_out, "attn_out")
     x = residual + attn_out.astype(residual.dtype)

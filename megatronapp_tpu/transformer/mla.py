@@ -258,8 +258,9 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
             softmax_in_fp32=cfg.attention_softmax_in_fp32,
             q_offset=q_offset)
     out = scope_capture("context", out, layer_id)
+    from megatronapp_tpu.inference.quantization import resolve_param
     out = out.reshape(b, s, nq * dv) @ _dist.apply(
-        "weight", p["out_kernel"], layer_id).astype(dt)
+        "weight", resolve_param(p["out_kernel"]), layer_id).astype(dt)
     return (out, new_cache) if kv_cache is not None else out
 
 

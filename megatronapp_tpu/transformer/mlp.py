@@ -55,15 +55,21 @@ def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
         # on the matching row slice (parallel/overlap.py *_manual).
         return _mlp_forward_tp_sharded(p, x, cfg, layer_id, ctx)
     _dist = get_disturbance()
+    # Serving-resident int8 weights dequantize at matmul entry
+    # (inference/quantization.py resolve_param — a no-op on plain
+    # arrays).
+    from megatronapp_tpu.inference.quantization import resolve_param
+    fc1_res = resolve_param(p["fc1_kernel"])
+    fc2_res = resolve_param(p["fc2_kernel"])
     # Latency-hiding tp path (--tp-comm-overlap): fc1 column-parallel via
     # ring all-gather-matmul, fc2 row-parallel via matmul-reduce-scatter.
     # One eligibility decision covers the pair (both weight dims must
     # shard evenly) so the intermediate layout stays consistent.
-    overlap = tp_overlap_eligible(cfg, ctx, p["fc1_kernel"].shape[1],
-                                  p["fc2_kernel"].shape[0],
+    overlap = tp_overlap_eligible(cfg, ctx, fc1_res.shape[1],
+                                  fc2_res.shape[0],
                                   batch=x.shape[0])
     x = x.astype(cfg.compute_dtype)
-    fc1_kernel = _dist.apply("weight", p["fc1_kernel"], layer_id)
+    fc1_kernel = _dist.apply("weight", fc1_res, layer_id)
     fc1_kernel = fc1_kernel.astype(cfg.compute_dtype)
     if overlap:
         # manual-ok: overlap gated by tp_overlap_eligible (False inside
@@ -82,7 +88,7 @@ def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
         y = apply_activation(cfg.activation, val, gate)
     else:
         y = apply_activation(cfg.activation, y)
-    fc2_kernel = _dist.apply("weight", p["fc2_kernel"], layer_id)
+    fc2_kernel = _dist.apply("weight", fc2_res, layer_id)
     fc2_kernel = fc2_kernel.astype(cfg.compute_dtype)
     if overlap:
         # manual-ok: same tp_overlap_eligible gate as fc1 above

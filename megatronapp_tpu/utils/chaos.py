@@ -56,6 +56,15 @@ tests/test_resilience.py pins this registry against its drill list):
                              its last verified length, pool audit()
                              passes, and the retried round leaves the
                              emitted stream unchanged.
+- ``kv-quant-write``         an int8-pool chunk write fails between
+                             quantize and the page-table commit — in the
+                             engine's chunk-scatter prefill
+                             (dynamic_engine._paged_prefill_chunked) and
+                             the disagg prefill worker's shipped-chunk
+                             write (disagg.PrefillWorker.advance) —
+                             exercises the admit rollback (blocks
+                             released, request requeued, audit clean)
+                             and the worker's untouched-pool retry.
 
 Simulated whole-process faults (hang / exit) are flag-driven rather than
 registry-driven: --simulated-fault KIND:DELAY routes through
@@ -77,6 +86,7 @@ SITES = (
     "paged-evict",
     "paged-cow",
     "spec-verify",
+    "kv-quant-write",
 )
 
 

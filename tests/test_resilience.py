@@ -58,10 +58,12 @@ class TestChaosRegistry:
         TestLocalCheckpointRobustness, step-nan → TestStepNanInjection,
         stepper-step → TestServingSelfHealing, paged-evict/paged-cow →
         TestPagedAllocatorChaos, spec-verify →
-        TestSpeculativeVerifierChaos)."""
+        TestSpeculativeVerifierChaos, kv-quant-write →
+        TestKvQuantWriteChaos)."""
         assert chaos.SITES == ("checkpoint-save", "local-checkpoint-save",
                                "step-nan", "stepper-step",
-                               "paged-evict", "paged-cow", "spec-verify")
+                               "paged-evict", "paged-cow", "spec-verify",
+                               "kv-quant-write")
 
     def test_arm_fire_bounded_and_auto_disarm(self):
         chaos.arm("stepper-step", times=2, after=1)
@@ -239,6 +241,94 @@ class TestSpeculativeVerifierChaos:
         assert faults == 1, "the armed fault must fire inside a round"
         assert faulted == clean, (
             "retried verify round changed the emitted stream")
+
+
+# ---------------------------------------------------------------------------
+class TestKvQuantWriteChaos:
+    """Chaos site in the quantized chunk-scatter path (ISSUE 10): a
+    fault between quantize and the page-table commit must leave the
+    int8 pool audit-clean — the engine releases the admitted blocks and
+    requeues the request (one lost step, stream unchanged), and the
+    disagg prefill worker's pool/pos stay untouched so the retried
+    chunk is exact."""
+
+    def _cfg(self):
+        return tiny_model(num_query_groups=2, compute_dtype=jnp.float32,
+                          remat_policy="none")
+
+    def test_engine_chunk_fault_rolls_back_admit(self):
+        from megatronapp_tpu.inference.dynamic_engine import (
+            DynamicInferenceEngine,
+        )
+        from megatronapp_tpu.inference.engine import SamplingParams
+        from megatronapp_tpu.models.gpt import init_gpt_params
+        cfg = self._cfg()
+        params, _ = init_gpt_params(jax.random.PRNGKey(3), cfg)
+        prompt = np.arange(1, 14, dtype=np.int32)
+
+        def run(fault: bool, after: int = 0):
+            eng = DynamicInferenceEngine(
+                params, cfg, max_batch=1, max_seq_len=64,
+                prefill_buckets=(16,), paged=True, block_size=8,
+                prefill_chunk=8, kv_cache_dtype="int8")
+            rid = eng.add_request(prompt, 6, SamplingParams(greedy=True))
+            faults = 0
+            if fault:
+                chaos.arm("kv-quant-write", times=1, after=after)
+            while eng.has_work:
+                try:
+                    eng.step()
+                except chaos.ChaosFault:
+                    faults += 1
+                    eng.pool.audit()        # rollback left no leak/skew
+                    assert eng.pool.blocks_in_use() == 0
+                    assert eng.slots[0] is None
+                    assert len(eng.waiting) == 1   # requeued, not lost
+            eng.pool.audit()
+            return eng.requests[rid].tokens.tolist(), faults
+
+        clean, _ = run(fault=False)
+        # after=0: fault before the FIRST chunk (nothing written);
+        # after=1: fault mid-prefill with chunk 1's rows already in the
+        # pool — the released blocks carry stale rows the retry
+        # overwrites.
+        for after in (0, 1):
+            faulted, faults = run(fault=True, after=after)
+            assert faults == 1, "armed fault must fire during prefill"
+            assert faulted == clean, (
+                "retried admission changed the emitted stream")
+
+    def test_disagg_worker_fault_leaves_pool_untouched(self, devices8):
+        from megatronapp_tpu.inference.disagg import DisaggServingEngine
+        from megatronapp_tpu.inference.engine import SamplingParams
+        from megatronapp_tpu.models.gpt import init_gpt_params
+        cfg = self._cfg()
+        params, _ = init_gpt_params(jax.random.PRNGKey(3), cfg)
+        prompt = np.arange(1, 20, dtype=np.int32)
+
+        def run(fault: bool):
+            eng = DisaggServingEngine(
+                params, cfg, max_batch=1, max_seq_len=64,
+                prefill_buckets=(16, 32), block_size=8, prefill_chunk=8,
+                kv_cache_dtype="int8", devices=devices8[:2])
+            rid = eng.add_request(prompt, 5, SamplingParams(greedy=True))
+            faults = 0
+            if fault:
+                chaos.arm("kv-quant-write", times=1, after=1)
+            while eng.has_work:
+                try:
+                    eng.step()
+                except chaos.ChaosFault:
+                    faults += 1
+                    eng.pool.audit()   # staged blocks intact, no skew
+            eng.pool.audit()
+            return eng.requests[rid].tokens.tolist(), faults
+
+        clean, _ = run(fault=False)
+        faulted, faults = run(fault=True)
+        assert faults == 1, "armed fault must fire in the worker"
+        assert faulted == clean, (
+            "retried shipped-chunk write changed the emitted stream")
 
 
 # ---------------------------------------------------------------------------

@@ -44,11 +44,15 @@ def main():
     # add_serving_args — single source of truth): --engine, --max-batch,
     # --paged-kv-cache, --kv-block-size, --num-kv-blocks,
     # --no-prefix-caching.
-    from megatronapp_tpu.config.arguments import add_serving_args
+    from megatronapp_tpu.config.arguments import (
+        add_serving_args, validate_serving_args,
+    )
     add_serving_args(ap)
     args = ap.parse_args()
 
     cfg = PRESETS[args.preset]()
+    validate_serving_args(
+        args, multi_latent_attention=cfg.multi_latent_attention)
     mcfg = None
     if args.engine == "mamba":
         from megatronapp_tpu.models.mamba import (
@@ -60,15 +64,30 @@ def main():
         params, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
     if args.load_quantized:
         from tools.checkpoint.quantize import load_quantized_params
-        loaded = load_quantized_params(args.load_quantized)
+        # --quantized-weights keeps the int8 kernels RESIDENT (dequant
+        # fused at matmul entry, inference/quantization.py
+        # residentize_params) instead of dequantizing on load.
+        loaded = load_quantized_params(args.load_quantized,
+                                       dequantize=not
+                                       args.quantized_weights)
         expect = "layers" if args.engine == "mamba" else "block"
         if expect not in loaded:
             raise SystemExit(
                 f"--load-quantized artifact does not look like a "
                 f"{args.engine} checkpoint (missing '{expect}'); "
                 f"top-level keys: {sorted(loaded)[:8]}")
-        params = loaded
-        print(f"loaded int8-quantized params from {args.load_quantized}")
+        if args.quantized_weights:
+            from megatronapp_tpu.inference.quantization import (
+                resident_nbytes, residentize_params,
+            )
+            params = residentize_params(loaded)
+            print(f"serving RESIDENT int8 params from "
+                  f"{args.load_quantized} "
+                  f"({resident_nbytes(params)/2**20:.1f} MiB on device)")
+        else:
+            params = loaded
+            print(f"loaded int8-quantized params from "
+                  f"{args.load_quantized}")
     elif args.load_dir:
         mngr = CheckpointManager(args.load_dir)
         state = mngr.restore({"step": 0, "params": params, "opt_state": {}})
@@ -76,6 +95,19 @@ def main():
             params = state["params"]
             print(f"loaded checkpoint step {state['step']}")
         mngr.close()
+    if args.quantized_weights and not args.load_quantized:
+        # (mamba is rejected by validate_serving_args above.)
+        from megatronapp_tpu.inference.quantization import (
+            quantize_params, residentize_params,
+        )
+        # resident_only: quantize ONLY leaves that will stay int8 —
+        # rounding a weight residentize would dequantize eagerly again
+        # costs accuracy for zero memory win.
+        qparams, report = quantize_params(params, resident_only=True)
+        params = residentize_params(qparams)
+        worst = max(report.values()) if report else 0.0
+        print(f"PTQ-quantized {len(report)} kernels at startup "
+              f"(max |w err| {worst:.4g}); int8 kept resident")
     tok = build_tokenizer(args.tokenizer_type, args.tokenizer_name_or_path,
                           vocab_size=cfg.vocab_size)
     if args.engine == "mamba":
@@ -124,11 +156,13 @@ def main():
                 prefill_slots=args.disagg_prefill_slots,
                 decode_slo_ms=args.decode_slo_ms, tp=args.serve_tp,
                 spec_method=spec, spec_k=args.spec_k,
-                draft_params=draft_params, draft_cfg=draft_cfg)
+                draft_params=draft_params, draft_cfg=draft_cfg,
+                kv_cache_dtype=args.kv_cache_dtype)
             print(f"serving DISAGGREGATED on {args.host}:{args.port} "
                   f"(prefill {engine.prefill_ctx.num_devices}d / decode "
                   f"{engine.decode_ctx.num_devices}d, tp={args.serve_tp}, "
                   f"slo={args.decode_slo_ms} ms, "
+                  f"kv={args.kv_cache_dtype}, "
                   f"spec={spec or 'off'})")
             TextGenerationServer(engine, args.host, args.port).run()
             return
@@ -149,9 +183,10 @@ def main():
             spec_method=spec,
             spec_k=args.spec_k, draft_params=draft_params,
             draft_cfg=draft_cfg, prefill_chunk=args.prefill_chunk,
-            ctx=tp_ctx)
+            ctx=tp_ctx, kv_cache_dtype=args.kv_cache_dtype)
         print(f"serving continuous batching on {args.host}:{args.port} "
-              f"(paged={args.paged_kv_cache}, tp={args.serve_tp}, "
+              f"(paged={args.paged_kv_cache}, "
+              f"kv={args.kv_cache_dtype}, tp={args.serve_tp}, "
               f"spec={engine.spec_method or 'off'})")
         TextGenerationServer(engine, args.host, args.port).run()
         return
