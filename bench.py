@@ -144,6 +144,18 @@ def _kv_quant_hook():
     return r if r.get("memory_decode") else None
 
 
+def _megakernel_hook():
+    """Megakernel decode + dispatch levers A/B
+    (tools/megakernel_benchmark.py) on the CPU backend — decode
+    dispatch-count ratio (plain vs fused, bf16 + int8), stream parity,
+    and the head-fold + scan-unroll fwd+bwd wall ratio tracked round
+    over round like the other hooks."""
+    if os.environ.get("BENCH_MEGAKERNEL", "1") != "1":
+        return None
+    r = _run_child("--megakernel", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("decode") else None
+
+
 def _disagg_hook():
     """Colocated-vs-disaggregated serving A/B
     (tools/disagg_benchmark.py) on the CPU sub-meshes — decode p99
@@ -204,6 +216,9 @@ def _attach_overlap_hooks(res):
     kvq = _kv_quant_hook()
     if kvq:
         res.setdefault("extra", {})["kv_quant"] = kvq
+    mkd = _megakernel_hook()
+    if mkd:
+        res.setdefault("extra", {})["megakernel"] = mkd
     return res
 
 
@@ -276,6 +291,7 @@ def parent_main(local_only: bool = False):
     pkv = _paged_kv_hook()
     spd = _spec_decode_hook()
     kvq = _kv_quant_hook()
+    mkd = _megakernel_hook()
     last = _load_last_good()
     if last is not None:
         # Top-level `stale` so the consumer can verifiably distinguish this
@@ -306,6 +322,8 @@ def parent_main(local_only: bool = False):
             last["extra"]["spec_decode"] = spd
         if kvq:
             last["extra"]["kv_quant"] = kvq
+        if mkd:
+            last["extra"]["megakernel"] = mkd
         print(json.dumps(last))
         return
     if cpu:
@@ -326,6 +344,8 @@ def parent_main(local_only: bool = False):
             cpu.setdefault("extra", {})["spec_decode"] = spd
         if kvq:
             cpu.setdefault("extra", {})["kv_quant"] = kvq
+        if mkd:
+            cpu.setdefault("extra", {})["megakernel"] = mkd
         print(json.dumps(cpu))
         return
     print(json.dumps({
@@ -462,6 +482,13 @@ def kv_quant_main():
     from tools.kv_quant_benchmark import run
     print(json.dumps(run(max_batch=4, block_size=8, max_new=6,
                          spec_k=4)))
+
+
+def megakernel_main():
+    """megakernel decode + dispatch levers A/B child (CPU env set by
+    the parent)."""
+    from tools.megakernel_benchmark import run
+    print(json.dumps(run(max_new=6, scan_unroll=2, iters=6)))
 
 
 def disagg_main():
@@ -607,5 +634,7 @@ if __name__ == "__main__":
         kv_quant_main()
     elif "--disagg" in sys.argv:
         disagg_main()
+    elif "--megakernel" in sys.argv:
+        megakernel_main()
     else:
         parent_main(local_only="--local" in sys.argv)

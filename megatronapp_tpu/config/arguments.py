@@ -62,6 +62,17 @@ def add_serving_args(ap: argparse.ArgumentParser):
                         "dequantized in-kernel on each DMA'd block "
                         "(needs --paged-kv-cache; MLA latent pools are "
                         "bf16-only)")
+    g.add_argument("--megakernel-decode", action="store_true",
+                   help="fused (megakernel) decode step (ISSUE 11, "
+                        "ops/pallas/kernel_gen.py): the per-token layer "
+                        "body runs as three fat Pallas kernels around "
+                        "the paged-attention kernel instead of the "
+                        "~15-fusion unfused tail (needs --engine "
+                        "dynamic --paged-kv-cache; streams stay "
+                        "token-exact). Ineligible configs (MLA, MoE, "
+                        "--serve-tp>1, MegaScope hooks, oversized "
+                        "weights) keep the unfused step with a logged "
+                        "reason")
     g.add_argument("--quantized-weights", action="store_true",
                    help="serve from int8 weights kept RESIDENT (per-"
                         "channel dequant fused at matmul entry, param "
@@ -138,6 +149,23 @@ def validate_serving_args(args, multi_latent_attention: bool = False):
                 "presets: the latent pool is already a compressed "
                 "representation and stays bf16-only for now — drop "
                 "--kv-cache-dtype int8 or pick a non-MLA preset")
+    if getattr(args, "megakernel_decode", False):
+        if getattr(args, "engine", "static") != "dynamic":
+            raise SystemExit(
+                "--megakernel-decode requires --engine dynamic (the "
+                "fused step is the dynamic engine's decode body)")
+        if not getattr(args, "paged_kv_cache", False):
+            raise SystemExit(
+                "--megakernel-decode requires --paged-kv-cache (the "
+                "fused step is built around the paged-attention "
+                "kernel)")
+        if getattr(args, "serve_disagg", False):
+            raise SystemExit(
+                "--megakernel-decode does not support --serve-disagg "
+                "yet (the disagg coordinator does not thread "
+                "fused_decode into its decode engine) — drop one of "
+                "the two flags; silently serving the unfused step "
+                "would violate the loud-fallback contract")
     if (getattr(args, "quantized_weights", False)
             and getattr(args, "engine", "static") == "mamba"):
         raise SystemExit(
@@ -324,7 +352,13 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
                    help="flash/dense crossover sequence length (PERF.md)")
     g.add_argument("--scan-unroll", type=int, default=1,
                    help="lax.scan unroll factor for the layer stack "
-                        "(PERF.md lever #3)")
+                        "(PERF.md lever #3; also unrolls the serving "
+                        "decode-step layer scan)")
+    g.add_argument("--flash-head-fold", action="store_true",
+                   help="fold q-head pairs into the trailing block dim "
+                        "of the flash BACKWARD kernels (D=64 -> 128 "
+                        "lanes, PERF.md lever #1); ineligible layouts "
+                        "keep the standard kernels")
     g.add_argument("--bf16", action="store_true", default=True)
     g.add_argument("--fp32", action="store_true",
                    help="disable bf16 compute")
@@ -728,6 +762,7 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
             attention_impl=args.attention_impl,
             flash_min_seq=args.flash_min_seq,
             scan_unroll=args.scan_unroll,
+            flash_head_fold=args.flash_head_fold,
             compute_dtype=jnp.float32 if args.fp32 else jnp.bfloat16,
             heterogeneous_layers_config_json=_hetero_json(args),
         )

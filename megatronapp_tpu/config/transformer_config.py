@@ -202,7 +202,18 @@ class TransformerConfig:
     # lax.scan unroll factor for the layer stack (PERF.md lever #3:
     # unrolling lets XLA software-pipeline across layer boundaries at
     # the cost of code size/compile time). Must divide num_layers.
+    # Honored by training (block_forward) AND the serving decode /
+    # multi-query step scans (ISSUE 11) — unrolling the decode layer
+    # loop removes its while-iteration dispatch overhead.
     scan_unroll: int = 1
+
+    # Head-fold flash BACKWARD kernels (PERF.md lever #1, ISSUE 11,
+    # --flash-head-fold): fold q-head pairs into the trailing block dim
+    # (D=64 → full 128-lane vreg rows for every q/do load and gradient
+    # accumulator, half the grid's head extent). Opt-in A/B knob until
+    # the on-chip numbers land; ineligible layouts (2D > 128, odd head
+    # counts, packed segments) silently keep the standard kernels.
+    flash_head_fold: bool = False
 
     # Heterogeneous per-layer structure (reference
     # heterogeneous_config.py HeterogeneousTransformerConfig): the HF

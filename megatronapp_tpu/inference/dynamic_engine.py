@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import logging
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -61,6 +62,8 @@ from megatronapp_tpu.inference.paged_cache import PagedKVCache, cdiv
 from megatronapp_tpu.models.gpt import gpt_embed, gpt_head, gpt_rope_tables
 from megatronapp_tpu.transformer.block import layer_forward
 from megatronapp_tpu.utils import chaos
+
+logger = logging.getLogger(__name__)
 
 
 class DeadlineExceeded(RuntimeError):
@@ -170,14 +173,15 @@ def _decode_step(params, tokens, cache, lengths, active,
         return hh, new_cache
 
     h, new_caches = jax.lax.scan(
-        body, h, (params["block"], ck, cv, jnp.arange(cfg.num_layers)))
+        body, h, (params["block"], ck, cv, jnp.arange(cfg.num_layers)),
+        unroll=cfg.scan_unroll)
     logits = gpt_head(params, h, cfg)[:, -1]
     return logits, new_caches
 
 
 def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
                        cfg: TransformerConfig, max_seq_len: int, ctx=None,
-                       scales=None):
+                       scales=None, fused: bool = False):
     """One-token decode for every slot against the paged block pool.
 
     pages: ([L, NB, bs, Hkv, D], same) K/V pools (MLA: latent + k_pe
@@ -185,8 +189,14 @@ def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
     positions; active [B] bool (inactive rows' writes are dropped and
     their outputs discarded). scales: ([L, NB, bs, Hkv] fp32, same) for
     an int8 pool — the step then quantizes the appended rows in-jit and
-    returns the updated scale pools alongside. Returns
-    (last_logits [B,V], new pages[, new scales] as one stacked tuple)."""
+    returns the updated scale pools alongside. fused: megakernel layer
+    body (ISSUE 11) — each scanned layer runs the fused Pallas kernels
+    of kernel_gen.fused_layer_decode instead of the unfused op tail
+    (callers gate on megakernel_ineligible_reason; streams token-exact).
+    The layer scan honors cfg.scan_unroll (PERF lever 3: unrolling
+    removes the while-loop dispatch overhead and lets XLA fuse across
+    layer boundaries). Returns (last_logits [B,V], new pages[, new
+    scales] as one stacked tuple)."""
     h = gpt_embed(params, tokens, cfg, position_ids=lengths[:, None])
     cos_full, sin_full = gpt_rope_tables(cfg, max_seq_len)
     if cos_full is not None:
@@ -218,7 +228,7 @@ def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
                 layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
                 kv_cache=(a_l, b_l), cache_index=None,
                 cache_positions=lengths, page_table=page_table,
-                active=active, ctx=ctx)
+                active=active, ctx=ctx, fused_decode=fused)
             return hh, new_cache
 
         xs = (params["block"], pa, pb, lids)
@@ -232,12 +242,13 @@ def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
                 layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
                 kv_cache=(a_l, b_l), cache_index=None,
                 cache_positions=lengths, page_table=page_table,
-                active=active, ctx=ctx, kv_scales=(sa_l, sb_l))
+                active=active, ctx=ctx, kv_scales=(sa_l, sb_l),
+                fused_decode=fused)
             return hh, new_cache
 
         xs = (params["block"], pa, pb, sa, sb, lids)
 
-    h, new_pages = jax.lax.scan(body, h, xs)
+    h, new_pages = jax.lax.scan(body, h, xs, unroll=cfg.scan_unroll)
     logits = gpt_head(params, h, cfg)[:, -1]
     return logits, new_pages
 
@@ -306,7 +317,7 @@ def _paged_multiquery_step(params, tokens, pages, page_table, starts,
 
         xs = (params["block"], pa, pb, sa, sb, lids)
 
-    h, new_pages = jax.lax.scan(body, h, xs)
+    h, new_pages = jax.lax.scan(body, h, xs, unroll=cfg.scan_unroll)
     logits = gpt_head(params, h, cfg)
     return logits, h, new_pages
 
@@ -385,7 +396,8 @@ class DynamicInferenceEngine:
                  spec_method: Optional[str] = None, spec_k: int = 4,
                  draft_params=None, draft_cfg=None,
                  prefill_chunk: int = 32, ctx=None, pool=None,
-                 kv_cache_dtype: str = "bf16"):
+                 kv_cache_dtype: str = "bf16",
+                 fused_decode: bool = False):
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -436,9 +448,17 @@ class DynamicInferenceEngine:
             if paged:
                 from megatronapp_tpu.config.parallel_config import TP_AXIS
                 from megatronapp_tpu.ops.pallas.paged_attention import (
-                    tp_paged_eligible,
+                    tp_paged_ineligible_reason,
                 )
-                self.tp_paged = tp_paged_eligible(cfg, ctx)
+                reason = tp_paged_ineligible_reason(cfg, ctx)
+                self.tp_paged = reason is None
+                if not self.tp_paged and ctx.tp > 1:
+                    # Name the SPECIFIC failed predicate instead of a
+                    # generic ineligible-fallback line (ISSUE 11
+                    # satellite).
+                    logger.warning(
+                        "paged kernels stay single-device on a tp=%d "
+                        "mesh: %s", ctx.tp, reason)
                 # Pages [L, NB, bs, Hkv, D]: shard Hkv when eligible so
                 # each device holds 1/tp of the pool; otherwise just
                 # commit them to this mesh (disagg decode sub-mesh). An
@@ -489,11 +509,30 @@ class DynamicInferenceEngine:
             if self.proposer is not None:
                 self.spec_method = spec_method
 
+        # Megakernel decode (ISSUE 11): requested via fused_decode=True /
+        # --megakernel-decode; eligibility is re-checked on every jit
+        # build (reset_compilation re-gates after MegaScope hook
+        # toggles). Ineligible requests keep the unfused step with a
+        # loud log naming the SPECIFIC failed predicate.
+        self._fused_requested = bool(fused_decode)
+        self.megakernel = False
+        if fused_decode and not paged:
+            raise ValueError(
+                "fused_decode=True requires the paged backend (the "
+                "fused step is built around the paged-attention "
+                "kernel) — pass paged=True / --paged-kv-cache")
+
         # Trace counter for the unified multi-query step (chunked prefill
         # + speculative verify): increments ONLY when jax re-traces, so
         # tests can assert chunked prefill stops retracing per
-        # (bucket, cached-length) pair.
+        # (bucket, cached-length) pair. decode_traces mirrors it for the
+        # plain decode step (the /stats jit-count satellite).
         self.mq_traces = 0
+        self.decode_traces = 0
+        # Compiled decode-step dispatch accounting, cached per jit build
+        # (utils/dispatch.py; computed lazily — it costs one AOT
+        # compile at the engine's shapes).
+        self._dispatch_stats = None
         self._build_jits()
 
     def _build_jits(self):
@@ -504,6 +543,7 @@ class DynamicInferenceEngine:
         self._prefill = jax.jit(
             functools.partial(_forward_with_cache, cfg=cfg))
         self._sample_b = jax.jit(_sample_batched)
+        self._dispatch_stats = None
         if self.paged:
             msl = self.max_seq_len
             # ctx rides into the step only on a tp-paged mesh (it then
@@ -511,14 +551,35 @@ class DynamicInferenceEngine:
             # attention_forward); otherwise the trace stays identical to
             # the single-device engine.
             step_ctx = self.ctx if self.tp_paged else None
+            # Megakernel decode eligibility (re-checked per build so
+            # MegaScope hook toggles + reset_compilation re-gate it).
+            self.megakernel = False
+            if self._fused_requested:
+                from megatronapp_tpu.ops.pallas.kernel_gen import (
+                    megakernel_ineligible_reason,
+                )
+                reason = megakernel_ineligible_reason(
+                    cfg, batch=self.max_batch, tp_paged=self.tp_paged,
+                    params=self.params)
+                if reason is None:
+                    self.megakernel = True
+                else:
+                    logger.warning(
+                        "megakernel decode requested but ineligible — "
+                        "keeping the unfused decode step: %s", reason)
+            fused = self.megakernel
+
             # `scales` is the int8 pool's fp32 scale-pool pair (None for
             # bf16 pools — an empty pytree, so the same jit signature
             # serves both dtypes and donation is a no-op there).
-            self._decode = jax.jit(
-                lambda p, t, pages, scales, tbl, l, a: _paged_decode_step(
-                    p, t, pages, tbl, l, a, cfg, msl, ctx=step_ctx,
-                    scales=scales),
-                donate_argnums=(2, 3))
+            def _decode_traced(p, t, pages, scales, tbl, l, a):
+                # Python side-effect: runs only while TRACING.
+                self.decode_traces += 1
+                return _paged_decode_step(p, t, pages, tbl, l, a, cfg,
+                                          msl, ctx=step_ctx,
+                                          scales=scales, fused=fused)
+
+            self._decode = jax.jit(_decode_traced, donate_argnums=(2, 3))
 
             def _mq_traced(p, t, pages, scales, tbl, starts, qlens, act):
                 # Python side-effect: runs only while TRACING.
@@ -542,8 +603,11 @@ class DynamicInferenceEngine:
                     point_mass=self.proposer.point_mass)
                 self.proposer.reset_compilation()
         else:
-            self._decode = jax.jit(
-                lambda p, t, c, l, a: _decode_step(p, t, c, l, a, cfg))
+            def _decode_traced_dense(p, t, c, l, a):
+                self.decode_traces += 1
+                return _decode_step(p, t, c, l, a, cfg)
+
+            self._decode = jax.jit(_decode_traced_dense)
 
     def reset_compilation(self):
         """Re-trace on next call (after MegaScope hook toggles — see
@@ -1216,10 +1280,71 @@ class DynamicInferenceEngine:
         return results
 
     # ---- observability ----------------------------------------------------
-    def stats_snapshot(self) -> Dict:
+    def dispatch_stats(self, force: bool = False) -> Optional[Dict]:
+        """Compiled decode-step dispatch accounting (ISSUE 11): lowers +
+        compiles the decode jit AOT at the engine's shapes and counts
+        executable fusions / custom-calls / while-loops per step
+        (utils/dispatch.py). Cached per jit build — the first call pays
+        one extra compile; /stats serves the cached value afterwards.
+        The megakernel fusion win is gated off THESE counts (the
+        compiled module), not wall time."""
+        if self._dispatch_stats is not None and not force:
+            return self._dispatch_stats
+        if not self.paged:
+            return None
+        from megatronapp_tpu.utils.dispatch import (
+            compiled_stats, launch_stats,
+        )
+        spec = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+            a.shape, a.dtype)
+        p_spec = jax.tree.map(spec, self.params)
+        pages_spec = jax.tree.map(spec, self.pool.pages)
+        scales_spec = jax.tree.map(spec, self.pool.scales)
+        mb = self.pool.page_table.shape[1]
+        args = (p_spec,
+                jax.ShapeDtypeStruct((self.max_batch, 1), jnp.int32),
+                pages_spec, scales_spec,
+                jax.ShapeDtypeStruct((self.max_batch, mb), jnp.int32),
+                jax.ShapeDtypeStruct((self.max_batch,), jnp.int32),
+                jax.ShapeDtypeStruct((self.max_batch,), jnp.bool_))
+        try:
+            # Gate metric: estimated kernel launches per executed step
+            # off the traced module (pallas_call == ONE TPU custom
+            # call; scan bodies × length; unroll credits loop steps).
+            stats = launch_stats(self._decode, *args)
+            # Record metrics: what THIS backend actually compiled (on
+            # CPU the interpret-mode kernels inline into plain HLO) +
+            # the XLA cost-model totals.
+            stats["compiled"] = compiled_stats(self._decode, *args)
+        except Exception as e:  # noqa: BLE001 — observability must not
+            # take the serving loop down with it (backend-specific
+            # lowering quirks degrade to a reported error).
+            logger.warning("decode dispatch accounting failed: %s", e)
+            stats = {"error": str(e)}
+        stats["megakernel"] = self.megakernel
+        stats["scan_unroll"] = self.cfg.scan_unroll
+        self._dispatch_stats = stats
+        # MegaScan: the fusion win is a monitored metric — emit it into
+        # the trace stream when a tracer is configured.
+        try:
+            from megatronapp_tpu.trace.tracer import get_tracer
+            tr = get_tracer()
+            if getattr(tr, "enabled", False):
+                tr.instant("decode-dispatch", **{
+                    k: v for k, v in stats.items()
+                    if isinstance(v, (int, float, bool))})
+        except Exception:  # noqa: BLE001 — tracing is best-effort
+            pass
+        return stats
+
+    def stats_snapshot(self, include_dispatch: bool = False) -> Dict:
         """JSON-ready serving stats (the server's GET /stats payload):
         pool occupancy, prefix-cache hit rate, speculative acceptance,
-        active batch size — serving is observable without log scraping."""
+        active batch size — serving is observable without log scraping.
+
+        include_dispatch=True adds the compiled decode-step dispatch
+        accounting (dispatch_stats; the first call pays one AOT compile
+        — /stats opts in, /healthz stays cheap)."""
         out = {
             "engine": "dynamic",
             "paged": self.paged,
@@ -1227,7 +1352,11 @@ class DynamicInferenceEngine:
             "active": sum(1 for r in self.slots if r is not None),
             "waiting": len(self.waiting),
             "multiquery_traces": self.mq_traces,
+            "decode_traces": self.decode_traces,
+            "megakernel": self.megakernel,
         }
+        if include_dispatch and self.paged:
+            out["decode_dispatch"] = self.dispatch_stats()
         if self.paged:
             pool = self.pool
             st = dict(pool.stats)

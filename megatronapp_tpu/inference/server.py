@@ -617,11 +617,17 @@ class TextGenerationServer:
     # ------------------------------------------------------------------
     def stats_snapshot(self) -> dict:
         """Serving stats for GET /stats. Dynamic engines report their
-        full snapshot (pool / speculation / batch occupancy); static and
+        full snapshot (pool / speculation / batch occupancy — plus the
+        compiled decode-step dispatch accounting, ISSUE 11: /stats opts
+        into include_dispatch, whose FIRST call pays one AOT compile and
+        is cached after; /healthz keeps the cheap snapshot); static and
         mamba engines report what exists for them."""
         eng = self.engine
         if hasattr(eng, "stats_snapshot"):
-            out = eng.stats_snapshot()
+            try:
+                out = eng.stats_snapshot(include_dispatch=True)
+            except TypeError:   # coordinator facades without the kwarg
+                out = eng.stats_snapshot()
         else:
             out = {"engine": type(eng).__name__.replace(
                 "InferenceEngine", "").lower()}

@@ -356,8 +356,18 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
     # manual pipeline region shards activations over tp along the seq dim
     # and the stage body runs the ring-overlapped projections — tp× fewer
     # stage FLOPs instead of the tp-replicated redundant compute.
-    from megatronapp_tpu.parallel.overlap import tp_stage_eligible
-    tp_shard = positions is None and tp_stage_eligible(cfg, ctx, s)
+    from megatronapp_tpu.parallel.overlap import tp_stage_ineligible_reason
+    _tp_reason = tp_stage_ineligible_reason(cfg, ctx, s)
+    tp_shard = positions is None and _tp_reason is None
+    if (not tp_shard and ctx is not None and ctx.tp > 1 and ctx.pp > 1):
+        # Trace-time log (fires once per compiled shape) naming the
+        # SPECIFIC failed predicate instead of a generic ineligible
+        # fallback (ISSUE 11 satellite).
+        import logging
+        logging.getLogger(__name__).info(
+            "pipeline stage body runs tp-REPLICATED: %s",
+            _tp_reason if positions is None
+            else "inference path (positions given)")
 
     def stage_fn(chunk_params, x, layer_offset):
         layer_offset = layer_offset * unit_layers

@@ -585,6 +585,276 @@ def _bwd_dkv_kernel_t(*refs, scale, causal,
         dkt_ref[0, 0] = dkt_acc[:].astype(dkt_ref.dtype)
         dvt_ref[0, 0] = dvt_acc[:].astype(dvt_ref.dtype)
 
+# ---------------------------------------------------------------------------
+# Head-fold backward kernels (PERF.md lever 1, ISSUE 11): at D = 64 the
+# straight kernels' [block, D] refs/accumulators fill only half of every
+# 128-lane vreg row. Folding a PAIR of q heads into the trailing block
+# dim ([B, H, S, D] → [B, H/2, S, 2D]) makes every q/do load, the dq /
+# dk / dv accumulators, and the gradient stores full 128-lane rows, and
+# halves the grid's head extent (half the per-tile dispatch overhead).
+# The score matmuls stay per-head (two D-contracted dots per tile — the
+# intrinsic K = D underfill is untouched, same as the transposed
+# orientation). GQA: a pair must share its kv head, so eligibility is
+# group even (pair inside one group) or group == 1 with hkv even (kv
+# folds alongside q). Opt-in via flash_attention(head_fold=True) /
+# --flash-head-fold; grad parity vs the unfolded kernels is pinned
+# ≤ 1e-5 in tests/test_kernel_gen.py. On-chip A/B queued behind the
+# tunnel; the CPU evidence is the fwd+bwd wall ratio + cost model in
+# tools/megakernel_benchmark.py.
+# ---------------------------------------------------------------------------
+
+
+def _fold_heads(x):
+    """[B, H, S, D] → [B, H/2, S, 2D] (head pair side by side in the
+    trailing dim)."""
+    b, h, s, d = x.shape
+    return jnp.swapaxes(x.reshape(b, h // 2, 2, s, d), 2, 3).reshape(
+        b, h // 2, s, 2 * d)
+
+
+def _unfold_heads(x):
+    """Inverse of _fold_heads."""
+    b, hp, s, d2 = x.shape
+    d = d2 // 2
+    return jnp.swapaxes(x.reshape(b, hp, s, 2, d), 2, 3).reshape(
+        b, 2 * hp, s, d)
+
+
+def _fold_rows(x):
+    """[B, H, S] per-row scalars (lse/delta) → [B, H/2, S, 2]."""
+    b, h, s = x.shape
+    return jnp.transpose(x.reshape(b, h // 2, 2, s), (0, 1, 3, 2))
+
+
+def _bwd_dq_kernel_fold(*refs, scale, causal, block_q, block_kv, num_kv,
+                        seq_q, seq_kv, bounded, kv_folded, d):
+    """dq with a folded head pair: q/do/lse/delta/dq refs carry both
+    heads ([bq, 2D] / [bq, 2]); the two per-head score chains share one
+    [bq, bkv] validity mask and accumulate into the [bq, 2D] dq rows."""
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+     dq_ref, dq_acc) = refs
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = iq * block_q
+    k_start = ik * block_kv
+
+    def compute(masked):
+        valid = None
+        if masked:
+            valid = _valid_mask(q_start, k_start, block_q, block_kv,
+                                seq_q, seq_kv, causal, bounded,
+                                None, None)
+        for half in (0, 1):
+            sl = slice(half * d, (half + 1) * d)
+            q = q_ref[0, 0][:, sl].astype(jnp.float32) * scale
+            do = do_ref[0, 0][:, sl].astype(jnp.float32)
+            k = k_ref[0, 0][:, sl] if kv_folded else k_ref[0, 0]
+            v = v_ref[0, 0][:, sl] if kv_folded else v_ref[0, 0]
+            if bounded:
+                k = _mask_rows(k, k_start, seq_kv)
+                v = _mask_rows(v, k_start, seq_kv)
+            lse = lse_ref[0, 0][:, half]
+            delta = delta_ref[0, 0][:, half]
+
+            s = jax.lax.dot_general(
+                q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if masked:
+                p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+                ds = jnp.where(valid, p * (dp - delta[:, None]), 0.0)
+            else:
+                p = jnp.exp(s - lse[:, None])
+                ds = p * (dp - delta[:, None])
+            dq_acc[:, sl] += jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+
+    _dispatch_tiles(compute, causal, bounded, q_start, k_start,
+                    block_q, block_kv)
+
+    @pl.when(ik == num_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_fold(*refs, scale, causal, block_q, block_kv, num_q,
+                         seq_q, seq_kv, bounded, kv_folded, d):
+    """dk/dv with a folded q-head pair. kv_folded (MHA, hkv even): the
+    kv pair folds alongside and the accumulators are [bkv, 2D]. Shared
+    kv head (GQA, group even): both halves accumulate into one [bkv, D]
+    dk/dv — the in-kernel half of the group reduction the caller
+    finishes over pairs."""
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+     dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = iq * block_q
+    k_start = ik * block_kv
+
+    def compute(masked):
+        valid = None
+        if masked:
+            valid = _valid_mask(q_start, k_start, block_q, block_kv,
+                                seq_q, seq_kv, causal, bounded,
+                                None, None)
+        for half in (0, 1):
+            sl = slice(half * d, (half + 1) * d)
+            acc_sl = sl if kv_folded else slice(None)
+            q = q_ref[0, 0][:, sl].astype(jnp.float32) * scale
+            do = do_ref[0, 0][:, sl].astype(jnp.float32)
+            k = k_ref[0, 0][:, sl] if kv_folded else k_ref[0, 0]
+            v = v_ref[0, 0][:, sl] if kv_folded else v_ref[0, 0]
+            if bounded:
+                q = _mask_rows(q, q_start, seq_q)
+                k = _mask_rows(k, k_start, seq_kv)
+                v = _mask_rows(v, k_start, seq_kv)
+                do = _mask_rows(do, q_start, seq_q)
+            lse = lse_ref[0, 0][:, half]
+            delta = delta_ref[0, 0][:, half]
+
+            s = jax.lax.dot_general(
+                q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do.astype(v.dtype), v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if masked:
+                s = jnp.where(valid, s, _NEG_INF)
+                p = jnp.where(valid, jnp.exp(s - lse[:, None]), 0.0)
+                ds = jnp.where(valid, p * (dp - delta[:, None]), 0.0)
+            else:
+                p = jnp.exp(s - lse[:, None])          # [bq, bkv]
+                ds = p * (dp - delta[:, None])         # [bq, bkv]
+            # dv += p^T @ do ; dk += ds^T @ q (scale already in q)
+            dv_acc[:, acc_sl] += jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dk_acc[:, acc_sl] += jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    _dispatch_tiles(compute, causal, bounded, q_start, k_start,
+                    block_q, block_kv)
+
+    @pl.when(iq == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def head_fold_eligible(h: int, hkv: int, d: int, segs=None) -> bool:
+    """May the backward fold head pairs? 2D must fit the 128-lane vreg
+    row, the q heads must pair evenly, every pair must share one kv head
+    (group even) or fold its kv pair alongside (MHA, hkv even), and
+    packed segments keep the unfolded kernels (their id specs are
+    per-head-agnostic but the folded kernels don't thread them)."""
+    group = h // hkv
+    if segs is not None or 2 * d > 128 or h % 2:
+        return False
+    return (group % 2 == 0) or (group == 1 and hkv % 2 == 0)
+
+
+def _flash_backward_fold(q, k, v, g, lse, delta, scale, causal,
+                         block_q, block_kv, nq, nk, bounded, group):
+    """Head-fold backward dispatch: fold pairs outside (one O(bytes)
+    transpose per operand), run the folded kernels, unfold the
+    gradients. GQA (group even) reduces dk/dv over pairs-per-group."""
+    b, h, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    kv_folded = group == 1
+
+    qf = _fold_heads(q)
+    dof = _fold_heads(g)
+    lsef = _fold_rows(lse)
+    deltaf = _fold_rows(delta)
+    if kv_folded:
+        kf, vf = _fold_heads(k), _fold_heads(v)
+        kv_dim = 2 * d
+        kv_idx_q = lambda b_, h_, iq, ik: (b_, h_, ik, 0)  # noqa: E731
+        kv_idx_k = lambda b_, h_, ik, iq: (b_, h_, ik, 0)  # noqa: E731
+    else:
+        kf, vf = k, v
+        kv_dim = d
+        kv_idx_q = (lambda b_, h_, iq, ik,
+                    g_=group: (b_, (2 * h_) // g_, ik, 0))
+        kv_idx_k = (lambda b_, h_, ik, iq,
+                    g_=group: (b_, (2 * h_) // g_, ik, 0))
+
+    qp_spec = pl.BlockSpec((1, 1, block_q, 2 * d),
+                           lambda b_, h_, iq, ik: (b_, h_, iq, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q, 2),
+                            lambda b_, h_, iq, ik: (b_, h_, iq, 0))
+
+    dqf = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_fold, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv, num_kv=nk,
+                          seq_q=sq, seq_kv=skv, bounded=bounded,
+                          kv_folded=kv_folded, d=d),
+        grid=(b, h // 2, nq, nk),
+        in_specs=[qp_spec,
+                  pl.BlockSpec((1, 1, block_kv, kv_dim), kv_idx_q),
+                  pl.BlockSpec((1, 1, block_kv, kv_dim), kv_idx_q),
+                  qp_spec, row_spec, row_spec],
+        out_specs=qp_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h // 2, sq, 2 * d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, 2 * d), jnp.float32)],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    qp_spec_k = pl.BlockSpec((1, 1, block_q, 2 * d),
+                             lambda b_, h_, ik, iq: (b_, h_, iq, 0))
+    row_spec_k = pl.BlockSpec((1, 1, block_q, 2),
+                              lambda b_, h_, ik, iq: (b_, h_, iq, 0))
+    dkv_out_spec = pl.BlockSpec((1, 1, block_kv, kv_dim),
+                                lambda b_, h_, ik, iq: (b_, h_, ik, 0))
+    dkf, dvf = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_fold, scale=scale,
+                          causal=causal, block_q=block_q,
+                          block_kv=block_kv, num_q=nq, seq_q=sq,
+                          seq_kv=skv, bounded=bounded,
+                          kv_folded=kv_folded, d=d),
+        grid=(b, h // 2, nk, nq),
+        in_specs=[qp_spec_k,
+                  pl.BlockSpec((1, 1, block_kv, kv_dim), kv_idx_k),
+                  pl.BlockSpec((1, 1, block_kv, kv_dim), kv_idx_k),
+                  qp_spec_k, row_spec_k, row_spec_k],
+        out_specs=[dkv_out_spec, dkv_out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h // 2, skv, kv_dim), k.dtype),
+            jax.ShapeDtypeStruct((b, h // 2, skv, kv_dim), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, kv_dim), jnp.float32),
+            pltpu.VMEM((block_kv, kv_dim), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    dq = _unfold_heads(dqf)
+    if kv_folded:
+        dk, dv = _unfold_heads(dkf), _unfold_heads(dvf)
+    else:
+        # Each pair already summed its two halves into the shared kv
+        # head; finish the GQA reduction over the group's pairs.
+        dk = dkf.reshape(b, hkv, group // 2, skv, d).sum(axis=2)
+        dv = dvf.reshape(b, hkv, group // 2, skv, d).sum(axis=2)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _bwd_dq_kernel(*refs, scale, causal, block_q, block_kv, num_kv,
                    seq_q, seq_kv, has_segs, bounded):
     if has_segs:
@@ -709,7 +979,8 @@ def _bwd_dkv_kernel(*refs, scale, causal,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(res, g, scale, causal, block_q, block_kv, segs=None):
+def _flash_backward(res, g, scale, causal, block_q, block_kv, segs=None,
+                    head_fold: bool = False):
     q, k, v, out, lse = res
     b, h, sq, d = q.shape
     hkv, skv = k.shape[1], k.shape[2]
@@ -722,6 +993,10 @@ def _flash_backward(res, g, scale, causal, block_q, block_kv, segs=None):
 
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)  # [B,H,Sq]
+    if head_fold and head_fold_eligible(h, hkv, d, segs):
+        return _flash_backward_fold(
+            q, k, v, g, lse, delta, scale, causal, block_q, block_kv,
+            nq, nk, bounded, group)
     if d < 128 and not _force_straight():
         return _flash_backward_t(
             q, k, v, g, lse, delta, scale, causal, block_q, block_kv,
@@ -901,19 +1176,21 @@ def _flash_backward_t(q, k, v, g, lse, delta, scale, causal,
 # Public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_bhsd(q, k, v, scale, causal, block_q, block_kv):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bhsd(q, k, v, scale, causal, block_q, block_kv,
+                          head_fold=False):
     out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_kv)
     return out
 
 
-def _fwd_rule(q, k, v, scale, causal, block_q, block_kv):
+def _fwd_rule(q, k, v, scale, causal, block_q, block_kv, head_fold):
     out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_kv)
     return out, (q, k, v, out, lse)
 
 
-def _bwd_rule(scale, causal, block_q, block_kv, res, g):
-    return _flash_backward(res, g, scale, causal, block_q, block_kv)
+def _bwd_rule(scale, causal, block_q, block_kv, head_fold, res, g):
+    return _flash_backward(res, g, scale, causal, block_q, block_kv,
+                           head_fold=head_fold)
 
 
 _flash_attention_bhsd.defvjp(_fwd_rule, _bwd_rule)
@@ -951,7 +1228,8 @@ _flash_attention_seg_bhsd.defvjp(_seg_fwd_rule, _seg_bwd_rule)
 def flash_attention(q, k, v, causal: bool = True,
                     softmax_scale: Optional[float] = None,
                     block_q: int = 512, block_kv: int = 512,
-                    segment_ids: Optional[jnp.ndarray] = None):
+                    segment_ids: Optional[jnp.ndarray] = None,
+                    head_fold: bool = False):
     """Flash attention on [B, S, H, D] tensors (GQA-aware).
 
     Returns [B, Sq, H, D]. Drop-in for ops.attention.dot_product_attention's
@@ -961,6 +1239,12 @@ def flash_attention(q, k, v, causal: bool = True,
     to within-segment (packed sequences, reference THD/packed_seq_params
     semantics) with the same O(S) memory profile; segment masking composes
     with the causal block-skip.
+
+    head_fold: fold q-head pairs into the trailing block dim in the
+    BACKWARD kernels (D=64 → full 128-lane rows; PERF.md lever 1,
+    --flash-head-fold). Silently keeps the standard kernels when
+    ineligible (head_fold_eligible: 2D > 128, odd head counts, packed
+    segments). Forward math is unchanged; grads parity-pinned ≤ 1e-5.
     """
     b, sq, h, d = q.shape
     if softmax_scale is None:
@@ -970,7 +1254,8 @@ def flash_attention(q, k, v, causal: bool = True,
     vt = jnp.swapaxes(v, 1, 2)
     if segment_ids is None:
         out = _flash_attention_bhsd(qt, kt, vt, float(softmax_scale),
-                                    causal, block_q, block_kv)
+                                    causal, block_q, block_kv,
+                                    bool(head_fold))
     else:
         segs = segment_ids.astype(jnp.int32)
         out = _flash_attention_seg_bhsd(

@@ -1,0 +1,708 @@
+"""Paged-attention kernel GENERATOR + fused (megakernel) decode kernels.
+
+ISSUE 11 tentpole. Before this module, ops/pallas/paged_attention.py
+hand-wrote four kernel variants (decode / multiquery × plain / tp) × two
+KV dtypes (bf16, int8 dequant-in-register) — eight bodies that had to be
+edited in lockstep. Every variant differed from the others along exactly
+three axes, so the bodies are now EMITTED from a spec instead of copied:
+
+  - ``ragged``     one query row per slot (decode) vs a per-request
+                   ragged q_len ∈ [1, S_q] window (speculative verify /
+                   chunked prefill) with the causal-tail mask and the
+                   q_lens scalar-prefetch ref;
+  - ``quantized``  bf16 pools vs int8 pools whose per-(row, kv-head)
+                   fp32 scale blocks ride the SAME page-table BlockSpec
+                   index map and dequantize in-register;
+  - tp head-shard  plain single-device placement vs a FULL-MANUAL
+                   shard_map over KV heads (``mesh=`` — each shard runs
+                   the emitted kernel on its matched GQA groups against
+                   its 1/tp slice of the pool).
+
+``paged_attention`` is the one entry point; the legacy names in
+paged_attention.py are thin wrappers over it. The emitted body is
+op-for-op the legacy body (the ragged=False specialization collapses the
+window transposes exactly the way the hand-written decode kernel did),
+so generated kernels are BITWISE-identical to the variants they replace
+— pinned in tests/test_kernel_gen.py against frozen copies of the old
+bodies across {bf16, int8} × {tp1, tp2} × {q_len 1, ragged} ×
+{GQA, MHA}. New variants (fp8 pools, MLA latent layouts, token-tree
+masks) are parameters here, not new copies.
+
+The second half of the module is the FUSED DECODE STEP (megakernel
+direction, *Event Tensor* arXiv 2604.13327): at decode batch sizes the
+per-token step is dispatch-dominated (PERF.md: 35.7% MFU full-step vs
+63.6% one layer body), so the dispatch-heavy tail of the layer body is
+folded into three fat Pallas kernels —
+
+  - ``fused_qkv``      RMS/LayerNorm + QKV projection + (optional) QK
+                       layernorm + rope, one kernel per layer entry;
+  - ``fused_out_proj`` attention epilogue: GQA head-flatten + out
+                       projection + bias + residual add;
+  - ``fused_mlp``      pre-MLP norm + fc1 + activation (incl. gated) +
+                       fc2 + bias + residual add.
+
+``fused_layer_decode`` assembles them around the generated paged
+attention kernel; transformer/block.py dispatches it for the s == 1
+paged decode path when ``cfg.megakernel_decode`` is on
+(DynamicInferenceEngine(fused_decode=True) / --megakernel-decode).
+Greedy streams are pinned token-exact against the unfused engine; the
+win is gated off the COMPILED module (utils/dispatch.py counts
+executable fusions/custom-calls per decode step), not wall time — the
+TPU tunnel is down, so on-chip wall numbers wait for the chip
+(PERF.md round-15).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _dequant_block(k, ks):
+    """[bs, Hkv, D] int8 block × [bs, Hkv] fp32 scales → fp32 block (the
+    in-register dequant of one DMA'd page)."""
+    return k.astype(jnp.float32) * ks[..., None]
+
+
+# ---------------------------------------------------------------------------
+# The generator: one spec → one emitted ragged-paged-attention body
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedSpec:
+    """Everything that selects a paged-attention kernel variant.
+
+    ragged=False requires s_q == 1 (the decode shape); ragged=True adds
+    the q_lens scalar-prefetch ref and the causal tail mask over the
+    [1, S_q] window. quantized adds the int8 scale-block refs. The tp
+    head-shard axis is NOT part of the body spec — sharding is pure
+    placement (``paged_attention(..., mesh=)`` wraps the same emitted
+    kernel in a full-manual shard_map)."""
+
+    ragged: bool
+    quantized: bool
+    s_q: int
+    block_size: int
+    num_blocks_seq: int
+    hkv: int
+    group: int
+    scale: float
+
+    def __post_init__(self):
+        if not self.ragged and self.s_q != 1:
+            raise ValueError(
+                f"non-ragged (decode) kernels are single-query: s_q="
+                f"{self.s_q} requires ragged=True (pass q_lens)")
+
+
+def emit_paged_kernel(spec: PagedSpec):
+    """Emit the kernel body for `spec`.
+
+    Grid (B, max_blocks_per_seq); block j of slot b is DMA'd from page
+    table[b, j] (scalar-prefetched index map). Online softmax over the
+    ragged valid range [0, lens[b]); fully-out-of-range blocks are
+    skipped whole. Ragged kernels additionally mask each local query row
+    i (absolute position kv_len - q_len + i) causally within the new
+    tail; at q_len == 1 the math collapses to the decode body's exact
+    block/accumulator order — the two legacy variants were the
+    ragged=False / ragged=True points of this one template."""
+    bs = spec.block_size
+    mbs = spec.num_blocks_seq
+    hkv, group, s_q = spec.hkv, spec.group, spec.s_q
+    hq = hkv * group
+    ragged, quantized = spec.ragged, spec.quantized
+    scale = spec.scale
+
+    def kernel(*refs):
+        if ragged:
+            table_ref, lens_ref, qlens_ref = refs[:3]
+            rest = refs[3:]
+        else:
+            table_ref, lens_ref = refs[:2]
+            rest = refs[2:]
+        del table_ref  # indirection is consumed by the BlockSpec index maps
+        q_ref, k_ref, v_ref = rest[:3]
+        rest = rest[3:]
+        if quantized:
+            ks_ref, vs_ref, o_ref, acc, m_scr, l_scr = rest
+        else:
+            o_ref, acc, m_scr, l_scr = rest
+        b = pl.program_id(0)
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            acc[:] = jnp.zeros_like(acc)
+            m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+            l_scr[:] = jnp.zeros_like(l_scr)
+
+        kv_len = lens_ref[b]
+        if ragged:
+            q_len = qlens_ref[b]
+            q_start = kv_len - q_len   # absolute position of local query 0
+
+        @pl.when(j * bs < kv_len)
+        def _compute():
+            q = q_ref[0].astype(jnp.float32) * scale
+            if quantized:
+                k = _dequant_block(k_ref[0], ks_ref[0])   # [bs, Hkv, D]
+                v = _dequant_block(v_ref[0], vs_ref[0])
+            else:
+                k = k_ref[0]                              # [bs, Hkv, D]
+                v = v_ref[0]
+            d = q.shape[-1]
+            if ragged:
+                # [Hkv, S_q*group, D] with inner index i = s*group + g
+                # (row i's query position is i // group after unfolding
+                # back through the [S_q, Hq] layout below).
+                q3 = jnp.transpose(q.reshape(s_q, hkv, group, d),
+                                   (1, 0, 2, 3)).reshape(hkv, s_q * group,
+                                                         d)
+            else:
+                q3 = q.reshape(hkv, group, d)
+            k3 = jnp.swapaxes(k, 0, 1)                    # [Hkv, bs, D]
+            v3 = jnp.swapaxes(v, 0, 1)
+            s = jax.lax.dot_general(                      # [Hkv, rows, bs]
+                q3.astype(k3.dtype), k3,
+                (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            pos = j * bs + jax.lax.broadcasted_iota(
+                jnp.int32, (1, bs), 1)[0]
+            if ragged:
+                row_q = jax.lax.broadcasted_iota(
+                    jnp.int32, (s_q * group, 1), 0)[:, 0] // group
+                abs_q = q_start + row_q                   # [S_q*group]
+                valid = ((pos[None, :] <= abs_q[:, None])
+                         & (pos[None, :] < kv_len))       # [S_q*g, bs]
+                s = jnp.where(valid[None], s, _NEG_INF)
+                # [S_q*Hq, bs] with row = s*hq + h (h = kvh*group + g).
+                s2 = jnp.transpose(
+                    s.reshape(hkv, s_q, group, bs),
+                    (1, 0, 2, 3)).reshape(s_q * hq, bs)
+                p_mask = jnp.transpose(
+                    jnp.broadcast_to(valid.reshape(1, s_q, group, bs),
+                                     (hkv, s_q, group, bs)),
+                    (1, 0, 2, 3)).reshape(s_q * hq, bs)
+            else:
+                valid = pos < kv_len                      # [bs]
+                s = jnp.where(valid[None, None, :], s, _NEG_INF)
+                s2 = s.reshape(hq, bs)
+                p_mask = valid[None, :]
+
+            m_prev = m_scr[:, 0]
+            m_new = jnp.maximum(m_prev, jnp.max(s2, axis=1))
+            m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+            p = jnp.exp(s2 - m_safe[:, None])
+            p = jnp.where(p_mask, p, 0.0)
+            corr = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+            corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
+            l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=1)
+            if ragged:
+                p3 = jnp.transpose(
+                    p.reshape(s_q, hkv, group, bs),
+                    (1, 0, 2, 3)).reshape(hkv, s_q * group, bs)
+            else:
+                p3 = p.reshape(hkv, group, bs)
+            pv = jax.lax.dot_general(                     # [Hkv, rows, D]
+                p3.astype(v3.dtype), v3,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            if ragged:
+                pv2 = jnp.transpose(
+                    pv.reshape(hkv, s_q, group, d),
+                    (1, 0, 2, 3)).reshape(s_q * hq, d)
+            else:
+                pv2 = pv.reshape(hq, d)
+            acc[:] = acc[:] * corr[:, None] + pv2
+            m_scr[:, 0] = m_new
+
+        @pl.when(j == mbs - 1)
+        def _finalize():
+            l = jnp.maximum(l_scr[:, 0], 1e-20)
+            if ragged:
+                a = acc[:]
+                o_ref[0] = (a / l[:, None]).reshape(
+                    s_q, hq, a.shape[-1]).astype(o_ref.dtype)
+            else:
+                o_ref[0] = (acc[:] / l[:, None]).astype(o_ref.dtype)
+
+    return kernel
+
+
+def paged_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
+                    v_pages: jnp.ndarray, page_table: jnp.ndarray,
+                    kv_lens: jnp.ndarray,
+                    q_lens: Optional[jnp.ndarray] = None,
+                    softmax_scale: Optional[float] = None,
+                    k_scales: Optional[jnp.ndarray] = None,
+                    v_scales: Optional[jnp.ndarray] = None,
+                    mesh=None) -> jnp.ndarray:
+    """Ragged paged attention — the single generator entry point.
+
+    q [B, Hq, D] (decode) or [B, S_q, Hq, D] with q_lens [B] (ragged
+    multi-query); k_pages/v_pages [NB, bs, Hkv, D]; page_table [B, MB]
+    int32; kv_lens [B]. k_scales/v_scales [NB, bs, Hkv] fp32 mark int8
+    pools (dequant rides the same page-table indirection, in-register).
+    mesh: head-shard the emitted kernel over the tp axis of this mesh
+    (full-manual shard_map — q on heads, pools + scale pools on Hkv,
+    table/lens replicated); callers gate on tp_paged_eligible. Returns
+    q's shape."""
+    ragged = q_lens is not None
+    if mesh is not None:
+        return _tp_place(q, k_pages, v_pages, page_table, kv_lens, q_lens,
+                         softmax_scale, k_scales, v_scales, mesh)
+    if ragged:
+        b, s_q, hq, d = q.shape
+    else:
+        b, hq, d = q.shape
+        s_q = 1
+    nb, bs, hkv, _ = k_pages.shape
+    mb = page_table.shape[1]
+    if softmax_scale is None:
+        softmax_scale = 1.0 / (d ** 0.5)
+    quantized = k_scales is not None
+    spec = PagedSpec(ragged=ragged, quantized=quantized, s_q=s_q,
+                     block_size=bs, num_blocks_seq=mb, hkv=hkv,
+                     group=hq // hkv, scale=float(softmax_scale))
+
+    kernel = emit_paged_kernel(spec)
+
+    # Page-table indirection: the table and per-slot lengths (and ragged
+    # q_lens) are scalar-prefetched so the index maps can DMA block
+    # t[b, j] straight from HBM — int8 scale blocks ride the same map.
+    kv_spec = pl.BlockSpec((1, bs, hkv, d),
+                           lambda b_, j, t, *_: (t[b_, j], 0, 0, 0))
+    if ragged:
+        q_spec = pl.BlockSpec((1, s_q, hq, d),
+                              lambda b_, j, *_: (b_, 0, 0, 0))
+    else:
+        q_spec = pl.BlockSpec((1, hq, d), lambda b_, j, *_: (b_, 0, 0))
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        sc_spec = pl.BlockSpec((1, bs, hkv),
+                               lambda b_, j, t, *_: (t[b_, j], 0, 0))
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scales, v_scales]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3 if ragged else 2,
+        grid=(b, mb),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((s_q * hq, d), jnp.float32),
+            pltpu.VMEM((s_q * hq, 1), jnp.float32),
+            pltpu.VMEM((s_q * hq, 1), jnp.float32),
+        ],
+    )
+    prefetch = [page_table.astype(jnp.int32), kv_lens.astype(jnp.int32)]
+    if ragged:
+        prefetch.append(q_lens.astype(jnp.int32))
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(*prefetch, *operands)
+
+
+def _tp_place(q, k_pages, v_pages, page_table, kv_lens, q_lens,
+              softmax_scale, k_scales, v_scales, mesh):
+    """Head-sharded placement of the emitted kernel: a FULL-MANUAL
+    shard_map over the tp axis — q sharded on heads, pools (and int8
+    scale pools) on Hkv, page table / lengths / q_lens replicated. Each
+    shard owns matched GQA groups (contiguous slicing of both head dims
+    preserves h // group), so the per-shard body is the UNMODIFIED
+    emitted kernel; no collectives run inside. tp_paged_eligible callers
+    gate on no ambient manual axes."""
+    from jax.sharding import PartitionSpec as P
+
+    from megatronapp_tpu.config.parallel_config import TP_AXIS
+    from megatronapp_tpu.parallel.collectives import shard_map_compat
+
+    ragged = q_lens is not None
+    if softmax_scale is None:
+        softmax_scale = 1.0 / (q.shape[-1] ** 0.5)
+    head = (P(None, None, TP_AXIS, None) if ragged
+            else P(None, TP_AXIS, None))
+    pages = P(None, None, TP_AXIS, None)      # pools [NB, bs, Hkv, D]
+    scales = P(None, None, TP_AXIS)           # scale pools [NB, bs, Hkv]
+    rep2, rep1 = P(None, None), P(None)
+
+    in_specs = [head, pages, pages, rep2, rep1]
+    operands = [q, k_pages, v_pages, page_table, kv_lens]
+    if ragged:
+        in_specs.append(rep1)
+        operands.append(q_lens)
+    if k_scales is not None:
+        in_specs += [scales, scales]
+        operands += [k_scales, v_scales]
+
+    def body(*args):
+        q_, k_, v_, t_, l_ = args[:5]
+        rest = args[5:]
+        ql_ = None
+        if ragged:
+            ql_, rest = rest[0], rest[1:]
+        ks_ = vs_ = None
+        if rest:
+            ks_, vs_ = rest
+        return paged_attention(q_, k_, v_, t_, l_, q_lens=ql_,
+                               softmax_scale=softmax_scale,
+                               k_scales=ks_, v_scales=vs_)
+
+    # manual-ok: full-manual kernel placement, no collectives in body;
+    # tp_paged_eligible callers gate on no ambient manual axes.
+    return shard_map_compat(body, mesh, in_specs=tuple(in_specs),
+                            out_specs=head)(*operands)
+
+
+# ---------------------------------------------------------------------------
+# Fused (megakernel) decode-layer kernels
+#
+# One decode token's layer body is ~15 small XLA fusions (two norms, two
+# projection matmuls + biases, rope, GQA reshapes, out-proj, fc1/act/
+# fc2, two residual adds) — each a separate dispatch inside the scan
+# body. The three kernels below fold that tail into fat single-program
+# Pallas kernels around the generated paged-attention kernel. Math is
+# op-for-op the unfused path's (same norm/rope/activation formulas, same
+# dtypes/casts), so greedy streams stay token-exact — pinned in
+# tests/test_kernel_gen.py. Shapes: decode x is [B, H] with B = a
+# handful of slots, so whole-operand (no-grid) kernels are the right
+# granularity; weights must fit the VMEM budget
+# (megakernel_ineligible_reason gates "where shapes allow"; a
+# grid-tiled variant for big models is the ROADMAP follow-up).
+# ---------------------------------------------------------------------------
+
+# Per-kernel operand budget for the no-grid fused kernels. Real TPU
+# VMEM is ~16 MB/core; interpret mode (CPU) has no limit but keeps the
+# same gate so eligibility is platform-independent. Operators can
+# override via MEGAKERNEL_VMEM_BUDGET (bytes) — e.g. raise it on CPU
+# engines or chips with more VMEM; the fallback log names the budget.
+MEGAKERNEL_VMEM_BUDGET = int(os.environ.get(
+    "MEGAKERNEL_VMEM_BUDGET", 12 * 1024 * 1024))
+
+
+def _rope_rows(x, cos, sin):
+    """Half-rotation RoPE on [B, H, D] rows with per-row tables
+    [B, half] — elementwise-identical to ops.rotary.apply_rope on the
+    [B, 1, H, D] decode shape (fp32 rotate, cast back)."""
+    half = cos.shape[-1]
+    rot = 2 * half
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    x1, x2 = x_rot[..., :half], x_rot[..., half:]
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    out1 = x1.astype(jnp.float32) * c - x2.astype(jnp.float32) * s
+    out2 = x2.astype(jnp.float32) * c + x1.astype(jnp.float32) * s
+    out = jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+    if x_pass.shape[-1]:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out
+
+
+def _fused_qkv(x, attn_p, cfg, cos, sin):
+    """Norm + QKV projection + (optional) QK-layernorm + rope in ONE
+    kernel — the attention kernel's entry, fused.
+
+    x [B, H] (residual dtype); returns (q, k, v) as [B, nq, D] /
+    [B, nkv, D] in compute dtype, exactly as the unfused
+    layer_forward → attention_forward prologue produces them."""
+    from megatronapp_tpu.config.transformer_config import NormKind
+    from megatronapp_tpu.inference.quantization import resolve_param
+    from megatronapp_tpu.ops.normalization import apply_norm, rms_norm
+
+    b, h = x.shape
+    nq, nkv, d = (cfg.num_attention_heads, cfg.num_query_groups,
+                  cfg.head_dim)
+    cdt = cfg.compute_dtype
+    eps = cfg.layernorm_epsilon
+    kind = cfg.normalization
+    has_ln_bias = kind == NormKind.layernorm
+    has_bias = "q_bias" in attn_p
+    has_rope = cos is not None
+    has_qk_ln = cfg.qk_layernorm
+
+    operands = [x, attn_p["ln1_scale"]]
+    if has_ln_bias:
+        operands.append(attn_p["ln1_bias"])
+    operands += [resolve_param(attn_p["q_kernel"]),
+                 resolve_param(attn_p["kv_kernel"])]
+    if has_bias:
+        operands += [attn_p["q_bias"], attn_p["kv_bias"]]
+    if has_rope:
+        operands += [cos, sin]
+    if has_qk_ln:
+        operands += [attn_p["q_ln_scale"], attn_p["k_ln_scale"]]
+
+    def kernel(*refs):
+        it = iter(refs)
+        x_ref = next(it)
+        ln_s = next(it)
+        ln_b = next(it) if has_ln_bias else None
+        wq_ref, wkv_ref = next(it), next(it)
+        qb_ref = next(it) if has_bias else None
+        kvb_ref = next(it) if has_bias else None
+        cos_ref = next(it) if has_rope else None
+        sin_ref = next(it) if has_rope else None
+        qln_ref = next(it) if has_qk_ln else None
+        kln_ref = next(it) if has_qk_ln else None
+        q_out, k_out, v_out = next(it), next(it), next(it)
+
+        xn = apply_norm(kind, x_ref[...], ln_s[...],
+                        ln_b[...] if ln_b is not None else None, eps)
+        xn = xn.astype(cdt)
+        q = xn @ wq_ref[...].astype(cdt)
+        kv = xn @ wkv_ref[...].astype(cdt)
+        if has_bias:
+            q = q + qb_ref[...].astype(cdt)
+            kv = kv + kvb_ref[...].astype(cdt)
+        q = q.reshape(b, nq, d)
+        k, v = jnp.split(kv.reshape(b, 2 * nkv, d), 2, axis=1)
+        if has_qk_ln:
+            q = rms_norm(q, qln_ref[...], eps)
+            k = rms_norm(k, kln_ref[...], eps)
+        if has_rope:
+            q = _rope_rows(q, cos_ref[...], sin_ref[...])
+            k = _rope_rows(k, cos_ref[...], sin_ref[...])
+        q_out[...] = q
+        k_out[...] = k
+        v_out[...] = v
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((b, nq, d), cdt),
+                   jax.ShapeDtypeStruct((b, nkv, d), cdt),
+                   jax.ShapeDtypeStruct((b, nkv, d), cdt)],
+        interpret=_interpret(),
+    )(*operands)
+
+
+def _fused_out_proj(attn_flat, attn_p, cfg, residual):
+    """Attention epilogue in ONE kernel: out projection + bias +
+    residual add (the paged-attention output arrives head-flat
+    [B, nq*D] — the GQA transpose/reshape is folded into the caller's
+    free reshape). residual [B, H] keeps its dtype; returns [B, H]."""
+    from megatronapp_tpu.inference.quantization import resolve_param
+
+    b, h = residual.shape
+    cdt = cfg.compute_dtype
+    has_bias = "out_bias" in attn_p
+    operands = [attn_flat, resolve_param(attn_p["out_kernel"]), residual]
+    if has_bias:
+        operands.append(attn_p["out_bias"])
+
+    def kernel(*refs):
+        if has_bias:
+            a_ref, w_ref, r_ref, b_ref, o_ref = refs
+        else:
+            a_ref, w_ref, r_ref, o_ref = refs
+        out = a_ref[...] @ w_ref[...].astype(cdt)
+        if has_bias:
+            out = out + b_ref[...].astype(cdt)
+        r = r_ref[...]
+        o_ref[...] = r + out.astype(r.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h), residual.dtype),
+        interpret=_interpret(),
+    )(*operands)
+
+
+def _fused_mlp(x, p, cfg):
+    """Pre-MLP norm + fc1 + activation (incl. gated) + fc2 + biases +
+    residual add in ONE kernel. x [B, H] (residual dtype) → [B, H]."""
+    from megatronapp_tpu.config.transformer_config import NormKind
+    from megatronapp_tpu.inference.quantization import resolve_param
+    from megatronapp_tpu.ops.activations import apply_activation, is_gated
+    from megatronapp_tpu.ops.normalization import apply_norm
+
+    b, h = x.shape
+    cdt = cfg.compute_dtype
+    eps = cfg.layernorm_epsilon
+    kind = cfg.normalization
+    act = cfg.activation
+    gated = is_gated(act)
+    has_ln_bias = kind == NormKind.layernorm
+    mlp_p = p["mlp"]
+    has_bias = "fc1_bias" in mlp_p
+
+    operands = [x, p["ln2_scale"]]
+    if has_ln_bias:
+        operands.append(p["ln2_bias"])
+    operands += [resolve_param(mlp_p["fc1_kernel"]),
+                 resolve_param(mlp_p["fc2_kernel"])]
+    if has_bias:
+        operands += [mlp_p["fc1_bias"], mlp_p["fc2_bias"]]
+
+    def kernel(*refs):
+        it = iter(refs)
+        x_ref, ln_s = next(it), next(it)
+        ln_b = next(it) if has_ln_bias else None
+        w1_ref, w2_ref = next(it), next(it)
+        b1_ref = next(it) if has_bias else None
+        b2_ref = next(it) if has_bias else None
+        o_ref = next(it)
+
+        xn = apply_norm(kind, x_ref[...], ln_s[...],
+                        ln_b[...] if ln_b is not None else None, eps)
+        xn = xn.astype(cdt)
+        y = xn @ w1_ref[...].astype(cdt)
+        if has_bias:
+            y = y + b1_ref[...].astype(cdt)
+        if gated:
+            gate, val = jnp.split(y, 2, axis=-1)
+            y = apply_activation(act, val, gate)
+        else:
+            y = apply_activation(act, y)
+        out = y @ w2_ref[...].astype(cdt)
+        if has_bias:
+            out = out + b2_ref[...].astype(cdt)
+        r = x_ref[...]
+        o_ref[...] = r + out.astype(r.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h), x.dtype),
+        interpret=_interpret(),
+    )(*operands)
+
+
+def fused_layer_decode(p, x, cfg, rope_cos, rope_sin, kv_cache,
+                       cache_positions, page_table, active,
+                       kv_scales=None):
+    """One decode layer as fused kernels: [fused norm+QKV+rope] →
+    [append scatter] → [generated paged-attention kernel] → [fused
+    out-proj + residual] → [fused norm+MLP + residual].
+
+    Drop-in for transformer/block.layer_forward's s == 1 paged decode
+    path (cfg.megakernel_decode; DynamicInferenceEngine(fused_decode=
+    True)): same arguments, same ((out, new_cache), aux) return, greedy
+    streams token-exact vs the unfused body. MegaScope capture /
+    disturbance sites are NOT traced here — megakernel_ineligible_reason
+    gates the fused path off while hooks are active."""
+    from megatronapp_tpu.ops.pallas.paged_attention import (
+        append_token_pages, quantize_kv_rows,
+    )
+    b = x.shape[0]
+    assert x.shape[1] == 1, "fused_layer_decode is the s == 1 decode body"
+    nq, d = cfg.num_attention_heads, cfg.head_dim
+    attn_p = p["attention"]
+    x2 = x[:, 0]
+    cos = rope_cos[:, 0] if rope_cos is not None else None
+    sin = rope_sin[:, 0] if rope_sin is not None else None
+
+    q, k, v = _fused_qkv(x2, {**attn_p, "ln1_scale": p["ln1_scale"],
+                              **({"ln1_bias": p["ln1_bias"]}
+                                 if "ln1_bias" in p else {})},
+                         cfg, cos, sin)
+
+    ck, cv = kv_cache
+    if active is None:
+        active = jnp.ones((b,), bool)
+    if kv_scales is not None:
+        cks, cvs = kv_scales
+        k_q, k_s = quantize_kv_rows(k)
+        v_q, v_s = quantize_kv_rows(v)
+        ck = append_token_pages(ck, k_q, page_table, cache_positions,
+                                active)
+        cv = append_token_pages(cv, v_q, page_table, cache_positions,
+                                active)
+        cks = append_token_pages(cks, k_s, page_table, cache_positions,
+                                 active)
+        cvs = append_token_pages(cvs, v_s, page_table, cache_positions,
+                                 active)
+        new_cache = (ck, cv, cks, cvs)
+        sc_kw = {"k_scales": cks, "v_scales": cvs}
+    else:
+        ck = append_token_pages(ck, k, page_table, cache_positions, active)
+        cv = append_token_pages(cv, v, page_table, cache_positions, active)
+        new_cache = (ck, cv)
+        sc_kw = {}
+
+    attn = paged_attention(q, ck, cv, page_table, cache_positions + 1,
+                           **sc_kw)                       # [B, nq, D]
+    x2 = _fused_out_proj(attn.reshape(b, nq * d), attn_p, cfg, x2)
+    x2 = _fused_mlp(x2, p, cfg)
+    return (x2[:, None], new_cache), None
+
+
+def megakernel_ineligible_reason(cfg, *, batch, tp_paged=False,
+                                 paged=True, params=None) -> Optional[str]:
+    """Why the fused (megakernel) decode step may NOT run — None when
+    eligible, otherwise the FIRST failed predicate by name (same
+    loud-fallback contract as tp_paged_ineligible_reason). params: the
+    engine's param pytree when available — resident int8 weights
+    (--quantized-weights) are ineligible because resolve_param runs
+    OUTSIDE the fused kernels, which would materialize dequantized
+    bf16 weight copies as kernel operands every step and give back
+    PR 10's halved kernel HBM (the unfused path fuses the per-channel
+    scale multiply into each consuming matmul)."""
+    if not paged:
+        return "dense (non-paged) backend — the fused step is built " \
+               "around the paged-attention kernel"
+    if cfg.multi_latent_attention:
+        return "multi_latent_attention: the MLA decode path gathers " \
+               "the latent run dense (no fused prologue yet)"
+    if cfg.is_moe:
+        return "MoE layers: expert dispatch is not fused yet"
+    if getattr(cfg, "hetero_block_specs", None):
+        return "heterogeneous per-layer configs unroll their own bodies"
+    if tp_paged:
+        return "tp head-sharded serving mesh: fused prologue/epilogue " \
+               "kernels are single-device (the tp engine keeps the " \
+               "unfused body)"
+    from megatronapp_tpu.scope import hooks
+    from megatronapp_tpu.scope.disturbance import get_disturbance
+    cap_sites = ("qkv_q", "qkv_k", "qkv_v", "context", "mlp1", "mlp2",
+                 "between_layers")
+    if any(hooks.is_enabled(s) for s in cap_sites):
+        return "MegaScope capture hooks active (fused kernels do not " \
+               "trace capture sites)"
+    dist = get_disturbance()
+    if any(dist.active(s) for s in ("weight", "calculation", "system")):
+        return "MegaScope disturbance sites active (fused kernels do " \
+               "not trace perturbations)"
+    if params is not None:
+        from megatronapp_tpu.inference.quantization import is_resident_leaf
+        if any(is_resident_leaf(leaf) for leaf in jax.tree.leaves(
+                params, is_leaf=is_resident_leaf)):
+            return ("resident int8 weights (--quantized-weights): the "
+                    "fused kernels would materialize dequantized "
+                    "weight copies per step — in-kernel weight dequant "
+                    "is the recorded follow-up")
+    # "Where shapes allow": the no-grid fused kernels hold their whole
+    # operand set in VMEM — big models need the grid-tiled follow-up.
+    h = cfg.hidden_size
+    nq, nkv, d = (cfg.num_attention_heads, cfg.num_query_groups,
+                  cfg.head_dim)
+    fc1_out = mlp_bytes = 0
+    from megatronapp_tpu.ops.activations import is_gated
+    fc1_out = (2 * cfg.ffn_hidden_size if is_gated(cfg.activation)
+               else cfg.ffn_hidden_size)
+    itemsize = jnp.dtype(cfg.params_dtype).itemsize
+    act_itemsize = jnp.dtype(cfg.compute_dtype).itemsize
+    qkv_bytes = (h * nq * d + h * 2 * nkv * d) * itemsize \
+        + batch * (h + (nq + 2 * nkv) * d) * act_itemsize
+    mlp_bytes = (h * fc1_out + cfg.ffn_hidden_size * h) * itemsize \
+        + batch * (2 * h + fc1_out) * act_itemsize
+    worst = max(qkv_bytes, mlp_bytes)
+    if worst > MEGAKERNEL_VMEM_BUDGET:
+        return (f"fused-kernel operands ({worst} B) exceed the VMEM "
+                f"budget ({MEGAKERNEL_VMEM_BUDGET} B) — needs the "
+                f"grid-tiled megakernel follow-up")
+    return None

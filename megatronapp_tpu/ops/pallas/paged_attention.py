@@ -1,4 +1,4 @@
-"""Ragged paged-attention decode kernel (Pallas TPU) + page helpers.
+"""Ragged paged-attention decode entry points (Pallas TPU) + page helpers.
 
 vLLM-style paged KV serving ("Ragged Paged Attention", arXiv 2604.15464,
 PAPERS.md): the decode cache lives in a shared block pool shaped
@@ -8,59 +8,47 @@ table with an online softmax over VALID blocks only — no slot pays for
 another slot's length, and admission is per-block instead of per-S_max
 row (inference/paged_cache.py is the allocator).
 
-Kernel shape choices mirror ops/pallas/flash_attention.py: fp32
-accumulators, whole-block skip of out-of-length tiles, GQA via an
-[Hkv, group, D] query reshape (q head h reads kv head h // group, the
-same grouping attention.py uses), and `interpret=_interpret()` so the
-kernel runs (and is tier-1 tested) on CPU. Page-table indirection uses
-`pltpu.PrefetchScalarGridSpec`: the table and per-slot kv lengths are
-scalar-prefetched so the BlockSpec index map can DMA block
-`table[b, j]` directly from HBM — the kernel never materializes a
-contiguous [B, S_max] cache.
+The kernel BODIES live in ops/pallas/kernel_gen.py (ISSUE 11): one
+dtype/shard/raggedness-parameterized generator emits the decode and
+multi-query variants from a spec — the four hand-written bodies this
+module used to carry (decode / multiquery × plain / tp, each × bf16 /
+int8) are deleted; the public names below are thin dispatchers kept for
+call-site compatibility (attention.py, dynamic_engine.py, disagg.py,
+speculative.py, tests). The emitted bodies are bitwise-identical to the
+legacy variants (pinned in tests/test_kernel_gen.py).
 
-A pure-jnp `paged_attention_reference` with the same signature is the
-parity oracle for tests, and `write_prompt_pages` /
-`append_token_pages` / `gather_pages*` are the jit-able scatter/gather
-paths that replace the dense engine's host-side cache scatter.
+This module keeps what is NOT kernel-body generation: the jnp parity
+oracles, the quantization helper (`quantize_kv_rows` — symmetric
+per-(row, kv-head) int8, fused into the engine's write-path jits), the
+page write/gather scatter helpers, and the tp eligibility predicate
+(`tp_paged_eligible` / `tp_paged_ineligible_reason`).
 
-TP sharding (ISSUE 9): GSPMD cannot partition a pallas_call, so — exactly
-like the flash wrapper in transformer/attention.py — the tp-mesh serving
-path places the kernels explicitly with a FULL-MANUAL shard_map over KV
-heads: `paged_attention_decode_tp` / `paged_attention_multiquery_tp` run
-the unmodified kernels on per-shard head slices (q heads and kv heads
-slice contiguously together, so each shard owns matched GQA groups and
-`group` is unchanged), with the page table and kv lengths replicated and
-the K/V pools sharded on their Hkv dim — each device holds 1/tp of the
-block pool and does 1/tp of the attention FLOPs/bytes. Eligibility is
-`tp_paged_eligible` (heads divisible by tp, non-MLA pools).
+TP sharding (ISSUE 9): GSPMD cannot partition a pallas_call, so the
+tp-mesh serving path places the emitted kernels with a FULL-MANUAL
+shard_map over KV heads (kernel_gen._tp_place): q heads and kv heads
+slice contiguously together so each shard owns matched GQA groups, the
+page table and kv lengths are replicated, and the K/V pools (plus int8
+scale pools) shard on their Hkv dim — each device holds 1/tp of the
+block pool and does 1/tp of the attention FLOPs/bytes.
 
-Quantized KV (ISSUE 10, `k_scales`/`v_scales`): the pools may be stored
-int8 with a per-(row, kv-head) fp32 scale pool [NB, bs, Hkv] living
-alongside — rows quantize independently on insert (`quantize_kv_rows`),
-so CoW copies, rewind, and stale-row overwrites need no re-scaling.
-Every kernel grows a quantized path: the scale blocks ride the SAME
-scalar-prefetched page-table indirection as the KV blocks (BlockSpec
-index map `t[b, j]`), and each DMA'd int8 block dequantizes in-register
-(one fp32 multiply per row×head) before the online-softmax update — no
-bf16 pool is ever materialized. The jnp references take the same scales
-and are the parity oracle; on CPU everything runs in interpret mode.
+Quantized KV (ISSUE 10, `k_scales`/`v_scales`): pools may be stored int8
+with a per-(row, kv-head) fp32 scale pool [NB, bs, Hkv] alongside — rows
+quantize independently on insert (`quantize_kv_rows`), so CoW copies,
+rewind, and stale-row overwrites need no re-scaling. The scale blocks
+ride the SAME scalar-prefetched page-table indirection as the KV blocks
+and dequantize in-register; no bf16 pool is ever materialized.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-_NEG_INF = -1e30
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from megatronapp_tpu.ops.pallas.kernel_gen import (  # noqa: F401 (re-export)
+    _NEG_INF, _dequant_block, _interpret, paged_attention,
+)
 
 
 def quantize_kv_rows(rows: jnp.ndarray):
@@ -78,86 +66,9 @@ def quantize_kv_rows(rows: jnp.ndarray):
     return q.astype(jnp.int8), scales.astype(jnp.float32)
 
 
-def _dequant_block(k, ks):
-    """[bs, Hkv, D] int8 block × [bs, Hkv] fp32 scales → fp32 block (the
-    in-register dequant of one DMA'd page)."""
-    return k.astype(jnp.float32) * ks[..., None]
-
-
 # ---------------------------------------------------------------------------
-# Decode kernel
+# Public kernel entry points — thin dispatchers over the generator
 # ---------------------------------------------------------------------------
-
-
-def _decode_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
-                   scale, block_size, num_blocks_seq, hkv, group,
-                   quantized=False):
-    """Grid (B, max_blocks_per_seq); block j of slot b is DMA'd from page
-    table_ref[b, j]. Online softmax over the ragged valid range
-    [0, lens_ref[b]); fully-out-of-range blocks are skipped whole.
-
-    quantized: k/v blocks arrive int8 with per-(row, head) fp32 scale
-    blocks (ks_ref/vs_ref, fetched through the same page-table index
-    map); dequant happens in-register on the fetched block."""
-    if quantized:
-        ks_ref, vs_ref, o_ref, acc, m_scr, l_scr = rest
-    else:
-        o_ref, acc, m_scr, l_scr = rest
-    b = pl.program_id(0)
-    j = pl.program_id(1)
-    hq = hkv * group
-
-    @pl.when(j == 0)
-    def _init():
-        acc[:] = jnp.zeros_like(acc)
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-
-    kv_len = lens_ref[b]
-
-    @pl.when(j * block_size < kv_len)
-    def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale          # [Hq, D]
-        if quantized:
-            k = _dequant_block(k_ref[0], ks_ref[0])       # [bs, Hkv, D]
-            v = _dequant_block(v_ref[0], vs_ref[0])
-        else:
-            k = k_ref[0]                                  # [bs, Hkv, D]
-            v = v_ref[0]
-        d = q.shape[-1]
-        q3 = q.reshape(hkv, group, d)
-        k3 = jnp.swapaxes(k, 0, 1)                        # [Hkv, bs, D]
-        v3 = jnp.swapaxes(v, 0, 1)
-        s = jax.lax.dot_general(                          # [Hkv, g, bs]
-            q3.astype(k3.dtype), k3,
-            (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        pos = j * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_size), 1)[0]
-        valid = pos < kv_len                              # [bs]
-        s = jnp.where(valid[None, None, :], s, _NEG_INF)
-        s2 = s.reshape(hq, block_size)
-
-        m_prev = m_scr[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s2, axis=1))
-        m_safe = jnp.maximum(m_new, _NEG_INF / 2)
-        p = jnp.exp(s2 - m_safe[:, None])
-        p = jnp.where(valid[None, :], p, 0.0)
-        corr = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
-        corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
-        l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=1)
-        p3 = p.reshape(hkv, group, block_size)
-        pv = jax.lax.dot_general(                         # [Hkv, g, D]
-            p3.astype(v3.dtype), v3,
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        acc[:] = acc[:] * corr[:, None] + pv.reshape(hq, d)
-        m_scr[:, 0] = m_new
-
-    @pl.when(j == num_blocks_seq - 1)
-    def _finalize():
-        l = jnp.maximum(l_scr[:, 0], 1e-20)
-        o_ref[0] = (acc[:] / l[:, None]).astype(o_ref.dtype)
 
 
 def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray,
@@ -174,155 +85,10 @@ def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray,
     allocation may be anything in range — they are masked, not read for
     math); kv_lens [B] int32 valid kv positions per slot (>= 1).
     k_scales/v_scales [num_blocks, block_size, Hkv] fp32: present iff the
-    pools are int8 (quantize_kv_rows layout) — the scale blocks ride the
-    same page-table indirection and dequant runs in-kernel.
-    Returns [B, Hq, D]."""
-    b, hq, d = q.shape
-    nb, bs, hkv, _ = k_pages.shape
-    mb = page_table.shape[1]
-    group = hq // hkv
-    quantized = k_scales is not None
-    if softmax_scale is None:
-        softmax_scale = 1.0 / (d ** 0.5)
-
-    kernel = functools.partial(
-        _decode_kernel, scale=float(softmax_scale), block_size=bs,
-        num_blocks_seq=mb, hkv=hkv, group=group, quantized=quantized)
-
-    kv_spec = pl.BlockSpec((1, bs, hkv, d),
-                           lambda b_, j, t, l: (t[b_, j], 0, 0, 0))
-    in_specs = [
-        pl.BlockSpec((1, hq, d), lambda b_, j, t, l: (b_, 0, 0)),
-        kv_spec, kv_spec,
-    ]
-    operands = [q, k_pages, v_pages]
-    if quantized:
-        sc_spec = pl.BlockSpec((1, bs, hkv),
-                               lambda b_, j, t, l: (t[b_, j], 0, 0))
-        in_specs += [sc_spec, sc_spec]
-        operands += [k_scales, v_scales]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, mb),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, hq, d), lambda b_, j, t, l: (b_, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((hq, d), jnp.float32),
-            pltpu.VMEM((hq, 1), jnp.float32),
-            pltpu.VMEM((hq, 1), jnp.float32),
-        ],
-    )
-    return pl.pallas_call(
-        kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
-        interpret=_interpret(),
-    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32),
-      *operands)
-
-
-# ---------------------------------------------------------------------------
-# Multi-query ragged kernel (speculative verify + chunked prefill)
-# ---------------------------------------------------------------------------
-
-
-def _multiquery_kernel(table_ref, lens_ref, qlens_ref, q_ref, k_ref, v_ref,
-                       *rest, scale, block_size,
-                       num_blocks_seq, hkv, group, s_q, quantized=False):
-    """Grid (B, max_blocks_per_seq): per-request ragged q_len ∈ [1, S_q]
-    queries against the page table — the multi-query generalization of
-    `_decode_kernel` (arXiv 2604.15464's unified prefill/decode
-    primitive). Local query i sits at absolute position
-    kv_len - q_len + i and attends kv positions <= that (causal within
-    the new tail, full attention to the context); padded query rows
-    (i >= q_len) compute garbage over the valid range and are discarded
-    by the caller. At q_len == 1 the math reduces to the decode kernel's
-    exact block/accumulator order.
-
-    quantized: int8 k/v blocks + per-(row, head) fp32 scale blocks
-    (ks_ref/vs_ref), dequantized in-register like `_decode_kernel`."""
-    if quantized:
-        ks_ref, vs_ref, o_ref, acc, m_scr, l_scr = rest
-    else:
-        o_ref, acc, m_scr, l_scr = rest
-    b = pl.program_id(0)
-    j = pl.program_id(1)
-    hq = hkv * group
-
-    @pl.when(j == 0)
-    def _init():
-        acc[:] = jnp.zeros_like(acc)
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-
-    kv_len = lens_ref[b]
-    q_len = qlens_ref[b]
-    q_start = kv_len - q_len          # absolute position of local query 0
-
-    @pl.when(j * block_size < kv_len)
-    def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale      # [S_q, Hq, D]
-        if quantized:
-            k = _dequant_block(k_ref[0], ks_ref[0])   # [bs, Hkv, D]
-            v = _dequant_block(v_ref[0], vs_ref[0])
-        else:
-            k = k_ref[0]                              # [bs, Hkv, D]
-            v = v_ref[0]
-        d = q.shape[-1]
-        # [Hkv, S_q*group, D] with inner index i = s*group + g (so row
-        # i's query position is i // group after unfolding back through
-        # the [S_q, Hq] layout below).
-        q3 = jnp.transpose(q.reshape(s_q, hkv, group, d),
-                           (1, 0, 2, 3)).reshape(hkv, s_q * group, d)
-        k3 = jnp.swapaxes(k, 0, 1)                    # [Hkv, bs, D]
-        v3 = jnp.swapaxes(v, 0, 1)
-        s = jax.lax.dot_general(                      # [Hkv, S_q*g, bs]
-            q3.astype(k3.dtype), k3,
-            (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        pos = j * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_size), 1)[0]
-        row_q = jax.lax.broadcasted_iota(
-            jnp.int32, (s_q * group, 1), 0)[:, 0] // group
-        abs_q = q_start + row_q                        # [S_q*group]
-        valid = ((pos[None, :] <= abs_q[:, None])
-                 & (pos[None, :] < kv_len))            # [S_q*g, bs]
-        s = jnp.where(valid[None], s, _NEG_INF)
-        # [S_q*Hq, bs] with row = s*hq + h (h = kvh*group + g).
-        s2 = jnp.transpose(
-            s.reshape(hkv, s_q, group, block_size),
-            (1, 0, 2, 3)).reshape(s_q * hq, block_size)
-        valid2 = jnp.transpose(
-            jnp.broadcast_to(valid.reshape(1, s_q, group, block_size),
-                             (hkv, s_q, group, block_size)),
-            (1, 0, 2, 3)).reshape(s_q * hq, block_size)
-
-        m_prev = m_scr[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s2, axis=1))
-        m_safe = jnp.maximum(m_new, _NEG_INF / 2)
-        p = jnp.exp(s2 - m_safe[:, None])
-        p = jnp.where(valid2, p, 0.0)
-        corr = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
-        corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
-        l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=1)
-        p3 = jnp.transpose(
-            p.reshape(s_q, hkv, group, block_size),
-            (1, 0, 2, 3)).reshape(hkv, s_q * group, block_size)
-        pv = jax.lax.dot_general(                      # [Hkv, S_q*g, D]
-            p3.astype(v3.dtype), v3,
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        pv2 = jnp.transpose(
-            pv.reshape(hkv, s_q, group, d),
-            (1, 0, 2, 3)).reshape(s_q * hq, d)
-        acc[:] = acc[:] * corr[:, None] + pv2
-        m_scr[:, 0] = m_new
-
-    @pl.when(j == num_blocks_seq - 1)
-    def _finalize():
-        l = jnp.maximum(l_scr[:, 0], 1e-20)
-        a = acc[:]
-        o_ref[0] = (a / l[:, None]).reshape(
-            s_q, hq, a.shape[-1]).astype(o_ref.dtype)
+    pools are int8 (quantize_kv_rows layout). Returns [B, Hq, D]."""
+    return paged_attention(q, k_pages, v_pages, page_table, kv_lens,
+                           softmax_scale=softmax_scale,
+                           k_scales=k_scales, v_scales=v_scales)
 
 
 def paged_attention_multiquery(q: jnp.ndarray, k_pages: jnp.ndarray,
@@ -341,52 +107,48 @@ def paged_attention_multiquery(q: jnp.ndarray, k_pages: jnp.ndarray,
     (their K/V must already be written into the pages); the rest are
     padding whose outputs are garbage and must be discarded. kv_lens [B]
     counts ALL valid kv positions including the new tail (>= q_lens >=
-    1). k_scales/v_scales [NB, bs, Hkv] fp32 mark int8 pools (see
-    paged_attention_decode). Returns [B, S_q, Hq, D]."""
-    b, s_q, hq, d = q.shape
-    nb, bs, hkv, _ = k_pages.shape
-    mb = page_table.shape[1]
-    group = hq // hkv
-    quantized = k_scales is not None
-    if softmax_scale is None:
-        softmax_scale = 1.0 / (d ** 0.5)
+    1). At q_len == 1 the emitted body reduces bitwise to the decode
+    kernel. Returns [B, S_q, Hq, D]."""
+    return paged_attention(q, k_pages, v_pages, page_table, kv_lens,
+                           q_lens=q_lens, softmax_scale=softmax_scale,
+                           k_scales=k_scales, v_scales=v_scales)
 
-    kernel = functools.partial(
-        _multiquery_kernel, scale=float(softmax_scale), block_size=bs,
-        num_blocks_seq=mb, hkv=hkv, group=group, s_q=s_q,
-        quantized=quantized)
 
-    kv_spec = pl.BlockSpec((1, bs, hkv, d),
-                           lambda b_, j, t, l, ql: (t[b_, j], 0, 0, 0))
-    in_specs = [
-        pl.BlockSpec((1, s_q, hq, d),
-                     lambda b_, j, t, l, ql: (b_, 0, 0, 0)),
-        kv_spec, kv_spec,
-    ]
-    operands = [q, k_pages, v_pages]
-    if quantized:
-        sc_spec = pl.BlockSpec((1, bs, hkv),
-                               lambda b_, j, t, l, ql: (t[b_, j], 0, 0))
-        in_specs += [sc_spec, sc_spec]
-        operands += [k_scales, v_scales]
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(b, mb),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, s_q, hq, d),
-                               lambda b_, j, t, l, ql: (b_, 0, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((s_q * hq, d), jnp.float32),
-            pltpu.VMEM((s_q * hq, 1), jnp.float32),
-            pltpu.VMEM((s_q * hq, 1), jnp.float32),
-        ],
-    )
-    return pl.pallas_call(
-        kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, s_q, hq, d), q.dtype),
-        interpret=_interpret(),
-    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32),
-      q_lens.astype(jnp.int32), *operands)
+def paged_attention_decode_tp(q: jnp.ndarray, k_pages: jnp.ndarray,
+                              v_pages: jnp.ndarray,
+                              page_table: jnp.ndarray,
+                              kv_lens: jnp.ndarray, mesh,
+                              softmax_scale: Optional[float] = None,
+                              k_scales: Optional[jnp.ndarray] = None,
+                              v_scales: Optional[jnp.ndarray] = None
+                              ) -> jnp.ndarray:
+    """`paged_attention_decode` head-sharded over the tp axis of `mesh`
+    (kernel_gen._tp_place: full-manual shard_map, pools + int8 scale
+    pools sharded on Hkv, table/lens replicated). Output is [B, Hq, D]
+    head-sharded (callers gather / constrain as needed)."""
+    return paged_attention(q, k_pages, v_pages, page_table, kv_lens,
+                           softmax_scale=softmax_scale,
+                           k_scales=k_scales, v_scales=v_scales,
+                           mesh=mesh)
+
+
+def paged_attention_multiquery_tp(q: jnp.ndarray, k_pages: jnp.ndarray,
+                                  v_pages: jnp.ndarray,
+                                  page_table: jnp.ndarray,
+                                  kv_lens: jnp.ndarray,
+                                  q_lens: jnp.ndarray, mesh,
+                                  softmax_scale: Optional[float] = None,
+                                  k_scales: Optional[jnp.ndarray] = None,
+                                  v_scales: Optional[jnp.ndarray] = None
+                                  ) -> jnp.ndarray:
+    """`paged_attention_multiquery` head-sharded over the tp axis of
+    `mesh` (speculative verify / chunked prefill on a tp serving mesh).
+    q [B, S_q, Hq, D] sharded on Hq; pools on Hkv (int8 pools: scale
+    pools sharded alongside); table/lens/q_lens replicated."""
+    return paged_attention(q, k_pages, v_pages, page_table, kv_lens,
+                           q_lens=q_lens, softmax_scale=softmax_scale,
+                           k_scales=k_scales, v_scales=v_scales,
+                           mesh=mesh)
 
 
 def dequantize_pages(pages: jnp.ndarray, scales: jnp.ndarray
@@ -549,124 +311,37 @@ def gather_pages_batched(pages: jnp.ndarray, page_table: jnp.ndarray
 
 
 # ---------------------------------------------------------------------------
-# TP-sharded kernel placement (full-manual shard_map over KV heads)
+# TP-shard eligibility (the placement itself lives in kernel_gen._tp_place)
 # ---------------------------------------------------------------------------
 
 
-def tp_paged_eligible(cfg, ctx) -> bool:
-    """True when the paged kernels may run head-sharded on ctx's tp axis:
-    tp > 1, standard (non-MLA) paged layout, and both head counts divide
-    by tp so each shard owns whole, matched GQA groups (q head h reads kv
-    head h // group — contiguous slicing of BOTH by tp preserves the
-    grouping per shard, the same eligibility rule as the flash
+def tp_paged_ineligible_reason(cfg, ctx) -> Optional[str]:
+    """Why the paged kernels may NOT run head-sharded on ctx's tp axis —
+    None when eligible, otherwise the FIRST failed predicate by name (so
+    fallback logs say what to fix instead of a generic "ineligible").
+    Eligibility: tp > 1, standard (non-MLA) paged layout, and both head
+    counts divide by tp so each shard owns whole, matched GQA groups
+    (q head h reads kv head h // group — contiguous slicing of BOTH by
+    tp preserves the grouping per shard, the same rule as the flash
     wrapper)."""
-    return (ctx is not None and ctx.tp > 1
-            and not cfg.multi_latent_attention
-            and cfg.num_attention_heads % ctx.tp == 0
-            and cfg.num_query_groups % ctx.tp == 0)
+    if ctx is None:
+        return "no mesh context (ctx is None)"
+    if ctx.tp <= 1:
+        return f"tp == {ctx.tp} (needs tp > 1 to shard heads)"
+    if cfg.multi_latent_attention:
+        return ("multi_latent_attention: the latent pool has no per-head "
+                "dim to shard")
+    if cfg.num_attention_heads % ctx.tp:
+        return (f"num_attention_heads ({cfg.num_attention_heads}) % tp "
+                f"({ctx.tp}) != 0")
+    if cfg.num_query_groups % ctx.tp:
+        return (f"num_query_groups ({cfg.num_query_groups}) % tp "
+                f"({ctx.tp}) != 0 (shards must own whole GQA groups)")
+    return None
 
 
-def _tp_specs(mesh):
-    from jax.sharding import PartitionSpec as P
-    from megatronapp_tpu.config.parallel_config import TP_AXIS
-    head = P(None, TP_AXIS, None)             # q/out [B, Hq, D]
-    pages = P(None, None, TP_AXIS, None)      # pools [NB, bs, Hkv, D]
-    scales = P(None, None, TP_AXIS)           # scale pools [NB, bs, Hkv]
-    rep2, rep1 = P(None, None), P(None)
-    return head, pages, scales, rep2, rep1
-
-
-def paged_attention_decode_tp(q: jnp.ndarray, k_pages: jnp.ndarray,
-                              v_pages: jnp.ndarray,
-                              page_table: jnp.ndarray,
-                              kv_lens: jnp.ndarray, mesh,
-                              softmax_scale: Optional[float] = None,
-                              k_scales: Optional[jnp.ndarray] = None,
-                              v_scales: Optional[jnp.ndarray] = None
-                              ) -> jnp.ndarray:
-    """`paged_attention_decode` head-sharded over the tp axis of `mesh`.
-
-    q [B, Hq, D] sharded on heads, pools [NB, bs, Hkv, D] sharded on
-    Hkv, page table + kv lengths replicated; each shard runs the
-    unmodified kernel on its own GQA groups against its 1/tp slice of
-    the block pool. int8 pools shard their scale pools on Hkv alongside
-    — a quantized shard owns exactly its heads' rows AND scales. Output
-    is [B, Hq, D] head-sharded (callers gather / constrain as
-    needed)."""
-    from megatronapp_tpu.parallel.collectives import shard_map_compat
-    head, pages, scales, rep2, rep1 = _tp_specs(mesh)
-    if softmax_scale is None:
-        softmax_scale = 1.0 / (q.shape[-1] ** 0.5)
-
-    # Full-manual placement of the pallas decode kernel — purely local
-    # per (head, pool) shard, no collectives; tp_paged_eligible callers
-    # gate on no ambient manual axes.
-    if k_scales is not None:
-        def body_q(q_, k_, v_, t_, l_, ks_, vs_):
-            return paged_attention_decode(q_, k_, v_, t_, l_,
-                                          softmax_scale=softmax_scale,
-                                          k_scales=ks_, v_scales=vs_)
-
-        # manual-ok: full-manual kernel placement, see note above
-        return shard_map_compat(
-            body_q, mesh,
-            in_specs=(head, pages, pages, rep2, rep1, scales, scales),
-            out_specs=head)(q, k_pages, v_pages, page_table, kv_lens,
-                            k_scales, v_scales)
-
-    def body(q_, k_, v_, t_, l_):
-        return paged_attention_decode(q_, k_, v_, t_, l_,
-                                      softmax_scale=softmax_scale)
-
-    # manual-ok: full-manual kernel placement, see note above
-    return shard_map_compat(
-        body, mesh, in_specs=(head, pages, pages, rep2, rep1),
-        out_specs=head)(q, k_pages, v_pages, page_table, kv_lens)
-
-
-def paged_attention_multiquery_tp(q: jnp.ndarray, k_pages: jnp.ndarray,
-                                  v_pages: jnp.ndarray,
-                                  page_table: jnp.ndarray,
-                                  kv_lens: jnp.ndarray,
-                                  q_lens: jnp.ndarray, mesh,
-                                  softmax_scale: Optional[float] = None,
-                                  k_scales: Optional[jnp.ndarray] = None,
-                                  v_scales: Optional[jnp.ndarray] = None
-                                  ) -> jnp.ndarray:
-    """`paged_attention_multiquery` head-sharded over the tp axis of
-    `mesh` (speculative verify / chunked prefill on a tp serving mesh).
-    q [B, S_q, Hq, D] sharded on Hq; pools on Hkv (int8 pools: scale
-    pools sharded alongside); table/lens/q_lens replicated."""
-    from jax.sharding import PartitionSpec as P
-    from megatronapp_tpu.config.parallel_config import TP_AXIS
-    from megatronapp_tpu.parallel.collectives import shard_map_compat
-    _, pages, scales, rep2, rep1 = _tp_specs(mesh)
-    head4 = P(None, None, TP_AXIS, None)      # q/out [B, S_q, Hq, D]
-    if softmax_scale is None:
-        softmax_scale = 1.0 / (q.shape[-1] ** 0.5)
-
-    # Full-manual placement of the pallas multi-query kernel — purely
-    # local per (head, pool) shard, no collectives; tp_paged_eligible
-    # callers gate on no ambient manual axes.
-    if k_scales is not None:
-        def body_q(q_, k_, v_, t_, l_, ql_, ks_, vs_):
-            return paged_attention_multiquery(q_, k_, v_, t_, l_, ql_,
-                                              softmax_scale=softmax_scale,
-                                              k_scales=ks_, v_scales=vs_)
-
-        # manual-ok: full-manual kernel placement, see note above
-        return shard_map_compat(
-            body_q, mesh,
-            in_specs=(head4, pages, pages, rep2, rep1, rep1, scales,
-                      scales),
-            out_specs=head4)(q, k_pages, v_pages, page_table, kv_lens,
-                             q_lens, k_scales, v_scales)
-
-    def body(q_, k_, v_, t_, l_, ql_):
-        return paged_attention_multiquery(q_, k_, v_, t_, l_, ql_,
-                                          softmax_scale=softmax_scale)
-
-    # manual-ok: full-manual kernel placement, see note above
-    return shard_map_compat(
-        body, mesh, in_specs=(head4, pages, pages, rep2, rep1, rep1),
-        out_specs=head4)(q, k_pages, v_pages, page_table, kv_lens, q_lens)
+def tp_paged_eligible(cfg, ctx) -> bool:
+    """True when the paged kernels may run head-sharded on ctx's tp axis
+    (see tp_paged_ineligible_reason for the predicate list — it names
+    the specific failure for fallback logs)."""
+    return tp_paged_ineligible_reason(cfg, ctx) is None

@@ -443,28 +443,50 @@ def tp_stage_eligible(cfg, ctx, seq_len: int) -> bool:
     (gate/value halves shard separately for gated activations). MoE
     layers dispatch locally per shard (any expert count); heterogeneous
     stacks are excluded (the pipeline rejects them anyway)."""
-    if ctx is None or ctx.tp <= 1 or ctx.pp <= 1 or ctx.cp > 1:
-        return False
+    return tp_stage_ineligible_reason(cfg, ctx, seq_len) is None
+
+
+def tp_stage_ineligible_reason(cfg, ctx, seq_len: int):
+    """Why the stage body may NOT run tp-sharded — None when eligible,
+    otherwise the FIRST failed predicate by name, so the replicated-body
+    fallback log says what to fix instead of a generic "ineligible"
+    (ISSUE 11 satellite; same contract as tp_paged_ineligible_reason)."""
+    if ctx is None:
+        return "no mesh context (ctx is None)"
+    if ctx.tp <= 1:
+        return f"tp == {ctx.tp} (nothing to shard)"
+    if ctx.pp <= 1:
+        return (f"pp == {ctx.pp} (the sharded body lives inside the "
+                f"manual pp pipeline region)")
+    if ctx.cp > 1:
+        return (f"cp == {ctx.cp} > 1 (the sequence is already the cp "
+                f"shard dim; tp-sharding it too needs the pp x cp "
+                f"head-sharding follow-up)")
     # FBD abstract half-meshes keep the proven tp-replicated body (same
     # exclusion as tp_overlap_eligible: abstract-mesh manual collectives
     # over tp are unvalidated there).
     if getattr(ctx, "abstract_collectives", False):
-        return False
+        return "FBD abstract half-mesh (manual tp collectives " \
+               "unvalidated on abstract meshes)"
     if not getattr(cfg, "tp_sharded_stage", True):
-        return False
+        return "kill-switch: cfg.tp_sharded_stage off " \
+               "(--no-tp-sharded-stage)"
     if getattr(cfg, "hetero_block_specs", None):
-        return False
+        return "heterogeneous per-layer configs (pipeline rejects them)"
     tp = ctx.tp
     if seq_len % tp:
-        return False
+        return f"seq_len ({seq_len}) % tp ({tp}) != 0"
     if cfg.num_attention_heads % tp:
-        return False
+        return (f"num_attention_heads ({cfg.num_attention_heads}) % tp "
+                f"({tp}) != 0")
     if not cfg.multi_latent_attention and cfg.num_query_groups % tp:
-        return False
+        return (f"num_query_groups ({cfg.num_query_groups}) % tp "
+                f"({tp}) != 0 (shards must own whole GQA groups)")
     has_dense_mlp = (not cfg.is_moe) or cfg.moe_layer_freq > 1
     if has_dense_mlp and cfg.ffn_hidden_size % tp:
-        return False
-    return True
+        return (f"ffn_hidden_size ({cfg.ffn_hidden_size}) % tp ({tp}) "
+                f"!= 0 (gate/value halves shard separately)")
+    return None
 
 
 # ---------------------------------------------------------------------------

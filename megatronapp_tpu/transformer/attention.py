@@ -484,7 +484,9 @@ def attention_forward(
                         lambda q_, k_, v_: flash_attention(
                             q_, k_, v_, causal=causal,
                             block_q=cfg.flash_block_q,
-                            block_kv=cfg.flash_block_kv),
+                            block_kv=cfg.flash_block_kv,
+                            head_fold=getattr(cfg, "flash_head_fold",
+                                              False)),
                         ctx.shard_map_mesh,
                         in_specs=(spec, spec, spec),
                         out_specs=spec))
@@ -504,7 +506,8 @@ def attention_forward(
                 attn_out = flash_attention(
                     q, k, v, causal=causal,
                     block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv,
-                    segment_ids=segment_ids)
+                    segment_ids=segment_ids,
+                    head_fold=getattr(cfg, "flash_head_fold", False))
         else:
             if segment_ids is not None:
                 seg_mask = (segment_ids[:, None, :, None]

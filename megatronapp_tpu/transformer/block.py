@@ -71,7 +71,8 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                   cache_positions=None, ctx=None,
                   zigzag: bool = False, segment_ids=None,
                   page_table=None, active=None, chunk_counts=None,
-                  tp_sharded: bool = False, kv_scales=None):
+                  tp_sharded: bool = False, kv_scales=None,
+                  fused_decode: bool = False):
     """One transformer layer. x: [B,S,H] → ((out, new_cache), aux_losses).
 
     page_table/active: paged-KV decode (inference/paged_cache.py) —
@@ -83,7 +84,26 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
 
     tp_sharded: ambient-manual tp-sharded stage body (pp pipeline) — x is
     the local [B, S/tp, H] seq chunk; norms/residuals run on it directly
-    (elementwise over seq) and the sublayers take their ring paths."""
+    (elementwise over seq) and the sublayers take their ring paths.
+
+    fused_decode: megakernel decode body (ISSUE 11) — the s == 1 paged
+    decode layer runs as the three fused Pallas kernels around the
+    generated paged-attention kernel (ops/pallas/kernel_gen.py
+    fused_layer_decode) instead of the ~15-fusion unfused tail. Callers
+    (DynamicInferenceEngine fused_decode=True) gate eligibility via
+    kernel_gen.megakernel_ineligible_reason; streams stay token-exact."""
+    if fused_decode:
+        if (page_table is None or kv_cache is None
+                or chunk_counts is not None or x.shape[1] != 1
+                or cfg.multi_latent_attention or "moe" in p):
+            raise ValueError(
+                "fused_decode covers the s == 1 non-MLA dense-MLP paged "
+                "decode body only — gate callers on "
+                "kernel_gen.megakernel_ineligible_reason")
+        from megatronapp_tpu.ops.pallas.kernel_gen import fused_layer_decode
+        return fused_layer_decode(p, x, cfg, rope_cos, rope_sin, kv_cache,
+                                  cache_positions, page_table, active,
+                                  kv_scales=kv_scales)
     residual = x
     h = apply_norm(cfg.normalization, x, p["ln1_scale"], p.get("ln1_bias"),
                    cfg.layernorm_epsilon)

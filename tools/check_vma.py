@@ -85,6 +85,12 @@ MANUAL_REGION_MODULES = (
     # jitted paths that also trace under ambient-manual callers — every
     # region-creating / GSPMD construct must carry an audited note.
     "megatronapp_tpu/ops/pallas/paged_attention.py",
+    # ISSUE 11 (kernel generator): the tp variants are now PLACED by
+    # kernel_gen._tp_place — the region-creating shard_map moved here
+    # with the kernel bodies; every GSPMD construct must carry an
+    # audited `manual-ok:` note (paged_attention.py keeps only thin
+    # dispatchers + eligibility).
+    "megatronapp_tpu/ops/pallas/kernel_gen.py",
     "megatronapp_tpu/inference/dynamic_engine.py",
     "megatronapp_tpu/inference/disagg.py",
     "megatronapp_tpu/inference/paged_cache.py",

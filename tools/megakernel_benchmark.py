@@ -1,0 +1,230 @@
+"""A/B microbenchmark: megakernel decode + dispatch levers (ISSUE 11;
+ops/pallas/kernel_gen.py, utils/dispatch.py).
+
+Two measurements, deterministic-first (the TPU tunnel has been down
+since bench round 2 — wall numbers here are CPU, the dispatch/cost
+numbers are compiled-module facts):
+
+  decode:  plain vs FUSED decode step on the same engine config &
+           requests. Gates: greedy streams EXACT, and the estimated
+           kernel launches per decode step (utils/dispatch.py
+           jaxpr_launch_stats — each pallas_call is one TPU custom
+           call; the CPU HLO text inlines interpret-mode kernels and
+           cannot be the gate) measurably REDUCED. The compiled
+           cost-model flops/bytes and CPU tokens/s ride along for the
+           record.
+  train:   fwd+bwd wall with the two staged PERF levers ON — flash
+           backward head-fold (lever 1, --flash-head-fold) + a
+           scan-unroll sweep (lever 3, --scan-unroll ∈ {1, 2, 4}) —
+           vs the baseline kernels at unroll 1, attention_impl=pallas
+           so the flash kernels actually run (interpret mode on CPU).
+           Paired interleaved timing with per-round leg rotation;
+           gates: loss parity EXACT across all legs and best-lever
+           wall ratio >= 1.0.
+
+Runs on CPU out of the box. bench.py runs this as its `--megakernel`
+child and attaches the result to the round record (extra.megakernel).
+
+  python tools/megakernel_benchmark.py --max-new 6
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DISPATCH_RATIO_GATE = 0.85   # fused launches must be <= 0.85x plain
+TRAIN_RATIO_GATE = 1.0       # levers-on fwd+bwd must not be slower
+LOSS_ATOL = 1e-6
+
+
+def _make_cfg(**over):
+    import jax.numpy as jnp
+
+    from megatronapp_tpu.config.transformer_config import TransformerConfig
+    kw = dict(num_layers=2, hidden_size=128, num_attention_heads=4,
+              num_query_groups=2, vocab_size=128,
+              max_position_embeddings=128, compute_dtype=jnp.bfloat16,
+              remat_policy="none")
+    kw.update(over)
+    return TransformerConfig(**kw)
+
+
+def _build(cfg, params, fused, **kw):
+    from megatronapp_tpu.inference.dynamic_engine import (
+        DynamicInferenceEngine,
+    )
+    return DynamicInferenceEngine(
+        params, cfg, max_batch=4, max_seq_len=96, prefill_buckets=(32, 64),
+        paged=True, block_size=8, fused_decode=fused, **kw)
+
+
+def _run_requests(engine, prompts, max_new):
+    from megatronapp_tpu.inference.engine import SamplingParams
+    ids = [engine.add_request(p, max_new, SamplingParams(greedy=True))
+           for p in prompts]
+    t0 = time.perf_counter()
+    results = engine.run_to_completion()
+    dt = time.perf_counter() - t0
+    return [results[r].tolist() for r in ids], dt, len(prompts) * max_new
+
+
+def run_decode_ab(max_new: int = 6, kv_dtype: str = "bf16",
+                  scan_unroll: int = 2):
+    """Plain vs fused decode step: dispatch-count gate + stream parity
+    + compiled cost model + CPU wall (record)."""
+    import jax
+    import numpy as np
+
+    from megatronapp_tpu.models.gpt import init_gpt_params
+
+    cfg = _make_cfg()
+    fused_cfg = dataclasses.replace(cfg, scan_unroll=scan_unroll)
+    params, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in (4, 9, 17, 26, 34, 41)]
+
+    plain = _build(cfg, params, fused=False, kv_cache_dtype=kv_dtype)
+    p_toks, p_dt, n_new = _run_requests(plain, prompts, max_new)
+    fused = _build(fused_cfg, params, fused=True, kv_cache_dtype=kv_dtype)
+    f_toks, f_dt, _ = _run_requests(fused, prompts, max_new)
+    fused.pool.audit()
+    assert fused.megakernel, "fused engine fell back to the unfused step"
+
+    sp = plain.dispatch_stats()
+    sf = fused.dispatch_stats()
+    ratio = sf["dispatches_per_step"] / sp["dispatches_per_step"]
+    out = {
+        "kv_dtype": kv_dtype,
+        "scan_unroll_fused": scan_unroll,
+        "greedy_match": p_toks == f_toks,
+        "dispatches_per_step": {"plain": sp["dispatches_per_step"],
+                                "fused": sf["dispatches_per_step"]},
+        "pallas_kernels_per_step": {"plain": sp["kernels"],
+                                    "fused": sf["kernels"]},
+        "loop_steps": {"plain": sp["loop_steps"],
+                       "fused": sf["loop_steps"]},
+        "dispatch_ratio": round(ratio, 4),
+        "dispatch_ratio_gate": DISPATCH_RATIO_GATE,
+        "within_gate": ratio <= DISPATCH_RATIO_GATE,
+        "plain_tok_s": round(n_new / p_dt, 1),
+        "fused_tok_s": round(n_new / f_dt, 1),
+    }
+    for name, st in (("plain", sp), ("fused", sf)):
+        cost = st.get("compiled", {}).get("cost")
+        if cost:
+            out.setdefault("compiled_cost", {})[name] = cost
+    return out
+
+
+def run_train_levers(iters: int = 6, seq: int = 256, batch: int = 2,
+                     unrolls=(1, 2, 4)):
+    """fwd+bwd wall: baseline kernels/unroll=1 vs head-fold + each
+    scan-unroll (paired interleaved, per-round leg rotation, min-of-
+    rounds). Loss parity across ALL legs gated exact (<= LOSS_ATOL)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from megatronapp_tpu.config.transformer_config import TransformerConfig
+    from megatronapp_tpu.models.gpt import gpt_loss, init_gpt_params
+
+    base_cfg = TransformerConfig(
+        num_layers=4, hidden_size=128, num_attention_heads=4,
+        vocab_size=512, max_position_embeddings=512,
+        attention_impl="pallas", flash_block_q=128, flash_block_kv=128,
+        remat_policy="none")
+    params, _ = init_gpt_params(jax.random.PRNGKey(0), base_cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, base_cfg.vocab_size,
+                                      (batch, seq)), jnp.int32)
+    labels = jnp.roll(tokens, -1, axis=-1)
+    mask = jnp.ones((batch, seq), jnp.float32)
+
+    def make(cfg):
+        return jax.jit(jax.value_and_grad(
+            lambda p: gpt_loss(p, tokens, labels, mask, cfg)[0]))
+
+    legs = {"base": make(base_cfg)}
+    for u in unrolls:
+        legs[f"fold_u{u}"] = make(dataclasses.replace(
+            base_cfg, flash_head_fold=True, scan_unroll=u))
+
+    losses = {}
+    for name, f in legs.items():
+        loss, g = f(params)            # compile + warmup
+        jax.block_until_ready(g)
+        losses[name] = float(loss)
+    base_loss = losses["base"]
+    loss_dev = max(abs(v - base_loss) for v in losses.values())
+
+    times = {k: [] for k in legs}
+    names = list(legs)
+    for r in range(iters):
+        for name in names[r % len(names):] + names[:r % len(names)]:
+            f = legs[name]
+            t0 = time.perf_counter()
+            loss, g = f(params)
+            jax.block_until_ready(g)
+            times[name].append(time.perf_counter() - t0)
+    mins = {k: min(v) for k, v in times.items()}
+    lever_names = [k for k in legs if k != "base"]
+    best = min(lever_names, key=lambda k: mins[k])
+    ratio = mins["base"] / mins[best]
+    return {
+        "seq": seq, "batch": batch, "layers": base_cfg.num_layers,
+        "losses": losses,
+        "loss_max_dev": loss_dev,
+        "loss_parity": loss_dev <= LOSS_ATOL,
+        "wall_ms_min": {k: round(v * 1e3, 2) for k, v in mins.items()},
+        "ratio_by_unroll": {
+            k: round(mins["base"] / mins[k], 4) for k in lever_names},
+        "best_lever": best,
+        "fwd_bwd_ratio": round(ratio, 4),
+        "ratio_gate": TRAIN_RATIO_GATE,
+        "within_gate": ratio >= TRAIN_RATIO_GATE,
+    }
+
+
+def run(**kw):
+    """Both measurements; returns a JSON-ready dict."""
+    import jax
+
+    return {
+        "environment": jax.devices()[0].platform,
+        "decode": run_decode_ab(
+            max_new=kw.get("max_new", 6),
+            scan_unroll=kw.get("scan_unroll", 2)),
+        "decode_int8": run_decode_ab(
+            max_new=kw.get("max_new", 6), kv_dtype="int8",
+            scan_unroll=kw.get("scan_unroll", 2)),
+        "train": run_train_levers(iters=kw.get("iters", 6)),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--max-new", type=int, default=6)
+    ap.add_argument("--scan-unroll", type=int, default=2,
+                    help="decode-side unroll for the fused leg")
+    ap.add_argument("--iters", type=int, default=6)
+    ap.add_argument("--local", action="store_true",
+                    help="force the CPU backend")
+    args = ap.parse_args(argv)
+
+    if args.local:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    res = run(max_new=args.max_new, scan_unroll=args.scan_unroll,
+              iters=args.iters)
+    print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

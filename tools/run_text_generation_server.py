@@ -40,6 +40,14 @@ def main():
     ap.add_argument("--port", type=int, default=5000)
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--max-seq-len", type=int, default=None)
+    # Serving-side copy of the training flag (same name/semantics; the
+    # main parser defines it in its training group, so it cannot live
+    # in add_serving_args without colliding there): unrolls the decode/
+    # multi-query layer scans — PERF lever 3, pairs with
+    # --megakernel-decode.
+    ap.add_argument("--scan-unroll", type=int, default=1,
+                    help="lax.scan unroll factor for the serving "
+                         "decode-step layer scans (PERF.md lever #3)")
     # Serving flags shared with the main parser (config/arguments.py
     # add_serving_args — single source of truth): --engine, --max-batch,
     # --paged-kv-cache, --kv-block-size, --num-kv-blocks,
@@ -53,6 +61,9 @@ def main():
     cfg = PRESETS[args.preset]()
     validate_serving_args(
         args, multi_latent_attention=cfg.multi_latent_attention)
+    if args.scan_unroll != 1:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, scan_unroll=args.scan_unroll)
     mcfg = None
     if args.engine == "mamba":
         from megatronapp_tpu.models.mamba import (
@@ -183,10 +194,12 @@ def main():
             spec_method=spec,
             spec_k=args.spec_k, draft_params=draft_params,
             draft_cfg=draft_cfg, prefill_chunk=args.prefill_chunk,
-            ctx=tp_ctx, kv_cache_dtype=args.kv_cache_dtype)
+            ctx=tp_ctx, kv_cache_dtype=args.kv_cache_dtype,
+            fused_decode=args.megakernel_decode)
         print(f"serving continuous batching on {args.host}:{args.port} "
               f"(paged={args.paged_kv_cache}, "
               f"kv={args.kv_cache_dtype}, tp={args.serve_tp}, "
+              f"megakernel={engine.megakernel}, "
               f"spec={engine.spec_method or 'off'})")
         TextGenerationServer(engine, args.host, args.port).run()
         return
