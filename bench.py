@@ -168,6 +168,17 @@ def _disagg_hook():
     return r if r.get("disagg") else None
 
 
+def _telemetry_hook():
+    """Telemetry-overhead A/B (tools/telemetry_benchmark.py) on the CPU
+    backend — driver-soak tokens/s with the metrics registry + request
+    tracer on vs off (gate >= 0.95) and the disabled-path ns/call
+    microbench tracked round over round like the other hooks."""
+    if os.environ.get("BENCH_TELEMETRY", "1") != "1":
+        return None
+    r = _run_child("--telemetry", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("telemetry") else None
+
+
 def _pp_tp_hook():
     """tp-sharded-vs-replicated pipeline stage body A/B
     (tools/pp_tp_benchmark.py) on the CPU mesh — fwd/fwd+bwd speedup and
@@ -219,6 +230,9 @@ def _attach_overlap_hooks(res):
     mkd = _megakernel_hook()
     if mkd:
         res.setdefault("extra", {})["megakernel"] = mkd
+    tel = _telemetry_hook()
+    if tel:
+        res.setdefault("extra", {})["telemetry"] = tel
     return res
 
 
@@ -292,6 +306,7 @@ def parent_main(local_only: bool = False):
     spd = _spec_decode_hook()
     kvq = _kv_quant_hook()
     mkd = _megakernel_hook()
+    tel = _telemetry_hook()
     last = _load_last_good()
     if last is not None:
         # Top-level `stale` so the consumer can verifiably distinguish this
@@ -324,6 +339,8 @@ def parent_main(local_only: bool = False):
             last["extra"]["kv_quant"] = kvq
         if mkd:
             last["extra"]["megakernel"] = mkd
+        if tel:
+            last["extra"]["telemetry"] = tel
         print(json.dumps(last))
         return
     if cpu:
@@ -346,6 +363,8 @@ def parent_main(local_only: bool = False):
             cpu.setdefault("extra", {})["kv_quant"] = kvq
         if mkd:
             cpu.setdefault("extra", {})["megakernel"] = mkd
+        if tel:
+            cpu.setdefault("extra", {})["telemetry"] = tel
         print(json.dumps(cpu))
         return
     print(json.dumps({
@@ -489,6 +508,14 @@ def megakernel_main():
     the parent)."""
     from tools.megakernel_benchmark import run
     print(json.dumps(run(max_new=6, scan_unroll=2, iters=6)))
+
+
+def telemetry_main():
+    """telemetry on-vs-off driver-soak A/B child (CPU env set by the
+    parent)."""
+    from tools.telemetry_benchmark import run
+    print(json.dumps(run(n_requests=6, prompt_len=16, max_new=24,
+                         repeats=3)))
 
 
 def disagg_main():
@@ -636,5 +663,7 @@ if __name__ == "__main__":
         disagg_main()
     elif "--megakernel" in sys.argv:
         megakernel_main()
+    elif "--telemetry" in sys.argv:
+        telemetry_main()
     else:
         parent_main(local_only="--local" in sys.argv)

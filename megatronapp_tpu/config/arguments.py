@@ -129,6 +129,26 @@ def add_serving_args(ap: argparse.ArgumentParser):
                         "chunks are preempted when the next chunk "
                         "would push the interval past this; /stats "
                         "and /healthz report attainment")
+    # Telemetry spine (ISSUE 12).
+    g.add_argument("--serving-metrics", action="store_true",
+                   help="enable the telemetry registry "
+                        "(utils/metrics.py): counters + log-bucket "
+                        "latency histograms from the engines, "
+                        "allocator, and driver, exported as Prometheus "
+                        "text at GET /metrics (env equivalent: "
+                        "MEGATRON_METRICS=1). Off by default — the "
+                        "disabled path is one dict check per site")
+    g.add_argument("--request-trace", action="store_true",
+                   help="enable the always-on bounded request-lifecycle "
+                        "tracer (trace/request_trace.py): B/E spans per "
+                        "request id (admit/queue/prefill/handoff/adopt/"
+                        "decode/retire) in a ring buffer, served as one "
+                        "merged Chrome trace at GET /trace (env "
+                        "equivalent: MEGATRON_REQUEST_TRACE=1)")
+    g.add_argument("--request-trace-capacity", type=int, default=16384,
+                   help="ring-buffer record capacity for "
+                        "--request-trace (old records fall off; memory "
+                        "stays bounded under production load)")
     return g
 
 

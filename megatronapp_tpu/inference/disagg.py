@@ -70,6 +70,11 @@ from megatronapp_tpu.inference.engine import (
 from megatronapp_tpu.inference.paged_cache import PagedKVCache, cdiv
 from megatronapp_tpu.parallel.fbd import build_half_meshes
 from megatronapp_tpu.parallel.mesh import MeshContext
+from megatronapp_tpu.trace.request_trace import (
+    PREFILL_PID, get_request_tracer,
+)
+from megatronapp_tpu.utils import metrics as telemetry
+from megatronapp_tpu.utils.metrics import Histogram
 
 
 def split_serving_meshes(tp: int = 1, devices=None
@@ -178,6 +183,9 @@ class PrefillWorker:
         self.stats = {"prefills_started": 0, "prefills_finished": 0,
                       "chunks": 0, "kv_shipped_bytes": 0,
                       "prefix_hit_tokens": 0}
+        # Prefill-mesh events land on their own pid row of the merged
+        # request trace (ISSUE 12).
+        self._rt = get_request_tracer()
 
     def set_params(self, params):
         """Rolling reload: mirror the new weights onto the prefill mesh
@@ -250,6 +258,8 @@ class PrefillWorker:
         the real chunk latency for its decode-SLO budget EWMA; without
         an SLO the chunks pipeline asynchronously against the decode
         mesh."""
+        self._rt.begin("prefill-chunk", state.req.request_id,
+                       pid=PREFILL_PID, pos=state.pos)
         c = min(self.chunk, state.p_len - state.pos)
         padded = np.zeros((1, self.chunk), np.int32)
         padded[0, :c] = state.tokens[state.pos:state.pos + c]
@@ -303,9 +313,12 @@ class PrefillWorker:
                 table_row, state.pos, c)
         state.pos += c
         self.stats["chunks"] += 1
+        telemetry.inc("disagg_prefill_chunks")
         if state.pos < state.p_len:
             if sync:
                 jax.block_until_ready(logits)
+            self._rt.end("prefill-chunk", state.req.request_id,
+                         pid=PREFILL_PID)
             return False
         # Prompt complete: register its blocks for followers and sample
         # the first generated token with the engine's exact key chain
@@ -329,6 +342,7 @@ class PrefillWorker:
             req.finished = True
         state.done = True
         self.stats["prefills_finished"] += 1
+        self._rt.end("prefill-chunk", req.request_id, pid=PREFILL_PID)
         return True
 
     def release(self, state: PrefillState):
@@ -397,6 +411,14 @@ class DisaggServingEngine:
                           "worst_interval_ms": 0.0,
                           "chunk_preemptions": 0,
                           "rejected_at_admission": 0}
+        # Histogram-backed SLO accounting (ISSUE 12): token-interval and
+        # TTFT percentiles replace the single worst-interval scalar as
+        # the attainment signal. Private Histogram instances — live even
+        # when the global metrics registry is off (the fleet router will
+        # score replicas off these).
+        self.interval_hist = Histogram(lo=1e-2, hi=1e6, growth=1.25)
+        self.ttft_hist = Histogram(lo=1e-2, hi=1e7, growth=1.25)
+        self._rt = get_request_tracer()
 
     # ---- engine-facade surface ------------------------------------------
     @property
@@ -431,11 +453,20 @@ class DisaggServingEngine:
         except DeadlineExceeded:
             self.slo_stats["rejected_at_admission"] += 1
             raise
+        now = time.monotonic()
         req = Request(next(self.engine._ids), prompt, max_new_tokens,
                       sampling or SamplingParams(), eod_id=eod_id,
-                      priority=priority, deadline_s=deadline_s)
+                      priority=priority, deadline_s=deadline_s,
+                      admit_t=now, queued_t=now)
         self.waiting.append(req)
         self.requests[req.request_id] = req
+        telemetry.inc("serving_requests_admitted")
+        rt = self._rt
+        if rt.enabled:
+            rt.instant("admit", req.request_id,
+                       prompt_tokens=len(prompt), priority=priority)
+            rt.begin("request", req.request_id)
+            rt.begin("queue-wait", req.request_id)
         return req.request_id
 
     def pop_request(self, request_id: int) -> Optional[Request]:
@@ -452,11 +483,13 @@ class DisaggServingEngine:
                 pass        # raced with prefill start: running below
             else:
                 req.finished = True
+                self._rt.finish(request_id, "abort")
                 return "waiting"
         if not req.finished:
             # In-flight prefill, parked, or decoding: the next step's
             # sweep releases its blocks (staging or decode slot alike).
             req.finished = True
+            self._rt.instant("abort", request_id)
             return "running"
         return None
 
@@ -489,10 +522,14 @@ class DisaggServingEngine:
             req.finished = True
             self._aborted.append(req)
             expired.append(req.request_id)
+            self._rt.finish(req.request_id, "expire")
         for state in self._inflight + self._parked:
             if overdue(state.req):
                 state.req.finished = True     # reclaimed by _sweep_staged
                 expired.append(state.req.request_id)
+                self._rt.instant("expire", state.req.request_id)
+        if expired:
+            telemetry.inc("serving_deadline_expired", len(expired))
         expired += self.engine.expire_overdue(now)
         return expired
 
@@ -502,6 +539,7 @@ class DisaggServingEngine:
         decode engine's own state."""
         for req in list(self.waiting):
             self.requests.pop(req.request_id, None)
+            self._rt.finish(req.request_id, "abort")
         self.waiting.clear()
         for state in self._inflight + self._parked:
             try:
@@ -509,6 +547,7 @@ class DisaggServingEngine:
             except Exception:  # noqa: BLE001 — best-effort reclaim
                 pass
             self.requests.pop(state.req.request_id, None)
+            self._rt.finish(state.req.request_id, "abort")
         self._inflight = []
         self._parked = []
         self.engine.abort_all()
@@ -570,6 +609,10 @@ class DisaggServingEngine:
                     self.worker.release(state)
                     lst.remove(state)
                     events["finished"].append(state.req.request_id)
+                    # abort/expire instants already fired at the mark
+                    # site; this closes every span still open (prefill
+                    # on the prefill pid, handoff-parked, request).
+                    self._rt.finish(state.req.request_id)
 
     def _adopt_parked(self, events):
         """Hand finished prefills to the decode side in (priority, rid)
@@ -581,6 +624,7 @@ class DisaggServingEngine:
             if self.engine.free_decode_slots() == 0:
                 break
             self._parked.remove(state)
+            self._rt.end("handoff-parked", state.req.request_id)
             self.engine.adopt_request(state.req, state.pslot,
                                       state.p_len)
             events["admitted"].append(state.req.request_id)
@@ -604,6 +648,13 @@ class DisaggServingEngine:
                 self.waiting.appendleft(req)
                 return
             self._inflight.append(state)
+            rt = self._rt
+            rt.end("queue-wait", req.request_id)
+            telemetry.observe("serving_queue_wait_ms",
+                              (time.monotonic() - req.queued_t) * 1e3)
+            rt.begin("prefill", req.request_id, pid=PREFILL_PID,
+                     prompt_tokens=state.p_len,
+                     cached_tokens=state.pos)
 
     def _prefill_budget_chunks(self, t_decode_done: float,
                                decode_active: bool) -> None:
@@ -644,13 +695,28 @@ class DisaggServingEngine:
         events) and park for handoff — or finish outright when the
         request is already done (max_new_tokens == 1 / immediate eod /
         aborted mid-prompt)."""
+        rid = state.req.request_id
+        self._rt.end("prefill", rid, pid=PREFILL_PID)
+        if len(state.req.generated) == 1:
+            # First completion only: a preempted request resumes through
+            # the prefill queue with generated tokens already recorded —
+            # its Nth token is not a TTFT sample (duplicate, oversized
+            # observations would inflate the replica-scoring
+            # percentiles).
+            ttft_ms = (time.monotonic() - state.req.admit_t) * 1e3
+            self.ttft_hist.observe(ttft_ms)
+            telemetry.observe("serving_ttft_ms", ttft_ms)
         self._first_tokens.append((state.req.request_id,
                                    state.req.generated[-1]))
         if state.req.finished:
             self.worker.release(state)
             self._finished_staged.append(state.req.request_id)
+            telemetry.inc("serving_requests_retired")
+            self._rt.finish(rid, "retire",
+                            generated=len(state.req.generated))
         else:
             self._parked.append(state)
+            self._rt.begin("handoff-parked", rid)
 
     # ---- main loop -------------------------------------------------------
     def step(self) -> Dict[str, List]:
@@ -681,6 +747,7 @@ class DisaggServingEngine:
                 self.slo_stats["decode_intervals"] += 1
                 self.slo_stats["worst_interval_ms"] = max(
                     self.slo_stats["worst_interval_ms"], interval * 1e3)
+                self.interval_hist.observe(interval * 1e3)
                 if (self.decode_slo_s is None
                         or interval <= self.decode_slo_s):
                     self.slo_stats["attained"] += 1
@@ -700,6 +767,8 @@ class DisaggServingEngine:
                         self.engine.waiting.remove(req)
                     except ValueError:
                         continue
+                    # (queued_t was already stamped by the engine's
+                    # _preempt; the move between queues is instant.)
                     self.waiting.append(req)
         t_decode_done = time.monotonic()
 
@@ -731,13 +800,29 @@ class DisaggServingEngine:
     def reset_compilation(self):
         self.engine.reset_compilation()
 
-    def stats_snapshot(self) -> Dict:
+    def stats_snapshot(self, include_dispatch: bool = False) -> Dict:
         """Engine snapshot + the disagg section: per-queue depths, SLO
-        attainment, handoff accounting (the /stats payload)."""
-        out = self.engine.stats_snapshot()
+        attainment (histogram-backed percentiles, ISSUE 12), handoff
+        accounting (the /stats payload). include_dispatch forwards to
+        the decode engine's compiled-dispatch accounting (ISSUE 11) —
+        the facade accepts the same kwarg as the plain engine, so the
+        server no longer TypeError-falls-back to a dispatch-less
+        snapshot."""
+        out = self.engine.stats_snapshot(include_dispatch=include_dispatch)
         out["engine"] = "disagg"
         s = dict(self.slo_stats)
         n = s["decode_intervals"]
+        ih, th = self.interval_hist, self.ttft_hist
+        if n:
+            # Percentiles estimated FROM the log-bucket histogram — the
+            # fleet-scale signal the single worst-interval scalar could
+            # not provide (worst_interval_ms stays for compatibility).
+            s["interval_p50_ms"] = round(ih.percentile(50), 3)
+            s["interval_p90_ms"] = round(ih.percentile(90), 3)
+            s["interval_p99_ms"] = round(ih.percentile(99), 3)
+        if th.count:
+            s["ttft_p50_ms"] = round(th.percentile(50), 3)
+            s["ttft_p99_ms"] = round(th.percentile(99), 3)
         out["disagg"] = {
             "prefill_devices": self.prefill_ctx.num_devices,
             "decode_devices": self.decode_ctx.num_devices,

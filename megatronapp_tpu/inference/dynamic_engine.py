@@ -47,6 +47,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import logging
+import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -60,8 +61,10 @@ from megatronapp_tpu.inference.engine import (
 )
 from megatronapp_tpu.inference.paged_cache import PagedKVCache, cdiv
 from megatronapp_tpu.models.gpt import gpt_embed, gpt_head, gpt_rope_tables
+from megatronapp_tpu.trace.request_trace import get_request_tracer
 from megatronapp_tpu.transformer.block import layer_forward
 from megatronapp_tpu.utils import chaos
+from megatronapp_tpu.utils import metrics as telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -127,6 +130,14 @@ class Request:
     slot: int = -1
     generated: list = dataclasses.field(default_factory=list)
     finished: bool = False
+    # Wall-clock admission time (time.monotonic()) — time-to-first-token
+    # telemetry measures from here (first admission only; a preempted
+    # request's resume is not a first token).
+    admit_t: float = 0.0
+    # When the request last ENTERED a queue (admission or re-queue after
+    # preemption/rollback) — queue-wait telemetry measures from here, so
+    # a resumed request's second wait doesn't include its first life.
+    queued_t: float = 0.0
     # Speculative-decoding stats (spec_method engines):
     spec_proposed: int = 0
     spec_accepted: int = 0
@@ -478,6 +489,11 @@ class DynamicInferenceEngine:
                                             self._params_sharding)
         else:
             self._params_sharding = None
+        # Telemetry (ISSUE 12): per-request lifecycle spans go to the
+        # singleton ring tracer (every call is one enabled check when
+        # tracing is off); counters/histograms to utils/metrics.
+        self._rt = get_request_tracer()
+        self._last_round_t: Optional[float] = None
         self.lengths = np.zeros((max_batch,), np.int32)
         self.last_tokens = np.zeros((max_batch, 1), np.int32)
         self.slots: List[Optional[Request]] = [None] * max_batch
@@ -636,11 +652,20 @@ class DynamicInferenceEngine:
                                     self.max_seq_len,
                                     pool=self.pool if self.paged else None,
                                     deadline_s=deadline_s)
+        now = time.monotonic()
         req = Request(next(self._ids), prompt, max_new_tokens,
                       sampling or SamplingParams(), eod_id=eod_id,
-                      priority=priority, deadline_s=deadline_s)
+                      priority=priority, deadline_s=deadline_s,
+                      admit_t=now, queued_t=now)
         self.waiting.append(req)
         self.requests[req.request_id] = req
+        telemetry.inc("serving_requests_admitted")
+        rt = self._rt
+        if rt.enabled:
+            rt.instant("admit", req.request_id,
+                       prompt_tokens=len(prompt), priority=priority)
+            rt.begin("request", req.request_id)
+            rt.begin("queue-wait", req.request_id)
         return req.request_id
 
     def pop_request(self, request_id: int) -> Optional[Request]:
@@ -661,12 +686,14 @@ class DynamicInferenceEngine:
                 pass    # raced with admission: treat as running below
             else:
                 req.finished = True
+                self._rt.finish(request_id, "abort")
                 return "waiting"
         if not req.finished:
             # Running — or mid-admission on the stepper thread (slot not
             # yet assigned): either way, marking finished retires it on
             # the next step, releasing its cache.
             req.finished = True
+            self._rt.instant("abort", request_id)
             return "running"
         return None
 
@@ -710,10 +737,16 @@ class DynamicInferenceEngine:
             req.finished = True
             self._aborted.append(req)    # finish event fires this step
             expired.append(req.request_id)
+            self._rt.finish(req.request_id, "expire")
         for req in self.slots:
             if req is not None and overdue(req):
                 req.finished = True      # retired (blocks released) below
                 expired.append(req.request_id)
+                # Spans close when the same step's retire pass reclaims
+                # the slot (the one finish funnel).
+                self._rt.instant("expire", req.request_id)
+        if expired:
+            telemetry.inc("serving_deadline_expired", len(expired))
         return expired
 
     def abort_all(self):
@@ -724,8 +757,14 @@ class DynamicInferenceEngine:
         slots without releasing would trip PagedKVCache.admit's
         slot-still-holds-blocks assert on the next request. Best-effort
         if the failure left pool bookkeeping itself inconsistent."""
+        # A crashed round never reached the point that refreshes
+        # _last_round_t — without this reset the first post-recovery
+        # round would observe the crash + backoff gap as a "token
+        # interval" and poison the histogram's tail.
+        self._last_round_t = None
         for req in list(self.waiting):
             self.requests.pop(req.request_id, None)
+            self._rt.finish(req.request_id, "abort")
         self.waiting.clear()
         for slot, req in enumerate(self.slots):
             if req is None:
@@ -738,6 +777,7 @@ class DynamicInferenceEngine:
                     pass
             self._free_slot(slot)
             self.requests.pop(req.request_id, None)
+            self._rt.finish(req.request_id, "abort")
 
     def _free_slot(self, slot: int):
         """Clear every per-slot engine resource (request ref, length,
@@ -797,6 +837,10 @@ class DynamicInferenceEngine:
         self.last_tokens[slot, 0] = req.generated[-1]
         if self.proposer is not None:
             self.proposer.on_admit(slot, req)
+        rt = self._rt
+        if rt.enabled:
+            rt.instant("adopt", req.request_id, slot=slot, length=length)
+            rt.begin("decode", req.request_id)
         return slot
 
     def _admit(self) -> List[Request]:
@@ -824,6 +868,12 @@ class DynamicInferenceEngine:
                     break
             req.slot = slot
             self.slots[slot] = req
+            rid = req.request_id
+            first_life = not req.generated   # vs resumed after preempt
+            self._rt.end("queue-wait", rid)
+            telemetry.observe("serving_queue_wait_ms",
+                              (time.monotonic() - req.queued_t) * 1e3)
+            self._rt.begin("prefill", rid, prompt_tokens=len(req.tokens))
             try:
                 self._prefill_into_slot(req, plan)
             except Exception:
@@ -839,8 +889,20 @@ class DynamicInferenceEngine:
                     self.pool.release(slot, np.asarray(req.tokens), 0)
                 self._free_slot(slot)
                 req.slot = -1
+                req.queued_t = time.monotonic()
                 self.waiting.appendleft(req)
+                self._rt.end("prefill", rid, error=True)
+                self._rt.begin("queue-wait", rid)   # requeued at the head
                 raise
+            self._rt.end("prefill", rid)
+            if first_life:
+                # TTFT is a first-token metric: a preempted request's
+                # resume prefill emits its Nth token, not its first —
+                # re-observing would inflate the percentiles the fleet
+                # router scores replicas by.
+                telemetry.observe("serving_ttft_ms",
+                                  (time.monotonic() - req.admit_t) * 1e3)
+            self._rt.begin("decode", rid)
             admitted.append(req)
         return admitted
 
@@ -1034,8 +1096,14 @@ class DynamicInferenceEngine:
                           int(self.lengths[slot]), preempted=True)
         self._free_slot(slot)
         req.slot = -1
+        req.queued_t = time.monotonic()
         self.waiting.appendleft(req)
         out.append(req)
+        rt = self._rt
+        if rt.enabled:
+            rt.end("decode", req.request_id)
+            rt.instant("preempt", req.request_id)
+            rt.begin("queue-wait", req.request_id)
 
     def _ensure_decode_capacity(self) -> List[Request]:
         """Before a decode step, every active slot needs the block that
@@ -1070,6 +1138,9 @@ class DynamicInferenceEngine:
                     self.pool.release(slot, np.asarray(req.tokens),
                                       int(self.lengths[slot]))
                 self._free_slot(slot)
+                telemetry.inc("serving_requests_retired")
+                self._rt.finish(req.request_id, "retire",
+                                generated=len(req.generated))
         return done
 
     # ---- main loop --------------------------------------------------------
@@ -1095,10 +1166,20 @@ class DynamicInferenceEngine:
         active = [r for r in self.slots
                   if r is not None and not r.finished]
         if active:
+            # Token-interval telemetry: back-to-back decode rounds only
+            # (an idle gap is not a token interval — same rule as the
+            # disagg coordinator's SLO accounting).
+            t_round = time.monotonic()
+            if self._last_round_t is not None:
+                telemetry.observe("decode_interval_ms",
+                                  (t_round - self._last_round_t) * 1e3)
             if self.spec_method:
                 self._spec_round(active, events)
             else:
                 self._plain_round(active, events)
+            self._last_round_t = time.monotonic()
+        else:
+            self._last_round_t = None
 
         events["finished"] = [r.request_id for r in self._retire()]
         events["finished"] += [r.request_id for r in self._aborted]
@@ -1107,32 +1188,39 @@ class DynamicInferenceEngine:
 
     def _plain_round(self, active: List[Request], events: Dict):
         """One-token decode for every active slot (non-speculative)."""
-        active_np = np.array(
-            [self.slots[i] is not None and not self.slots[i].finished
-             for i in range(self.max_batch)])
-        active_mask = jnp.asarray(active_np)
-        lengths = jnp.asarray(self.lengths)
-        if self.paged:
-            logits, new = self._decode(
-                self.params, jnp.asarray(self.last_tokens),
-                self.pool.pages, self.pool.scales,
-                jnp.asarray(self.pool.page_table[:self.max_batch]),
-                lengths, active_mask)
-            self._commit_pools(new)
-        else:
-            logits, self.cache = self._decode(
-                self.params, jnp.asarray(self.last_tokens), self.cache,
-                lengths, active_mask)
-        # The decode wrote each active row's kv at lengths[slot].
-        self.lengths += active_np.astype(np.int32)
-        logits = mask_padded_vocab(logits, self.cfg)
-        toks = self._sample_all(logits)
-        self.spec_stats["model_steps"] += 1
-        self.spec_stats["emitted_tokens"] += len(active)
-        for req in active:
-            tok = int(toks[req.slot])
-            self._record_token(req, tok)
-            events["tokens"].append((req.request_id, tok))
+        # try/finally like _spec_round's span: a failing step must not
+        # leak an orphan B that mis-pairs with a later round's E.
+        self._rt.begin("decode-step", None, batch=len(active))
+        try:
+            active_np = np.array(
+                [self.slots[i] is not None and not self.slots[i].finished
+                 for i in range(self.max_batch)])
+            active_mask = jnp.asarray(active_np)
+            lengths = jnp.asarray(self.lengths)
+            if self.paged:
+                logits, new = self._decode(
+                    self.params, jnp.asarray(self.last_tokens),
+                    self.pool.pages, self.pool.scales,
+                    jnp.asarray(self.pool.page_table[:self.max_batch]),
+                    lengths, active_mask)
+                self._commit_pools(new)
+            else:
+                logits, self.cache = self._decode(
+                    self.params, jnp.asarray(self.last_tokens), self.cache,
+                    lengths, active_mask)
+            # The decode wrote each active row's kv at lengths[slot].
+            self.lengths += active_np.astype(np.int32)
+            logits = mask_padded_vocab(logits, self.cfg)
+            toks = self._sample_all(logits)
+            self.spec_stats["model_steps"] += 1
+            self.spec_stats["emitted_tokens"] += len(active)
+            telemetry.inc("serving_tokens_emitted", len(active))
+            for req in active:
+                tok = int(toks[req.slot])
+                self._record_token(req, tok)
+                events["tokens"].append((req.request_id, tok))
+        finally:
+            self._rt.end("decode-step", None)
 
     def _spec_round(self, active: List[Request], events: Dict):
         """One speculate+verify round: propose up to spec_k drafts per
@@ -1155,6 +1243,7 @@ class DynamicInferenceEngine:
                 k_caps[slot] = self.pool.extend_capacity(
                     slot, length + 1, want)
 
+        self._rt.begin("spec-round", None, batch=len(active))
         try:
             self._spec_round_inner(active, events, k_caps)
         except Exception:
@@ -1172,6 +1261,8 @@ class DynamicInferenceEngine:
                     self.pool.rewind(req.slot,
                                      int(self.lengths[req.slot]) + 1)
             raise
+        finally:
+            self._rt.end("spec-round", None)
 
     def _spec_round_inner(self, active: List[Request], events: Dict,
                           k_caps: np.ndarray):
@@ -1258,6 +1349,14 @@ class DynamicInferenceEngine:
             self.spec_stats["proposed"] += n
             self.spec_stats["accepted"] += a
             self.spec_stats["emitted_tokens"] += m
+            # Acceptance histogram (ISSUE 12): accepted drafts per
+            # verify round, per request row — /metrics percentiles show
+            # the acceptance DISTRIBUTION, not just the mean rate.
+            telemetry.observe("spec_accepted_per_round", a,
+                              lo=0.5, hi=64, growth=1.5)
+            telemetry.inc("spec_proposed_tokens", n)
+            telemetry.inc("spec_accepted_tokens", a)
+            telemetry.inc("serving_tokens_emitted", m)
             self.proposer.on_verified(slot, a)
 
     def run_to_completion(self,

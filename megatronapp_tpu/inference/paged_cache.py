@@ -46,6 +46,7 @@ import numpy as np
 
 from megatronapp_tpu.config.transformer_config import TransformerConfig
 from megatronapp_tpu.utils import chaos
+from megatronapp_tpu.utils import metrics as telemetry
 
 
 def cdiv(a: int, b: int) -> int:
@@ -194,6 +195,7 @@ class PagedKVCache:
             if key is not None and self._table.get(key) == blk:
                 del self._table[key]
             self.stats["evictions"] += 1
+            telemetry.inc("paged_evictions")
             return blk
         return None
 
@@ -221,6 +223,7 @@ class PagedKVCache:
             self.scales = tuple(s.at[:, dst].set(s[:, src])
                                 for s in self.scales)
         self.stats["cow_copies"] += 1
+        telemetry.inc("paged_cow_copies")
 
     def _note_usage(self):
         self.stats["peak_blocks_in_use"] = max(
@@ -305,6 +308,8 @@ class PagedKVCache:
         self.page_table[slot, :len(blocks)] = blocks
         self.stats["prefix_hit_tokens"] += cached
         self.stats["prefill_tokens"] += p_len - cached
+        telemetry.inc("paged_prefix_hit_tokens", cached)
+        telemetry.inc("paged_prefill_tokens", p_len - cached)
         self._note_usage()
         return AdmitPlan(blocks, cached, cow)
 
@@ -451,3 +456,4 @@ class PagedKVCache:
         self.page_table[slot, :] = 0
         if preempted:
             self.stats["preemptions"] += 1
+            telemetry.inc("paged_preemptions")

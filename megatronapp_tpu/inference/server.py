@@ -46,7 +46,9 @@ from megatronapp_tpu.inference.dynamic_engine import DeadlineExceeded
 from megatronapp_tpu.inference.engine import (
     SamplingParams, StaticInferenceEngine,
 )
+from megatronapp_tpu.trace.request_trace import get_request_tracer
 from megatronapp_tpu.utils import chaos
+from megatronapp_tpu.utils import metrics as telemetry
 
 
 class _ClientGone(Exception):
@@ -123,6 +125,7 @@ class DynamicBatchingDriver:
         if timeout_s is not None:
             if timeout_s <= 0:
                 self.deadline_expired += 1
+                telemetry.inc("serving_deadline_expired")
                 raise DeadlineExceeded(
                     "request deadline expired at admission "
                     f"(timeout_s={timeout_s})")
@@ -219,6 +222,7 @@ class DynamicBatchingDriver:
             except Exception as e:  # noqa: BLE001 — broadcast & reset
                 self.restarts += 1
                 self.consecutive_failures += 1
+                telemetry.inc("serving_step_failures")
                 with self._cv:
                     for rid, sub in self._subs.items():
                         self._errors[rid] = e
@@ -245,6 +249,8 @@ class DynamicBatchingDriver:
                 # BEFORE the generic finished handling pops their sub
                 # (their pool blocks were reclaimed by the step's retire
                 # pass).
+                # (the engine's expiry sweep already counted these into
+                # the telemetry registry — only driver bookkeeping here)
                 for rid in ev.get("expired", ()):
                     if rid in self._subs:
                         self.deadline_expired += 1
@@ -624,10 +630,10 @@ class TextGenerationServer:
         mamba engines report what exists for them."""
         eng = self.engine
         if hasattr(eng, "stats_snapshot"):
-            try:
-                out = eng.stats_snapshot(include_dispatch=True)
-            except TypeError:   # coordinator facades without the kwarg
-                out = eng.stats_snapshot()
+            # Both the plain engine and the disagg facade accept
+            # include_dispatch (ISSUE 12 satellite: the facade used to
+            # TypeError here, silently dropping dispatch stats).
+            out = eng.stats_snapshot(include_dispatch=True)
         else:
             out = {"engine": type(eng).__name__.replace(
                 "InferenceEngine", "").lower()}
@@ -695,6 +701,73 @@ class TextGenerationServer:
             else 200)
 
     # ------------------------------------------------------------------
+    def _export_live_gauges(self):
+        """Point-in-time gauges refreshed at scrape time (counters and
+        histograms accumulate at the instrumented sites; queue depths
+        and pool occupancy are state, not events)."""
+        eng = self.engine
+        if hasattr(eng, "slots"):
+            telemetry.set_gauge("serving_active_slots", sum(
+                1 for r in eng.slots if r is not None))
+        if hasattr(eng, "waiting"):
+            telemetry.set_gauge("serving_waiting", len(eng.waiting))
+        pool = getattr(eng, "pool", None)
+        if pool is not None:
+            telemetry.set_gauge("paged_blocks_in_use",
+                                pool.blocks_in_use())
+            telemetry.set_gauge("paged_blocks_free", pool.free_blocks())
+            telemetry.set_gauge("paged_blocks_evictable",
+                                pool.evictable_blocks())
+        if self._driver is not None:
+            st = self._driver.stats()
+            telemetry.set_gauge("serving_stepper_alive",
+                                int(st["alive"]))
+            telemetry.set_gauge("serving_stepper_restarts",
+                                st["restarts"] + st["thread_restarts"])
+
+    def metrics_text(self) -> str:
+        """Prometheus text for GET /metrics (also the driver-side dump
+        hook — callers can scrape without an HTTP round-trip)."""
+        if telemetry.enabled():
+            self._export_live_gauges()
+        return telemetry.render_prometheus()
+
+    async def handle_metrics(self, request):
+        """GET /metrics: Prometheus text exposition of the telemetry
+        registry (enable with --serving-metrics / MEGATRON_METRICS=1;
+        a disabled registry serves a one-line comment, not a 404, so
+        scrapers keep a stable target)."""
+        from aiohttp import web
+        return web.Response(text=self.metrics_text(),
+                            content_type="text/plain")
+
+    # ------------------------------------------------------------------
+    def dump_request_trace(self, path: Optional[str] = None) -> dict:
+        """Driver hook: render the request-trace ring as one merged
+        Chrome trace (prefill + decode mesh rows); optionally write it
+        to `path` for chrome://tracing / Perfetto."""
+        trace = get_request_tracer().chrome_trace()
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(trace, f)
+        return trace
+
+    async def handle_trace(self, request):
+        """GET /trace: the per-request lifecycle ring as a Chrome trace
+        JSON (enable with --request-trace / MEGATRON_REQUEST_TRACE=1).
+        Server-side file dumps go through the dump_request_trace driver
+        hook — a client-supplied path here would be an arbitrary-file-
+        write primitive on an unauthenticated endpoint."""
+        from aiohttp import web
+        rt = get_request_tracer()
+        if not rt.enabled:
+            return web.json_response(
+                {"message": "request tracing disabled — enable with "
+                            "--request-trace or MEGATRON_REQUEST_TRACE=1"},
+                status=404)
+        return web.json_response(self.dump_request_trace())
+
+    # ------------------------------------------------------------------
     def build_app(self):
         from aiohttp import web
         app = web.Application()
@@ -702,6 +775,8 @@ class TextGenerationServer:
         app.router.add_post("/api", self.handle_api)
         app.router.add_get("/stats", self.handle_stats)
         app.router.add_get("/healthz", self.handle_healthz)
+        app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/trace", self.handle_trace)
         app.router.add_get("/ws", self.handle_ws)
         return app
 

@@ -510,6 +510,7 @@ def make_proposer(method: str, engine, draft_params=None, draft_cfg=None,
                   **kwargs) -> Optional[Proposer]:
     """Build the requested proposer, or None (with a warning) when it is
     unavailable — the engine then falls back to plain decode."""
+    from megatronapp_tpu.utils import metrics as telemetry
     if method == "ngram":
         return NGramProposer(engine, **kwargs)
     if method == "mtp":
@@ -518,6 +519,7 @@ def make_proposer(method: str, engine, draft_params=None, draft_cfg=None,
                 "spec_method='mtp' requested but the model has no MTP "
                 "depth modules (cfg.mtp_num_layers == 0 or params lack "
                 "'mtp') — falling back to plain decode", stacklevel=2)
+            telemetry.inc("spec_proposer_fallbacks")
             return None
         return MTPProposer(engine)
     if method == "draft":
@@ -525,6 +527,7 @@ def make_proposer(method: str, engine, draft_params=None, draft_cfg=None,
             warnings.warn(
                 "spec_method='draft' requested without draft_params/"
                 "draft_cfg — falling back to plain decode", stacklevel=2)
+            telemetry.inc("spec_proposer_fallbacks")
             return None
         return DraftModelProposer(engine, draft_params, draft_cfg)
     raise ValueError(f"unknown spec_method {method!r} "

@@ -1,0 +1,203 @@
+"""Per-request lifecycle tracing: an always-on bounded ring of B/E spans.
+
+The MegaScan tracer (trace/tracer.py) is iteration-window-gated — right
+for training, useless for serving, where the interesting timeline is a
+REQUEST's: admit → queue wait → prefill chunks → parked/handoff → adopt
+→ decode steps → spec rounds → retire/expire/abort/preempt. This module
+is the serving-side counterpart (ISSUE 12): a singleton ring-buffer
+tracer that the engines emit Chrome-trace-style B/E/i records into,
+bounded by ``capacity`` (old records fall off — tracing can stay ON in
+production), with the SAME record schema as tracer.py so the existing
+aggregation machinery (trace/aggregate.py: B/E→X pairing, Chrome trace
+metadata) renders it.
+
+Timeline layout:
+
+- ``pid`` is the LOGICAL mesh/component: ``DECODE_PID`` (0) for the
+  engine/decode side, ``PREFILL_PID`` (1) for the disaggregated prefill
+  worker — a disagg request's prefill chunks and its decode lifetime
+  merge into ONE Chrome trace with one process row per mesh.
+- ``tid`` is the request id + 1 for per-request spans (each request gets
+  its own timeline row; B/E pairing in aggregate.py keys on
+  (pid, tid, name), so concurrent requests never mis-pair), and 0 for
+  step-granularity spans (decode-step, spec-round).
+
+Pairing is guaranteed by construction: ``end()`` is a no-op unless that
+span is open (no orphan E), and ``finish()`` closes every span a
+request still has open (retire/expire/abort paths all funnel through
+it — no orphan B). tests/test_metrics.py pins every-B-has-a-matching-E
+across the full lifecycle including expire and preempt.
+
+The disabled path is one attribute truthiness check per call site.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+DECODE_PID = 0      # engine / decode sub-mesh timeline
+PREFILL_PID = 1     # disaggregated prefill sub-mesh timeline
+
+_PROCESS_NAMES = {DECODE_PID: "decode-mesh", PREFILL_PID: "prefill-mesh"}
+
+
+class RequestTracer:
+    """Bounded always-on request-lifecycle tracer (singleton via
+    get_request_tracer)."""
+
+    def __init__(self, capacity: int = 16384):
+        self.enabled = False
+        self.capacity = capacity
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        # rid -> [(pid, name), ...] open spans, innermost last.
+        self._open: Dict[int, List[tuple]] = {}
+        self._t0 = time.perf_counter_ns()
+
+    # -- configuration -----------------------------------------------------
+    def configure(self, enabled: bool = True,
+                  capacity: Optional[int] = None):
+        with self._lock:
+            self.enabled = enabled
+            if capacity is not None and capacity != self.capacity:
+                self.capacity = capacity
+                self._ring = deque(self._ring, maxlen=capacity)
+
+    def reset(self):
+        """Drop all records and open-span state (tests; fresh epochs)."""
+        with self._lock:
+            self._ring.clear()
+            self._open.clear()
+            self._t0 = time.perf_counter_ns()
+
+    def _ts_us(self) -> float:
+        return (time.perf_counter_ns() - self._t0) / 1e3
+
+    # -- emission ----------------------------------------------------------
+    def _emit(self, name: str, ph: str, rid: Optional[int], pid: int,
+              attrs: Dict[str, Any]):
+        rec = {
+            "name": name, "ph": ph, "ts": self._ts_us(),
+            "pid": pid,
+            "tid": 0 if rid is None else rid + 1,
+            "iteration": 0,
+            "args": dict(attrs, rid=rid) if rid is not None else dict(attrs),
+        }
+        with self._lock:
+            self._ring.append(rec)
+
+    def begin(self, name: str, rid: Optional[int],
+              pid: int = DECODE_PID, **attrs):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._open.setdefault(rid, []).append((pid, name))
+        self._emit(name, "B", rid, pid, attrs)
+
+    def end(self, name: str, rid: Optional[int],
+            pid: int = DECODE_PID, **attrs):
+        """Close an open span. Tolerant: a no-op when `name` is not open
+        for `rid` — the lifecycle paths overlap (abort during prefill,
+        expire while parked) and an orphan E would corrupt B/E pairing
+        downstream."""
+        if not self.enabled:
+            return
+        with self._lock:
+            spans = self._open.get(rid)
+            if not spans or (pid, name) not in spans:
+                return
+            # Remove the innermost matching occurrence.
+            for i in range(len(spans) - 1, -1, -1):
+                if spans[i] == (pid, name):
+                    del spans[i]
+                    break
+            if not spans:
+                self._open.pop(rid, None)
+        self._emit(name, "E", rid, pid, attrs)
+
+    def instant(self, name: str, rid: Optional[int] = None,
+                pid: int = DECODE_PID, **attrs):
+        if not self.enabled:
+            return
+        self._emit(name, "i", rid, pid, attrs)
+
+    def finish(self, rid: int, reason: Optional[str] = None, **attrs):
+        """Terminal event for a request: optional instant `reason`
+        (retire/expire/abort) then close EVERY span it still has open,
+        innermost first — the one funnel that guarantees no orphan B on
+        any exit path."""
+        if not self.enabled:
+            return
+        if reason is not None:
+            self._emit(reason, "i", rid, DECODE_PID, attrs)
+        with self._lock:
+            spans = self._open.pop(rid, [])
+        for pid, name in reversed(spans):
+            self._emit(name, "E", rid, pid, {})
+
+    # -- export ------------------------------------------------------------
+    def dump(self) -> List[dict]:
+        """Ring contents, oldest first (records stay in the ring)."""
+        with self._lock:
+            return list(self._ring)
+
+    def _windowed_records(self) -> List[dict]:
+        """Records wrapped in a synthetic single-iteration window per
+        pid, so trace/aggregate.py's iteration-stitching machinery
+        (which keys offsets on 'iteration' B/E spans) accepts a serving
+        trace as one window."""
+        recs = self.dump()
+        if not recs:
+            return []
+        t_end = max(r["ts"] for r in recs) + 1.0
+        out = []
+        for pid in sorted({r["pid"] for r in recs}):
+            out.append({"name": "iteration", "ph": "B", "ts": 0.0,
+                        "pid": pid, "tid": 0, "iteration": 0, "args": {}})
+        out.extend(recs)
+        for pid in sorted({r["pid"] for r in recs}):
+            out.append({"name": "iteration", "ph": "E", "ts": t_end,
+                        "pid": pid, "tid": 0, "iteration": 0, "args": {}})
+        return out
+
+    def chrome_trace(self, process_names: Optional[Dict[int, str]] = None
+                     ) -> dict:
+        """Render the ring as one merged Chrome trace through the
+        existing aggregation machinery (B/E→X pairing + process
+        metadata) — prefill-mesh and decode-mesh events land as separate
+        process rows of the SAME trace."""
+        from megatronapp_tpu.trace.aggregate import (
+            chrome_trace as _chrome, transform_to_complete_events,
+        )
+        recs = sorted(self._windowed_records(),
+                      key=lambda r: (r["ts"], r["pid"]))
+        events = transform_to_complete_events(recs)
+        return _chrome(events, process_names or _PROCESS_NAMES)
+
+    def save(self, path: Optional[str] = None, trace_dir: str = "trace"
+             ) -> str:
+        """Write the ring as a benchmark-data-*.json file compatible
+        with `python -m megatronapp_tpu.trace.aggregate -b DIR`, so
+        serving request traces stitch offline next to training traces."""
+        if path is None:
+            os.makedirs(trace_dir, exist_ok=True)
+            path = os.path.join(trace_dir, "benchmark-data-requests.json")
+        with open(path, "w") as f:
+            json.dump(self._windowed_records(), f)
+        return path
+
+
+_TRACER = RequestTracer()
+
+
+def get_request_tracer() -> RequestTracer:
+    return _TRACER
+
+
+if os.environ.get("MEGATRON_REQUEST_TRACE"):
+    _TRACER.configure(enabled=True)

@@ -38,6 +38,7 @@ from megatronapp_tpu.training.train_step import (
     globalize_batch, make_train_step,
 )
 from megatronapp_tpu.trace.tracer import get_tracer
+from megatronapp_tpu.utils import metrics as telemetry
 from megatronapp_tpu.utils.flops import flops_per_token
 
 
@@ -808,6 +809,13 @@ def pretrain_gpt(
                     "step_time_ms": step_time_ms,
                     "tflops_per_device": tflops,
                 })
+                # Telemetry registry (ISSUE 12): step-time histogram +
+                # throughput gauge land in the SAME registry the serving
+                # stack exports at /metrics — one signal substrate.
+                telemetry.observe("train_step_time_ms", step_time_ms,
+                                  lo=1e-2, hi=1e7)
+                telemetry.set_gauge("train_tokens_per_sec",
+                                    round(tokens_per_sec, 1))
                 e2e.track_iterations(
                     steps_in_window, dt,
                     window_tokens // train_cfg.seq_length)

@@ -17,6 +17,8 @@ import time
 from collections import deque
 from typing import Deque, List, Optional
 
+from megatronapp_tpu.utils import metrics as telemetry
+
 
 @dataclasses.dataclass
 class StepRecord:
@@ -63,9 +65,17 @@ class StragglerDetector:
             mean = sum(times) / len(times)
             var = sum((t - mean) ** 2 for t in times) / len(times)
             std = var ** 0.5
-            if std > 0 and (elapsed - mean) / std > self.z_threshold:
-                self.flagged.append(rec)
-                outlier = rec
+            if std > 0:
+                z = (elapsed - mean) / std
+                # z-score into the shared telemetry registry (ISSUE 12):
+                # the straggler signal becomes scrapeable at /metrics
+                # alongside the step-time histogram, instead of living
+                # only in the log line.
+                telemetry.set_gauge("train_straggler_z", round(z, 4))
+                if z > self.z_threshold:
+                    telemetry.inc("train_straggler_flags")
+                    self.flagged.append(rec)
+                    outlier = rec
         # Outliers are excluded from the baseline window.
         if outlier is None:
             self.window.append(rec)

@@ -147,23 +147,40 @@ class TestPagedAllocatorChaos:
                             num_blocks=num_blocks, block_size=block_size)
 
     def test_eviction_fault_rolls_back_admit(self):
-        pool = self._pool(num_blocks=4, block_size=4)
-        toks_a = np.arange(16, dtype=np.int32)
-        plan = pool.admit(0, toks_a)            # takes all 4 blocks
-        assert plan is not None
-        pool.release(0, toks_a, 16)             # full blocks → hashed LRU
-        assert pool.evictable_blocks() == 4 and pool.free_blocks() == 0
+        # Telemetry registry on (ISSUE 12 satellite): the drill and the
+        # observability layer verify each other — the injected fault
+        # fires BEFORE the eviction mutates anything, so the eviction
+        # counter must NOT move on the fault, and must count exactly the
+        # recovery's real evictions after.
+        from megatronapp_tpu.utils import metrics
+        metrics.disable()
+        metrics.enable()
+        try:
+            pool = self._pool(num_blocks=4, block_size=4)
+            toks_a = np.arange(16, dtype=np.int32)
+            plan = pool.admit(0, toks_a)        # takes all 4 blocks
+            assert plan is not None
+            pool.release(0, toks_a, 16)         # full blocks → hashed LRU
+            assert pool.evictable_blocks() == 4 and pool.free_blocks() == 0
 
-        toks_b = np.arange(100, 116, dtype=np.int32)
-        chaos.arm("paged-evict", times=1)
-        with pytest.raises(chaos.ChaosFault):
-            pool.admit(0, toks_b)               # needs an eviction
-        pool.audit()                            # nothing leaked
-        assert pool.blocks_in_use() == 0
-        # Recovery: the same admit succeeds once the fault is spent.
-        plan = pool.admit(0, toks_b)
-        assert plan is not None and plan.cached_tokens == 0
-        pool.audit()
+            toks_b = np.arange(100, 116, dtype=np.int32)
+            chaos.arm("paged-evict", times=1)
+            with pytest.raises(chaos.ChaosFault):
+                pool.admit(0, toks_b)           # needs an eviction
+            pool.audit()                        # nothing leaked
+            assert pool.blocks_in_use() == 0
+            assert metrics.counter_value("paged_evictions") == 0, (
+                "fault fired before the eviction — nothing to count")
+            # Recovery: the same admit succeeds once the fault is spent.
+            plan = pool.admit(0, toks_b)
+            assert plan is not None and plan.cached_tokens == 0
+            pool.audit()
+            assert metrics.counter_value("paged_evictions") == 4, (
+                "recovery evicted all 4 LRU blocks — the telemetry "
+                "counter must agree with pool.stats")
+            assert pool.stats["evictions"] == 4
+        finally:
+            metrics.disable()
 
     def test_cow_fault_rolls_back_cached_refs(self):
         pool = self._pool(num_blocks=6, block_size=4)
@@ -875,22 +892,36 @@ class TestServingSelfHealing:
         drv = srv._driver
         drv.crash_backoff_base = 0.01
 
-        chaos.arm("stepper-step", times=1)
-        # Hold the driver's cv (an RLock) across both submits so the
-        # stepper can't consume the armed fault between them — the
-        # crash must land with BOTH requests in flight.
-        with drv._cv:
-            r1, d1 = drv.submit(np.asarray([1, 2, 3], np.int32), 4,
-                                SamplingParams(greedy=True))
-            r2, d2 = drv.submit(np.asarray([4, 5], np.int32), 4,
-                                SamplingParams(greedy=True))
-        assert d1.wait(120) and d2.wait(120)
-        for rid in (r1, r2):
-            with pytest.raises(chaos.ChaosFault):
-                drv.result_tokens(rid)
-        assert eng.pool.audit()            # blocks reclaimed
-        assert drv.restarts == 1
-        assert drv.consecutive_failures == 1
+        # Telemetry registry on (ISSUE 12 satellite): the watchdog's
+        # step-failure must land in the registry counter too; try/finally
+        # so a failing drill assertion can't leak the process-global
+        # registry into later tests.
+        from megatronapp_tpu.utils import metrics
+        metrics.disable()
+        metrics.enable()
+        try:
+            chaos.arm("stepper-step", times=1)
+            # Hold the driver's cv (an RLock) across both submits so the
+            # stepper can't consume the armed fault between them — the
+            # crash must land with BOTH requests in flight.
+            with drv._cv:
+                r1, d1 = drv.submit(np.asarray([1, 2, 3], np.int32), 4,
+                                    SamplingParams(greedy=True))
+                r2, d2 = drv.submit(np.asarray([4, 5], np.int32), 4,
+                                    SamplingParams(greedy=True))
+            assert d1.wait(120) and d2.wait(120)
+            for rid in (r1, r2):
+                with pytest.raises(chaos.ChaosFault):
+                    drv.result_tokens(rid)
+            assert eng.pool.audit()            # blocks reclaimed
+            assert drv.restarts == 1
+            assert drv.consecutive_failures == 1
+            # Fault injection and observability verified against each
+            # other: exactly one injected crash → exactly one counted
+            # step failure in the telemetry registry.
+            assert metrics.counter_value("serving_step_failures") == 1
+        finally:
+            metrics.disable()
 
         # Self-healed: the next request decodes normally and clears the
         # failure streak.

@@ -58,6 +58,23 @@ def main():
     add_serving_args(ap)
     args = ap.parse_args()
 
+    # Telemetry opt-in BEFORE engine construction, so admission-time
+    # counters and the first prefill spans are captured (ISSUE 12).
+    if args.serving_metrics:
+        from megatronapp_tpu.utils import metrics as telemetry
+        telemetry.enable()
+        print("telemetry registry enabled — GET /metrics serves "
+              "Prometheus text")
+    if args.request_trace:
+        from megatronapp_tpu.trace.request_trace import (
+            get_request_tracer,
+        )
+        get_request_tracer().configure(
+            enabled=True, capacity=args.request_trace_capacity)
+        print(f"request tracing enabled (ring capacity "
+              f"{args.request_trace_capacity}) — GET /trace serves a "
+              "merged Chrome trace")
+
     cfg = PRESETS[args.preset]()
     validate_serving_args(
         args, multi_latent_attention=cfg.multi_latent_attention)
