@@ -200,6 +200,17 @@ def _dist_opt_hook():
     return r if r.get("memory") else None
 
 
+def _fp8_hook():
+    """fp8 end-to-end A/B (tools/fp8_benchmark.py) on the CPU backend —
+    fp8-vs-bf16 training loss parity on the tp2 rings, the compiled
+    collective-permute byte ratio, and the fp8 KV-pool byte/parity
+    gates tracked round over round like the other hooks."""
+    if os.environ.get("BENCH_FP8", "1") != "1":
+        return None
+    r = _run_child("--fp8", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("train") else None
+
+
 def _attach_overlap_hooks(res):
     """Attach the tp-overlap, cp/a2a, pp×tp, dist-opt, paged-kv, and
     spec-decode A/B results to a round record."""
@@ -233,6 +244,9 @@ def _attach_overlap_hooks(res):
     tel = _telemetry_hook()
     if tel:
         res.setdefault("extra", {})["telemetry"] = tel
+    f8 = _fp8_hook()
+    if f8:
+        res.setdefault("extra", {})["fp8"] = f8
     return res
 
 
@@ -307,6 +321,7 @@ def parent_main(local_only: bool = False):
     kvq = _kv_quant_hook()
     mkd = _megakernel_hook()
     tel = _telemetry_hook()
+    f8 = _fp8_hook()
     last = _load_last_good()
     if last is not None:
         # Top-level `stale` so the consumer can verifiably distinguish this
@@ -341,6 +356,8 @@ def parent_main(local_only: bool = False):
             last["extra"]["megakernel"] = mkd
         if tel:
             last["extra"]["telemetry"] = tel
+        if f8:
+            last["extra"]["fp8"] = f8
         print(json.dumps(last))
         return
     if cpu:
@@ -365,6 +382,8 @@ def parent_main(local_only: bool = False):
             cpu.setdefault("extra", {})["megakernel"] = mkd
         if tel:
             cpu.setdefault("extra", {})["telemetry"] = tel
+        if f8:
+            cpu.setdefault("extra", {})["fp8"] = f8
         print(json.dumps(cpu))
         return
     print(json.dumps({
@@ -518,6 +537,12 @@ def telemetry_main():
                          repeats=3)))
 
 
+def fp8_main():
+    """fp8 training + KV A/B child (CPU env set by the parent)."""
+    from tools.fp8_benchmark import run
+    print(json.dumps(run(iters=6, max_new=6)))
+
+
 def disagg_main():
     """colocated-vs-disaggregated serving A/B child (CPU env set by the
     parent; virtual sub-mesh devices set here, pre-jax-import)."""
@@ -665,5 +690,7 @@ if __name__ == "__main__":
         megakernel_main()
     elif "--telemetry" in sys.argv:
         telemetry_main()
+    elif "--fp8" in sys.argv:
+        fp8_main()
     else:
         parent_main(local_only="--local" in sys.argv)

@@ -53,15 +53,20 @@ def add_serving_args(ap: argparse.ArgumentParser):
     g.add_argument("--no-prefix-caching", action="store_false",
                    dest="prefix_caching",
                    help="disable refcounted shared-prefix block reuse")
-    # Quantized serving (ISSUE 10).
-    g.add_argument("--kv-cache-dtype", choices=["bf16", "int8"],
+    # Quantized serving (ISSUE 10 int8, ISSUE 13 fp8). Choices AND help
+    # derive from the shared KV_CACHE_DTYPES registry
+    # (inference/paged_cache.py) — the flag, the server validation, and
+    # the pool check cannot drift apart.
+    from megatronapp_tpu.inference.paged_cache import (
+        KV_CACHE_DTYPES, kv_cache_dtype_help,
+    )
+    g.add_argument("--kv-cache-dtype", choices=sorted(KV_CACHE_DTYPES),
                    default="bf16",
-                   help="paged KV-pool storage dtype: int8 stores pages "
-                        "quantized per (row, kv-head) with fp32 scales "
-                        "alongside — ~(D+4)/2D of the bf16 pool bytes, "
-                        "dequantized in-kernel on each DMA'd block "
-                        "(needs --paged-kv-cache; MLA latent pools are "
-                        "bf16-only)")
+                   help="paged KV-pool storage dtype — "
+                        + kv_cache_dtype_help()
+                        + " (quantized dtypes need --paged-kv-cache; "
+                        "MLA latent pools are bf16-only; quantized "
+                        "pools cost ~(D+4)/2D of the bf16 bytes)")
     g.add_argument("--megakernel-decode", action="store_true",
                    help="fused (megakernel) decode step (ISSUE 11, "
                         "ops/pallas/kernel_gen.py): the per-token layer "
@@ -157,18 +162,20 @@ def validate_serving_args(args, multi_latent_attention: bool = False):
     source of truth for every entry point consuming add_serving_args) —
     reject impossible configs with an actionable message instead of a
     deep stack trace at engine construction."""
-    if getattr(args, "kv_cache_dtype", "bf16") == "int8":
-        if not getattr(args, "paged_kv_cache", False):
-            raise SystemExit(
-                "--kv-cache-dtype int8 requires --paged-kv-cache (the "
-                "per-block quantization scales live alongside the block "
-                "pool; the dense slot cache has no block structure)")
-        if multi_latent_attention:
-            raise SystemExit(
-                "--kv-cache-dtype int8 is not supported for MLA "
-                "presets: the latent pool is already a compressed "
-                "representation and stays bf16-only for now — drop "
-                "--kv-cache-dtype int8 or pick a non-MLA preset")
+    # kv_cache_dtype validation shares the pool's registry messages
+    # (inference/paged_cache.py validate_kv_cache_dtype), so the flag
+    # help, this parse-time check, and the pool constructor agree by
+    # construction (ISSUE 13 satellite).
+    from megatronapp_tpu.inference.paged_cache import (
+        validate_kv_cache_dtype,
+    )
+    try:
+        validate_kv_cache_dtype(
+            getattr(args, "kv_cache_dtype", "bf16"),
+            paged=getattr(args, "paged_kv_cache", False),
+            mla=multi_latent_attention)
+    except ValueError as e:
+        raise SystemExit(str(e))
     if getattr(args, "megakernel_decode", False):
         if getattr(args, "engine", "static") != "dynamic":
             raise SystemExit(
@@ -382,6 +389,23 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     g.add_argument("--bf16", action="store_true", default=True)
     g.add_argument("--fp32", action="store_true",
                    help="disable bf16 compute")
+    # fp8 training GEMMs (ISSUE 13, training/fp8.py).
+    g.add_argument("--fp8", action="store_true",
+                   help="fp8 (e4m3) GEMMs with delayed-scaling amax "
+                        "history inside the tp-overlap ring matmuls "
+                        "(fwd + bwd; parallel/overlap.py). Requires "
+                        "--tp-comm-overlap with tp > 1 on a pp==1, "
+                        "cp==1, dense non-MLA/non-MoE layout; the amax/"
+                        "scale state rides the train state, so "
+                        "checkpoints resume bitwise")
+    g.add_argument("--fp8-margin", type=int, default=0,
+                   help="delayed-scaling margin: scale = FP8_MAX / "
+                        "(amax * 2**margin) — headroom against "
+                        "inter-step amax growth (TE --fp8-margin)")
+    g.add_argument("--fp8-amax-history-len", type=int, default=16,
+                   help="amax history window per (layer, site, tensor); "
+                        "the scale follows the max over the window "
+                        "(TE --fp8-amax-history-len)")
 
     g = ap.add_argument_group("learning-rate")  # _add_learning_rate_args
     g.add_argument("--lr", type=float, default=3e-4)
@@ -787,6 +811,16 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
             heterogeneous_layers_config_json=_hetero_json(args),
         )
 
+    if getattr(args, "fp8", False):
+        import dataclasses as _dc_fp8
+        if args.fp8_amax_history_len < 1:
+            raise ValueError(
+                f"--fp8-amax-history-len must be >= 1, got "
+                f"{args.fp8_amax_history_len}")
+        model = _dc_fp8.replace(
+            model, fp8=True, fp8_margin=args.fp8_margin,
+            fp8_amax_history_len=args.fp8_amax_history_len)
+
     vpp = 1
     if args.num_layers_per_virtual_pipeline_stage:
         per_stage = (model.num_layers //
@@ -809,6 +843,15 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
         pipeline_order_policy="bfc" if args.use_dpp else "dfc",
         use_dpp=args.use_dpp,
     )
+
+    # fp8 eligibility (ISSUE 13): reject impossible layouts at parse
+    # time with the predicate that failed (training/fp8.py names it) —
+    # a silent no-op fp8 run would be worse than an error.
+    if model.fp8:
+        from megatronapp_tpu.training.fp8 import fp8_ineligible_reason
+        reason = fp8_ineligible_reason(model, parallel)
+        if reason is not None:
+            raise ValueError(reason)
 
     # Cross-validation (reference validate_args: seq/cp divisibility :695).
     if args.seq_length % (args.context_parallel_size or 1) != 0:

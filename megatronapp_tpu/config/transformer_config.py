@@ -215,6 +215,20 @@ class TransformerConfig:
     # counts, packed segments) silently keep the standard kernels.
     flash_head_fold: bool = False
 
+    # fp8 (e4m3) training GEMMs with delayed-scaling amax history
+    # (ISSUE 13, --fp8): the tp-overlap ring matmuls quantize both
+    # operands to fp8 with per-(layer, site, tensor) scales derived
+    # from an amax history threaded through the train state
+    # (training/fp8.py). Requires tp_comm_overlap on a tp>1, pp==1,
+    # cp==1, dense non-MLA/non-MoE layout (fp8_ineligible_reason names
+    # the first failed predicate). fp8_margin: scale = FP8_MAX /
+    # (amax * 2**margin) — headroom against inter-step amax growth.
+    # fp8_amax_history_len: history window H (TE-default-ish 16; the
+    # scale follows max over the window).
+    fp8: bool = False
+    fp8_margin: int = 0
+    fp8_amax_history_len: int = 16
+
     # Heterogeneous per-layer structure (reference
     # heterogeneous_config.py HeterogeneousTransformerConfig): the HF
     # Nemotron "block_configs" JSON (encoded string). When set, layers

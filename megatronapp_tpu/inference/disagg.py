@@ -152,7 +152,8 @@ class PrefillWorker:
             # the cross-mesh handoff ships int8 rows + fp32 scales —
             # (D + 4) / (2 D) of the bf16 row bytes — and the fused
             # scatter commits all four pool tensors.
-            self._quantize = jax.jit(quantize_kv_rows)
+            self._quantize = jax.jit(functools.partial(
+                quantize_kv_rows, dtype=pool.pages[0].dtype))
 
             def _write_quant(pk, pv, sk, sv, rk, rv, rsk, rsv,
                              table_row, start, count):

@@ -434,11 +434,11 @@ class DynamicInferenceEngine:
             self.cache = None
         else:
             assert pool is None, "pool injection requires paged=True"
-            if kv_cache_dtype != "bf16":
-                raise ValueError(
-                    "kv_cache_dtype=int8 requires the paged backend "
-                    "(per-block scales live alongside the block pool) — "
-                    "pass paged=True / --paged-kv-cache")
+            from megatronapp_tpu.inference.paged_cache import (
+                validate_kv_cache_dtype,
+            )
+            validate_kv_cache_dtype(kv_cache_dtype, paged=False,
+                                    mla=cfg.multi_latent_attention)
             self.pool = None
             self.cache = init_kv_cache(cfg, max_batch, self.max_seq_len)
 

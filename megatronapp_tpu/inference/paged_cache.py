@@ -32,7 +32,14 @@ sharing semantics never depend on the storage dtype. Capacity
 accounting (`bytes_total`, `bytes_per_block`) reads the addressable
 arrays, so it is dtype-aware by construction. The MLA latent pool stays
 bf16-only (the latent is already a compressed representation; int8
-rejection is explicit)."""
+rejection is explicit).
+
+fp8 pools (ISSUE 13, ``kv_cache_dtype="fp8"``): same scale-pool layout
+as int8 but the pages store e4m3 — quantize_kv_rows maps each row's
+absmax to the e4m3 range bound (448) and saturate-casts, dropping the
+integer rounding step; dequant stays the same cast-and-scale in-kernel
+path. The storage dtypes, their CLI choices, and every validation
+message derive from the one KV_CACHE_DTYPES registry below."""
 
 from __future__ import annotations
 
@@ -53,6 +60,75 @@ def cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
+@dataclasses.dataclass(frozen=True)
+class KvDtypeSpec:
+    """One KV-cache storage dtype (the SHARED registry entry): the pool
+    check, the CLI choices/help, and the server-side validation all
+    derive from KV_CACHE_DTYPES so adding a dtype cannot leave them
+    disagreeing (ISSUE 13 satellite). Quantized entries take their
+    page dtype and range bound from the KERNEL registry
+    (ops/pallas/kernel_gen.QUANT_DTYPES — the same map quantize_kv_rows
+    and the PagedSpec quant-dtype axis consume), so a new storage
+    format lands there once and flows to the CLI/pool/kernels
+    together."""
+    name: str
+    page_dtype: object          # jnp dtype of the page pools (None = compute)
+    quantized: bool             # per-(row, kv-head) fp32 scale pool present
+    qmax: Optional[float]       # symmetric quantization range bound
+    help: str                   # one-line CLI help fragment
+
+
+def _quantized_spec(name: str, help_text: str) -> KvDtypeSpec:
+    from megatronapp_tpu.ops.pallas.kernel_gen import QUANT_DTYPES
+    dtype, _tile, qmax = QUANT_DTYPES[name]
+    return KvDtypeSpec(name, dtype, True, qmax, help_text)
+
+
+KV_CACHE_DTYPES = {
+    "bf16": KvDtypeSpec("bf16", None, False, None,
+                        "compute-dtype pages (the baseline)"),
+    "int8": _quantized_spec(
+        "int8",
+        "int8 pages + per-(row, kv-head) fp32 scales, rounded "
+        "symmetric [-127, 127], dequantized in-kernel per DMA'd block"),
+    "fp8": _quantized_spec(
+        "fp8",
+        "fp8 (e4m3) pages + per-(row, kv-head) fp32 scales — same "
+        "bytes as int8 but saturating float rounding (no integer "
+        "rounding step), dequantized in-kernel per DMA'd block"),
+}
+
+
+def kv_cache_dtype_help() -> str:
+    """CLI help text for --kv-cache-dtype, derived from the registry."""
+    return "; ".join(f"{n}: {s.help}" for n, s in KV_CACHE_DTYPES.items())
+
+
+def validate_kv_cache_dtype(name: str, *, paged: bool = True,
+                            mla: bool = False) -> KvDtypeSpec:
+    """Single source of truth for kv_cache_dtype validation: the pool
+    constructor, the engine, and the parse-time CLI check all raise
+    THESE messages (ValueError; entry points wrap in SystemExit)."""
+    spec = KV_CACHE_DTYPES.get(name)
+    if spec is None:
+        raise ValueError(
+            f"kv_cache_dtype must be one of "
+            f"{sorted(KV_CACHE_DTYPES)}, got {name!r}")
+    if spec.quantized and not paged:
+        raise ValueError(
+            f"kv_cache_dtype={spec.name} requires the paged backend "
+            "(the per-block quantization scales live alongside the "
+            "block pool; the dense slot cache has no block structure) "
+            "— pass paged=True / --paged-kv-cache")
+    if spec.quantized and mla:
+        raise ValueError(
+            f"kv_cache_dtype={spec.name} is not supported for MLA: the "
+            "latent pool is already a compressed representation and "
+            "stays bf16-only for now — run with kv_cache_dtype=bf16 "
+            f"(or drop --kv-cache-dtype {spec.name})")
+    return spec
+
+
 @dataclasses.dataclass
 class AdmitPlan:
     """Result of admitting a token sequence into a slot."""
@@ -68,19 +144,12 @@ class PagedKVCache:
                  max_seq_len: int, num_blocks: Optional[int] = None,
                  block_size: int = 16, enable_prefix_caching: bool = True,
                  extra_slots: int = 0, kv_cache_dtype: str = "bf16"):
-        if kv_cache_dtype not in ("bf16", "int8"):
-            raise ValueError(
-                f"kv_cache_dtype must be 'bf16' or 'int8', got "
-                f"{kv_cache_dtype!r}")
-        if kv_cache_dtype == "int8" and cfg.multi_latent_attention:
-            raise ValueError(
-                "int8 KV-cache pages are not supported for MLA: the "
-                "latent pool is already a compressed representation and "
-                "stays bf16-only for now — run with kv_cache_dtype=bf16 "
-                "(or drop --kv-cache-dtype int8)")
+        dtype_spec = validate_kv_cache_dtype(
+            kv_cache_dtype, paged=True, mla=cfg.multi_latent_attention)
         self.cfg = cfg
         self.kv_cache_dtype = kv_cache_dtype
-        self.quantized = kv_cache_dtype == "int8"
+        self.dtype_spec = dtype_spec
+        self.quantized = dtype_spec.quantized
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len
         self.block_size = block_size
@@ -109,7 +178,8 @@ class PagedKVCache:
                           cfg.compute_dtype))
         else:
             shape = (l, nb, bs, cfg.num_query_groups, cfg.head_dim)
-            dt = jnp.int8 if self.quantized else cfg.compute_dtype
+            dt = (dtype_spec.page_dtype if self.quantized
+                  else cfg.compute_dtype)
             self.pages = (jnp.zeros(shape, dt), jnp.zeros(shape, dt))
             if self.quantized:
                 sshape = (l, nb, bs, cfg.num_query_groups)

@@ -25,11 +25,16 @@ dequantizes eagerly so unexpected model families keep working.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from megatronapp_tpu.utils import metrics as telemetry
+
+logger = logging.getLogger(__name__)
 
 # Leaves whose name ends with one of these are quantized (matmul kernels);
 # everything else (norms, biases, embeddings' positional tables, routers)
@@ -106,8 +111,7 @@ def quantize_params(params, resident_only: bool = False
         if not resident_only:
             return True
         name = prefix[-1] if prefix else ""
-        return (any(name.endswith(s) for s in RESIDENT_KERNELS)
-                and "moe" not in prefix)
+        return any(name.endswith(s) for s in RESIDENT_KERNELS)
 
     def walk(tree, prefix=()):
         if isinstance(tree, dict):
@@ -145,8 +149,11 @@ def dequantize_params(tree):
 
 # Kernels whose forward-pass consumers call resolve_param at matmul
 # entry (transformer/attention.py, transformer/mlp.py, transformer/
-# mla.py out-proj) and may therefore stay int8-resident for serving.
-# MoE expert stacks are excluded until moe_forward resolves them.
+# mla.py out-proj, transformer/moe.py expert GEMMs) and may therefore
+# stay int8-resident for serving. MoE expert stacks resolve at
+# moe_forward matmul entry since ISSUE 13 — the old "moe" carve-out
+# (the last non-resident tensor family) is gone; any remaining
+# fallback dequantization is counted + logged by residentize_params.
 RESIDENT_KERNELS = ("q_kernel", "kv_kernel", "out_kernel",
                     "fc1_kernel", "fc2_kernel")
 
@@ -166,29 +173,53 @@ def resolve_param(w, dtype=None):
     return w if dtype is None else w.astype(dtype)
 
 
-def residentize_params(tree, _path=()):
+def residentize_params(tree):
     """Convert a quantize_params pytree into the serving-resident form:
-    RESIDENT_KERNELS leaves become {"qint8", "qscale"} jnp-array pairs
-    (kept int8 in HBM, dequantized at matmul entry by resolve_param);
-    every other quantized leaf dequantizes eagerly. Idempotent on
-    unquantized pytrees."""
-    if is_quantized_leaf(tree):
-        name = _path[-1] if _path else ""
-        if (any(name.endswith(s) for s in RESIDENT_KERNELS)
-                and "moe" not in _path):
-            return {"qint8": jnp.asarray(tree["q"]),
-                    "qscale": jnp.asarray(tree["scale"], jnp.float32)}
-        return jnp.asarray(dequantize_leaf(tree))
-    if isinstance(tree, dict):
-        return {k: residentize_params(v, _path + (k,))
-                for k, v in tree.items()}
-    if isinstance(tree, list):
-        return [residentize_params(v, _path + (str(i),))
-                for i, v in enumerate(tree)]
-    if isinstance(tree, tuple):
-        return tuple(residentize_params(v, _path + (str(i),))
-                     for i, v in enumerate(tree))
-    return tree
+    RESIDENT_KERNELS leaves (incl. MoE expert stacks since ISSUE 13)
+    become {"qint8", "qscale"} jnp-array pairs (kept int8 in HBM,
+    dequantized at matmul entry by resolve_param); every other
+    quantized leaf dequantizes eagerly. Idempotent on unquantized
+    pytrees.
+
+    Fallback observability (ISSUE 13 satellite): eager dequantization
+    here is a silent loss of the resident-HBM win — every fallback's
+    dequantized bytes are counted into the metrics registry
+    (``quantized_weights_dequantized_bytes``) and logged ONCE per call,
+    so a future carve-out regression shows up in /metrics instead of
+    only in an HBM profile."""
+    fallback = {"bytes": 0, "paths": []}
+
+    def walk(tree, path):
+        if is_quantized_leaf(tree):
+            name = path[-1] if path else ""
+            if any(name.endswith(s) for s in RESIDENT_KERNELS):
+                return {"qint8": jnp.asarray(tree["q"]),
+                        "qscale": jnp.asarray(tree["scale"], jnp.float32)}
+            deq = jnp.asarray(dequantize_leaf(tree))
+            fallback["bytes"] += int(deq.nbytes)
+            fallback["paths"].append("/".join(path))
+            return deq
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        if isinstance(tree, list):
+            return [walk(v, path + (str(i),))
+                    for i, v in enumerate(tree)]
+        if isinstance(tree, tuple):
+            return tuple(walk(v, path + (str(i),))
+                         for i, v in enumerate(tree))
+        return tree
+
+    out = walk(tree, ())
+    if fallback["bytes"]:
+        telemetry.inc("quantized_weights_dequantized_bytes",
+                      fallback["bytes"])
+        logger.warning(
+            "residentize_params: %d quantized leaves have no "
+            "resolve-aware consumer and were dequantized eagerly "
+            "(%d bytes of the resident-HBM win given back): %s",
+            len(fallback["paths"]), fallback["bytes"],
+            ", ".join(fallback["paths"][:8]))
+    return out
 
 
 def resident_nbytes(tree) -> int:

@@ -178,7 +178,8 @@ def gpt_forward(p, tokens: jnp.ndarray, cfg: TransformerConfig,
                 attention_mask: Optional[jnp.ndarray] = None,
                 position_offset: int = 0, ctx=None,
                 segment_ids: Optional[jnp.ndarray] = None,
-                zigzag_keep: bool = False, return_hidden: bool = False):
+                zigzag_keep: bool = False, return_hidden: bool = False,
+                fp8=None):
     """tokens [B,S] → (logits [B,S,V] fp32, moe_aux_loss) —
     (+ pre-head hidden states and rope tables when return_hidden, for the
     MTP depth modules).
@@ -214,7 +215,8 @@ def gpt_forward(p, tokens: jnp.ndarray, cfg: TransformerConfig,
     cos, sin = gpt_rope_tables(cfg, s, position_offset,
                                positions=(positions[0] if zz else positions))
     h, aux = block_forward(p["block"], h, cfg, cos, sin, attention_mask,
-                           ctx=ctx, zigzag=zz, segment_ids=segment_ids)
+                           ctx=ctx, zigzag=zz, segment_ids=segment_ids,
+                           fp8=None if fp8 is None else fp8["block"])
     logits = gpt_head(p, h, cfg)
     if zz and not zigzag_keep:
         logits = jnp.take(logits, jnp.asarray(zigzag_inverse_indices(
@@ -226,7 +228,8 @@ def gpt_forward(p, tokens: jnp.ndarray, cfg: TransformerConfig,
 
 def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
              loss_mask: Optional[jnp.ndarray], cfg: TransformerConfig,
-             ctx=None, segment_ids: Optional[jnp.ndarray] = None):
+             ctx=None, segment_ids: Optional[jnp.ndarray] = None,
+             fp8=None):
     """Training loss (CE + MoE aux). Mirrors pretrain_gpt.py loss_func
     (/root/reference/pretrain_gpt.py:159)."""
     from megatronapp_tpu.ops.context_parallel import (
@@ -266,7 +269,7 @@ def gpt_loss(p, tokens: jnp.ndarray, targets: jnp.ndarray,
     else:
         logits, aux = gpt_forward(p, tokens, cfg, ctx=ctx,
                                   segment_ids=segment_ids,
-                                  zigzag_keep=True)
+                                  zigzag_keep=True, fp8=fp8)
     if zigzag_active(cfg, ctx) and segment_ids is None:
         # Logits are in zigzag order — permute targets/mask to match (the
         # masked-mean CE is permutation-invariant).

@@ -82,31 +82,97 @@ def _dequant_block(k, ks):
 # ---------------------------------------------------------------------------
 
 
+QUANT_DTYPES = {
+    # THE canonical quantized-KV storage registry: quant_dtype axis of
+    # PagedSpec → (page jnp dtype, TPU min tile (sublane, lane) for the
+    # KV block windows, symmetric quantization range bound qmax). Both
+    # 1-byte formats want the (32, 128) layout on-chip; bf16 pools tile
+    # (16, 128). The tile is PARAMETERIZED (not hard-coded in the body)
+    # so the fp8 (32, 128) layout can be flipped on and validated when
+    # the chip returns — interpret mode (CPU) imposes no tiling, so the
+    # same spec runs everywhere today. quantize_kv_rows derives its
+    # range from qmax, and the serving-facing KV_CACHE_DTYPES registry
+    # (inference/paged_cache.py) builds its quantized entries FROM this
+    # map — one place to add a storage dtype end-to-end.
+    "int8": (jnp.int8, (32, 128), 127.0),
+    "fp8": (jnp.float8_e4m3fn, (32, 128), 448.0),
+}
+
+
+def quant_dtype_of(pages_dtype) -> Optional[str]:
+    """Map a page pool's storage dtype to the PagedSpec quant_dtype axis
+    (None = unquantized compute-dtype pool)."""
+    for name, (dt, _, _) in QUANT_DTYPES.items():
+        if jnp.dtype(pages_dtype) == jnp.dtype(dt):
+            return name
+    return None
+
+
+def quant_qmax_of(pages_dtype) -> float:
+    """Symmetric quantization range bound for a registered quantized
+    page dtype (127 int8, 448 e4m3)."""
+    name = quant_dtype_of(pages_dtype)
+    if name is None:
+        raise ValueError(
+            f"{pages_dtype} is not a registered quantized KV storage "
+            f"dtype ({sorted(QUANT_DTYPES)})")
+    return QUANT_DTYPES[name][2]
+
+
+def default_kv_tile(quant_dtype: Optional[str]):
+    """Min TPU tile (sublane, lane) of the KV block windows for this
+    storage dtype — the shape knob an on-chip tuning pass flips."""
+    if quant_dtype is None:
+        return (16, 128)
+    return QUANT_DTYPES[quant_dtype][1]
+
+
 @dataclasses.dataclass(frozen=True)
 class PagedSpec:
     """Everything that selects a paged-attention kernel variant.
 
     ragged=False requires s_q == 1 (the decode shape); ragged=True adds
     the q_lens scalar-prefetch ref and the causal tail mask over the
-    [1, S_q] window. quantized adds the int8 scale-block refs. The tp
-    head-shard axis is NOT part of the body spec — sharding is pure
-    placement (``paged_attention(..., mesh=)`` wraps the same emitted
-    kernel in a full-manual shard_map)."""
+    [1, S_q] window. quant_dtype ("int8" | "fp8" | None) adds the
+    scale-block refs and the in-register dequant of each DMA'd block —
+    the dequant body (cast to fp32 × per-(row, head) scale) is shared by
+    both quantized formats, so a new storage dtype is a registry entry
+    (QUANT_DTYPES), not a new body. kv_tile is the (sublane, lane) min
+    tile of the KV block windows (dtype-dependent on TPU — fp8/int8 want
+    (32, 128)); interpret mode ignores it, and paged_attention derives
+    the per-dtype default, so it only needs touching for on-chip layout
+    experiments. The tp head-shard axis is NOT part of the body spec —
+    sharding is pure placement (``paged_attention(..., mesh=)`` wraps
+    the same emitted kernel in a full-manual shard_map)."""
 
     ragged: bool
-    quantized: bool
+    quant_dtype: Optional[str]
     s_q: int
     block_size: int
     num_blocks_seq: int
     hkv: int
     group: int
     scale: float
+    kv_tile: tuple = (16, 128)
+
+    @property
+    def quantized(self) -> bool:
+        return self.quant_dtype is not None
 
     def __post_init__(self):
         if not self.ragged and self.s_q != 1:
             raise ValueError(
                 f"non-ragged (decode) kernels are single-query: s_q="
                 f"{self.s_q} requires ragged=True (pass q_lens)")
+        if self.quant_dtype is not None \
+                and self.quant_dtype not in QUANT_DTYPES:
+            raise ValueError(
+                f"quant_dtype must be one of {sorted(QUANT_DTYPES)} or "
+                f"None, got {self.quant_dtype!r}")
+        if len(self.kv_tile) != 2 or self.kv_tile[1] % 128:
+            raise ValueError(
+                f"kv_tile must be (sublane, lane) with lane a multiple "
+                f"of 128, got {self.kv_tile!r}")
 
 
 def emit_paged_kernel(spec: PagedSpec):
@@ -275,9 +341,16 @@ def paged_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
     if softmax_scale is None:
         softmax_scale = 1.0 / (d ** 0.5)
     quantized = k_scales is not None
-    spec = PagedSpec(ragged=ragged, quantized=quantized, s_q=s_q,
+    quant_dtype = quant_dtype_of(k_pages.dtype) if quantized else None
+    if quantized and quant_dtype is None:
+        raise ValueError(
+            f"scales passed but page dtype {k_pages.dtype} is not a "
+            f"registered quantized storage format "
+            f"({sorted(QUANT_DTYPES)})")
+    spec = PagedSpec(ragged=ragged, quant_dtype=quant_dtype, s_q=s_q,
                      block_size=bs, num_blocks_seq=mb, hkv=hkv,
-                     group=hq // hkv, scale=float(softmax_scale))
+                     group=hq // hkv, scale=float(softmax_scale),
+                     kv_tile=default_kv_tile(quant_dtype))
 
     kernel = emit_paged_kernel(spec)
 
@@ -616,8 +689,8 @@ def fused_layer_decode(p, x, cfg, rope_cos, rope_sin, kv_cache,
         active = jnp.ones((b,), bool)
     if kv_scales is not None:
         cks, cvs = kv_scales
-        k_q, k_s = quantize_kv_rows(k)
-        v_q, v_s = quantize_kv_rows(v)
+        k_q, k_s = quantize_kv_rows(k, dtype=ck.dtype)
+        v_q, v_s = quantize_kv_rows(v, dtype=cv.dtype)
         ck = append_token_pages(ck, k_q, page_table, cache_positions,
                                 active)
         cv = append_token_pages(cv, v_q, page_table, cache_positions,

@@ -51,19 +51,34 @@ from megatronapp_tpu.ops.pallas.kernel_gen import (  # noqa: F401 (re-export)
 )
 
 
-def quantize_kv_rows(rows: jnp.ndarray):
-    """Symmetric per-(row, head) int8 quantization of KV rows.
+def quantize_kv_rows(rows: jnp.ndarray, dtype=jnp.int8):
+    """Symmetric per-(row, head) quantization of KV rows.
 
-    rows [..., Hkv, D] → (int8 rows [..., Hkv, D], fp32 scales
+    rows [..., Hkv, D] → (quantized rows [..., Hkv, D], fp32 scales
     [..., Hkv]). Each (token, head) row quantizes independently over D —
     inserts never re-scale already-written rows, so partial blocks,
     copy-on-write copies, and speculative rewinds need no block-level
-    bookkeeping. jit-able; fused into the engine's write-path jits."""
+    bookkeeping. jit-able; fused into the engine's write-path jits.
+
+    dtype selects the storage format (the page pool's dtype — callers
+    pass ``pages.dtype`` so the write path follows the pool):
+    - int8: round to [-127, 127] with scale = absmax / 127 (the PR-10
+      path, bit-identical to before);
+    - fp8 (e4m3fn): scale = absmax / 448 and SATURATE-cast — e4m3
+      overflow is NaN, not inf, so the clip is load-bearing; the float
+      cast rounds natively (no integer rounding step — the "drops the
+      scale-pool rounding" half of the fp8 mode)."""
+    from megatronapp_tpu.ops.pallas.kernel_gen import quant_qmax_of
     r32 = rows.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(r32), axis=-1)
-    scales = jnp.maximum(absmax / 127.0, 1e-12)
-    q = jnp.clip(jnp.round(r32 / scales[..., None]), -127, 127)
-    return q.astype(jnp.int8), scales.astype(jnp.float32)
+    qmax = quant_qmax_of(dtype)
+    if jnp.dtype(dtype) == jnp.dtype(jnp.int8):
+        scales = jnp.maximum(absmax / qmax, 1e-12)
+        q = jnp.clip(jnp.round(r32 / scales[..., None]), -qmax, qmax)
+    else:
+        scales = jnp.maximum(absmax / qmax, 1e-12)
+        q = jnp.clip(r32 / scales[..., None], -qmax, qmax)
+    return q.astype(dtype), scales.astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------

@@ -324,6 +324,305 @@ _mm_rs.defvjp(_mm_rs_fwd, _mm_rs_bwd)
 
 
 # ---------------------------------------------------------------------------
+# fp8 (e4m3) ring variants with delayed-scaling amax history (ISSUE 13).
+#
+# MAINTENANCE NOTE: these four bodies are deliberate twins of the bf16
+# bodies above (same ring/permute/span structure, plus the fp32 upcast
+# + per-projection descale). A structural fix to a bf16 body (permute
+# ordering, span placement, accumulator dtype) must be mirrored here —
+# unifying them behind an optional (upcast, invs) parameterization is a
+# recorded follow-up, deferred because the bf16 bodies are the most
+# bitwise-pinned code in the repo.
+#
+# Same ring structure as the bf16 bodies above, but both GEMM operands
+# are quantized to fp8 with per-tensor delayed scales derived from an
+# amax HISTORY (training/fp8.py): forward tensors (x, every w_j) are
+# observed in the fwd, the cotangents in the bwd, and the updated
+# history travels OUT through the custom_vjp cotangent of the ``fp8``
+# input — the train step installs it into state["fp8"] directly, never
+# through the optimizer. The fp8 chunks are what the ppermute ring
+# moves (half the bf16 hop bytes — the deterministic byte-count
+# evidence of tools/fp8_benchmark.py); the GEMMs upcast fp8 → fp32 in
+# register (e4m3 values are exact in fp32, so this is the fp8-input
+# matmul with fp32 accumulation an MXU would run) and apply the
+# combined 1/(s_a * s_b) descale on the product.
+# ---------------------------------------------------------------------------
+
+
+def _fp8_quant_global(x, scale):
+    """Quantize a GLOBAL (GSPMD-sharded) array outside the shard_map:
+    the amax/saturation reductions are global by construction, so no
+    in-body pmax is needed. Returns (x_fp8, amax, sat_count)."""
+    from megatronapp_tpu.training.fp8 import fp8_quantize
+    return fp8_quantize(x, scale)
+
+
+def _ag_mm_fp8_body(tp, op_name, out_dtype, xl, wls, invs):
+    """fp8 twin of _ag_mm_body: xl fp8 [b, S/tp, H] chunks ring around,
+    each GEMM upcasts in register and applies its projection's combined
+    descale inv_j = 1/(s_x * s_w_j)."""
+    me = lax.axis_index(TP_AXIS)
+    b, sc, _ = xl.shape
+    ys = [zeros_like_vma((b, sc * tp, wl.shape[1]), out_dtype, xl)
+          for wl in wls]
+    perm = _ring_perm(tp)
+    chunk = xl
+    for step in range(tp):
+        nxt = None
+        if step + 1 < tp:
+            _mark(OVERLAP_PERMUTE_EVENT, "B", chunk, op=op_name, step=step)
+            nxt = lax.ppermute(chunk, TP_AXIS, perm)
+        owner = (me + step) % tp
+        _mark(OVERLAP_COMPUTE_EVENT, "B", chunk, op=op_name, step=step)
+        cf = chunk.astype(jnp.float32)
+        last = None
+        for j, wl in enumerate(wls):
+            piece = ((cf @ wl.astype(jnp.float32))
+                     * invs[j]).astype(out_dtype)
+            ys[j] = lax.dynamic_update_slice_in_dim(ys[j], piece,
+                                                    owner * sc, axis=1)
+            last = piece
+        _mark(OVERLAP_COMPUTE_EVENT, "E", last, op=op_name, step=step)
+        if nxt is not None:
+            _mark(OVERLAP_PERMUTE_EVENT, "E", nxt, op=op_name, step=step)
+            chunk = nxt
+    return tuple(ys)
+
+
+def _mm_rs_fp8_rings(tp, out_dtype, yls, wls, invs,
+                     op_name="matmul-reduce-scatter-fp8"):
+    """fp8 twin of _mm_rs_rings: per-chunk partial products descale with
+    their own inv_j before the sum; the running partial permutes in
+    out_dtype (same hop bytes as the baseline — the fp8 win here is the
+    operand side, not the partial-sum side)."""
+    me = lax.axis_index(TP_AXIS)
+    sc = yls[0].shape[1] // tp
+    perm = _ring_perm(tp)
+
+    def piece(c, step):
+        _mark(OVERLAP_COMPUTE_EVENT, "B", yls[0], op=op_name, step=step)
+        out = None
+        for yl, wl, inv in zip(yls, wls, invs):
+            yc = lax.dynamic_slice_in_dim(yl, c * sc, sc,
+                                          axis=1).astype(jnp.float32)
+            t = (yc @ wl.astype(jnp.float32)) * inv
+            out = t if out is None else out + t
+        out = out.astype(out_dtype)
+        _mark(OVERLAP_COMPUTE_EVENT, "E", out, op=op_name, step=step)
+        return out
+
+    acc = piece((me + 1) % tp, 0)
+    for step in range(1, tp):
+        _mark(OVERLAP_PERMUTE_EVENT, "B", acc, op=op_name, step=step)
+        moving = lax.ppermute(acc, TP_AXIS, perm)
+        nxt = piece((me + 1 + step) % tp, step)
+        _mark(OVERLAP_PERMUTE_EVENT, "E", moving, op=op_name, step=step)
+        acc = moving + nxt
+    return acc
+
+
+def _ag_mm_fp8_bwd_body(tp, out_dtype, w_dtypes, xl, wls, dyls, inv_dws,
+                        inv_dxs):
+    """Fused fp8 backward ring for all_gather_matmul: ONE ring pass of
+    the fp8 x chunks accumulates every wgrad (descaled per projection by
+    inv_dw_j = 1/(s_x s_g_j)); the dgrad is the fp8 reduce-scatter of
+    the quantized cotangents against the transposed fp8 weights
+    (inv_dx_j = 1/(s_g_j s_w_j)). All operands are fp8; accumulators
+    fp32."""
+    me = lax.axis_index(TP_AXIS)
+    b, sc, h = xl.shape
+    perm = _ring_perm(tp)
+    op = "all-gather-matmul-fp8-bwd"
+
+    dws = [zeros_like_vma((h, wl.shape[1]), jnp.float32, xl) for wl in wls]
+    chunk = xl
+    for step in range(tp):
+        nxt = None
+        if step + 1 < tp:
+            _mark(OVERLAP_PERMUTE_EVENT, "B", chunk, op=op, step=step)
+            nxt = lax.ppermute(chunk, TP_AXIS, perm)
+        owner = (me + step) % tp
+        _mark(OVERLAP_COMPUTE_EVENT, "B", chunk, op=op, step=step)
+        cf = chunk.astype(jnp.float32)
+        pm = None
+        for j, (wl, dyl) in enumerate(zip(wls, dyls)):
+            dyc = lax.dynamic_slice_in_dim(
+                dyl, owner * sc, sc, axis=1).astype(jnp.float32)
+            pm = (cf.reshape(b * sc, h).T
+                  @ dyc.reshape(b * sc, wl.shape[1])) * inv_dws[j]
+            dws[j] = dws[j] + pm
+        _mark(OVERLAP_COMPUTE_EVENT, "E", pm, op=op, step=step)
+        if nxt is not None:
+            _mark(OVERLAP_PERMUTE_EVENT, "E", nxt, op=op, step=step)
+            chunk = nxt
+
+    dx = _mm_rs_fp8_rings(tp, out_dtype, dyls,
+                          tuple(wl.T for wl in wls), inv_dxs, op_name=op)
+    dws = [lax.psum(dw, (DP_AXIS, EP_AXIS)) for dw in dws]
+    return (dx,
+            tuple(dw.astype(dt) for dw, dt in zip(dws, w_dtypes)))
+
+
+def _mm_rs_fp8_bwd_body(tp, y_dtype, w_dtype, yl, wl, dol, inv_dy,
+                        inv_dw):
+    """Fused fp8 backward ring for matmul_reduce_scatter: one ring
+    all-gather of the fp8 cotangent chunks feeds dgrad
+    (dy = (do @ w^T) / (s_do s_w)) and wgrad
+    (dw = sum_c y_c^T @ do_c / (s_y s_do)) together."""
+    me = lax.axis_index(TP_AXIS)
+    b, sc, h = dol.shape
+    nl = wl.shape[0]
+    perm = _ring_perm(tp)
+    op = "matmul-reduce-scatter-fp8-bwd"
+
+    dy = zeros_like_vma((b, sc * tp, nl), y_dtype, dol)
+    dw = zeros_like_vma((nl, h), jnp.float32, dol)
+    wt = wl.astype(jnp.float32).T
+    chunk = dol
+    for step in range(tp):
+        nxt = None
+        if step + 1 < tp:
+            _mark(OVERLAP_PERMUTE_EVENT, "B", chunk, op=op, step=step)
+            nxt = lax.ppermute(chunk, TP_AXIS, perm)
+        owner = (me + step) % tp
+        _mark(OVERLAP_COMPUTE_EVENT, "B", chunk, op=op, step=step)
+        cf = chunk.astype(jnp.float32)
+        dyc = ((cf @ wt) * inv_dy).astype(y_dtype)
+        yc = lax.dynamic_slice_in_dim(
+            yl, owner * sc, sc, axis=1).astype(jnp.float32)
+        pm = (yc.reshape(b * sc, nl).T @ cf.reshape(b * sc, h)) * inv_dw
+        _mark(OVERLAP_COMPUTE_EVENT, "E", dyc, op=op, step=step)
+        dy = lax.dynamic_update_slice_in_dim(dy, dyc, owner * sc, axis=1)
+        dw = dw + pm
+        if nxt is not None:
+            _mark(OVERLAP_PERMUTE_EVENT, "E", nxt, op=op, step=step)
+            chunk = nxt
+    dw = lax.psum(dw, (DP_AXIS, EP_AXIS))
+    return dy, dw.astype(w_dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ag_mm_fp8(mesh, margin, x, ws, fp8):
+    return _ag_mm_fp8_fwd(mesh, margin, x, ws, fp8)[0]
+
+
+def _ag_mm_fp8_fwd(mesh, margin, x, ws, fp8):
+    from megatronapp_tpu.training.fp8 import fp8_scale_from_hist
+    tp = mesh.shape[TP_AXIS]
+    n = len(ws)
+    out_dtype = jnp.result_type(x.dtype, *(w.dtype for w in ws))
+    hist = fp8["hist"]                       # [1 + 2n, H]
+    scales = fp8_scale_from_hist(hist, margin)
+    xq, ax, sx_cnt = _fp8_quant_global(x, scales[0])
+    wqs, aws, sws = [], [], []
+    for j, w in enumerate(ws):
+        wq, aw, sw_cnt = _fp8_quant_global(w, scales[1 + j])
+        wqs.append(wq)
+        aws.append(aw)
+        sws.append(sw_cnt)
+    wqs = tuple(wqs)
+    invs = tuple(1.0 / (scales[0] * scales[1 + j]) for j in range(n))
+    ys = _shard_map(
+        functools.partial(_ag_mm_fp8_body, tp, "all-gather-matmul-fp8",
+                          out_dtype), mesh,
+        in_specs=(P(_BATCH, TP_AXIS, None), (P(None, TP_AXIS),) * n,
+                  (P(),) * n),
+        out_specs=(P(_BATCH, None, TP_AXIS),) * n)(xq, wqs, invs)
+    # Dtype witnesses: residual leaves must be jax types, so original
+    # dtypes travel as zero-size arrays (xq/wqs are fp8 — the primal
+    # dtypes are otherwise lost by quantization).
+    wit = (tuple(jnp.zeros((0,), w.dtype) for w in ws),
+           jnp.zeros((0,), x.dtype), jnp.zeros((0,), out_dtype))
+    res = (xq, wqs, hist, scales, (ax, tuple(aws)),
+           (sx_cnt, tuple(sws)), wit)
+    return ys, res
+
+
+def _ag_mm_fp8_bwd(mesh, margin, res, dys):
+    from megatronapp_tpu.training.fp8 import rolled_hist
+    (xq, wqs, hist, scales, (ax, aws), (sx_cnt, sws), wit) = res
+    w_wits, x_wit, out_wit = wit
+    w_dtypes = tuple(w.dtype for w in w_wits)
+    x_dtype, out_dtype = x_wit.dtype, out_wit.dtype
+    tp = mesh.shape[TP_AXIS]
+    n = len(wqs)
+    dyqs, ags, sgs = [], [], []
+    for j, dy in enumerate(dys):
+        dq, ag, sg_cnt = _fp8_quant_global(dy, scales[1 + n + j])
+        dyqs.append(dq)
+        ags.append(ag)
+        sgs.append(sg_cnt)
+    dyqs = tuple(dyqs)
+    inv_dws = tuple(1.0 / (scales[0] * scales[1 + n + j])
+                    for j in range(n))
+    inv_dxs = tuple(1.0 / (scales[1 + n + j] * scales[1 + j])
+                    for j in range(n))
+    dx, dws = _shard_map(
+        functools.partial(_ag_mm_fp8_bwd_body, tp, out_dtype, w_dtypes),
+        mesh,
+        in_specs=(P(_BATCH, TP_AXIS, None), (P(None, TP_AXIS),) * n,
+                  (P(_BATCH, None, TP_AXIS),) * n,
+                  (P(),) * n, (P(),) * n),
+        out_specs=(P(_BATCH, TP_AXIS, None),
+                   (P(None, TP_AXIS),) * n))(
+        xq, wqs, dyqs, inv_dws, inv_dxs)
+    amaxes = jnp.stack([ax, *aws, *ags])
+    sats = jnp.stack([sx_cnt, *sws, *sgs])
+    dfp8 = {"hist": rolled_hist(hist, amaxes), "sat": sats}
+    return dx.astype(x_dtype), dws, dfp8
+
+
+_ag_mm_fp8.defvjp(_ag_mm_fp8_fwd, _ag_mm_fp8_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _mm_rs_fp8(mesh, margin, y, w, fp8):
+    return _mm_rs_fp8_fwd(mesh, margin, y, w, fp8)[0]
+
+
+def _mm_rs_fp8_fwd(mesh, margin, y, w, fp8):
+    from megatronapp_tpu.training.fp8 import fp8_scale_from_hist
+    tp = mesh.shape[TP_AXIS]
+    out_dtype = jnp.result_type(y.dtype, w.dtype)
+    hist = fp8["hist"]                       # [3, H]: y, w, dout
+    scales = fp8_scale_from_hist(hist, margin)
+    yq, ay, sy_cnt = _fp8_quant_global(y, scales[0])
+    wq, aw, sw_cnt = _fp8_quant_global(w, scales[1])
+    inv = 1.0 / (scales[0] * scales[1])
+    out = _shard_map(
+        functools.partial(_mm_rs_fp8_rings, tp, out_dtype), mesh,
+        in_specs=(P(_BATCH, None, TP_AXIS), P(TP_AXIS, None), P()),
+        out_specs=P(_BATCH, TP_AXIS, None))((yq,), (wq,), (inv,))
+    wit = (jnp.zeros((0,), y.dtype), jnp.zeros((0,), w.dtype))
+    res = (yq, wq, hist, scales, (ay, aw), (sy_cnt, sw_cnt), wit)
+    return out, res
+
+
+def _mm_rs_fp8_bwd(mesh, margin, res, dout):
+    from megatronapp_tpu.training.fp8 import rolled_hist
+    yq, wq, hist, scales, (ay, aw), (sy_cnt, sw_cnt), wit = res
+    y_dtype, w_dtype = wit[0].dtype, wit[1].dtype
+    tp = mesh.shape[TP_AXIS]
+    doq, ag, sg_cnt = _fp8_quant_global(dout, scales[2])
+    inv_dy = 1.0 / (scales[2] * scales[1])
+    inv_dw = 1.0 / (scales[0] * scales[2])
+    dy, dw = _shard_map(
+        functools.partial(_mm_rs_fp8_bwd_body, tp, y_dtype, w_dtype),
+        mesh,
+        in_specs=(P(_BATCH, None, TP_AXIS), P(TP_AXIS, None),
+                  P(_BATCH, TP_AXIS, None), P(), P()),
+        out_specs=(P(_BATCH, None, TP_AXIS), P(TP_AXIS, None)))(
+        yq, wq, doq, inv_dy, inv_dw)
+    amaxes = jnp.stack([ay, aw, ag])
+    sats = jnp.stack([sy_cnt, sw_cnt, sg_cnt])
+    dfp8 = {"hist": rolled_hist(hist, amaxes), "sat": sats}
+    return dy, dw, dfp8
+
+
+_mm_rs_fp8.defvjp(_mm_rs_fp8_fwd, _mm_rs_fp8_bwd)
+
+
+# ---------------------------------------------------------------------------
 # Ambient-manual variants: the same fused rings, callable from INSIDE an
 # existing full-manual shard_map (the pp pipeline stage body). No shard_map
 # wrapper (nested shard_maps are unsupported on this jax build) and no
@@ -532,7 +831,7 @@ def ring_all_gather(x, axis_name: str, n: int, axis: int = 0,
 # Public API
 # ---------------------------------------------------------------------------
 
-def all_gather_matmul(x, w, mesh):
+def all_gather_matmul(x, w, mesh, fp8=None, fp8_margin=0):
     """Column-parallel ``x @ w`` with ring-overlapped sequence all-gather.
 
     x: [B, S, H]; w: [H, N] with N % tp == 0 (sharded over tp on N) — or
@@ -542,7 +841,13 @@ def all_gather_matmul(x, w, mesh):
     Each output is [B, S, N_j] sharded over tp on the last dim —
     layout-identical to the GSPMD column-parallel matmul. S not divisible
     by tp is zero-padded internally (outside the custom_vjp, so gradients
-    of the pad/slice are automatic)."""
+    of the pad/slice are automatic).
+
+    fp8 (ISSUE 13): the site's delayed-scaling state
+    {"hist" [1+2n, H], "sat" [1+2n]} — both GEMM operands quantize to
+    e4m3 with scales from the history, the ring moves fp8 chunks, and
+    the UPDATED history travels out as this input's cotangent
+    (training/fp8.py; the train step installs it into state["fp8"])."""
     tp = mesh.shape[TP_AXIS]
     fused = isinstance(w, (tuple, list))
     ws = tuple(w) if fused else (w,)
@@ -556,20 +861,26 @@ def all_gather_matmul(x, w, mesh):
     sp = _round_up(s, tp)
     if sp != s:
         x = jnp.pad(x, ((0, 0), (0, sp - s), (0, 0)))
-    ys = _ag_mm(mesh, x, ws)
+    if fp8 is not None:
+        ys = _ag_mm_fp8(mesh, int(fp8_margin), x, ws, fp8)
+    else:
+        ys = _ag_mm(mesh, x, ws)
     if sp != s:
         ys = tuple(y[:, :s] for y in ys)
     return ys if fused else ys[0]
 
 
-def matmul_reduce_scatter(y, w, mesh):
+def matmul_reduce_scatter(y, w, mesh, fp8=None, fp8_margin=0):
     """Row-parallel ``y @ w`` with ring-overlapped partial-sum
     reduce-scatter along the sequence dim.
 
     y: [B, S, N] with N % tp == 0 (sharded over tp on N); w: [N, H].
     Returns the full [B, S, H] (manually sharded over tp along S; a
     replicated consumer triggers the trailing all-gather — same total
-    volume as the GSPMD all-reduce, with the RS half overlapped)."""
+    volume as the GSPMD all-reduce, with the RS half overlapped).
+
+    fp8: the site's delayed-scaling state {"hist" [3, H], "sat" [3]}
+    (input, weight, cotangent) — see all_gather_matmul."""
     tp = mesh.shape[TP_AXIS]
     if y.shape[-1] % tp or y.shape[-1] != w.shape[0]:
         raise ValueError(
@@ -579,7 +890,10 @@ def matmul_reduce_scatter(y, w, mesh):
     sp = _round_up(s, tp)
     if sp != s:
         y = jnp.pad(y, ((0, 0), (0, sp - s), (0, 0)))
-    out = _mm_rs(mesh, y, w)
+    if fp8 is not None:
+        out = _mm_rs_fp8(mesh, int(fp8_margin), y, w, fp8)
+    else:
+        out = _mm_rs(mesh, y, w)
     return out[:, :s] if sp != s else out
 
 

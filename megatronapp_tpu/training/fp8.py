@@ -1,0 +1,220 @@
+"""fp8 (e4m3) training with delayed-scaling amax history (ISSUE 13).
+
+The tp-overlap ring matmuls (parallel/overlap.py ``_ag_mm``/``_mm_rs``)
+own every training GEMM call site under ``--tp-comm-overlap``; this
+module owns the DELAYED-SCALING machinery around their fp8 variants:
+
+- **State.** One fp32 amax history row per (layer, site, tensor):
+  ``hist [n_tensors, H]`` where slot 0 is the most recent step's amax
+  and H = ``cfg.fp8_amax_history_len`` — stacked over layers exactly
+  like the block params so it rides the same ``lax.scan``. Per-site
+  ``sat [n_tensors]`` carries the step's count of saturated elements
+  (the overflow observability satellite). Sites per layer:
+  attention ``qkv`` (x + 2 weights + 2 cotangents = 5 tensors),
+  attention ``out`` / mlp ``fc1`` / mlp ``fc2`` (3 each: input, weight,
+  cotangent).
+
+- **Scales.** Derived from the history at every use —
+  ``scale = FP8_MAX / (max(hist) * 2**margin)`` (TE-style delayed
+  scaling; 1.0 while the history is empty) — so there is no separate
+  scale leaf whose update order could drift from the history's; the
+  documented "current scale" in /metrics is this same derivation.
+
+- **Transport.** The new history never touches the optimizer: the fp8
+  ring custom_vjps define the COTANGENT of the hist input to BE the
+  rolled history with the step's observed amaxes in slot 0 (forward
+  tensors observed in fwd, the cotangent tensor in bwd). The train step
+  differentiates the (params, fp8_state) pair, accumulates the fp8
+  half with elementwise max across microbatches (each microbatch rolls
+  the SAME old history, so max combines exactly the amax slots), and
+  installs ``state["fp8"] = fp8_grads`` directly. Because the state is
+  a first-class member of the train-state pytree it checkpoints,
+  restores, and reshards with everything else — resume is bitwise.
+
+Scope: the fp8 path lives where the rings live — tp > 1 with
+``--tp-comm-overlap`` on, pp == 1 (the ambient-manual tp-sharded stage
+rings keep bf16), dense non-MLA/non-MoE/non-hetero layers.
+``fp8_ineligible_reason`` names the first failed predicate (the house
+loud-fallback contract).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.utils import metrics as telemetry
+
+FP8_DTYPE = jnp.float8_e4m3fn
+FP8_MAX = 448.0          # e4m3fn finfo max (overflow is NaN, not inf)
+
+# Per-layer fp8 sites and their tensor counts — index order inside each
+# site's hist/sat rows: [input, weight_0..weight_{n-1}, grad_0..grad_{n-1}]
+# for the all-gather-matmul sites (fused QKV has two weights) and
+# [input, weight, grad] for the matmul-reduce-scatter sites.
+SITE_TENSORS = {
+    ("attention", "qkv"): 5,
+    ("attention", "out"): 3,
+    ("mlp", "fc1"): 3,
+    ("mlp", "fc2"): 3,
+}
+
+
+def fp8_scale_from_hist(hist: jnp.ndarray, margin: int) -> jnp.ndarray:
+    """Delayed scale per tensor: hist [..., H] → scale [...]
+    (FP8_MAX / (amax * 2**margin); 1.0 while the history is empty)."""
+    amax = jnp.max(hist, axis=-1)
+    return jnp.where(amax > 0.0,
+                     FP8_MAX / (amax * (2.0 ** margin)),
+                     jnp.ones_like(amax))
+
+
+def fp8_quantize(x: jnp.ndarray, scale) -> tuple:
+    """Saturating e4m3 cast of ``x * scale``.
+
+    Returns (x_fp8, amax fp32 scalar, saturated-element count fp32
+    scalar). The clip is load-bearing: e4m3fn overflows to NaN."""
+    x32 = x.astype(jnp.float32) * scale
+    amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    sat = jnp.sum(jnp.abs(x32) > FP8_MAX).astype(jnp.float32)
+    q = jnp.clip(x32, -FP8_MAX, FP8_MAX).astype(FP8_DTYPE)
+    return q, amax, sat
+
+
+def rolled_hist(hist: jnp.ndarray, amaxes: jnp.ndarray) -> jnp.ndarray:
+    """New delayed-scaling history: shift every row right by one and
+    install this step's observed amaxes in slot 0.
+
+    hist [n, H], amaxes [n] → [n, H]."""
+    return jnp.concatenate(
+        [amaxes[:, None], hist[:, :-1]], axis=1)
+
+
+def _site(num_layers: int, n_tensors: int, hist_len: int) -> Dict:
+    return {
+        "hist": jnp.zeros((num_layers, n_tensors, hist_len), jnp.float32),
+        "sat": jnp.zeros((num_layers, n_tensors), jnp.float32),
+    }
+
+
+def init_fp8_state(cfg) -> Dict:
+    """The per-run fp8 state pytree (threaded through train_state /
+    checkpointing): amax histories + per-step saturation counts for
+    every (layer, site, tensor), stacked over layers for the block
+    scan."""
+    l = cfg.num_layers
+    h = int(getattr(cfg, "fp8_amax_history_len", 16))
+    out: Dict = {"block": {}}
+    for (mod, site), n in SITE_TENSORS.items():
+        out["block"].setdefault(mod, {})[site] = _site(l, n, h)
+    return out
+
+
+def fp8_ineligible_reason(cfg, parallel) -> Optional[str]:
+    """Why --fp8 may NOT run — None when eligible, otherwise the FIRST
+    failed predicate by name (tp_paged_ineligible_reason contract).
+    Checked at parse time (config/arguments.py) AND at train wiring."""
+    if not getattr(cfg, "fp8", False):
+        return "cfg.fp8 off"
+    if not getattr(cfg, "tp_comm_overlap", False):
+        return ("--fp8 requires --tp-comm-overlap: the fp8 GEMMs live "
+                "inside the ring all-gather / reduce-scatter matmul "
+                "bodies (parallel/overlap.py)")
+    tp = getattr(parallel, "tensor_parallel", 1)
+    if tp <= 1:
+        return (f"--fp8 requires --tensor-model-parallel-size > 1 "
+                f"(got {tp}): with tp == 1 no ring matmul ever runs, "
+                "so fp8 would silently be a no-op")
+    if getattr(parallel, "pipeline_parallel", 1) > 1:
+        return ("--fp8 does not support pipeline parallelism yet: the "
+                "ambient-manual tp-sharded stage rings keep bf16 "
+                "(amax state threading through the pp scan is the "
+                "recorded follow-up)")
+    if getattr(parallel, "context_parallel", 1) > 1:
+        return ("--fp8 requires context_parallel == 1 (the GSPMD "
+                "overlap rings are cp==1-only — tp_overlap_eligible)")
+    if cfg.is_moe:
+        return ("--fp8 does not support MoE layers: expert GEMMs "
+                "dispatch outside the tp rings")
+    if cfg.multi_latent_attention:
+        return ("--fp8 does not support MLA: the dense MLA projections "
+                "only ring inside the pp stage body, which keeps bf16")
+    if getattr(cfg, "hetero_block_specs", None):
+        return "--fp8 does not support heterogeneous per-layer configs"
+    if cfg.mtp_num_layers:
+        return ("--fp8 does not support MTP depth modules yet (their "
+                "layer bodies run outside the fp8-threaded block scan)")
+    if getattr(parallel, "forward_backward_disaggregating", False):
+        return ("--fp8 is not supported with "
+                "--forward-backward-disaggregating (the FBD executor "
+                "path does not thread the fp8 state)")
+    if getattr(parallel, "use_dpp", False):
+        return ("--fp8 is not wired into the host-driven DPP runtime "
+                "(--use-dpp)")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Train-step integration helpers
+# ---------------------------------------------------------------------------
+
+
+def fp8_zeros_like(fp8_state):
+    return jax.tree.map(jnp.zeros_like, fp8_state)
+
+
+def fp8_accumulate(acc, new):
+    """Combine two microbatches' fp8 observations: histories combine
+    with elementwise max (both are roll(old) with the microbatch amax in
+    slot 0, so max keeps the rolled tail and takes the larger amax);
+    saturation counts ADD (each microbatch counts its own elements)."""
+    def comb(path, a, b):
+        if path[-1].key == "sat":
+            return a + b
+        return jnp.maximum(a, b)
+    return jax.tree_util.tree_map_with_path(comb, acc, new)
+
+
+def fp8_carry_sat(old_state, new_obs):
+    """Promote the step's saturation observations to CUMULATIVE totals:
+    the state's sat leaves count every saturated element since step 0
+    (they checkpoint with the histories), while the hist leaves take the
+    step's rolled value as-is. Applied once per step in train_step,
+    where both the old state and the step's observations are in hand."""
+    def comb(path, old, new):
+        if path[-1].key == "sat":
+            return old + new
+        return new
+    return jax.tree_util.tree_map_with_path(comb, old_state, new_obs)
+
+
+def export_fp8_metrics(fp8_state, cfg):
+    """Host-side /metrics export (ISSUE 13 satellite): per-site current
+    scale + worst amax gauges (aggregated over layers/tensors — scale
+    drift is a per-site signal), the history depth, and the CUMULATIVE
+    saturation totals (the state's sat leaves accumulate every step via
+    fp8_carry_sat, so a gauge set at log time is exact regardless of
+    log_interval). One device_get per logged step, all math in numpy on
+    the fetched host arrays; callers gate on telemetry.enabled()."""
+    import numpy as np
+    if not telemetry.enabled():
+        return
+    margin = int(getattr(cfg, "fp8_margin", 0))
+    telemetry.set_gauge("fp8_amax_history_len",
+                        int(getattr(cfg, "fp8_amax_history_len", 16)))
+    host = jax.device_get(fp8_state)
+    for mod, sites in host["block"].items():
+        for site, leaves in sites.items():
+            hist = np.asarray(leaves["hist"])        # [L, n, H]
+            amax = np.max(hist, axis=-1)             # [L, n]
+            scale = np.where(amax > 0.0,
+                             FP8_MAX / np.maximum(amax, 1e-30)
+                             / (2.0 ** margin), 1.0)
+            telemetry.set_gauge(f"fp8_amax_{mod}_{site}",
+                                float(hist.max()))
+            telemetry.set_gauge(f"fp8_scale_{mod}_{site}",
+                                float(scale.min()))
+            telemetry.set_gauge(f"fp8_saturated_{mod}_{site}",
+                                float(np.sum(np.asarray(leaves["sat"]))))

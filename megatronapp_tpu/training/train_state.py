@@ -70,11 +70,17 @@ def pick_rules(ctx: MeshContext, optimizer=None):
 
 def setup_train_state(rng, params_and_axes_fn: Callable, optimizer,
                       ctx: MeshContext, rules=None,
-                      sharded_init: bool = False) -> Tuple[Any, Any, Any]:
+                      sharded_init: bool = False,
+                      fp8_state=None) -> Tuple[Any, Any, Any]:
     """Initialize the full train state into its shardings.
 
     params_and_axes_fn(rng) -> (params, logical_axes). Returns
     (state, state_shardings, params_axes).
+
+    fp8_state (ISSUE 13, training/fp8.init_fp8_state): when given, the
+    delayed-scaling amax histories join the state pytree under "fp8"
+    (replicated — a few KB of fp32) so checkpoint save/restore and
+    resharding carry them with everything else and resume is bitwise.
 
     sharded_init=False (default): two-stage init — jit with fully
     REPLICATED out_shardings (every device runs the identical init
@@ -109,12 +115,20 @@ def setup_train_state(rng, params_and_axes_fn: Callable, optimizer,
     def _init(rng):
         params, _ = params_and_axes_fn(rng)
         opt_state = optimizer.init(params)
-        return {"step": jnp.zeros((), jnp.int32), "params": params,
-                "opt_state": opt_state}
+        state = {"step": jnp.zeros((), jnp.int32), "params": params,
+                 "opt_state": opt_state}
+        if fp8_state is not None:
+            state["fp8"] = jax.tree.map(jnp.asarray, fp8_state)
+        return state
 
     state_struct = jax.eval_shape(_init, rng)
     axes = state_logical_axes(params_axes, state_struct["opt_state"])
     shardings = tree_logical_to_sharding(axes, ctx.mesh, rules)
+    if fp8_state is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        shardings["fp8"] = jax.tree.map(
+            lambda _: NamedSharding(ctx.mesh, PartitionSpec()),
+            fp8_state)
     if getattr(optimizer, "zero1", False) and \
             getattr(optimizer, "shard_state", True):
         # ZeRO-1: the m/v/master leaves additionally shard over the dp

@@ -82,6 +82,7 @@ def make_train_step(
     pipeline: bool = False,
     trace_phases: bool = False,
     donate: bool = True,
+    fp8: bool = False,
 ):
     """loss_fn(params, microbatch_dict) -> (loss, metrics_dict).
 
@@ -91,7 +92,16 @@ def make_train_step(
     internally — parallel/pipeline.py); otherwise a lax.scan accumulates
     grads microbatch by microbatch (reference
     forward_backward_no_pipelining, schedules.py:618).
-    """
+
+    fp8 (ISSUE 13): loss_fn additionally accepts fp8= (the delayed-
+    scaling amax state, state["fp8"]) and the step differentiates the
+    (params, fp8) PAIR — the fp8 half's "gradient" is the updated
+    history (parallel/overlap.py fp8 custom_vjps), which accumulates
+    with elementwise max / saturation-count sum across microbatches
+    (training/fp8.fp8_accumulate), bypasses grad scaling, the grad
+    norm, and the optimizer entirely, and lands in state["fp8"]
+    directly. A NaN-skipped step keeps the old history (nothing
+    observed)."""
     sched = lr_schedule(opt_cfg, train_iters)
     # ZeRO-1 manual update path (--dist-opt-comm ring|bulk): the weight
     # update runs inside one full-manual shard_map with the updated
@@ -120,46 +130,83 @@ def make_train_step(
         )
         inner_loss = loss_fn
 
-        def loss_fn(params, micro):  # noqa: F811 — traced wrapper
+        def loss_fn(params, micro, **kw):  # noqa: F811 — traced wrapper
             # Spans must sit on the params→loss differentiation path so the
             # custom-VJP backward mirrors fire: B 'forward' on params entry
             # (its bwd emits E 'backward' when the last param cotangent
             # leaves), E 'forward' + B 'backward' mirror on the loss.
             params = phase_span_begin(params, "forward", "backward")
-            loss, metrics = inner_loss(params, micro)
+            loss, metrics = inner_loss(params, micro, **kw)
             loss = phase_span_end(loss, "forward", "backward")
             loss = phase_span_begin(loss, "loss")
             loss = phase_span_end(loss, "loss")
             return loss, metrics
 
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    if fp8 and pipeline:
+        raise ValueError("fp8 does not support the pipeline loss path "
+                         "(fp8_ineligible_reason gates this off)")
+    if fp8:
+        def _fp8_target(pair, micro):
+            params, fstate = pair
+            return loss_fn(params, micro, fp8=fstate)
+        grad_fn = jax.value_and_grad(_fp8_target, has_aux=True)
+    else:
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def step(state, batch):
         params = state["params"]
         num_micro = jax.tree.leaves(batch)[0].shape[0]
+        fp8_new = None
 
         if pipeline:
             (loss, aux), grads = grad_fn(params, batch)
         else:
+            from megatronapp_tpu.training.fp8 import (
+                fp8_accumulate, fp8_zeros_like,
+            )
+
             def accum(carry, micro):
                 g_acc, loss_acc, aux_acc = carry
-                (loss, metrics), g = grad_fn(params, micro)
-                g_acc = jax.tree.map(
-                    lambda a, b: a + b.astype(a.dtype), g_acc, g)
+                if fp8:
+                    (loss, metrics), (g, g8) = grad_fn(
+                        (params, state["fp8"]), micro)
+                    gp_acc, f8_acc = g_acc
+                    g_acc = (jax.tree.map(
+                        lambda a, b: a + b.astype(a.dtype), gp_acc, g),
+                        fp8_accumulate(f8_acc, g8))
+                else:
+                    (loss, metrics), g = grad_fn(params, micro)
+                    g_acc = jax.tree.map(
+                        lambda a, b: a + b.astype(a.dtype), g_acc, g)
                 return (g_acc, loss_acc + loss,
                         jax.tree.map(lambda a, b: a + b, aux_acc,
                                      metrics)), None
 
             zeros = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            metrics_struct = jax.eval_shape(
-                lambda: loss_fn(params,
-                                jax.tree.map(lambda x: x[0], batch))[1])
+            if fp8:
+                zeros = (zeros, fp8_zeros_like(state["fp8"]))
+                metrics_struct = jax.eval_shape(
+                    lambda: loss_fn(
+                        params, jax.tree.map(lambda x: x[0], batch),
+                        fp8=state["fp8"])[1])
+            else:
+                metrics_struct = jax.eval_shape(
+                    lambda: loss_fn(params,
+                                    jax.tree.map(lambda x: x[0],
+                                                 batch))[1])
             aux_zeros = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, s.dtype), metrics_struct)
             (g_sum, loss_sum, aux_sum), _ = jax.lax.scan(
                 accum, (zeros, jnp.zeros((), jnp.float32), aux_zeros), batch)
 
+            if fp8:
+                g_sum, fp8_new = g_sum
+                # Saturation totals are CUMULATIVE in the state (the
+                # observations are per-step counts); histories take the
+                # step's rolled value.
+                from megatronapp_tpu.training.fp8 import fp8_carry_sat
+                fp8_new = fp8_carry_sat(state["fp8"], fp8_new)
             inv = 1.0 / num_micro
             grads = jax.tree.map(lambda g: g * inv, g_sum)
             loss = loss_sum * inv
@@ -180,32 +227,45 @@ def make_train_step(
             if zero1_manual:
                 from megatronapp_tpu.training.distributed_optimizer \
                     import manual_apply
-                return manual_apply(
+                new_params, new_opt = manual_apply(
                     optimizer, grads, state["opt_state"], params,
                     state_shardings, ctx.mesh, zero1_plan,
                     overlap=(opt_cfg.dist_opt_comm == "ring"))
-            updates, new_opt = optimizer.update(
-                grads, state["opt_state"], params)
-            if hasattr(optimizer, "apply_updates"):
-                # Master-weight aware (ZeRO-1 mixed precision): params
-                # become the rounded image of the fp32 master shard.
-                new_params = optimizer.apply_updates(params, updates,
-                                                     new_opt)
             else:
-                new_params = jax.tree.map(
-                    lambda p, u: (p + u.astype(p.dtype)), params, updates)
+                updates, new_opt = optimizer.update(
+                    grads, state["opt_state"], params)
+                if hasattr(optimizer, "apply_updates"):
+                    # Master-weight aware (ZeRO-1 mixed precision):
+                    # params become the rounded image of the fp32
+                    # master shard.
+                    new_params = optimizer.apply_updates(params, updates,
+                                                         new_opt)
+                else:
+                    new_params = jax.tree.map(
+                        lambda p, u: (p + u.astype(p.dtype)), params,
+                        updates)
+            if fp8:
+                # The accumulated fp8 "gradient" IS the next history
+                # (rolled, amaxes in slot 0) — installed directly,
+                # never via the optimizer.
+                return new_params, new_opt, fp8_new
             return new_params, new_opt
 
         def skip(_):
+            if fp8:
+                return params, state["opt_state"], state["fp8"]
             return params, state["opt_state"]
 
         if check_nan:
-            new_params, new_opt = jax.lax.cond(finite, do_update, skip,
-                                               operand=None)
+            updated = jax.lax.cond(finite, do_update, skip, operand=None)
             skipped = jnp.where(finite, 0, 1).astype(jnp.int32)
         else:
-            new_params, new_opt = do_update(None)
+            updated = do_update(None)
             skipped = jnp.zeros((), jnp.int32)
+        if fp8:
+            new_params, new_opt, new_fp8 = updated
+        else:
+            new_params, new_opt = updated
 
         if trace_phases:
             new_params = phase_span_end(new_params, "optimizer")
@@ -214,6 +274,8 @@ def make_train_step(
             "params": new_params,
             "opt_state": new_opt,
         }
+        if fp8:
+            new_state["fp8"] = new_fp8
         metrics = {
             "loss": loss,
             "grad_norm": grad_norm,
@@ -233,20 +295,24 @@ def make_train_step(
 
 
 def make_eval_step(loss_fn, ctx: MeshContext, state_shardings,
-                   pipeline: bool = False):
+                   pipeline: bool = False, fp8: bool = False):
     """Forward-only loss (reference evaluate(), training.py eval loop).
 
     pipeline=True: loss_fn consumes the whole microbatched batch (the SPMD
-    pipeline schedules internally), matching make_train_step."""
+    pipeline schedules internally), matching make_train_step.
+
+    fp8: evaluate through the same fp8 forward as training (the amax
+    state is read, never updated — no backward runs here)."""
     b_sh = batch_shardings(ctx)
 
     def step(state, batch):
+        kw = {"fp8": state["fp8"]} if fp8 else {}
         if pipeline:
             loss, _ = loss_fn(state["params"], batch)
             return loss
 
         def body(acc, micro):
-            loss, _ = loss_fn(state["params"], micro)
+            loss, _ = loss_fn(state["params"], micro, **kw)
             return acc + loss, None
         total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), batch)
         return total / jax.tree.leaves(batch)[0].shape[0]

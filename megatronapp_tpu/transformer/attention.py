@@ -90,6 +90,7 @@ def attention_forward(
     chunk_counts: Optional[jnp.ndarray] = None,
     tp_sharded: bool = False,
     kv_scales=None,
+    fp8=None,
 ) -> jnp.ndarray:
     """x: [B, S, H] → [B, S, H]. Returns (out, new_kv_cache).
 
@@ -152,6 +153,15 @@ def attention_forward(
     overlap = (kv_cache is None and not tp_sharded
                and tp_overlap_eligible(cfg, ctx, nq * d, 2 * nkv * d,
                                        batch=b))
+    # fp8 (ISSUE 13): this layer's delayed-scaling state for the
+    # qkv/out-proj ring sites — only legal when the rings actually run
+    # (the amax history would silently rot otherwise).
+    if fp8 is not None and not overlap:
+        raise ValueError(
+            "fp8 state passed but the tp-overlap rings are not "
+            "eligible here (tp_overlap_eligible is False / decode "
+            "path) — check fp8_ineligible_reason at wiring time")
+    fp8_margin = int(getattr(cfg, "fp8_margin", 0))
     # Serving-resident int8 weights (inference/quantization.py
     # residentize_params): resolve_param dequantizes at matmul entry —
     # int8 stays in HBM, XLA fuses the per-channel scale multiply.
@@ -230,7 +240,9 @@ def attention_forward(
         # ambient manual regions; the pipeline takes tp_sharded above)
         q, kv = all_gather_matmul(
             x, (q_kernel.astype(cfg.compute_dtype),
-                kv_kernel.astype(cfg.compute_dtype)), ctx.shard_map_mesh)
+                kv_kernel.astype(cfg.compute_dtype)), ctx.shard_map_mesh,
+            fp8=None if fp8 is None else fp8["qkv"],
+            fp8_margin=fp8_margin)
     else:
         q = x @ q_kernel.astype(cfg.compute_dtype)
         kv = x @ kv_kernel.astype(cfg.compute_dtype)
@@ -296,8 +308,8 @@ def attention_forward(
                 # attend — and scatter the scales through the same page
                 # table.
                 cks, cvs = kv_scales
-                k_q, k_s = quantize_kv_rows(k)
-                v_q, v_s = quantize_kv_rows(v)
+                k_q, k_s = quantize_kv_rows(k, dtype=ck.dtype)
+                v_q, v_s = quantize_kv_rows(v, dtype=cv.dtype)
                 ck = append_chunk_pages(ck, k_q, page_table,
                                         cache_positions, counts, active)
                 cv = append_chunk_pages(cv, v_q, page_table,
@@ -336,8 +348,8 @@ def attention_forward(
                 active = jnp.ones((b,), bool)
             if kv_scales is not None:
                 cks, cvs = kv_scales
-                k_q, k_s = quantize_kv_rows(k[:, 0])
-                v_q, v_s = quantize_kv_rows(v[:, 0])
+                k_q, k_s = quantize_kv_rows(k[:, 0], dtype=ck.dtype)
+                v_q, v_s = quantize_kv_rows(v[:, 0], dtype=cv.dtype)
                 ck = append_token_pages(ck, k_q, page_table,
                                         cache_positions, active)
                 cv = append_token_pages(cv, v_q, page_table,
@@ -526,8 +538,11 @@ def attention_forward(
     out_kernel = out_kernel.astype(cfg.compute_dtype)
     if overlap:
         # manual-ok: same tp_overlap_eligible gate as the QKV ring above
-        out = matmul_reduce_scatter(attn_out.reshape(b, s, nq * d),
-                                    out_kernel, ctx.shard_map_mesh)
+        out = matmul_reduce_scatter(
+            attn_out.reshape(b, s, nq * d), out_kernel,
+            ctx.shard_map_mesh,
+            fp8=None if fp8 is None else fp8["out"],
+            fp8_margin=fp8_margin)
     else:
         out = attn_out.reshape(b, s, nq * d) @ out_kernel
     if "out_bias" in p:

@@ -72,7 +72,7 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                   zigzag: bool = False, segment_ids=None,
                   page_table=None, active=None, chunk_counts=None,
                   tp_sharded: bool = False, kv_scales=None,
-                  fused_decode: bool = False):
+                  fused_decode: bool = False, fp8=None):
     """One transformer layer. x: [B,S,H] → ((out, new_cache), aux_losses).
 
     page_table/active: paged-KV decode (inference/paged_cache.py) —
@@ -91,7 +91,12 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     generated paged-attention kernel (ops/pallas/kernel_gen.py
     fused_layer_decode) instead of the ~15-fusion unfused tail. Callers
     (DynamicInferenceEngine fused_decode=True) gate eligibility via
-    kernel_gen.megakernel_ineligible_reason; streams stay token-exact."""
+    kernel_gen.megakernel_ineligible_reason; streams stay token-exact.
+
+    fp8: this layer's delayed-scaling amax state (training/fp8.py,
+    ISSUE 13) — {"attention": {"qkv", "out"}, "mlp": {"fc1", "fc2"}}
+    sub-dicts threaded into the tp-overlap ring GEMMs; the updated
+    histories travel out through their cotangents."""
     if fused_decode:
         if (page_table is None or kv_cache is None
                 or chunk_counts is not None or x.shape[1] != 1
@@ -141,7 +146,8 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
             ctx=ctx, zigzag=zigzag, segment_ids=segment_ids,
             page_table=page_table, active=active,
             chunk_counts=chunk_counts, tp_sharded=tp_sharded,
-            kv_scales=kv_scales)
+            kv_scales=kv_scales,
+            fp8=None if fp8 is None else fp8["attention"])
     # Tag for the 'selective_attn' remat policy (a no-op otherwise).
     attn_out = checkpoint_name(attn_out, "attn_out")
     x = residual + attn_out.astype(residual.dtype)
@@ -151,11 +157,15 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                    cfg.layernorm_epsilon)
     aux = None
     if "moe" in p:
+        if fp8 is not None:
+            raise ValueError("fp8 does not support MoE layers "
+                             "(fp8_ineligible_reason gates this off)")
         mlp_out, aux = moe_forward(p["moe"], h, cfg, layer_id=layer_id,
                                    ctx=ctx, tp_sharded=tp_sharded)
     else:
         mlp_out = mlp_forward(p["mlp"], h, cfg, layer_id=layer_id, ctx=ctx,
-                              tp_sharded=tp_sharded)
+                              tp_sharded=tp_sharded,
+                              fp8=None if fp8 is None else fp8["mlp"])
     x = residual + mlp_out.astype(residual.dtype)
     # MegaScope 'system' perturbation + capture site between layers
     # (transformer_block.py:542-544).
@@ -238,11 +248,23 @@ def init_block_params(rng, cfg: TransformerConfig, num_layers: int = None):
 def block_forward(stacked_p, x: jnp.ndarray, cfg: TransformerConfig,
                   rope_cos=None, rope_sin=None, attention_mask=None,
                   layer_offset: int = 0, ctx=None, zigzag: bool = False,
-                  segment_ids=None, tp_sharded: bool = False):
+                  segment_ids=None, tp_sharded: bool = False, fp8=None):
     """Run all stacked layers via lax.scan. Returns (x, moe_aux_sum).
 
     tp_sharded: thread the ambient-manual tp-sharded stage-body path
-    through every layer (pp pipeline; see layer_forward)."""
+    through every layer (pp pipeline; see layer_forward).
+
+    fp8: layer-stacked delayed-scaling amax state (training/fp8.py,
+    leaves [L, n_tensors, H]) — rides the SAME layer scan as the
+    stacked params, so each layer's ring GEMMs see their own history
+    slice and the scan's xs-cotangent stacks the updated histories
+    back to [L, ...] for the train step."""
+    if fp8 is not None and (getattr(cfg, "hetero_block_specs", None)
+                            or (isinstance(stacked_p, dict)
+                                and "dense" in stacked_p)):
+        raise ValueError("fp8 does not support heterogeneous / "
+                         "MoE-interleaved layer stacks "
+                         "(fp8_ineligible_reason gates this off)")
     if getattr(cfg, "hetero_block_specs", None):
         if segment_ids is not None or zigzag:
             raise NotImplementedError(
@@ -256,23 +278,28 @@ def block_forward(stacked_p, x: jnp.ndarray, cfg: TransformerConfig,
             layer_offset=layer_offset, ctx=ctx)
     hetero = isinstance(stacked_p, dict) and "dense" in stacked_p
 
-    def run_layer(layer_p, h, lid):
+    def run_layer(layer_p, h, lid, fp8_l=None):
         (h2, _), aux = layer_forward(
             layer_p, h, cfg, rope_cos, rope_sin, attention_mask,
             layer_id=lid, ctx=ctx, zigzag=zigzag,
-            segment_ids=segment_ids, tp_sharded=tp_sharded)
+            segment_ids=segment_ids, tp_sharded=tp_sharded, fp8=fp8_l)
         return h2, (aux if aux is not None
                     else jnp.zeros((), jnp.float32))
 
     if not hetero:
-        def body(carry, layer_p):
+        def body(carry, layer_in):
             h, lid = carry
-            h2, aux = run_layer(layer_p, h, lid)
+            if fp8 is not None:
+                layer_p, fp8_l = layer_in
+            else:
+                layer_p, fp8_l = layer_in, None
+            h2, aux = run_layer(layer_p, h, lid, fp8_l)
             return (h2, lid + 1), aux
 
         body = _remat_wrap(body, cfg.remat_policy)
+        xs = stacked_p if fp8 is None else (stacked_p, fp8)
         (x, _), aux = jax.lax.scan(
-            body, (x, jnp.int32(layer_offset)), stacked_p,
+            body, (x, jnp.int32(layer_offset)), xs,
             unroll=cfg.scan_unroll)
         return x, jnp.sum(aux)
 

@@ -43,12 +43,22 @@ def init_mlp_params(rng, cfg: TransformerConfig, out_std: float,
 
 
 def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
-                ctx=None, tp_sharded: bool = False):
+                ctx=None, tp_sharded: bool = False, fp8=None):
+    """fp8: this layer's delayed-scaling state for the fc1/fc2 ring
+    sites ({"fc1": {hist, sat}, "fc2": ...} — training/fp8.py). Only
+    legal when the tp-overlap rings actually run (fp8_ineligible_reason
+    gates callers); raising here instead of silently ignoring keeps the
+    amax history from rotting."""
     from megatronapp_tpu.scope.disturbance import get_disturbance
     from megatronapp_tpu.parallel.overlap import (
         all_gather_matmul, matmul_reduce_scatter, tp_overlap_eligible,
     )
     if tp_sharded:
+        if fp8 is not None:
+            raise ValueError(
+                "fp8 is not supported on the tp-sharded pipeline stage "
+                "body (ambient-manual rings keep bf16) — "
+                "fp8_ineligible_reason gates this off")
         # Ambient-manual tp-sharded stage body (pp pipeline): x is this
         # shard's [b, S/tp, H] seq chunk; fc1 runs as a ring all-gather-
         # matmul on a local column slice, fc2 as a matmul-reduce-scatter
@@ -68,13 +78,22 @@ def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
     overlap = tp_overlap_eligible(cfg, ctx, fc1_res.shape[1],
                                   fc2_res.shape[0],
                                   batch=x.shape[0])
+    if fp8 is not None and not overlap:
+        raise ValueError(
+            "fp8 state passed but the tp-overlap rings are not "
+            "eligible here (tp_overlap_eligible is False) — the fp8 "
+            "GEMMs live inside the ring bodies; check "
+            "fp8_ineligible_reason at wiring time")
+    margin = int(getattr(cfg, "fp8_margin", 0))
     x = x.astype(cfg.compute_dtype)
     fc1_kernel = _dist.apply("weight", fc1_res, layer_id)
     fc1_kernel = fc1_kernel.astype(cfg.compute_dtype)
     if overlap:
         # manual-ok: overlap gated by tp_overlap_eligible (False inside
         # ambient manual regions; the pipeline takes the tp_sharded path)
-        y = all_gather_matmul(x, fc1_kernel, ctx.shard_map_mesh)
+        y = all_gather_matmul(x, fc1_kernel, ctx.shard_map_mesh,
+                              fp8=None if fp8 is None else fp8["fc1"],
+                              fp8_margin=margin)
     else:
         y = x @ fc1_kernel
     if "fc1_bias" in p:
@@ -92,7 +111,9 @@ def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
     fc2_kernel = fc2_kernel.astype(cfg.compute_dtype)
     if overlap:
         # manual-ok: same tp_overlap_eligible gate as fc1 above
-        out = matmul_reduce_scatter(y, fc2_kernel, ctx.shard_map_mesh)
+        out = matmul_reduce_scatter(
+            y, fc2_kernel, ctx.shard_map_mesh,
+            fp8=None if fp8 is None else fp8["fc2"], fp8_margin=margin)
     else:
         out = y @ fc2_kernel
     if "fc2_bias" in p:

@@ -112,11 +112,18 @@ def _apply_act(cfg: TransformerConfig, y: jnp.ndarray) -> jnp.ndarray:
 
 
 def _expert_ffn(p, x: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
-    """Batched expert MLP: x [E, C, H] → [E, C, H] (GroupedMLP analogue)."""
+    """Batched expert MLP: x [E, C, H] → [E, C, H] (GroupedMLP analogue).
+
+    Expert kernels resolve at matmul entry (inference/quantization.py
+    resolve_param — a no-op on plain arrays): serving-resident int8
+    expert stacks stay int8 in HBM with the per-channel dequant fused
+    into the expert GEMMs, exactly like the dense fc1/fc2 path."""
+    from megatronapp_tpu.inference.quantization import resolve_param
     dt = cfg.compute_dtype
-    y = jnp.einsum("ech,ehf->ecf", x.astype(dt), p["fc1_kernel"].astype(dt))
+    y = jnp.einsum("ech,ehf->ecf", x.astype(dt),
+                   resolve_param(p["fc1_kernel"], dt))
     return jnp.einsum("ecf,efh->ech", _apply_act(cfg, y),
-                      p["fc2_kernel"].astype(dt))
+                      resolve_param(p["fc2_kernel"], dt))
 
 
 def _dropless_experts(p, x_flat, topk_idx, topk_probs,
@@ -126,6 +133,7 @@ def _dropless_experts(p, x_flat, topk_idx, topk_probs,
     row groups — static shapes, no capacity buffer, zero drops. This is
     the reference's default behavior (no --moe-expert-capacity-factor ⇒
     dispatchers never drop; experts.py GroupedMLP runs ragged groups)."""
+    from megatronapp_tpu.inference.quantization import resolve_param
     t, h = x_flat.shape
     k = cfg.moe_router_topk
     e = cfg.num_moe_experts
@@ -135,10 +143,15 @@ def _dropless_experts(p, x_flat, topk_idx, topk_probs,
     token_of = order // k
     group_sizes = jnp.bincount(flat_expert, length=e).astype(jnp.int32)
 
+    # Resident int8 expert stacks dequantize here, at matmul entry
+    # (resolve_param is a no-op on plain arrays) — the ragged grouped
+    # GEMM consumes the dequant directly, so the int8 stack is what
+    # lives in HBM.
     x_sorted = jnp.take(x_flat.astype(dt), token_of, axis=0)
-    y = jax.lax.ragged_dot(x_sorted, p["fc1_kernel"].astype(dt),
+    y = jax.lax.ragged_dot(x_sorted, resolve_param(p["fc1_kernel"], dt),
                            group_sizes)
-    y = jax.lax.ragged_dot(_apply_act(cfg, y), p["fc2_kernel"].astype(dt),
+    y = jax.lax.ragged_dot(_apply_act(cfg, y),
+                           resolve_param(p["fc2_kernel"], dt),
                            group_sizes)
 
     w_sorted = jnp.take(topk_probs.reshape(t * k), order).astype(

@@ -394,7 +394,7 @@ class TestGeneratorBitwise:
     def test_non_ragged_multi_query_rejected(self):
         from megatronapp_tpu.ops.pallas.kernel_gen import PagedSpec
         with pytest.raises(ValueError, match="ragged"):
-            PagedSpec(ragged=False, quantized=False, s_q=3, block_size=8,
+            PagedSpec(ragged=False, quant_dtype=None, s_q=3, block_size=8,
                       num_blocks_seq=4, hkv=2, group=2, scale=1.0)
 
 

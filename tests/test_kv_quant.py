@@ -502,10 +502,13 @@ class TestServingArgsValidation:
 
     def test_startup_ptq_quantizes_resident_leaves_only(self):
         """resident_only PTQ must not round-trip weights residentize
-        would dequantize eagerly (e.g. MoE expert stacks): those leaves
-        stay bit-identical to the checkpoint."""
+        would dequantize eagerly. Since ISSUE 13, MoE expert stacks ARE
+        resident (moe_forward resolves them at matmul entry), so they
+        quantize too; the router stays full precision (top-k selection
+        is perturbation-sensitive)."""
         from megatronapp_tpu.inference.quantization import (
-            is_quantized_leaf, quantize_params, residentize_params,
+            is_quantized_leaf, is_resident_leaf, quantize_params,
+            residentize_params,
         )
         cfg = TransformerConfig(
             num_layers=2, hidden_size=64, num_attention_heads=4,
@@ -515,12 +518,14 @@ class TestServingArgsValidation:
         params, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
         q, report = quantize_params(params, resident_only=True)
         assert is_quantized_leaf(q["block"]["attention"]["q_kernel"])
-        assert not is_quantized_leaf(q["block"]["moe"]["fc1_kernel"])
-        assert not any("moe" in k for k in report)
+        assert is_quantized_leaf(q["block"]["moe"]["fc1_kernel"])
+        assert any("moe" in k for k in report)
+        assert not is_quantized_leaf(q["block"]["moe"]["router_kernel"])
         res = residentize_params(q)
+        assert is_resident_leaf(res["block"]["moe"]["fc1_kernel"])
         np.testing.assert_array_equal(
-            np.asarray(res["block"]["moe"]["fc1_kernel"]),
-            np.asarray(params["block"]["moe"]["fc1_kernel"]))
+            np.asarray(res["block"]["moe"]["router_kernel"]),
+            np.asarray(params["block"]["moe"]["router_kernel"]))
 
 
 class TestBenchmarkSmoke:
