@@ -200,6 +200,17 @@ def _dist_opt_hook():
     return r if r.get("memory") else None
 
 
+def _fleet_hook():
+    """Affinity-router-vs-round-robin fleet A/B
+    (tools/fleet_benchmark.py) on the CPU backend — fleet prefix-cache
+    hit rate, decode p99, live-migration stream parity tracked round
+    over round like the other hooks."""
+    if os.environ.get("BENCH_FLEET", "1") != "1":
+        return None
+    r = _run_child("--fleet", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("affinity") else None
+
+
 def _fp8_hook():
     """fp8 end-to-end A/B (tools/fp8_benchmark.py) on the CPU backend —
     fp8-vs-bf16 training loss parity on the tp2 rings, the compiled
@@ -247,6 +258,9 @@ def _attach_overlap_hooks(res):
     f8 = _fp8_hook()
     if f8:
         res.setdefault("extra", {})["fp8"] = f8
+    flt = _fleet_hook()
+    if flt:
+        res.setdefault("extra", {})["fleet"] = flt
     return res
 
 
@@ -322,6 +336,7 @@ def parent_main(local_only: bool = False):
     mkd = _megakernel_hook()
     tel = _telemetry_hook()
     f8 = _fp8_hook()
+    flt = _fleet_hook()
     last = _load_last_good()
     if last is not None:
         # Top-level `stale` so the consumer can verifiably distinguish this
@@ -358,6 +373,8 @@ def parent_main(local_only: bool = False):
             last["extra"]["telemetry"] = tel
         if f8:
             last["extra"]["fp8"] = f8
+        if flt:
+            last["extra"]["fleet"] = flt
         print(json.dumps(last))
         return
     if cpu:
@@ -384,6 +401,8 @@ def parent_main(local_only: bool = False):
             cpu.setdefault("extra", {})["telemetry"] = tel
         if f8:
             cpu.setdefault("extra", {})["fp8"] = f8
+        if flt:
+            cpu.setdefault("extra", {})["fleet"] = flt
         print(json.dumps(cpu))
         return
     print(json.dumps({
@@ -543,6 +562,14 @@ def fp8_main():
     print(json.dumps(run(iters=6, max_new=6)))
 
 
+def fleet_main():
+    """affinity-router-vs-round-robin fleet A/B child (CPU env set by
+    the parent)."""
+    from tools.fleet_benchmark import run
+    print(json.dumps(run(n_replicas=2, groups=4, followers=3,
+                         prefix_len=32, max_new=8)))
+
+
 def disagg_main():
     """colocated-vs-disaggregated serving A/B child (CPU env set by the
     parent; virtual sub-mesh devices set here, pre-jax-import)."""
@@ -692,5 +719,7 @@ if __name__ == "__main__":
         telemetry_main()
     elif "--fp8" in sys.argv:
         fp8_main()
+    elif "--fleet" in sys.argv:
+        fleet_main()
     else:
         parent_main(local_only="--local" in sys.argv)

@@ -134,6 +134,34 @@ def add_serving_args(ap: argparse.ArgumentParser):
                         "chunks are preempted when the next chunk "
                         "would push the interval past this; /stats "
                         "and /healthz report attainment")
+    # Fleet serving (ISSUE 14, inference/fleet.py).
+    g.add_argument("--serve-fleet", type=int, default=1, metavar="N",
+                   help="run N engine replicas behind the KV-affinity "
+                        "fleet router (inference/fleet.py): admission "
+                        "scores prefix-cache affinity + queue depth + "
+                        "pool pressure + SLO attainment per replica; "
+                        "replica death fails sessions over losslessly; "
+                        "reloads roll one replica at a time. N=1 keeps "
+                        "the single-engine path (needs --engine dynamic "
+                        "--paged-kv-cache for N>1; with --serve-disagg "
+                        "each replica is its own prefill/decode "
+                        "sub-mesh pair)")
+    g.add_argument("--fleet-migrate", action="store_true",
+                   help="live session migration between fleet replicas "
+                        "(PagedKVCache.export_slot/import_slot — "
+                        "quantized KV rows + scales ship verbatim, "
+                        "streams stay token-exact): overloaded replicas "
+                        "hand running sessions to underloaded ones, and "
+                        "rolling reloads drain by migration instead of "
+                        "waiting for completion")
+    g.add_argument("--fleet-autoscale", action="store_true",
+                   help="EWMA-attainment-driven autoscaling of each "
+                        "disagg replica's prefill/decode mesh split "
+                        "(fleet.MeshSplitAutoscaler): low decode-SLO "
+                        "attainment shrinks the prefill sub-mesh, "
+                        "persistent prefill-queue depth grows it; "
+                        "applied by drain + rebuild (needs "
+                        "--serve-disagg)")
     # Telemetry spine (ISSUE 12).
     g.add_argument("--serving-metrics", action="store_true",
                    help="enable the telemetry registry "
@@ -193,6 +221,50 @@ def validate_serving_args(args, multi_latent_attention: bool = False):
                 "fused_decode into its decode engine) — drop one of "
                 "the two flags; silently serving the unfused step "
                 "would violate the loud-fallback contract")
+    # Fleet serving (ISSUE 14): parse-time validation in the usual
+    # first-failed-predicate style — each impossible combination gets
+    # its own actionable message.
+    fleet = getattr(args, "serve_fleet", 1)
+    if fleet < 1:
+        raise SystemExit(
+            f"--serve-fleet must be >= 1 (got {fleet}); 1 = the "
+            "single-engine path, N > 1 = N replicas behind the fleet "
+            "router")
+    if fleet > 1:
+        if getattr(args, "engine", "static") != "dynamic":
+            raise SystemExit(
+                "--serve-fleet N>1 requires --engine dynamic (the "
+                "router drives replica step loops through the "
+                "continuous-batching driver)")
+        if not getattr(args, "paged_kv_cache", False):
+            raise SystemExit(
+                "--serve-fleet N>1 requires --paged-kv-cache (affinity "
+                "scoring rides the pool's rolling block hashes and "
+                "migration ships pool blocks)")
+        if getattr(args, "megakernel_decode", False):
+            raise SystemExit(
+                "--serve-fleet does not support --megakernel-decode "
+                "yet (the fused decode step is gated per engine build "
+                "and the fleet router does not thread fused_decode "
+                "into its replicas) — drop one of the two flags; "
+                "silently serving the unfused step would violate the "
+                "loud-fallback contract")
+    if getattr(args, "fleet_migrate", False) and fleet < 2:
+        raise SystemExit(
+            "--fleet-migrate needs --serve-fleet >= 2 (live session "
+            "migration moves KV between REPLICA pools; with one "
+            "replica there is nowhere to migrate to)")
+    if getattr(args, "fleet_autoscale", False):
+        if not getattr(args, "serve_disagg", False):
+            raise SystemExit(
+                "--fleet-autoscale needs --serve-disagg (the "
+                "autoscaler's knob is each replica's prefill/decode "
+                "mesh split — a colocated engine has no split to "
+                "resize)")
+        if getattr(args, "engine", "static") != "dynamic":
+            raise SystemExit(
+                "--fleet-autoscale needs --engine dynamic (it is a "
+                "fleet-router policy)")
     if (getattr(args, "quantized_weights", False)
             and getattr(args, "engine", "static") == "mamba"):
         raise SystemExit(

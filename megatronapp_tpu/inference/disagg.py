@@ -77,20 +77,41 @@ from megatronapp_tpu.utils import metrics as telemetry
 from megatronapp_tpu.utils.metrics import Histogram
 
 
-def split_serving_meshes(tp: int = 1, devices=None
+def split_serving_meshes(tp: int = 1, devices=None,
+                         prefill_devices: Optional[int] = None
                          ) -> Tuple[MeshContext, MeshContext]:
-    """(prefill_ctx, decode_ctx) on disjoint device halves, each a tp
+    """(prefill_ctx, decode_ctx) on disjoint device subsets, each a tp
     mesh — the serving analogue of `split_fbd_meshes` (same half-mesh
-    construction, no DP bookkeeping: serving replicates params)."""
+    construction, no DP bookkeeping: serving replicates params).
+
+    prefill_devices=None keeps the historical even split on the first
+    2*tp devices. An explicit count gives the prefill sub-mesh that many
+    devices and the decode sub-mesh the REST of `devices` — the knob the
+    fleet autoscaler turns (inference/fleet.py MeshSplitAutoscaler):
+    EWMA decode-SLO attainment shrinks the prefill side, prefill-queue
+    pressure grows it. Both sides must hold at least one whole tp
+    group."""
     if devices is None:
         devices = jax.devices()
-    need = 2 * tp
-    if len(devices) < need:
-        raise ValueError(
-            f"prefill/decode disaggregation at tp={tp} needs {need} "
-            f"devices, have {len(devices)}")
+    devices = list(devices)
     par = ParallelConfig(tensor_parallel=tp)
-    return build_half_meshes(par, par, list(devices)[:need])
+    if prefill_devices is None:
+        need = 2 * tp
+        if len(devices) < need:
+            raise ValueError(
+                f"prefill/decode disaggregation at tp={tp} needs {need} "
+                f"devices, have {len(devices)}")
+        return build_half_meshes(par, par, devices[:need])
+    n_pre = int(prefill_devices)
+    n_dec = len(devices) - n_pre
+    if (n_pre < tp or n_dec < tp or n_pre % tp or n_dec % tp):
+        raise ValueError(
+            f"uneven prefill/decode split {n_pre}/{n_dec} over "
+            f"{len(devices)} devices is invalid at tp={tp}: both "
+            "sub-meshes need a positive multiple of tp devices")
+    from megatronapp_tpu.parallel.mesh import build_mesh
+    return (build_mesh(par, devices=devices[:n_pre]),
+            build_mesh(par, devices=devices[n_pre:]))
 
 
 def _split2(four):
@@ -372,9 +393,10 @@ class DisaggServingEngine:
                  devices=None, spec_method: Optional[str] = None,
                  spec_k: int = 4, draft_params=None, draft_cfg=None,
                  idle_chunks_per_step: int = 4,
-                 kv_cache_dtype: str = "bf16"):
+                 kv_cache_dtype: str = "bf16",
+                 prefill_devices: Optional[int] = None):
         self.prefill_ctx, self.decode_ctx = split_serving_meshes(
-            tp=tp, devices=devices)
+            tp=tp, devices=devices, prefill_devices=prefill_devices)
         max_seq_len = max_seq_len or cfg.max_position_embeddings
         pool = PagedKVCache(
             cfg, max_batch, max_seq_len, num_blocks=num_blocks,
@@ -558,6 +580,24 @@ class DisaggServingEngine:
         host-side pytree is placed onto each mesh independently)."""
         self.engine.set_params(params)
         self.worker.set_params(params)
+
+    # ---- live session migration (ISSUE 14, inference/fleet.py) ----------
+    # Only sessions already in a DECODE slot are exportable (their KV is
+    # complete in the shared pool); in-flight/parked prefills and queued
+    # requests return None from export and migrate by requeue instead —
+    # the engine-level export checks slot occupancy, so the delegation
+    # is safe for every lifecycle stage.
+    def export_request(self, rid: int) -> Optional[dict]:
+        return self.engine.export_request(rid)
+
+    def import_request(self, payload: dict) -> bool:
+        return self.engine.import_request(payload)
+
+    def release_exported(self, rid: int):
+        return self.engine.release_exported(rid)
+
+    def free_decode_slots(self) -> int:
+        return self.engine.free_decode_slots()
 
     def drained_for_reload(self) -> bool:
         """True when a params swap is safe: no decode slot occupied, no

@@ -494,6 +494,14 @@ class DynamicInferenceEngine:
         # tracing is off); counters/histograms to utils/metrics.
         self._rt = get_request_tracer()
         self._last_round_t: Optional[float] = None
+        # Private always-on decode-interval histogram (the disagg
+        # coordinator keeps the same) — the PER-REPLICA SLO signal the
+        # fleet router scores off (inference/fleet.py): the router's
+        # own round timing would measure the whole serial fleet round,
+        # not this replica's decode cadence. Live even when the global
+        # metrics registry is off.
+        from megatronapp_tpu.utils.metrics import Histogram
+        self.interval_hist = Histogram(lo=1e-2, hi=1e6, growth=1.25)
         self.lengths = np.zeros((max_batch,), np.int32)
         self.last_tokens = np.zeros((max_batch, 1), np.int32)
         self.slots: List[Optional[Request]] = [None] * max_batch
@@ -843,6 +851,77 @@ class DynamicInferenceEngine:
             rt.begin("decode", req.request_id)
         return slot
 
+    # ---- live session migration (ISSUE 14, inference/fleet.py) -----------
+    def export_request(self, rid: int) -> Optional[dict]:
+        """READ-ONLY snapshot of a RUNNING request's migratable state:
+        the pool's exported KV rows (+ scales, verbatim bytes) plus the
+        Request object itself — which carries the sampler fold_in chain
+        position (request_id + len(generated)) and every admission
+        field, so the destination continues the EXACT stream (greedy
+        and sampled alike: the key chain PRNGKey(seed)∘rid∘step never
+        references which replica computes the step). Returns None when
+        the request is not currently decoding in a slot — waiting /
+        mid-prefill requests own no resumable KV and migrate by simple
+        requeue instead. Nothing is mutated here: the source rolls
+        nothing back if the migration dies between export and import
+        (the "fleet-migrate" chaos site)."""
+        assert self.paged, "session export requires the paged backend"
+        req = self.requests.get(rid)
+        if (req is None or req.finished or req.slot < 0
+                or self.slots[req.slot] is not req or not req.generated):
+            return None
+        valid_len = int(self.lengths[req.slot])
+        payload = self.pool.export_slot(req.slot, valid_len)
+        payload["req"] = req
+        return payload
+
+    def import_request(self, payload: dict) -> bool:
+        """Install a migrated session from an `export_request` payload:
+        the pool scatters the exported rows into fresh blocks
+        (copy-exact — see PagedKVCache.import_slot) and the request
+        resumes decoding at its exact position. Returns False with the
+        destination untouched when no decode slot is free or the pool
+        cannot host the rows. The MTP proposer's pre-head hidden is not
+        shipped (proposal-quality-only, same note as the disagg adopt
+        path); ngram/draft proposers are unaffected."""
+        assert self.paged, "session import requires the paged backend"
+        req: Request = payload["req"]
+        slot = next((i for i in range(self.max_batch)
+                     if self.slots[i] is None), None)
+        if slot is None:
+            return False
+        if not self.pool.import_slot(slot, payload):
+            return False
+        valid_len = payload["valid_len"]
+        req.slot = slot
+        self.slots[slot] = req
+        self.requests[req.request_id] = req
+        self.lengths[slot] = valid_len
+        self.last_tokens[slot, 0] = req.generated[-1]
+        # Followers on THIS replica hit the migrated prompt blocks like
+        # any locally-prefilled ones.
+        self.pool.register_prefix(slot, np.asarray(req.tokens), valid_len)
+        if self.proposer is not None:
+            self.proposer.on_admit(slot, req)
+        self._rt.instant("migrate-in", req.request_id, slot=slot,
+                         length=valid_len)
+        return True
+
+    def release_exported(self, rid: int):
+        """Source-side completion of a migration: the destination has
+        imported the KV copy, so this replica's slot releases. The
+        prompt prefix registers first (release() does) — the KV stays
+        weight-valid, so followers on THIS replica keep hitting it. The
+        request itself now lives in the destination engine's books."""
+        req = self.requests.pop(rid)
+        # req.slot already points at the DESTINATION slot (import set
+        # it) — find the source slot by identity.
+        slot = next(i for i, r in enumerate(self.slots) if r is req)
+        self.pool.release(slot, np.asarray(req.tokens),
+                          int(self.lengths[slot]))
+        self._free_slot(slot)
+        self._rt.instant("migrate-out", rid, slot=slot)
+
     def _admit(self) -> List[Request]:
         admitted = []
         if self.pause_admission:
@@ -1171,8 +1250,9 @@ class DynamicInferenceEngine:
             # disagg coordinator's SLO accounting).
             t_round = time.monotonic()
             if self._last_round_t is not None:
-                telemetry.observe("decode_interval_ms",
-                                  (t_round - self._last_round_t) * 1e3)
+                iv_ms = (t_round - self._last_round_t) * 1e3
+                telemetry.observe("decode_interval_ms", iv_ms)
+                self.interval_hist.observe(iv_ms)
             if self.spec_method:
                 self._spec_round(active, events)
             else:

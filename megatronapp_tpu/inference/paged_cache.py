@@ -60,6 +60,26 @@ def cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
+def prefix_block_keys(tokens, block_size: int, limit: int) -> List[bytes]:
+    """Rolling hash per FULL block of tokens[:limit]: key i commits to
+    the whole prefix through block i, so a table hit is an exact prefix
+    match. The ONE hashing implementation shared by the pool's prefix
+    cache and the fleet router's affinity map (inference/fleet.py) — a
+    hash mismatch between them would silently zero the affinity signal,
+    so neither side rolls its own."""
+    tokens = np.asarray(tokens, np.int32)
+    keys: List[bytes] = []
+    digest = b""
+    for i in range(limit // block_size):
+        digest = hashlib.sha1(
+            digest + np.ascontiguousarray(
+                tokens[i * block_size:(i + 1) * block_size],
+                dtype=np.int32).tobytes()
+        ).digest()
+        keys.append(digest)
+    return keys
+
+
 @dataclasses.dataclass(frozen=True)
 class KvDtypeSpec:
     """One KV-cache storage dtype (the SHARED registry entry): the pool
@@ -197,7 +217,17 @@ class PagedKVCache:
             [] for _ in range(self.num_slots)]
         self.stats = {"prefix_hit_tokens": 0, "prefill_tokens": 0,
                       "cow_copies": 0, "evictions": 0, "preemptions": 0,
-                      "peak_blocks_in_use": 0, "handoff_transfers": 0}
+                      "peak_blocks_in_use": 0, "handoff_transfers": 0,
+                      "slot_exports": 0, "slot_imports": 0}
+        # Fleet-router hooks (inference/fleet.py): prefix_listener(keys)
+        # fires with every batch of NEWLY registered prefix-block hashes
+        # (the router's hash→replica affinity map is fed from these
+        # events); flush_listener() fires when the prefix cache is
+        # flushed (rolling reload — the router must drop this replica's
+        # affinity entries, or it would keep steering sessions to it for
+        # stale-weight "hits"). Both default to None (zero cost).
+        self.prefix_listener = None
+        self.flush_listener = None
 
     # ---- placement -------------------------------------------------------
     def place_pages(self, sharding, scales_sharding=None):
@@ -301,18 +331,10 @@ class PagedKVCache:
 
     # ---- prefix hashing --------------------------------------------------
     def _block_keys(self, tokens: np.ndarray, limit: int) -> List[bytes]:
-        """Rolling hash per FULL block of tokens[:limit] (key i commits to
-        the whole prefix through block i, so a table hit is an exact
-        prefix match)."""
-        bs = self.block_size
-        keys, digest = [], b""
-        for i in range(limit // bs):
-            digest = hashlib.sha1(
-                digest + np.ascontiguousarray(
-                    tokens[i * bs:(i + 1) * bs], dtype=np.int32).tobytes()
-            ).digest()
-            keys.append(digest)
-        return keys
+        """Rolling hash per FULL block of tokens[:limit] (delegates to
+        the module-level prefix_block_keys — the implementation shared
+        with the fleet router's affinity map)."""
+        return prefix_block_keys(tokens, self.block_size, limit)
 
     # ---- engine-facing API ----------------------------------------------
     def admit(self, slot: int, tokens: np.ndarray) -> Optional[AdmitPlan]:
@@ -430,6 +452,12 @@ class PagedKVCache:
         for blk in self._lru:
             self._free.append(blk)
         self._lru.clear()
+        if self.flush_listener is not None:
+            # Structural invalidation (ISSUE 14 satellite): ANY flush —
+            # however set_params was reached — drops the fleet router's
+            # affinity entries for this replica, so the router cannot
+            # keep steering sessions at stale-weight "hits".
+            self.flush_listener()
 
     def transfer_slot(self, src: int, dst: int):
         """Move block ownership from slot `src` to slot `dst` (which
@@ -445,6 +473,113 @@ class PagedKVCache:
         self.page_table[dst, :] = self.page_table[src, :]
         self.page_table[src, :] = 0
         self.stats["handoff_transfers"] += 1
+
+    def export_slot(self, slot: int, valid_len: int) -> dict:
+        """READ-ONLY export of a slot's written KV rows for CROSS-POOL
+        live session migration (inference/fleet.py — the PR-8/10 disagg
+        handoff generalized; `transfer_slot` above stays the intra-pool
+        fast path). Gathers the first `valid_len` rows of every pool
+        tensor to host arrays IN THE STORED DTYPE: quantized pools ship
+        their int8/fp8 rows + fp32 scales VERBATIM — no dequantize/
+        re-quantize round trip, so an import on the destination is
+        copy-exact and the migrated stream stays token-exact. Nothing
+        here mutates the source pool: a migration that fails after the
+        export (the "fleet-migrate" chaos site) leaves the source slot
+        fully intact."""
+        import jax
+        from megatronapp_tpu.ops.pallas.paged_attention import (
+            gather_prefix_pages,
+        )
+        assert valid_len > 0, "export_slot: nothing written yet"
+        nblocks = cdiv(valid_len, self.block_size)
+        owned = self._slot_blocks[slot]
+        assert nblocks <= len(owned), (
+            f"export_slot: slot {slot} owns {len(owned)} blocks but "
+            f"{valid_len} rows need {nblocks}")
+        table_row = jnp.asarray(self.page_table[slot])
+
+        def grab(pools):
+            return tuple(
+                np.asarray(jax.device_get(
+                    gather_prefix_pages(p, table_row, nblocks)
+                ))[:, :valid_len] for p in pools)
+
+        rows = grab(self.pages)
+        scales = grab(self.scales) if self.scales is not None else None
+        nbytes = sum(r.nbytes for r in rows)
+        if scales is not None:
+            nbytes += sum(s.nbytes for s in scales)
+        self.stats["slot_exports"] += 1
+        telemetry.inc("fleet_kv_exported_bytes", nbytes)
+        return {"kv_cache_dtype": self.kv_cache_dtype, "rows": rows,
+                "scales": scales, "valid_len": valid_len,
+                "nbytes": nbytes}
+
+    def import_slot(self, slot: int, payload: dict) -> bool:
+        """Install an `export_slot` payload into empty slot `slot`:
+        allocate fresh blocks covering valid_len rows and scatter the
+        exported rows (+ scales) into them verbatim. ALL-OR-NOTHING:
+        returns False with every allocated block returned to the pool
+        when capacity is short, and rolls the allocation back on any
+        scatter fault — `audit()` passes either way. The storage dtype
+        must match (rows are stored bytes, never converted): fleet
+        replicas share one --kv-cache-dtype by construction."""
+        if payload["kv_cache_dtype"] != self.kv_cache_dtype:
+            raise ValueError(
+                f"cannot import {payload['kv_cache_dtype']!r} KV rows "
+                f"into a {self.kv_cache_dtype!r} pool — migration ships "
+                "the stored rows verbatim; every fleet replica must run "
+                "the same --kv-cache-dtype")
+        assert not self._slot_blocks[slot], (
+            f"import_slot: destination slot {slot} still holds blocks")
+        valid_len = payload["valid_len"]
+        need = cdiv(valid_len, self.block_size)
+        fresh: List[int] = []
+
+        def _rollback():
+            for b in fresh:
+                self._refcount[b] = 0
+                self._free.append(b)
+
+        try:
+            for _ in range(need):
+                blk = self._take_free()
+                if blk is None:
+                    _rollback()
+                    return False
+                self._refcount[blk] = 1
+                fresh.append(blk)
+        except Exception:
+            _rollback()
+            raise
+        self._slot_blocks[slot] = fresh
+        self.page_table[slot, :] = 0
+        self.page_table[slot, :need] = fresh
+        from megatronapp_tpu.ops.pallas.paged_attention import (
+            write_prompt_pages,
+        )
+        table_row = jnp.asarray(self.page_table[slot])
+        try:
+            self.pages = tuple(
+                write_prompt_pages(p, jnp.asarray(r), table_row, 0,
+                                   valid_len)
+                for p, r in zip(self.pages, payload["rows"]))
+            if self.scales is not None:
+                self.scales = tuple(
+                    write_prompt_pages(p, jnp.asarray(r), table_row, 0,
+                                       valid_len)
+                    for p, r in zip(self.scales, payload["scales"]))
+        except Exception:
+            # Partially-scattered rows are dead data in returned blocks
+            # that the next writer overwrites — bookkeeping stays clean.
+            self._slot_blocks[slot] = []
+            self.page_table[slot, :] = 0
+            _rollback()
+            raise
+        self.stats["slot_imports"] += 1
+        telemetry.inc("fleet_kv_imported_bytes", payload["nbytes"])
+        self._note_usage()
+        return True
 
     def rewind(self, slot: int, valid_len: int):
         """Roll back a slot to `valid_len` written positions: release the
@@ -504,6 +639,7 @@ class PagedKVCache:
         if not self.enable_prefix_caching:
             return
         owned = self._slot_blocks[slot]
+        inserted: List[bytes] = []
         for i, key in enumerate(self._block_keys(tokens, valid_len)):
             if i >= len(owned):
                 break
@@ -511,6 +647,11 @@ class PagedKVCache:
             if blk not in self._hash_of and key not in self._table:
                 self._table[key] = blk
                 self._hash_of[blk] = key
+                inserted.append(key)
+        if inserted and self.prefix_listener is not None:
+            # Per-replica prefix-insert event: the fleet router's
+            # affinity map learns which replica holds which prefix.
+            self.prefix_listener(inserted)
 
     def release(self, slot: int, tokens: np.ndarray, valid_len: int,
                 preempted: bool = False):

@@ -148,7 +148,20 @@ class DynamicBatchingDriver:
         event that fires once the new weights are live. Thread-safe; a
         second reload request before the first lands supersedes its
         params, and BOTH events fire when the (latest) swap lands — a
-        superseded waiter must not block forever."""
+        superseded waiter must not block forever.
+
+        Fleet engines (inference/fleet.FleetRouter) own a BETTER reload
+        than the generic drain-the-whole-engine machinery: replicas
+        drain and swap ONE AT A TIME inside their step loop, so fleet
+        admission never pauses and zero requests drop — the driver
+        delegates to `begin_rolling_reload` and only keeps the stepper
+        awake (reload accounting lives in the fleet's own snapshot)."""
+        if hasattr(self.engine, "begin_rolling_reload"):
+            done = self.engine.begin_rolling_reload(params)
+            with self._cv:
+                self._ensure_thread()
+                self._cv.notify_all()
+            return done
         done = threading.Event()
         with self._cv:
             waiters = ([done] if self._reload is None
@@ -280,7 +293,9 @@ class DynamicBatchingDriver:
             "subscribers": len(self._subs),
             "max_active": self.max_active,
             "reloads": self.reloads,
-            "reload_pending": self._reload is not None,
+            "reload_pending": (self._reload is not None
+                               or getattr(self.engine, "reload_pending",
+                                          False)),
         }
 
 
@@ -315,9 +330,11 @@ class TextGenerationServer:
         from megatronapp_tpu.inference.dynamic_engine import (
             DynamicInferenceEngine,
         )
+        from megatronapp_tpu.inference.fleet import FleetRouter
         self._driver = (DynamicBatchingDriver(engine)
                         if isinstance(engine, (DynamicInferenceEngine,
-                                               DisaggServingEngine))
+                                               DisaggServingEngine,
+                                               FleetRouter))
                         else None)
 
     # ------------------------------------------------------------------
@@ -673,6 +690,25 @@ class TextGenerationServer:
                     "queues": snap["disagg"]["queues"],
                     "slo": snap["disagg"]["slo"],
                 }
+            if "fleet" in snap:
+                # Aggregated fleet health: replica states + attainment
+                # so an orchestrator sees a degraded fleet (dead
+                # replica, reduced capacity) without scraping /stats.
+                f = snap["fleet"]
+                out["fleet"] = {
+                    "num_replicas": f["num_replicas"],
+                    "live_replicas": f["live_replicas"],
+                    "reload_pending": f["reload_pending"],
+                    "migrations": f["migrations"],
+                    "failovers": f["failovers"],
+                    "replicas": [
+                        {k: r.get(k) for k in
+                         ("idx", "state", "active", "waiting",
+                          "attainment", "params_version")}
+                        for r in f["replicas"]],
+                }
+                if f["live_replicas"] < f["num_replicas"]:
+                    out["status"] = "degraded"
             pool_stats = snap.get("pool")
             if pool_stats is not None:
                 # One source of truth for the pool fields (the engine's
@@ -718,6 +754,39 @@ class TextGenerationServer:
             telemetry.set_gauge("paged_blocks_free", pool.free_blocks())
             telemetry.set_gauge("paged_blocks_evictable",
                                 pool.evictable_blocks())
+        reps = getattr(eng, "replicas", None)
+        if reps is not None:
+            # Per-replica labeled series (one metric family, N labeled
+            # series — the fleet dashboard shape).
+            lab = telemetry.labeled
+            for rep in reps:
+                r = str(rep.idx)
+                telemetry.set_gauge(
+                    lab("fleet_replica_up", replica=r),
+                    int(rep.state != "dead"))
+                telemetry.set_gauge(
+                    lab("fleet_replica_attainment", replica=r),
+                    round(rep.attainment(getattr(eng, "slo_ms", None)),
+                          4))
+                if rep.state == "dead":
+                    # Zero the capacity series: frozen last-alive
+                    # values would over-count live capacity on
+                    # dashboards forever.
+                    for g in ("fleet_replica_active_slots",
+                              "fleet_replica_waiting",
+                              "fleet_replica_blocks_in_use"):
+                        telemetry.set_gauge(lab(g, replica=r), 0)
+                    continue
+                reng = rep.engine
+                telemetry.set_gauge(
+                    lab("fleet_replica_active_slots", replica=r),
+                    sum(1 for s in reng.slots if s is not None))
+                telemetry.set_gauge(
+                    lab("fleet_replica_waiting", replica=r),
+                    len(reng.waiting))
+                telemetry.set_gauge(
+                    lab("fleet_replica_blocks_in_use", replica=r),
+                    reng.pool.blocks_in_use())
         if self._driver is not None:
             st = self._driver.stats()
             telemetry.set_gauge("serving_stepper_alive",

@@ -58,6 +58,11 @@ class RequestTracer:
         # rid -> [(pid, name), ...] open spans, innermost last.
         self._open: Dict[int, List[tuple]] = {}
         self._t0 = time.perf_counter_ns()
+        # pid -> Chrome-trace process-row label. Extensible at runtime:
+        # the fleet router labels replica rows ("replica-N decode") so a
+        # migrated request's spans read across replicas in one trace
+        # (ISSUE 14 — migration spans join the per-request timeline).
+        self._pid_names: Dict[int, str] = dict(_PROCESS_NAMES)
 
     # -- configuration -----------------------------------------------------
     def configure(self, enabled: bool = True,
@@ -68,11 +73,20 @@ class RequestTracer:
                 self.capacity = capacity
                 self._ring = deque(self._ring, maxlen=capacity)
 
+    def set_process_name(self, pid: int, name: str):
+        """Label a process row (fleet replicas; custom meshes).
+        reset() restores the default labels — custom names are part of
+        the trace epoch, not global state."""
+        with self._lock:
+            self._pid_names[pid] = name
+
     def reset(self):
-        """Drop all records and open-span state (tests; fresh epochs)."""
+        """Drop all records, open-span state, and custom process
+        labels (tests; fresh epochs)."""
         with self._lock:
             self._ring.clear()
             self._open.clear()
+            self._pid_names = dict(_PROCESS_NAMES)
             self._t0 = time.perf_counter_ns()
 
     def _ts_us(self) -> float:
@@ -177,7 +191,7 @@ class RequestTracer:
         recs = sorted(self._windowed_records(),
                       key=lambda r: (r["ts"], r["pid"]))
         events = transform_to_complete_events(recs)
-        return _chrome(events, process_names or _PROCESS_NAMES)
+        return _chrome(events, process_names or dict(self._pid_names))
 
     def save(self, path: Optional[str] = None, trace_dir: str = "trace"
              ) -> str:

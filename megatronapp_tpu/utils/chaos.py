@@ -65,6 +65,17 @@ tests/test_resilience.py pins this registry against its drill list):
                              exercises the admit rollback (blocks
                              released, request requeued, audit clean)
                              and the worker's untouched-pool retry.
+- ``fleet-migrate``          a live session migration dies between the
+                             source pool's KV export and the
+                             destination's import
+                             (inference/fleet.FleetRouter
+                             .migrate_request) — the replica-death-mid-
+                             migration point: exercises the
+                             exception-safe rollback (export is
+                             read-only, import all-or-nothing, so the
+                             source slot stays intact, both pools
+                             audit() clean, and the retried stream is
+                             bit-identical).
 
 Simulated whole-process faults (hang / exit) are flag-driven rather than
 registry-driven: --simulated-fault KIND:DELAY routes through
@@ -87,6 +98,7 @@ SITES = (
     "paged-cow",
     "spec-verify",
     "kv-quant-write",
+    "fleet-migrate",
 )
 
 

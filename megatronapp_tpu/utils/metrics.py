@@ -43,8 +43,22 @@ from typing import Dict, List, Optional
 __all__ = [
     "Histogram", "Ewma", "MetricsRegistry", "enable", "disable",
     "enabled", "registry", "inc", "set_gauge", "observe", "observe_ewma",
-    "render_prometheus", "snapshot", "counter_value",
+    "render_prometheus", "snapshot", "counter_value", "labeled",
 ]
+
+
+def labeled(name: str, **labels) -> str:
+    """Attach Prometheus labels to a metric name:
+    ``labeled("fleet_replica_active", replica=0)`` →
+    ``fleet_replica_active{replica="0"}``. The renderer keeps the label
+    block verbatim (only the base name is sanitized) and merges
+    histogram ``le`` labels into it — the fleet server exports
+    per-replica gauges this way (one metric family, N labeled series,
+    the Prometheus-native shape for per-replica dashboards)."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
 
 
 class Histogram:
@@ -95,6 +109,33 @@ class Histogram:
                 return lower * (upper / lower) ** frac
             cum += c
         return self.bounds[-1] * self.growth    # unreachable if total>0
+
+    def fraction_below(self, x: float) -> float:
+        """Fraction of observations <= x, estimated from the bucket
+        counts (geometric interpolation inside the covering bucket —
+        the SLO-attainment read: fraction of latencies within budget).
+        Returns 1.0 on an empty histogram (no evidence of violation)."""
+        with self._lock:
+            counts = list(self.counts)
+            total = self.count
+        if total == 0:
+            return 1.0
+        below = 0.0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if i >= len(self.bounds):            # overflow bucket
+                upper = self.bounds[-1] * self.growth
+                lower = self.bounds[-1]
+            else:
+                upper = self.bounds[i]
+                lower = upper / self.growth
+            if x >= upper:
+                below += c
+            elif x > lower:
+                below += c * (math.log(x / lower)
+                              / math.log(upper / lower))
+        return min(1.0, below / total)
 
     def stats(self) -> Dict[str, float]:
         return {
@@ -165,7 +206,26 @@ class MetricsRegistry:
     # -- export ------------------------------------------------------------
     @staticmethod
     def _sanitize(name: str) -> str:
-        return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+        """Sanitize the metric name; a ``labeled()`` suffix (the first
+        '{' onward) is preserved verbatim."""
+        base, brace, rest = name.partition("{")
+        return re.sub(r"[^a-zA-Z0-9_:]", "_", base) + brace + rest
+
+    @staticmethod
+    def _series(name: str, suffix: str = "",
+                extra_label: Optional[str] = None) -> str:
+        """Compose a series line head for a possibly-labeled name:
+        the suffix lands on the BASE name and extra labels merge into
+        the existing label block (``h{replica="0"}`` + ``_bucket`` +
+        ``le="1"`` → ``h_bucket{replica="0",le="1"}``)."""
+        base, brace, rest = name.partition("{")
+        if not brace:
+            labels = f"{{{extra_label}}}" if extra_label else ""
+            return base + suffix + labels
+        inner = rest[:-1] if rest.endswith("}") else rest
+        if extra_label:
+            inner = f"{inner},{extra_label}"
+        return f"{base}{suffix}{{{inner}}}"
 
     @staticmethod
     def _fmt(v: float) -> str:
@@ -185,22 +245,32 @@ class MetricsRegistry:
             ewmas = {k: e.value for k, e in self.ewmas.items()
                      if e.value is not None}
             hists = dict(self.histograms)
+        # TYPE lines carry the BASE family name (a labeled() name's
+        # series share one family); emit each family's TYPE once.
+        typed = set()
+
+        def _type_line(n: str, kind: str):
+            base = n.partition("{")[0]
+            if base not in typed:
+                typed.add(base)
+                lines.append(f"# TYPE {base} {kind}")
+
         for name in sorted(counters):
             n = self._sanitize(name)
-            lines.append(f"# TYPE {n} counter")
-            lines.append(f"{n} {self._fmt(counters[name])}")
+            _type_line(n, "counter")
+            lines.append(f"{self._series(n)} {self._fmt(counters[name])}")
         for name in sorted(gauges):
             n = self._sanitize(name)
-            lines.append(f"# TYPE {n} gauge")
-            lines.append(f"{n} {self._fmt(gauges[name])}")
+            _type_line(n, "gauge")
+            lines.append(f"{self._series(n)} {self._fmt(gauges[name])}")
         for name in sorted(ewmas):
-            n = self._sanitize(name) + "_ewma"
-            lines.append(f"# TYPE {n} gauge")
+            n = self._series(self._sanitize(name), "_ewma")
+            _type_line(n, "gauge")
             lines.append(f"{n} {self._fmt(ewmas[name])}")
         for name in sorted(hists):
             h = hists[name]
             n = self._sanitize(name)
-            lines.append(f"# TYPE {n} histogram")
+            _type_line(n, "histogram")
             with h._lock:
                 counts = list(h.counts)
                 total, s = h.count, h.sum
@@ -213,10 +283,12 @@ class MetricsRegistry:
                 # cumulative count, plus +Inf (cumulative semantics stay
                 # exact for any quantile query).
                 if c:
-                    lines.append(f'{n}_bucket{{le="{bound:g}"}} {cum}')
-            lines.append(f'{n}_bucket{{le="+Inf"}} {total}')
-            lines.append(f"{n}_sum {self._fmt(s)}")
-            lines.append(f"{n}_count {total}")
+                    lines.append(self._series(
+                        n, "_bucket", f'le="{bound:g}"') + f" {cum}")
+            lines.append(self._series(n, "_bucket", 'le="+Inf"')
+                         + f" {total}")
+            lines.append(f"{self._series(n, '_sum')} {self._fmt(s)}")
+            lines.append(f"{self._series(n, '_count')} {total}")
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> Dict:

@@ -167,6 +167,84 @@ def main():
                       "(--draft-load-dir not given) — acceptance will be "
                       "poor; outputs stay exact either way")
         spec = None if args.spec_method == "none" else args.spec_method
+        if args.serve_fleet > 1 or args.fleet_autoscale:
+            # Fleet serving (ISSUE 14): N replicas behind the
+            # KV-affinity router. Disagg replicas divide the device
+            # pool into disjoint slices; plain (non-disagg) replicas
+            # all run on the default device — per-replica device
+            # placement for plain fleets is a recorded follow-up
+            # (the tp path already needs a per-replica MeshContext).
+            from megatronapp_tpu.inference.fleet import FleetRouter
+            devices = jax.devices()
+            n = args.serve_fleet
+            # Disagg replicas divide the WHOLE device pool so the
+            # autoscaler has room to move tp groups between each
+            # replica's prefill/decode sub-meshes; a minimal 2*tp
+            # slice would pin every split at tp/tp and recommend()
+            # could never fire.
+            if args.serve_disagg and len(devices) < n * 2 * args.serve_tp:
+                raise SystemExit(
+                    f"--serve-fleet {n} --serve-disagg at tp="
+                    f"{args.serve_tp} needs {n * 2 * args.serve_tp} "
+                    f"devices ({n} replicas x 2 sub-meshes x tp), "
+                    f"have {len(devices)}")
+            per = max(2 * args.serve_tp,
+                      (len(devices) // max(n, 1))
+                      // args.serve_tp * args.serve_tp)
+            if args.fleet_autoscale and per <= 2 * args.serve_tp:
+                print("WARNING: --fleet-autoscale has no headroom — "
+                      f"each replica gets {per} devices (= 2*tp), so "
+                      "the prefill/decode split cannot move; add "
+                      "devices or lower --serve-fleet/--serve-tp")
+
+            def replica_engine(i, **hints):
+                if args.serve_disagg:
+                    from megatronapp_tpu.inference.disagg import (
+                        DisaggServingEngine,
+                    )
+                    hints.setdefault("prefill_devices",
+                                     per // 2 // args.serve_tp
+                                     * args.serve_tp)
+                    return DisaggServingEngine(
+                        params, cfg, tokenizer=tok,
+                        max_batch=args.max_batch,
+                        max_seq_len=args.max_seq_len,
+                        block_size=args.kv_block_size,
+                        num_blocks=args.num_kv_blocks,
+                        enable_prefix_caching=args.prefix_caching,
+                        prefill_chunk=args.prefill_chunk,
+                        prefill_slots=args.disagg_prefill_slots,
+                        decode_slo_ms=args.decode_slo_ms,
+                        tp=args.serve_tp,
+                        devices=devices[i * per:(i + 1) * per],
+                        spec_method=spec, spec_k=args.spec_k,
+                        draft_params=draft_params, draft_cfg=draft_cfg,
+                        kv_cache_dtype=args.kv_cache_dtype, **hints)
+                return DynamicInferenceEngine(
+                    params, cfg, tokenizer=tok,
+                    max_batch=args.max_batch,
+                    max_seq_len=args.max_seq_len, paged=True,
+                    block_size=args.kv_block_size,
+                    num_blocks=args.num_kv_blocks,
+                    enable_prefix_caching=args.prefix_caching,
+                    spec_method=spec, spec_k=args.spec_k,
+                    draft_params=draft_params, draft_cfg=draft_cfg,
+                    prefill_chunk=args.prefill_chunk,
+                    kv_cache_dtype=args.kv_cache_dtype)
+
+            engine = FleetRouter(
+                engine_factory=replica_engine, num_replicas=n,
+                migrate=args.fleet_migrate,
+                autoscale=args.fleet_autoscale,
+                slo_ms=args.decode_slo_ms)
+            print(f"serving FLEET of {n} "
+                  f"{'disagg' if args.serve_disagg else 'dynamic'} "
+                  f"replicas on {args.host}:{args.port} "
+                  f"(policy=affinity, migrate={args.fleet_migrate}, "
+                  f"autoscale={args.fleet_autoscale}, "
+                  f"kv={args.kv_cache_dtype})")
+            TextGenerationServer(engine, args.host, args.port).run()
+            return
         if args.serve_disagg:
             if not args.paged_kv_cache:
                 raise SystemExit("--serve-disagg needs --paged-kv-cache "
