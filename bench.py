@@ -211,6 +211,19 @@ def _fleet_hook():
     return r if r.get("affinity") else None
 
 
+def _pipeline_hook():
+    """Zero-bubble-vs-1F1B pipeline schedule A/B
+    (tools/pipeline_benchmark.py) on the CPU mesh — the simulated-
+    timeline bubble-fraction gate (zb strictly below 1F1B at the bench
+    shapes incl. the 2x-slow stage), the 2-step pp2 train loss-parity
+    pin, and the pp2 x cp2 x tp2 compiled FLOPs ratio tracked round
+    over round like the other hooks."""
+    if os.environ.get("BENCH_PIPELINE", "1") != "1":
+        return None
+    r = _run_child("--pipeline", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("bubble") else None
+
+
 def _fp8_hook():
     """fp8 end-to-end A/B (tools/fp8_benchmark.py) on the CPU backend —
     fp8-vs-bf16 training loss parity on the tp2 rings, the compiled
@@ -261,6 +274,9 @@ def _attach_overlap_hooks(res):
     flt = _fleet_hook()
     if flt:
         res.setdefault("extra", {})["fleet"] = flt
+    ppl = _pipeline_hook()
+    if ppl:
+        res.setdefault("extra", {})["pipeline"] = ppl
     return res
 
 
@@ -337,6 +353,7 @@ def parent_main(local_only: bool = False):
     tel = _telemetry_hook()
     f8 = _fp8_hook()
     flt = _fleet_hook()
+    ppl = _pipeline_hook()
     last = _load_last_good()
     if last is not None:
         # Top-level `stale` so the consumer can verifiably distinguish this
@@ -375,6 +392,8 @@ def parent_main(local_only: bool = False):
             last["extra"]["fp8"] = f8
         if flt:
             last["extra"]["fleet"] = flt
+        if ppl:
+            last["extra"]["pipeline"] = ppl
         print(json.dumps(last))
         return
     if cpu:
@@ -403,6 +422,8 @@ def parent_main(local_only: bool = False):
             cpu.setdefault("extra", {})["fp8"] = f8
         if flt:
             cpu.setdefault("extra", {})["fleet"] = flt
+        if ppl:
+            cpu.setdefault("extra", {})["pipeline"] = ppl
         print(json.dumps(cpu))
         return
     print(json.dumps({
@@ -508,6 +529,13 @@ def pp_tp_main():
     from tools.pp_tp_benchmark import run
     print(json.dumps(run(tp=2, pp=2, batch=2, seq=64, hidden=128,
                          layers=4, microbatches=4, iters=9, warmup=2)))
+
+
+def pipeline_main():
+    """Zero-bubble schedule + pp x cp x tp composition A/B child (CPU
+    mesh env set by the parent)."""
+    from tools.pipeline_benchmark import run
+    print(json.dumps(run(steps=2)))
 
 
 def dist_opt_main():
@@ -703,6 +731,8 @@ if __name__ == "__main__":
         cp_a2a_main()
     elif "--pp-tp" in sys.argv:
         pp_tp_main()
+    elif "--pipeline" in sys.argv:
+        pipeline_main()
     elif "--dist-opt" in sys.argv:
         dist_opt_main()
     elif "--paged-kv" in sys.argv:

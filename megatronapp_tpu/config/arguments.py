@@ -414,6 +414,29 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
     g.add_argument("--forward-backward-disaggregating", action="store_true")
     g.add_argument("--use-dpp", action="store_true",
                    help="breadth-first-chunk pipeline order (MegaDPP)")
+    # Pipeline schedule programs + the trace-driven planner (ISSUE 15,
+    # parallel/schedule.py). Choices derive from the schedule layer's
+    # canonical list so a new schedule is one edit, not three.
+    from megatronapp_tpu.parallel.schedule import SCHEDULES
+    g.add_argument("--pp-schedule", default="1f1b",
+                   choices=list(SCHEDULES),
+                   help="pipeline schedule program executed by the "
+                        "manual region (parallel/schedule.py): 1f1b "
+                        "(interleaved automatically when vpp > 1), vpp "
+                        "(alias requiring "
+                        "--num-layers-per-virtual-pipeline-stage), or "
+                        "zero-bubble (backward split into B=dgrad / "
+                        "W=wgrad; W deferred into bubble slots, the "
+                        "weight update fenced on all W done — grads "
+                        "identical to the fused backward)")
+    g.add_argument("--pp-plan-from-trace", action="store_true",
+                   help="let the trace-driven planner "
+                        "(parallel/schedule.Planner) retune the "
+                        "schedule from per-stage step-time EWMAs "
+                        "(MegaScan ring-hop spans + the straggler "
+                        "signal + the heterogeneous stage table); "
+                        "re-plans log loudly and rebuild the train "
+                        "step")
     # Multi-host runtime (reference torchrun MASTER_ADDR/RANK/WORLD_SIZE →
     # jax.distributed; auto-detected on TPU pods).
     g.add_argument("--multi-host", action="store_true",
@@ -914,7 +937,31 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
         forward_backward_disaggregating=args.forward_backward_disaggregating,
         pipeline_order_policy="bfc" if args.use_dpp else "dfc",
         use_dpp=args.use_dpp,
+        pp_schedule=args.pp_schedule,
+        pp_plan_from_trace=args.pp_plan_from_trace,
     )
+
+    # Schedule-flag cross-validation (ISSUE 15): the host-driven MegaDPP
+    # runtime executes its own dynamic order — a non-default
+    # --pp-schedule there would be silently ignored, which is worse
+    # than an error.
+    if args.use_dpp and args.pp_schedule != "1f1b":
+        raise ValueError(
+            f"--pp-schedule {args.pp_schedule} does not compose with "
+            "--use-dpp (the host-driven MegaDPP runtime schedules "
+            "dynamically); drop one of the flags")
+    if args.use_dpp and args.pp_plan_from_trace:
+        raise ValueError(
+            "--pp-plan-from-trace does not compose with --use-dpp (the "
+            "host runtime already schedules dynamically); drop one")
+    # Same policy for the FBD executor (it runs its own legacy
+    # schedule; train.py re-checks for programmatic callers).
+    if args.forward_backward_disaggregating and (
+            args.pp_schedule != "1f1b" or args.pp_plan_from_trace):
+        raise ValueError(
+            "--pp-schedule/--pp-plan-from-trace do not compose with "
+            "--forward-backward-disaggregating (the FBD executor runs "
+            "its own schedule); drop one")
 
     # fp8 eligibility (ISSUE 13): reject impossible layouts at parse
     # time with the predicate that failed (training/fp8.py names it) —
@@ -961,19 +1008,33 @@ def configs_from_args(args) -> Tuple[TransformerConfig, ParallelConfig,
             if model.ffn_hidden_size % tp:
                 _reject("--ffn-hidden-size (fc1/fc2 shard dim)",
                         model.ffn_hidden_size)
-        # The tp-sharded stage body only runs when pp>1, cp==1 and the
-        # kill switch is off (tp_stage_eligible); with cp>1 the pipeline
-        # keeps the tp-replicated body, so its stricter whole-head /
-        # sequence divisibility rules must not reject those configs.
+        # The tp-sharded stage body runs when pp>1 and the kill switch
+        # is off (tp_stage_eligible) — INCLUDING cp>1 since the
+        # pp x cp x tp composition (ISSUE 15), where the residual
+        # stream shards the sequence over (cp, tp) jointly on the
+        # contiguous p2p cp ring. Layouts the composition excludes
+        # (MLA, MoE, a2a-family cp comms) keep the tp-replicated body,
+        # so the stricter whole-head / sequence divisibility rules must
+        # not reject those configs.
+        from megatronapp_tpu.parallel.overlap import (
+            tp_stage_cp_excluded_reason,
+        )
+        cp = args.context_parallel_size or 1
         tp_stage_candidate = (args.pipeline_model_parallel_size > 1
                               and model.tp_sharded_stage
-                              and args.context_parallel_size <= 1)
-        if tp_stage_candidate and args.seq_length % tp:
+                              and (cp <= 1
+                                   or tp_stage_cp_excluded_reason(
+                                       model, cp) is None))
+        seq_shard = tp * (cp if cp > 1 else 1)
+        if tp_stage_candidate and args.seq_length % seq_shard:
+            what = (f"tp ({tp})" if cp <= 1
+                    else f"cp*tp ({seq_shard})")
             raise ValueError(
                 "--tp-comm-overlap with pp>1 runs the tp-SHARDED "
-                "pipeline stage body, which shards the sequence over tp: "
-                f"--seq-length ({args.seq_length}) must divide by tp "
-                f"({tp}) — or pass --no-tp-sharded-stage for the "
+                "pipeline stage body, which shards the sequence over "
+                f"{'tp' if cp <= 1 else '(cp, tp) jointly'}: "
+                f"--seq-length ({args.seq_length}) must divide by "
+                f"{what} — or pass --no-tp-sharded-stage for the "
                 "replicated baseline")
         if model.multi_latent_attention:
             # Dense MLA never routes through the GSPMD overlap rings

@@ -69,6 +69,17 @@ class ParallelConfig:
     # allows (pure pp); otherwise the policy above orders the SPMD
     # schedule statically.
     use_dpp: bool = False
+    # Pipeline schedule program (parallel/schedule.py, ISSUE 15):
+    # '1f1b' (interleaved automatically when vpp > 1), 'vpp' (alias that
+    # requires vpp > 1), or 'zero-bubble' (backward split into B=dgrad /
+    # W=wgrad steps; W deferred into bubble slots, weight update fenced
+    # on all W done — grads identical to the fused backward).
+    pp_schedule: str = "1f1b"
+    # Trace-driven dynamic planning: let parallel/schedule.Planner
+    # choose/retune the schedule from per-stage step-time EWMAs
+    # (MegaScan spans + straggler signal + the heterogeneous stage
+    # table). Re-plans log loudly and rebuild the train step.
+    pp_plan_from_trace: bool = False
 
     def __post_init__(self):
         for name in ("tensor_parallel", "pipeline_parallel", "context_parallel",
@@ -83,6 +94,18 @@ class ParallelConfig:
             raise ValueError(
                 f"pipeline_order_policy must be 'dfc' or 'bfc', got "
                 f"{self.pipeline_order_policy!r}")
+        # Canonical name list lives with the schedule layer (lazy import
+        # — config must stay import-light).
+        from megatronapp_tpu.parallel.schedule import SCHEDULES
+        if self.pp_schedule not in SCHEDULES:
+            raise ValueError(
+                f"pp_schedule must be one of {SCHEDULES}, "
+                f"got {self.pp_schedule!r}")
+        if self.pp_schedule == "vpp" and self.virtual_pipeline_parallel <= 1:
+            raise ValueError(
+                "pp_schedule 'vpp' requires virtual_pipeline_parallel > 1 "
+                "(--num-layers-per-virtual-pipeline-stage); plain 1F1B "
+                "is pp_schedule '1f1b'")
 
     @property
     def model_parallel_size(self) -> int:

@@ -298,13 +298,16 @@ def gpt_head(p, h: jnp.ndarray, cfg: TransformerConfig) -> jnp.ndarray:
 
 def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
                       cfg: TransformerConfig, ctx, vpp: int = 1,
-                      order_policy: str = "dfc", segment_ids_mb=None):
+                      order_policy: str = "dfc", segment_ids_mb=None,
+                      schedule: str = "1f1b"):
     """Pipelined training loss over microbatched inputs [M, mb, S].
 
     Embedding and LM head run outside the pipeline body (compiler-sharded
     over dp/tp); the layer stack runs inside spmd_pipeline over the pp axis.
     The reference runs its schedules imperatively per rank
-    (schedules.py:1918 1F1B); here the schedule is one jitted scan.
+    (schedules.py:1918 1F1B); here the schedule is an instruction program
+    executed by the jitted region (parallel/schedule.py) — `schedule`
+    picks 1f1b/vpp or the zero-bubble B/W split (--pp-schedule).
 
     segment_ids_mb: optional [M, mb, S] packed map — segments and the
     per-token rope tables ride the pipeline as per-microbatch aux inputs
@@ -318,11 +321,33 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
 
     m, mb, s = tokens_mb.shape
     if segment_ids_mb is not None:
+        if schedule == "zero-bubble":
+            raise NotImplementedError(
+                "--pp-schedule zero-bubble does not compose with packed "
+                "sequences (per-microbatch aux inputs) yet — run the "
+                "1f1b schedule there")
         return _gpt_pipeline_loss_packed(
             p, tokens_mb, targets_mb, loss_mask_mb, segment_ids_mb, cfg,
             ctx, vpp, order_policy)
+    # tp-sharded stage body (parallel/overlap.py tp_stage_eligible —
+    # decided BEFORE the zigzag layout: when both apply, the tp FLOPs
+    # cut takes the contiguous cp ring over the zigzag load balance).
+    from megatronapp_tpu.parallel.overlap import tp_stage_ineligible_reason
+    _tp_reason = tp_stage_ineligible_reason(cfg, ctx, s)
     positions = None
-    if zigzag_active(cfg, ctx):
+    if zigzag_active(cfg, ctx) and _tp_reason is None:
+        # pp x cp x tp composition (ISSUE 15): the seq-over-(cp, tp)
+        # sharded stage body runs the CONTIGUOUS cp ring — the zigzag
+        # permutation does not compose with the tp seq-sharding. The
+        # tp-side FLOPs cut (tp x) dominates the zigzag load-balance
+        # win; --no-tp-sharded-stage restores the zigzag layout.
+        import logging
+        logging.getLogger(__name__).info(
+            "pp x cp x tp composition: tp-sharded stage bodies take the "
+            "contiguous cp ring (zigzag layout does not compose with "
+            "seq-over-tp sharding; --no-tp-sharded-stage restores "
+            "zigzag)")
+    elif zigzag_active(cfg, ctx):
         # Zigzag cp layout (see gpt_forward): permute the sequence so each
         # cp rank's contiguous block holds chunks (i, 2cp-1-i); rope tables
         # follow the permuted positions, and the in-pipeline cp-rank slicing
@@ -357,10 +382,9 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
 
     # tp-sharded stage body (parallel/overlap.py tp_stage_eligible): the
     # manual pipeline region shards activations over tp along the seq dim
-    # and the stage body runs the ring-overlapped projections — tp× fewer
-    # stage FLOPs instead of the tp-replicated redundant compute.
-    from megatronapp_tpu.parallel.overlap import tp_stage_ineligible_reason
-    _tp_reason = tp_stage_ineligible_reason(cfg, ctx, s)
+    # (jointly with cp under the pp x cp x tp composition) and the stage
+    # body runs the ring-overlapped projections — tp× fewer stage FLOPs
+    # instead of the tp-replicated redundant compute.
     tp_shard = positions is None and _tp_reason is None
     if (not tp_shard and ctx is not None and ctx.tp > 1 and ctx.pp > 1):
         # Trace-time log (fires once per compiled shape) naming the
@@ -377,15 +401,17 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
         cos_l, sin_l = cos, sin
         from megatronapp_tpu.config.parallel_config import CP_AXIS
         from megatronapp_tpu.parallel.collectives import current_manual_axes
-        if (not tp_shard and CP_AXIS in current_manual_axes()
-                and cos is not None):
-            # Inside the pipeline body the cp axis is manual: x carries the
-            # local S/cp sequence block — slice the rope tables to match.
-            # (In the pp==1 fallback stage_fn runs outside any manual
-            # region and x carries the full sequence — no slicing. Under
-            # tp_shard attention re-gathers the full sequence through its
-            # rings, so the FULL tables are the right ones there too.)
-            s_loc = x.shape[1]
+        if CP_AXIS in current_manual_axes() and cos is not None:
+            # Inside the pipeline body the cp axis is manual: x carries
+            # the local sequence block — slice the rope tables to this
+            # cp rank's chunk. Under tp_shard the stream is additionally
+            # tp-sharded ([.., S/(cp*tp), H]) and attention re-gathers
+            # only the cp-LOCAL chunk through its tp rings, so the right
+            # tables cover x.shape[1] * tp rows. With cp == 1 both
+            # spellings slice the whole table at offset 0 (no-op). (In
+            # the pp==1 fallback stage_fn runs outside any manual region
+            # and x carries the full sequence — no slicing.)
+            s_loc = x.shape[1] * (ctx.tp if tp_shard else 1)
             start = jax.lax.axis_index(CP_AXIS) * s_loc
             cos_l = jax.lax.dynamic_slice_in_dim(cos, start, s_loc)
             sin_l = jax.lax.dynamic_slice_in_dim(sin, start, s_loc)
@@ -397,7 +423,7 @@ def gpt_pipeline_loss(p, tokens_mb, targets_mb, loss_mask_mb,
     out_mb, aux = spmd_pipeline(
         stage_fn, p["block"], h, ctx, num_microbatches=m, vpp=vpp,
         compute_dtype=cfg.compute_dtype, order_policy=order_policy,
-        tp_shard=tp_shard)
+        tp_shard=tp_shard, schedule=schedule)
     # Aux losses are summed over the M microbatches inside the pipeline;
     # normalize to per-microbatch scale to match the non-pipelined path.
     aux = aux / m

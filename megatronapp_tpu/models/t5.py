@@ -232,7 +232,8 @@ def t5_loss(p, batch, enc_cfg: TransformerConfig, ctx=None):
 
 
 def t5_pipeline_loss(p, batch_mb, enc_cfg: TransformerConfig, ctx,
-                     vpp: int = 1, order_policy: str = "dfc"):
+                     vpp: int = 1, order_policy: str = "dfc",
+                     schedule: str = "1f1b"):
     """Pipelined T5 loss over microbatched batches ({field: [M, mb, S]}).
 
     TPU-first redesign of the reference encoder/decoder PP split
@@ -276,6 +277,7 @@ def t5_pipeline_loss(p, batch_mb, enc_cfg: TransformerConfig, ctx,
     enc_out_mb, _ = spmd_pipeline(
         enc_stage, p["encoder"], h_enc, ctx, num_microbatches=m, vpp=vpp,
         compute_dtype=enc_cfg.compute_dtype, order_policy=order_policy,
+        schedule=schedule,
         aux_mb=({"enc_mask": enc_mask_mb}
                 if enc_mask_mb is not None else None))
     enc_out_mb = apply_norm(enc_cfg.normalization, enc_out_mb,
@@ -305,7 +307,7 @@ def t5_pipeline_loss(p, batch_mb, enc_cfg: TransformerConfig, ctx,
     out_mb, _ = spmd_pipeline(
         dec_stage, p["decoder"], h_dec, ctx, num_microbatches=m, vpp=vpp,
         compute_dtype=dec_cfg.compute_dtype, order_policy=order_policy,
-        aux_mb=aux)
+        schedule=schedule, aux_mb=aux)
 
     out_mb = apply_norm(dec_cfg.normalization, out_mb,
                         p["dec_final_ln_scale"], None,

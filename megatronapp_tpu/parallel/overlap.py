@@ -731,18 +731,49 @@ def matmul_reduce_scatter_manual(y, w, tp, overlap=True):
 
 def tp_stage_eligible(cfg, ctx, seq_len: int) -> bool:
     """Whether the full-manual pipeline may run its stage body tp-SHARDED
-    (activations [mb, S/tp, H] between stages, projections through the
-    ambient rings above) instead of tp-replicated.
+    (activations [mb, S/tp, H] between stages — [mb, S/(cp*tp), H] under
+    the pp x cp x tp composition — projections through the ambient rings
+    above) instead of tp-replicated.
 
-    Requirements: tp > 1 inside a pp > 1 manual region with cp == 1 (seq
-    is the tp shard dim), the kill-switch ``cfg.tp_sharded_stage`` on,
-    S % tp == 0, whole heads per shard (nq — and nkv for GQA — divisible
-    by tp; the manual path slices head groups, unlike the GSPMD-overlap
-    path which only needs flat dims), and dense-MLP ffn divisible by tp
-    (gate/value halves shard separately for gated activations). MoE
-    layers dispatch locally per shard (any expert count); heterogeneous
-    stacks are excluded (the pipeline rejects them anyway)."""
+    Requirements: tp > 1 inside a pp > 1 manual region, the kill-switch
+    ``cfg.tp_sharded_stage`` on, S divisible by the seq shard degree
+    (tp, or cp*tp when cp > 1), whole heads per shard (nq — and nkv for
+    GQA — divisible by tp; the manual path slices head groups, unlike
+    the GSPMD-overlap path which only needs flat dims), and dense-MLP
+    ffn divisible by tp (gate/value halves shard separately for gated
+    activations). MoE layers dispatch locally per shard (any expert
+    count); heterogeneous stacks are excluded (the pipeline rejects them
+    anyway). Under cp > 1 (ISSUE 15) the composition is restricted to
+    dense non-MLA, non-MoE stacks on the contiguous p2p cp ring: heads
+    shard over tp, the QKV ring gathers only the cp-local seq chunk,
+    and attention runs the cp ring per head shard."""
     return tp_stage_ineligible_reason(cfg, ctx, seq_len) is None
+
+
+def tp_stage_cp_excluded_reason(cfg, cp: int):
+    """Config-only predicates excluding the pp x cp x tp composition
+    (ISSUE 15): the residual stream between stages shards the sequence
+    over (cp, tp) jointly and attention runs the contiguous cp ring per
+    tp head shard — restricted to dense non-MLA, non-MoE stacks on the
+    p2p cp ring for now. Shared by the runtime eligibility check below
+    and the parse-time validation in config/arguments.py so the two
+    sites cannot drift; returns the first failed predicate or None."""
+    if cfg.multi_latent_attention:
+        return (f"cp == {cp} > 1 with MLA (the latent "
+                "attention's shared-rope gather is not composed "
+                "with the cp ring under tp-sharded stage bodies "
+                "yet — the replicated body handles MLA + cp)")
+    if cfg.is_moe:
+        return (f"cp == {cp} > 1 with MoE (expert dispatch "
+                "under the joint cp x tp token split is not "
+                "validated yet — the replicated body handles "
+                "MoE + cp)")
+    if cfg.cp_comm_type != "p2p":
+        return (f"cp_comm_type {cfg.cp_comm_type!r} (the tp-sharded "
+                "stage body composes with the contiguous p2p cp "
+                "ring only; a2a-family comms redistribute heads, "
+                "which are already tp-sliced here)")
+    return None
 
 
 def tp_stage_ineligible_reason(cfg, ctx, seq_len: int):
@@ -758,9 +789,9 @@ def tp_stage_ineligible_reason(cfg, ctx, seq_len: int):
         return (f"pp == {ctx.pp} (the sharded body lives inside the "
                 f"manual pp pipeline region)")
     if ctx.cp > 1:
-        return (f"cp == {ctx.cp} > 1 (the sequence is already the cp "
-                f"shard dim; tp-sharding it too needs the pp x cp "
-                f"head-sharding follow-up)")
+        reason = tp_stage_cp_excluded_reason(cfg, ctx.cp)
+        if reason is not None:
+            return reason
     # FBD abstract half-meshes keep the proven tp-replicated body (same
     # exclusion as tp_overlap_eligible: abstract-mesh manual collectives
     # over tp are unvalidated there).
@@ -773,8 +804,11 @@ def tp_stage_ineligible_reason(cfg, ctx, seq_len: int):
     if getattr(cfg, "hetero_block_specs", None):
         return "heterogeneous per-layer configs (pipeline rejects them)"
     tp = ctx.tp
-    if seq_len % tp:
-        return f"seq_len ({seq_len}) % tp ({tp}) != 0"
+    seq_shard = tp * ctx.cp
+    if seq_len % seq_shard:
+        return (f"seq_len ({seq_len}) % tp ({tp}) != 0" if ctx.cp == 1
+                else f"seq_len ({seq_len}) % (cp*tp) ({seq_shard}) != 0 "
+                     f"(the stream shards the sequence over cp AND tp)")
     if cfg.num_attention_heads % tp:
         return (f"num_attention_heads ({cfg.num_attention_heads}) % tp "
                 f"({tp}) != 0")

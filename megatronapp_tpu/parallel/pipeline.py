@@ -21,28 +21,45 @@ lower ppermute/axis_index through an SPMD path XLA:CPU aborts on
 — so the body owns EVERY axis. The microbatch dim threads over (dp, ep)
 when it divides evenly, sequence over cp (attention dispatches to the cp
 ring impls directly via the ambient-manual check). tp has two modes:
-``tp_shard=True`` (cp == 1 layouts passing overlap.tp_stage_eligible)
-shards the activations along the SEQUENCE over tp between stages —
-[mb, S/tp, H] residual streams, tp× smaller pp ppermute hops, stage
+``tp_shard=True`` (layouts passing overlap.tp_stage_eligible) shards the
+activations along the SEQUENCE over tp between stages — [mb, S/tp, H]
+residual streams (and, composing with cp > 1, [mb, S/(cp*tp), H]: the
+pp x cp x tp composition, ISSUE 15), tp× smaller pp ppermute hops, stage
 bodies running the parallel/overlap.py ring all-gather-matmul /
 matmul-reduce-scatter primitives on per-shard weight slices (tp× fewer
-stage FLOPs, collectives hidden under the GEMM chunks). Otherwise tp
-rides replicated inside the body (each tp rank redundantly computes the
-stage — kept for ineligible layouts; the tp-GSPMD sharding of the old
-partial-auto region needed exactly the partial-auto mode this build
-aborts on). Stage hand-offs emit per-step
-``pp-overlap-permute`` MegaScan spans so the schedule's comm is visible in
-the merged trace.
+stage FLOPs, collectives hidden under the GEMM chunks). Under cp > 1 the
+QKV ring gathers only the cp-LOCAL sequence chunk and attention runs the
+contiguous cp ring per tp head shard. Otherwise tp rides replicated
+inside the body (each tp rank redundantly computes the stage — kept for
+ineligible layouts; the tp-GSPMD sharding of the old partial-auto region
+needed exactly the partial-auto mode this build aborts on). Stage
+hand-offs emit per-step ``pp-overlap-permute`` MegaScan spans so the
+schedule's comm is visible in the merged trace — and those spans are the
+per-stage step-time signal the trace-driven planner
+(parallel/schedule.Planner) mines for scheduling decisions.
 
-Unified schedule (steps t = 0..M*vpp + pp - 2), u = t - stage:
-  round r = u // (pp*vpp), within-round w = u % (pp*vpp),
-  chunk c = w // pp, microbatch m = r*pp + (w % pp).
-vpp=1 degenerates to the non-interleaved schedule (inject every step,
-chunk 0); vpp>1 is the interleaved/circular schedule with the familiar
-bubble reduction (pp-1)/(M*vpp) — reference schedules.py:856-1780. The
-activation emitted by the last stage at step t is consumed by stage 0 at
-t+1 via the same ring ppermute, which is exactly the chunk hand-off the
-reference implements with batched p2p ops.
+The schedule is a per-stage instruction PROGRAM (parallel/schedule.py,
+ISSUE 15), not a hard-coded loop: the scan body indexes clocked
+(active, microbatch, chunk) tables at [step, stage]. For '1f1b'/'vpp'
+the tables reproduce the unified closed-form schedule exactly
+(u = t - stage, round r = u // (pp*vpp), chunk c = (u % (pp*vpp)) // pp,
+m = r*pp + u % pp; bubble (pp-1)/(M*vpp) — reference
+schedules.py:856-1780) and the backward stays the scan's autodiff
+transpose. The activation emitted by the last stage at step t is
+consumed by stage 0 at t+1 via the same ring ppermute, which is exactly
+the chunk hand-off the reference implements with batched p2p ops.
+
+schedule='zero-bubble' splits the backward into B = dgrad and W = wgrad
+instructions (the ZB-H1 family): a custom_vjp wraps the stage program —
+the forward scan additionally saves each (chunk, microbatch) stage INPUT,
+and the hand-written backward scan executes the validated B/W program:
+B recomputes the stage forward and pulls ONLY the activation cotangent
+(the wgrad path is dead code in that vjp), sending it down the reverse
+ring one hop per slot; W recomputes and pulls ONLY the weight cotangent
+from the saved (input, output-cotangent) pair, accumulated into the grad
+buffers at the program's deferred slots. All W's complete inside the
+program, so the optimizer fence is structural and ZeRO-1 sees grads
+identical to the fused backward (parity pinned ≤1e-6).
 
 Virtual-stage layer placement matches the reference interleaved convention:
 chunk c on stage s holds global layers [(c*pp + s) * Lc, ...) where
@@ -105,6 +122,7 @@ def spmd_pipeline(
     order_policy: str = "dfc",
     aux_mb: Any = None,
     tp_shard: bool = False,
+    schedule: str = "1f1b",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Run the pipelined layer stack.
 
@@ -136,11 +154,20 @@ def spmd_pipeline(
     h_mb: [M, mb, S, H] microbatched hidden states (e.g. embeddings) — must
     be fp32 when pp > 1 (cast to compute_dtype happens inside; see body).
     tp_shard: run the stage body tp-SHARDED — activations enter/leave the
-    region with the sequence dim sharded over tp ([mb, S/tp, H] inside),
-    stage_fn must thread tp_sharded=True into the transformer stack, and
-    params gain a real tp entry in the grad-axes bookkeeping (each shard
+    region with the sequence dim sharded over tp ([mb, S/tp, H] inside;
+    composing with cp > 1, over (cp, tp): [mb, S/(cp*tp), H]), stage_fn
+    must thread tp_sharded=True into the transformer stack, and params
+    gain a real tp entry in the grad-axes bookkeeping (each shard
     contributes a slice-local partial wgrad the transpose psums). Caller
-    gates on overlap.tp_stage_eligible (cp == 1, divisible S/heads/ffn).
+    gates on overlap.tp_stage_eligible (divisible S/heads/ffn; under
+    cp > 1 dense non-MLA stacks on the contiguous p2p ring).
+
+    schedule — the instruction program the manual region executes
+    (parallel/schedule.py): '1f1b' (interleaved automatically when
+    vpp > 1), 'vpp' (alias that REQUIRES vpp > 1), or 'zero-bubble'
+    (backward split into B=dgrad / W=wgrad steps via a custom_vjp whose
+    hand-written backward scan executes the validated B/W program;
+    grads match the fused backward, the weight update fences on all W).
     Returns (out_mb [M, mb, S, H] from the last stage, summed aux losses).
     """
     pp = ctx.pp
@@ -170,6 +197,19 @@ def spmd_pipeline(
     if order_policy not in ("dfc", "bfc"):
         raise ValueError(f"order_policy must be 'dfc' or 'bfc', got "
                          f"{order_policy!r}")
+    from megatronapp_tpu.parallel import schedule as schedlib
+    if schedule not in schedlib.SCHEDULES:
+        raise ValueError(f"schedule must be one of {schedlib.SCHEDULES}, "
+                         f"got {schedule!r}")
+    if schedule == "vpp" and vpp <= 1:
+        raise ValueError("schedule 'vpp' requires vpp > 1 (it is the "
+                         "interleaved schedule; plain 1F1B is '1f1b')")
+    zero_bubble = schedule == "zero-bubble"
+    if zero_bubble and aux_mb:
+        raise NotImplementedError(
+            "zero-bubble does not compose with per-microbatch aux "
+            "inputs (packed sequences) yet — run --pp-schedule 1f1b "
+            "there")
     if vpp > 1 and order_policy == "dfc" and M % pp != 0:
         raise ValueError(
             f"interleaved (dfc) pipeline requires num_microbatches ({M}) "
@@ -197,14 +237,24 @@ def spmd_pipeline(
             out, aux = spmd_pipeline(
                 shifted, chunk_params, h, ctx, M, vpp=1,
                 compute_dtype=compute_dtype, order_policy="dfc",
-                aux_mb=aux_mb, tp_shard=tp_shard)
+                aux_mb=aux_mb, tp_shard=tp_shard,
+                schedule="1f1b" if schedule == "vpp" else schedule)
             aux_total = aux_total + aux
             h = out.astype(jnp.float32)
         return out, aux_total
 
     mesh = ctx.mesh
     total_steps = M * vpp + pp - 1
-    cycle = pp * vpp
+    # Clocked instruction tables (parallel/schedule.py): the scan indexes
+    # [step, stage] instead of computing the closed-form schedule inline
+    # — identical entries for '1f1b'/'vpp' (pinned in tests), and the
+    # hook that lets zero-bubble (and planner-emitted programs) swap in
+    # as data. Validated before anything executes them.
+    f_tables = schedlib.forward_tables(pp, M, vpp)
+    b_tables = schedlib.zb_backward_tables(pp, M, vpp) if zero_bubble \
+        else None
+    schedlib.validate_programs(pp, M, vpp, f_tables, b_tables)
+    f_act_np, f_mb_np, f_ck_np = f_tables
     # Context parallelism composes INSIDE this (full-)manual region (nested
     # shard_maps are unreliable in this JAX build): with cp > 1 the body is
     # manual over cp too, sequence enters pre-sharded [.., S/cp, ..],
@@ -226,7 +276,6 @@ def spmd_pipeline(
         h_mb_in = pvary(h_mb_in, (PP_AXIS,))
         aux_mb_in = jax.tree.map(
             lambda a: pvary(a, (PP_AXIS,)), aux_mb_in)
-        stage = jax.lax.axis_index(PP_AXIS)
         params_s = jax.tree.map(lambda x: x[0], params_local)
         # Params enter replicated over the token-splitting axes (cp seq
         # chunks; (dp, ep) microbatch shards) but every shard contributes a
@@ -244,69 +293,173 @@ def spmd_pipeline(
         layers_per_chunk = jax.tree.leaves(params_s)[0].shape[1]
         mb_shape = h_mb_in.shape[1:]
 
-        state = zeros_like_vma(mb_shape, compute_dtype, h_mb_in)
-        outputs = zeros_like_vma(h_mb_in.shape, compute_dtype, h_mb_in)
-        aux = zeros_like_vma((), jnp.float32, h_mb_in)
-
-        def step(carry, t):
-            state, outputs, aux = carry
-            u = t - stage
-            r = u // cycle
-            w = u % cycle
-            chunk = w // pp
-            m = r * pp + (w % pp)
-            active = (u >= 0) & (m >= 0) & (m < M)
-            m_safe = jnp.clip(m, 0, M - 1)
-
-            # Stage 0 injects a fresh microbatch while running chunk 0;
-            # otherwise consume the ring state.
-            inject = jax.lax.dynamic_index_in_dim(h_mb_in, m_safe,
-                                                  keepdims=False)
-            inject = inject.astype(compute_dtype)
-            x = jnp.where((stage == 0) & (chunk == 0), inject, state)
-
-            chunk_params = jax.tree.map(
+        def chunk_slice(params_s_, chunk):
+            return jax.tree.map(
                 lambda p: jax.lax.dynamic_index_in_dim(p, chunk,
                                                        keepdims=False),
-                params_s)
-            layer_offset = (chunk * pp + stage) * layers_per_chunk
-            # Tag every ring span the stage body emits (the tp-sharded
-            # body's tp-overlap-* rings) so in-pipeline hops are
-            # distinguishable from top-level tp overlap in merged traces.
-            with span_tags(region="pp-stage"):
-                if aux_mb_in:
-                    aux_m = jax.tree.map(
-                        lambda a: jax.lax.dynamic_index_in_dim(
-                            a, m_safe, keepdims=False), aux_mb_in)
-                    y, a = stage_fn(chunk_params, x, layer_offset, aux_m)
+                params_s_)
+
+        def forward_scan(params_s_, h_mb_, save_inputs, consts=None):
+            """Execute the forward instruction program. save_inputs
+            (zero-bubble) additionally records each (chunk, microbatch)
+            stage INPUT — the residual the hand-written B/W backward
+            rematerializes from. consts: the closure-converted stage
+            callable's hoisted values (zero-bubble path — inside the
+            custom_vjp every captured tracer must be an explicit arg on
+            this jax build)."""
+            # Tables as numpy → real jit constants wherever this traces.
+            f_act = jnp.asarray(f_act_np)
+            f_mbt = jnp.asarray(f_mb_np)
+            f_ckt = jnp.asarray(f_ck_np)
+            state = zeros_like_vma(mb_shape, compute_dtype, h_mb_)
+            outputs = zeros_like_vma(h_mb_.shape, compute_dtype, h_mb_)
+            aux = zeros_like_vma((), jnp.float32, h_mb_)
+            carry = (state, outputs, aux)
+            if save_inputs:
+                xs_buf = zeros_like_vma((vpp, M) + mb_shape,
+                                        compute_dtype, h_mb_)
+                carry = carry + (xs_buf,)
+            stage = jax.lax.axis_index(PP_AXIS)
+
+            def step(carry, t):
+                if save_inputs:
+                    state, outputs, aux, xs_buf = carry
                 else:
-                    y, a = stage_fn(chunk_params, x, layer_offset)
-            aux = aux + jnp.where(active, a, 0.0)
+                    state, outputs, aux = carry
+                active = f_act[t, stage]
+                m_safe = f_mbt[t, stage]
+                chunk = f_ckt[t, stage]
 
-            # Last stage, last chunk → collect output.
-            collect = active & (stage == pp - 1) & (chunk == vpp - 1)
-            prev = jax.lax.dynamic_index_in_dim(outputs, m_safe,
-                                                keepdims=False)
-            outputs = jax.lax.dynamic_update_index_in_dim(
-                outputs, jnp.where(collect, y, prev), m_safe, 0)
+                # Stage 0 injects a fresh microbatch while running chunk
+                # 0; otherwise consume the ring state.
+                inject = jax.lax.dynamic_index_in_dim(h_mb_, m_safe,
+                                                      keepdims=False)
+                inject = inject.astype(compute_dtype)
+                x = jnp.where((stage == 0) & (chunk == 0), inject, state)
+                if save_inputs:
+                    zi = (0,) * len(mb_shape)
+                    prev_x = jax.lax.dynamic_slice(
+                        xs_buf, (chunk, m_safe) + zi,
+                        (1, 1) + mb_shape)[0, 0]
+                    xs_buf = jax.lax.dynamic_update_slice(
+                        xs_buf, jnp.where(active, x, prev_x)[None, None],
+                        (chunk, m_safe) + zi)
 
-            # Stage hand-off: one ring hop per schedule step. The span
-            # makes the exposed hop visible per pp rank in MegaScan traces
-            # (t is traced — ring_span threads it into the callback).
-            # Caveat (this jax build): scan linearization under jax.grad
-            # drops in-scan debug callbacks, so these spans appear in
-            # forward/eval executions; the cp/moe spans inside the
-            # remat'd layer bodies survive training steps too.
-            ring_span(PP_OVERLAP_PERMUTE_EVENT, "B", y, PP_AXIS, step=t,
-                      op="pp-schedule")
-            state = jax.lax.ppermute(
-                y, PP_AXIS, [(i, (i + 1) % pp) for i in range(pp)])
-            ring_span(PP_OVERLAP_PERMUTE_EVENT, "E", state, PP_AXIS, step=t,
-                      op="pp-schedule")
-            return (state, outputs, aux), None
+                chunk_params = chunk_slice(params_s_, chunk)
+                layer_offset = (chunk * pp + stage) * layers_per_chunk
+                if consts is not None:
+                    y, a = closed_stage(chunk_params, x, layer_offset,
+                                        *consts)
+                else:
+                    # Tag every ring span the stage body emits (the
+                    # tp-sharded body's tp-overlap-* rings) so
+                    # in-pipeline hops are distinguishable from
+                    # top-level tp overlap in merged traces.
+                    with span_tags(region="pp-stage"):
+                        if aux_mb_in:
+                            aux_m = jax.tree.map(
+                                lambda a: jax.lax.dynamic_index_in_dim(
+                                    a, m_safe, keepdims=False),
+                                aux_mb_in)
+                            y, a = stage_fn(chunk_params, x,
+                                            layer_offset, aux_m)
+                        else:
+                            y, a = stage_fn(chunk_params, x,
+                                            layer_offset)
+                aux = aux + jnp.where(active, a, 0.0)
 
-        (state, outputs, aux), _ = jax.lax.scan(
-            step, (state, outputs, aux), jnp.arange(total_steps))
+                # Last stage, last chunk → collect output.
+                collect = active & (stage == pp - 1) & (chunk == vpp - 1)
+                prev = jax.lax.dynamic_index_in_dim(outputs, m_safe,
+                                                    keepdims=False)
+                outputs = jax.lax.dynamic_update_index_in_dim(
+                    outputs, jnp.where(collect, y, prev), m_safe, 0)
+
+                # Stage hand-off: one ring hop per schedule step. The
+                # span makes the exposed hop visible per pp rank in
+                # MegaScan traces (t is traced — ring_span threads it
+                # into the callback); trace/detect.stage_step_gaps mines
+                # the inter-hop gaps as the planner's per-stage signal.
+                # Caveat (this jax build): scan linearization under
+                # jax.grad drops in-scan debug callbacks, so these spans
+                # appear in forward/eval executions; the cp/moe spans
+                # inside the remat'd layer bodies survive training steps
+                # too.
+                ring_span(PP_OVERLAP_PERMUTE_EVENT, "B", y, PP_AXIS,
+                          step=t, op="pp-schedule")
+                state = jax.lax.ppermute(
+                    y, PP_AXIS, [(i, (i + 1) % pp) for i in range(pp)])
+                ring_span(PP_OVERLAP_PERMUTE_EVENT, "E", state, PP_AXIS,
+                          step=t, op="pp-schedule")
+                new_carry = (state, outputs, aux)
+                if save_inputs:
+                    new_carry = new_carry + (xs_buf,)
+                return new_carry, None
+
+            carry, _ = jax.lax.scan(step, carry,
+                                    jnp.arange(total_steps))
+            if save_inputs:
+                state, outputs, aux, xs_buf = carry
+                return outputs, aux, xs_buf
+            state, outputs, aux = carry
+            return outputs, aux, None
+
+        if not zero_bubble:
+            outputs, aux, _ = forward_scan(params_s, h_mb_in, False)
+        else:
+            # Hoist whatever the caller's stage_fn closed over (rope
+            # tables etc.) into explicit custom_vjp inputs — tracer
+            # consts inside a custom_vjp jaxpr fail to lower on this
+            # jax build, and the closure-free callable is what lets the
+            # hand-written backward pull dgrad and wgrad separately.
+            # CONTRACT: hoisted consts receive ZERO cotangents from
+            # zb_bwd — everything differentiable (learned tables,
+            # adapters) MUST ride chunk_params, never the stage_fn
+            # closure, or its gradients silently vanish under
+            # zero-bubble while 1f1b trains them.
+            def _stage(chunk_params, xx, off):
+                with span_tags(region="pp-stage"):
+                    return stage_fn(chunk_params, xx, off)
+
+            closed_stage, stage_consts = jax.closure_convert(
+                _stage, chunk_slice(params_s, 0),
+                jnp.zeros(mb_shape, compute_dtype),
+                jnp.asarray(0, jnp.int32))
+            # Per-slot dispatch mode: when the stage BODY is
+            # collective-free (no tp-sharded rings, no cp ring, no moe
+            # ep a2a — dp only shards the microbatch dim and its grad
+            # psum lives at the region transpose, outside the branches)
+            # each backward slot runs exactly its program instruction
+            # via lax.switch: stages taking different branches cannot
+            # diverge on a collective because there are none inside.
+            # With collectives in the body, XLA:CPU's rendezvous spans
+            # EVERY device listed in the instruction's groups —
+            # diverging branches deadlock — so both vjps run
+            # unconditionally and the program masks which one lands
+            # (redundant masked compute; an MPMD runtime has no such
+            # constraint — the bubble model carries the perf claim,
+            # parity carries correctness).
+            zb_switch = (not tp_shard) and ctx.cp == 1 and ctx.ep == 1
+            if not zb_switch:
+                # Trace-time log (once per compiled shape): the user
+                # asked for zero-bubble on a mesh where the SPMD
+                # realization costs ~2x backward compute — say so
+                # instead of silently regressing step time (the
+                # planner refuses to auto-apply it here; a static
+                # --pp-schedule zero-bubble is honored for parity/
+                # MPMD-model work but is not a CPU/SPMD perf win).
+                import logging
+                logging.getLogger(__name__).warning(
+                    "zero-bubble runs in MASKED dual-vjp dispatch on "
+                    "this mesh (collectives inside the stage body): "
+                    "both backward vjps execute every slot — ~2x "
+                    "backward compute vs the fused transpose; the "
+                    "modeled bubble win applies to MPMD runtimes, not "
+                    "this SPMD realization")
+            outputs, aux = _make_zb_core(
+                forward_scan, chunk_slice, closed_stage,
+                layers_per_chunk, mb_shape, b_tables, zb_switch)(
+                    params_s, h_mb_in, tuple(stage_consts))
         # Sum aux losses across stages; average over the token-splitting
         # shards (cp seq chunks, (dp, ep) microbatch shards), whose aux
         # terms are per-local-token means. Outputs live on the last stage.
@@ -316,18 +469,197 @@ def spmd_pipeline(
         aux = jax.lax.psum(aux, red_axes) / denom
         return outputs[None], aux[None]
 
-    if tp_shard and cp > 1:
-        raise ValueError("tp_shard requires cp == 1 (the sequence is the "
-                         "tp shard dim); gate callers on tp_stage_eligible")
+    def _make_zb_core(forward_scan, chunk_slice, closed_stage,
+                      layers_per_chunk, mb_shape, b_tables, zb_switch):
+        """Zero-bubble executor: custom_vjp around the stage program.
+
+        fwd — the forward instruction scan, additionally saving every
+        (chunk, microbatch) stage input (the only residual besides the
+        params). bwd — a hand-written scan over the VALIDATED B/W
+        program: B rematerializes the stage forward and pulls ONLY the
+        activation cotangent (closing over the params makes the wgrad
+        path dead code in that vjp), ships it down the reverse ring one
+        hop per slot, and parks the incoming output-cotangent for its W;
+        W rematerializes and pulls ONLY the weight cotangent from the
+        saved (input, cotangent) pair, accumulating into the grad
+        buffers at the program's deferred slot. Grads therefore equal
+        the fused backward (fp32-accumulation order aside — parity
+        pinned ≤1e-6); the scan ends only after every W, so the weight
+        update is fenced on all W done and ZeRO-1 slices identical
+        grads. The ppermute runs unconditionally every slot (outside
+        the lax.switch) — stages in different branches never diverge on
+        the pp collective, and a stage's tp/cp ring collectives stay
+        within its own (same-branch) shard group."""
+        kind_np, bmb_np, bck_np = b_tables
+
+        @jax.custom_vjp
+        def zb_core(params_s_, h_mb_, consts):
+            outputs, aux, _ = forward_scan(params_s_, h_mb_, False,
+                                           consts)
+            return outputs, aux
+
+        def zb_fwd(params_s_, h_mb_, consts):
+            outputs, aux, xs_buf = forward_scan(params_s_, h_mb_, True,
+                                                consts)
+            return (outputs, aux), (params_s_, xs_buf, consts)
+
+        def zb_bwd(res, cot):
+            params_s_, xs_buf, consts = res
+            d_out, d_aux = cot
+            stage = jax.lax.axis_index(PP_AXIS)
+            kind_t = jnp.asarray(kind_np)
+            bmb_t = jnp.asarray(bmb_np)
+            bck_t = jnp.asarray(bck_np)
+            zi = (0,) * len(mb_shape)
+
+            d_state0 = zeros_like_vma(mb_shape, compute_dtype, d_out)
+            dy_buf0 = zeros_like_vma((vpp, M) + mb_shape, compute_dtype,
+                                     d_out)
+            d_h0 = zeros_like_vma((M,) + mb_shape, jnp.float32, d_out)
+            d_params0 = jax.tree.map(
+                lambda p: zeros_like_vma(p.shape, p.dtype, d_out),
+                params_s_)
+
+            def bstep(carry, t):
+                d_state, dy_buf, d_params, d_h = carry
+                kind = kind_t[t, stage]
+                m = bmb_t[t, stage]
+                c = bck_t[t, stage]
+                x_m = jax.lax.dynamic_slice(
+                    xs_buf, (c, m) + zi, (1, 1) + mb_shape)[0, 0]
+                chunk_params = chunk_slice(params_s_, c)
+                layer_offset = (c * pp + stage) * layers_per_chunk
+                # The top of each cotangent wavefront consumes the
+                # OUTPUT cotangent; every other B consumes what the
+                # reverse ring delivered last slot (program-validated).
+                top = (stage == pp - 1) & (c == vpp - 1)
+                dy_in = jnp.where(
+                    top,
+                    jax.lax.dynamic_index_in_dim(d_out, m,
+                                                 keepdims=False),
+                    d_state)
+
+                def f_of_x(xx):
+                    return closed_stage(chunk_params, xx, layer_offset,
+                                        *consts)
+
+                def f_of_p(p_):
+                    return closed_stage(p_, x_m, layer_offset, *consts)
+
+                def nop_branch(_):
+                    emit = zeros_like_vma(mb_shape, compute_dtype,
+                                          d_state)
+                    return emit, dy_buf, d_params, d_h
+
+                def b_branch(_):
+                    _, pull = jax.vjp(f_of_x, x_m)
+                    (dx,) = pull((dy_in, d_aux))
+                    dy_buf2 = jax.lax.dynamic_update_slice(
+                        dy_buf, dy_in[None, None], (c, m) + zi)
+                    # Stage 0 / chunk 0 closes the chain: its dx is the
+                    # injected microbatch's cotangent (fp32 boundary).
+                    first = (stage == 0) & (c == 0)
+                    prev = jax.lax.dynamic_index_in_dim(
+                        d_h, m, keepdims=False)
+                    d_h2 = jax.lax.dynamic_update_index_in_dim(
+                        d_h,
+                        jnp.where(first,
+                                  prev + dx.astype(jnp.float32), prev),
+                        m, 0)
+                    return dx, dy_buf2, d_params, d_h2
+
+                def w_branch(_):
+                    dy_m = jax.lax.dynamic_slice(
+                        dy_buf, (c, m) + zi, (1, 1) + mb_shape)[0, 0]
+                    _, pull = jax.vjp(f_of_p, chunk_params)
+                    (dp,) = pull((dy_m, d_aux))
+
+                    def acc(a_, g_):
+                        cur = jax.lax.dynamic_index_in_dim(
+                            a_, c, keepdims=False)
+                        return jax.lax.dynamic_update_index_in_dim(
+                            a_, cur + g_.astype(a_.dtype), c, 0)
+
+                    d_params2 = jax.tree.map(acc, d_params, dp)
+                    emit = zeros_like_vma(mb_shape, compute_dtype,
+                                          d_state)
+                    return emit, dy_buf, d_params2, d_h
+
+                if zb_switch:
+                    emit, dy_buf2, d_params2, d_h2 = jax.lax.switch(
+                        kind, [nop_branch, b_branch, w_branch], 0)
+                else:
+                    # Masked (uniform) dispatch: every device runs both
+                    # vjps in the same order — no collective divergence
+                    # — and the program's kind masks which one lands.
+                    is_b = kind == schedlib.KIND_B
+                    is_w = kind == schedlib.KIND_W
+                    _, pull_x = jax.vjp(f_of_x, x_m)
+                    (dx,) = pull_x((dy_in, d_aux))
+                    dy_m = jax.lax.dynamic_slice(
+                        dy_buf, (c, m) + zi, (1, 1) + mb_shape)[0, 0]
+                    _, pull_p = jax.vjp(f_of_p, chunk_params)
+                    (dp,) = pull_p((dy_m, d_aux))
+                    zero_emit = zeros_like_vma(mb_shape, compute_dtype,
+                                               d_state)
+                    emit = jnp.where(is_b, dx, zero_emit)
+                    dy_buf2 = jax.lax.dynamic_update_slice(
+                        dy_buf,
+                        jnp.where(is_b, dy_in, dy_m)[None, None],
+                        (c, m) + zi)
+                    first = (stage == 0) & (c == 0)
+                    prev_h = jax.lax.dynamic_index_in_dim(
+                        d_h, m, keepdims=False)
+                    d_h2 = jax.lax.dynamic_update_index_in_dim(
+                        d_h,
+                        jnp.where(is_b & first,
+                                  prev_h + dx.astype(jnp.float32),
+                                  prev_h), m, 0)
+
+                    def acc_masked(a_, g_):
+                        cur = jax.lax.dynamic_index_in_dim(
+                            a_, c, keepdims=False)
+                        return jax.lax.dynamic_update_index_in_dim(
+                            a_,
+                            jnp.where(is_w, cur + g_.astype(a_.dtype),
+                                      cur), c, 0)
+
+                    d_params2 = jax.tree.map(acc_masked, d_params, dp)
+                ring_span(PP_OVERLAP_PERMUTE_EVENT, "B", emit, PP_AXIS,
+                          step=t, op="pp-zb-bwd")
+                d_state = jax.lax.ppermute(
+                    emit, PP_AXIS,
+                    [(i, (i - 1) % pp) for i in range(pp)])
+                ring_span(PP_OVERLAP_PERMUTE_EVENT, "E", d_state,
+                          PP_AXIS, step=t, op="pp-zb-bwd")
+                return (d_state, dy_buf2, d_params2, d_h2), None
+
+            (d_state, dy_buf, d_params, d_h), _ = jax.lax.scan(
+                bstep, (d_state0, dy_buf0, d_params0, d_h0),
+                jnp.arange(kind_np.shape[0]))
+            # The hoisted stage consts (rope tables) take zero
+            # cotangents — nothing in the stack differentiates them.
+            d_consts = tuple(
+                zeros_like_vma(cst.shape, cst.dtype, d_out)
+                for cst in consts)
+            return d_params, d_h, d_consts
+
+        zb_core.defvjp(zb_fwd, zb_bwd)
+        return zb_core
+
     if tp_shard and aux_mb:
         raise NotImplementedError(
             "tp_shard does not compose with per-microbatch aux inputs "
             "(packed sequences) yet — callers keep tp-replicated there")
     # With the tp-sharded stage body the seq dim shards over tp at the
-    # region boundary: each shard receives/returns its [.., S/tp, H]
-    # chunk, the transpose delivers REAL per-shard output cotangents,
-    # and the pp ring hops inside carry tp× less data.
-    cp_spec = (CP_AXIS if cp > 1 else (TP_AXIS if tp_shard else None))
+    # region boundary ((cp, tp) jointly when cp > 1 — the pp x cp x tp
+    # composition): each shard receives/returns its [.., S/tp, H] (or
+    # [.., S/(cp*tp), H]) chunk, the transpose delivers REAL per-shard
+    # output cotangents, and the pp ring hops inside carry tp× less data.
+    seq_axes = (() if cp <= 1 else (CP_AXIS,)) \
+        + ((TP_AXIS,) if tp_shard else ())
+    cp_spec = (seq_axes if len(seq_axes) > 1
+               else (seq_axes[0] if seq_axes else None))
     h_spec = P(None, batch_axes, cp_spec)
     out_spec = P(PP_AXIS, None, batch_axes, cp_spec)
     aux_mb = {} if aux_mb is None else aux_mb

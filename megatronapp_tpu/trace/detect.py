@@ -123,6 +123,44 @@ def detect_stage2(events: List[dict], related: Dict[int, Set[int]],
     return total > 0 and slow_cnt > STAGE2_FRACTION * total
 
 
+def stage_step_gaps(events: List[dict],
+                    name: str = "pp-overlap-permute") -> Dict[int, list]:
+    """Per-stage compute-time samples mined from the pipeline's ring-hop
+    spans — the bridge from MegaScan detection to MegaDPP scheduling
+    (ISSUE 15): between hop E(step t) and hop B(step t+1) on one stage
+    timeline the rank runs its stage body, so those gaps ARE the
+    per-stage step times the pipeline planner
+    (parallel/schedule.Planner.ingest_trace_events) consumes.
+
+    Returns {stage (args.rank): [gap_seconds, ...]}. Spans from other
+    ring domains (op != 'pp-*') are ignored; timelines are keyed
+    (pid, tid, op) so dp/cp shards of one stage never interleave AND
+    the forward scan's hops ('pp-schedule') never pair with the
+    zero-bubble backward scan's ('pp-zb-bwd') — a cross-scan gap spans
+    the LM head + loss + head backward, not a stage body."""
+    by_tid: Dict[tuple, List[dict]] = defaultdict(list)
+    for e in events:
+        if e.get("name") != name:
+            continue
+        op = str(e.get("args", {}).get("op", ""))
+        if not op.startswith("pp"):
+            continue
+        by_tid[(e.get("pid"), e.get("tid"), op)].append(e)
+    gaps: Dict[int, list] = defaultdict(list)
+    for evs in by_tid.values():
+        evs.sort(key=lambda e: e["ts"])
+        last_end = None
+        for e in evs:
+            rank = e.get("args", {}).get("rank")
+            if e["ph"] == "B" and last_end is not None and rank is not None:
+                gap_us = e["ts"] - last_end
+                if gap_us > 0:
+                    gaps[int(rank)].append(gap_us / 1e6)
+            elif e["ph"] == "E":
+                last_end = e["ts"]
+    return dict(gaps)
+
+
 def try_detect(events: List[dict], related: Dict[int, Set[int]],
                stage1_threshold: int = STAGE1_THRESHOLD) -> List[int]:
     """Full two-stage detection; returns abnormal pids (reference

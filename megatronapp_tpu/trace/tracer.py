@@ -269,6 +269,14 @@ class Tracer:
             recs, self._records = self._records, []
         return recs
 
+    def peek(self) -> List[Dict[str, Any]]:
+        """Non-destructive snapshot of the buffered records: the
+        trace-driven pipeline planner (parallel/schedule.Planner) reads
+        the per-stage ring-hop spans of a traced iteration BEFORE save()
+        drains them to disk."""
+        with self._lock:
+            return list(self._records)
+
     def save(self, path: Optional[str] = None):
         """Append records to the per-process trace file (reference background
         saver thread, trace.py:136-193; file naming parity with

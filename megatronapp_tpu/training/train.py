@@ -237,6 +237,16 @@ def pretrain_gpt(
             raise ValueError(reason)
 
     if parallel_cfg.forward_backward_disaggregating:
+        # The FBD executor runs its own legacy schedule — a non-default
+        # schedule program or the planner would be silently ignored,
+        # which is worse than an error (same policy as the --use-dpp
+        # parse-time check; this covers programmatic callers too).
+        if getattr(parallel_cfg, "pp_schedule", "1f1b") != "1f1b" or \
+                getattr(parallel_cfg, "pp_plan_from_trace", False):
+            raise ValueError(
+                "--pp-schedule/--pp-plan-from-trace do not compose "
+                "with forward_backward_disaggregating (the FBD "
+                "executor runs its own schedule); drop one")
         # The FBD executor path has no resilience wiring yet (ROADMAP
         # follow-up) — say so loudly instead of silently dropping the
         # protection the operator asked for.
@@ -443,13 +453,24 @@ def pretrain_gpt(
                 train_cfg.global_batch_size, seed=train_cfg.seed,
                 start_idx=consumed)
 
+    pp_schedule = getattr(parallel_cfg, "pp_schedule", "1f1b")
     if ctx.pp > 1:
-        def loss_fn(params, batch_mb):
-            return gpt_pipeline_loss(
-                params, batch_mb["tokens"], batch_mb["labels"],
-                batch_mb["loss_mask"], model_cfg, ctx, vpp=vpp,
-                order_policy=parallel_cfg.pipeline_order_policy,
-                segment_ids_mb=batch_mb.get("segment_ids"))
+        def make_pp_loss_fn(schedule):
+            """Pipelined loss bound to one schedule program — the
+            planner re-plan path rebuilds through this (ISSUE 15)."""
+            def loss_fn(params, batch_mb):
+                return gpt_pipeline_loss(
+                    params, batch_mb["tokens"], batch_mb["labels"],
+                    batch_mb["loss_mask"], model_cfg, ctx, vpp=vpp,
+                    order_policy=parallel_cfg.pipeline_order_policy,
+                    segment_ids_mb=batch_mb.get("segment_ids"),
+                    schedule=schedule)
+            return loss_fn
+
+        loss_fn = make_pp_loss_fn(pp_schedule)
+        if pp_schedule != "1f1b":
+            log_fn(f"pipeline schedule: {pp_schedule} (instruction "
+                   "program executor, parallel/schedule.py)")
     else:
         loss_fn = gpt_microbatch_loss(model_cfg, ctx=ctx)
     eval_step_fn = None
@@ -505,22 +526,84 @@ def pretrain_gpt(
             log_fn(f"dpp: --dist-opt-comm {opt_cfg.dist_opt_comm} is not "
                    "wired into the host-driven runtime — the ZeRO-1 "
                    "update runs in gspmd mode here")
-    else:
-        step_fn = make_train_step(
-            loss_fn, optimizer, opt_cfg, ctx, shardings,
+    def _build_step(loss_fn_, trace_phases=False, donate=True):
+        """The ONE build site for the jitted SPMD step — startup, the
+        phase-traced variant, and the planner's _apply_schedule rebuild
+        all go through it so they can never drift apart."""
+        return make_train_step(
+            loss_fn_, optimizer, opt_cfg, ctx, shardings,
             train_cfg.train_iters,
             check_nan=train_cfg.check_for_nan_in_loss,
-            pipeline=ctx.pp > 1, fp8=fp8_on)
+            pipeline=ctx.pp > 1, trace_phases=trace_phases,
+            donate=donate, fp8=fp8_on)
+
+    if not use_dpp_runtime:
+        step_fn = _build_step(loss_fn)
     # Non-donating variant for rerun replay (compiles only if a failure is
     # ever classified; the donating step would delete the live state's
     # buffers on replay). The DPP step never donates, so it replays as-is.
-    replay_step_fn = step_fn if use_dpp_runtime else make_train_step(
-        loss_fn, optimizer, opt_cfg, ctx, shardings, train_cfg.train_iters,
-        check_nan=train_cfg.check_for_nan_in_loss, pipeline=ctx.pp > 1,
-        donate=False, fp8=fp8_on)
+    replay_step_fn = step_fn if use_dpp_runtime else \
+        _build_step(loss_fn, donate=False)
+
+    # Trace-driven dynamic pipeline planning (ISSUE 15 — closing the
+    # MegaScan → MegaDPP loop): per-(stage, vstage) step-time EWMAs fed
+    # by the pipeline's ring-hop trace spans and the whole-step
+    # straggler signal drive a planner that models every candidate
+    # schedule's bubble and re-plans with hysteresis; a re-plan rebuilds
+    # the jitted step family below (loudly).
+    planner = None
+    saw_packed = False  # one packed batch freezes planning for the run
+    if (getattr(parallel_cfg, "pp_plan_from_trace", False) and ctx.pp > 1
+            and not use_dpp_runtime):
+        import dataclasses as _dc_plan
+
+        from megatronapp_tpu.parallel.overlap import tp_stage_eligible
+        from megatronapp_tpu.parallel.schedule import Planner
+
+        # Mirror pipeline.py's zb_switch: the planner may auto-apply
+        # zero-bubble only where the executor realizes it with the
+        # per-slot switch backward. On masked-dispatch meshes
+        # (tp-sharded / cp-ring / moe-ep stage bodies) both vjps run
+        # every slot — the modeled bubble win is paid back ~2x in
+        # redundant backward compute, so switching there would make
+        # real steps slower while the model claims improvement.
+        zb_realizable = (ctx.cp == 1 and ctx.ep == 1 and not (
+            ctx.tp > 1
+            and tp_stage_eligible(model_cfg, ctx,
+                                  train_cfg.seq_length)))
+        planner = Planner(ctx.pp, vpp=vpp, model_cfg=model_cfg,
+                          allow_zero_bubble=zb_realizable)
+        if not zb_realizable:
+            log_fn("pp-planner: zero-bubble candidate DISABLED on this "
+                   "mesh — the stage body carries collectives "
+                   "(tp-sharded rings / cp ring / moe ep), so the "
+                   "executor runs zero-bubble as masked dual-vjp "
+                   "compute that costs more than the bubble saves; "
+                   "planning stays among the remaining schedules")
+        _plan0 = planner.plan(num_micro)
+        # Pin "current" to the CONFIGURED schedule so re-plans measure
+        # improvement against what is actually running (plan() alone
+        # would seed with the modeled winner before any signal exists).
+        # Under vpp > 1 the candidate is named 'vpp' and '1f1b' is the
+        # same interleaved schedule — seed with the alias so the
+        # planner never "switches" between two names for one program.
+        _seed = ("vpp" if (vpp > 1 and pp_schedule == "1f1b")
+                 else pp_schedule)
+        planner.current = _dc_plan.replace(
+            _plan0, schedule=_seed,
+            bubble_fraction=_plan0.candidates.get(
+                _seed, _plan0.bubble_fraction))
+        log_fn(f"pp-planner: active (schedule {pp_schedule!r}, modeled "
+               f"bubble {planner.current.bubble_fraction:.4f}, "
+               "candidates "
+               f"{ {k: round(v, 4) for k, v in _plan0.candidates.items()} }"
+               f", stage costs "
+               f"{[round(c, 3) for c in _plan0.stage_costs]})")
 
     tracer = get_tracer()
     traced_step_fn = step_fn
+    fenced_trace = False
+    phase_traced = False
     if train_cfg.trace:
         tracer.configure(
             enabled=True, trace_dir=train_cfg.trace_dir,
@@ -539,11 +622,8 @@ def pretrain_gpt(
             log_fn("trace: dpp runtime active — schedule-phase spans come "
                    "from the runner's per-phase metrics")
         elif callbacks_supported():
-            traced_step_fn = make_train_step(
-                loss_fn, optimizer, opt_cfg, ctx, shardings,
-                train_cfg.train_iters,
-                check_nan=train_cfg.check_for_nan_in_loss,
-                pipeline=ctx.pp > 1, trace_phases=True, fp8=fp8_on)
+            phase_traced = True
+            traced_step_fn = _build_step(loss_fn, trace_phases=True)
         else:
             # Host-timestamped dispatch windows (round-4 verdict task 6
             # fallback): backends without host callbacks (the tunneled
@@ -559,6 +639,16 @@ def pretrain_gpt(
             # its traced iterations the same way.
             log_fn("trace: backend lacks host callbacks; using fenced "
                    "dispatch windows for schedule-phase spans")
+            fenced_trace = True
+            if planner is not None:
+                # Committing a re-plan the loop below cannot apply would
+                # desync the planner's state/metrics from the schedule
+                # actually running — planning stays observational here
+                # (EWMAs + gauges only; maybe_replan is never called).
+                log_fn("pp-planner: fenced-dispatch trace mode pins the "
+                       "compiled step — planning is OBSERVATIONAL (no "
+                       "re-plans); restart with --pp-schedule to change "
+                       "schedules")
             if ctx.pp > 1:
                 _fwd_only = jax.jit(lambda p, b: loss_fn(p, b)[0])
             else:
@@ -591,6 +681,32 @@ def pretrain_gpt(
             # the fenced wrapper exposes the underlying jitted step.
             fenced_step._hlo_source = step_fn
             traced_step_fn = fenced_step
+
+    def _apply_schedule(new_schedule: str) -> bool:
+        """Planner re-plan: swap the pipeline schedule program and
+        rebuild the jitted step family (one recompile, loudly logged).
+        Returns True when applied. Grads are schedule-invariant
+        (zero-bubble parity pinned ≤1e-6), so switching mid-run never
+        perturbs the optimizer trajectory beyond accumulation order."""
+        nonlocal loss_fn, step_fn, replay_step_fn, traced_step_fn
+        nonlocal pp_schedule
+        if fenced_trace:
+            log_fn("pp-planner: re-plan NOT applied — fenced-dispatch "
+                   "trace mode pins the compiled step (backend without "
+                   "host callbacks); restart with --pp-schedule "
+                   f"{new_schedule} to take it")
+            return False
+        log_fn(f"pp-planner: APPLYING schedule {new_schedule!r} "
+               f"(was {pp_schedule!r}) — rebuilding the train step "
+               "(one-time recompile)")
+        pp_schedule = new_schedule
+        loss_fn = make_pp_loss_fn(new_schedule)
+        step_fn = _build_step(loss_fn)
+        replay_step_fn = _build_step(loss_fn, donate=False)
+        traced_step_fn = step_fn
+        if phase_traced:
+            traced_step_fn = _build_step(loss_fn, trace_phases=True)
+        return True
 
     # Per-collective events via the XLA profiler (reference
     # mappings.py:27-60 group+bytes instrumentation; here synthesized
@@ -738,6 +854,44 @@ def pretrain_gpt(
             batch = globalize_batch(
                 reshape_global_batch(rows.take(cur_gbs), cur_micro), ctx)
             consumed += cur_gbs
+            if (ctx.pp > 1 and not use_dpp_runtime
+                    and "segment_ids" in batch):
+                # Packed batches cannot run the zero-bubble program
+                # (per-microbatch aux inputs). The stream may MIX packed
+                # and unpacked batches, so one packed batch freezes
+                # planning for the rest of the run, and a zero-bubble
+                # schedule — planner-applied OR statically configured —
+                # reverts to 1f1b BEFORE the step instead of crashing
+                # mid-stream (grads are schedule-invariant, so the
+                # revert is a perf-only change; a crash hours in is
+                # not).
+                if planner is not None and not saw_packed:
+                    saw_packed = True
+                    log_fn("pp-planner: packed batch in the stream — "
+                           "planning frozen (zero-bubble does not "
+                           "compose with packed sequences)")
+                if pp_schedule == "zero-bubble":
+                    log_fn("zero-bubble does not compose with packed "
+                           "sequences (segment_ids in batch) — "
+                           "reverting to 1f1b (grads are schedule-"
+                           "invariant; perf-only change)")
+                    if not _apply_schedule("1f1b"):
+                        # Fenced-dispatch trace mode pins the compiled
+                        # zero-bubble step — the packed batch WOULD
+                        # crash on retrace with a confusing
+                        # NotImplementedError; name the conflict now.
+                        raise ValueError(
+                            "packed batch (segment_ids) in the stream "
+                            "while the zero-bubble step is pinned by "
+                            "fenced-dispatch trace mode — restart with "
+                            "--pp-schedule 1f1b for packed data")
+                    if planner is not None and \
+                            planner.current is not None:
+                        planner.current = _dc_plan.replace(
+                            planner.current, schedule="1f1b",
+                            bubble_fraction=planner.current.candidates
+                            .get("1f1b",
+                                 planner.current.bubble_fraction))
             tokens_per_step = cur_gbs * train_cfg.seq_length
             straggler.start()
             with tracer.scope("train-step"):
@@ -790,6 +944,11 @@ def pretrain_gpt(
             tracer.iteration_end(
                 it, fence=state["params"] if was_traced else None)
             if was_traced:
+                if planner is not None:
+                    # MegaScan → planner: mine the traced iteration's
+                    # ring-hop spans for per-stage compute gaps BEFORE
+                    # save() drains the buffer to disk.
+                    planner.ingest_trace_events(tracer.peek())
                 tracer.save()
             window_tokens += tokens_per_step
 
@@ -844,6 +1003,20 @@ def pretrain_gpt(
                         export_fp8_metrics,
                     )
                     export_fp8_metrics(state["fp8"], model_cfg)
+                if planner is not None:
+                    # Whole-step sample keeps the per-stage EWMAs alive
+                    # between traced iterations; the gauges make the
+                    # planner's input signal observable at /metrics
+                    # (ISSUE 15 satellite). Re-plan with hysteresis —
+                    # frozen once ANY packed batch has been seen
+                    # (zero-bubble does not compose with per-microbatch
+                    # aux inputs, and the stream may mix).
+                    planner.observe_step(step_time_ms / 1e3)
+                    planner.export_metrics()
+                    if not saw_packed and not fenced_trace:
+                        newp = planner.maybe_replan(cur_micro)
+                        if newp is not None:
+                            _apply_schedule(newp.schedule)
                 e2e.track_iterations(
                     steps_in_window, dt,
                     window_tokens // train_cfg.seq_length)

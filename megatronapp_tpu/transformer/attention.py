@@ -215,14 +215,34 @@ def attention_forward(
             q = rms_norm(q, p["q_ln_scale"], cfg.layernorm_epsilon)
             k = rms_norm(k, p["k_ln_scale"], cfg.layernorm_epsilon)
         if rope_cos is not None:
-            # Full-length tables: q/k carry the FULL sequence post-ring.
+            # Post-ring tables: q/k carry the full sequence (cp == 1) or
+            # this cp rank's full LOCAL chunk (cp > 1 — the caller
+            # sliced the tables to the chunk, models/gpt.py stage_fn).
             q = rotary.apply_rope(q, rope_cos, rope_sin)
             k = rotary.apply_rope(k, rope_cos, rope_sin)
-        attn_out = dot_product_attention(
-            q, k, v, mask_type=cfg.attn_mask_type, attention_mask=None,
-            softmax_scale=None,
-            softmax_in_fp32=cfg.attention_softmax_in_fp32,
-            layer_id=layer_id)
+        if ctx.cp > 1:
+            # pp x cp x tp composition (ISSUE 15): after the tp ring
+            # gather the sequence is still the cp-LOCAL chunk — run the
+            # contiguous cp ring attention per tp head shard instead of
+            # treating the chunk as the whole sequence.
+            # tp_stage_eligible restricts this path to dense
+            # contiguous-p2p layouts (no zigzag — the caller skipped the
+            # permutation).
+            from megatronapp_tpu.ops.context_parallel import (
+                context_attention,
+            )
+            # manual-ok: context_attention detects the ambient manual cp
+            # axis and runs its ring body directly (no nested shard_map)
+            attn_out = context_attention(
+                q, k, v, ctx.shard_map_mesh, "p2p",
+                causal=cfg.attn_mask_type == AttnMaskType.causal,
+                overlap_ring=getattr(cfg, "cp_comm_overlap", True))
+        else:
+            attn_out = dot_product_attention(
+                q, k, v, mask_type=cfg.attn_mask_type,
+                attention_mask=None, softmax_scale=None,
+                softmax_in_fp32=cfg.attention_softmax_in_fp32,
+                layer_id=layer_id)
         attn_out = scope_capture("context", attn_out, layer_id)
         out_kernel = _dist.apply("weight", resolve_param(p["out_kernel"]),
                                  layer_id).astype(dt)

@@ -113,6 +113,39 @@ def layer_cfg_for_spec(cfg: TransformerConfig,
                                **over)
 
 
+def layer_relative_cost(spec: HeteroBlockSpec,
+                        cfg: TransformerConfig) -> float:
+    """Relative per-layer FLOP weight for the pipeline planner's
+    heterogeneous stage table (parallel/schedule.stage_cost_model).
+
+    Counts the projection GEMM work (the seq-independent part — the
+    attention-score term scales every NORMAL-attention layer identically
+    and cancels in the relative comparison the planner makes): no_op
+    halves cost 0, linear replacements one [H, H] matmul, normal
+    attention its QKV + out projections at the spec's GQA group count,
+    normal MLP its fc1/fc2 (plus the gate half for gated activations)
+    at the spec's ffn size."""
+    from megatronapp_tpu.config.transformer_config import ActivationKind
+    h = cfg.hidden_size
+    d = cfg.head_dim
+    nq = cfg.num_attention_heads
+    cost = 0.0
+    if spec.attention == OP_LINEAR:
+        cost += h * h
+    elif spec.attention == OP_NORMAL:
+        nkv = spec.num_query_groups or cfg.num_query_groups
+        cost += h * (nq + 2 * nkv) * d      # fused QKV projection
+        cost += h * nq * d                  # out projection
+    if spec.mlp == OP_LINEAR:
+        cost += h * h
+    elif spec.mlp == OP_NORMAL:
+        ffn = spec.ffn_hidden_size or cfg.ffn_hidden_size
+        gated = cfg.activation in (ActivationKind.swiglu,
+                                   ActivationKind.geglu)
+        cost += (3 if gated else 2) * h * ffn
+    return cost
+
+
 def init_hetero_block_params(rng, cfg: TransformerConfig):
     """Per-layer (unstacked) params + logical axes; layer i follows
     cfg.hetero_block_specs[i]."""

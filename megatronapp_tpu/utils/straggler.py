@@ -26,6 +26,18 @@ class StepRecord:
     elapsed_s: float
 
 
+def _window_z(values, x: float) -> Optional[float]:
+    """z-score of x against the trailing window (population std); None
+    when the window is degenerate (zero spread)."""
+    n = len(values)
+    mean = sum(values) / n
+    var = sum((t - mean) ** 2 for t in values) / n
+    std = var ** 0.5
+    if std <= 0:
+        return None
+    return (x - mean) / std
+
+
 class StragglerDetector:
     def __init__(self, window: int = 64, z_threshold: float = 3.0,
                  min_samples: int = 8):
@@ -61,12 +73,8 @@ class StragglerDetector:
         rec = StepRecord(self._step, elapsed)
         outlier = None
         if len(self.window) >= self.min_samples:
-            times = [r.elapsed_s for r in self.window]
-            mean = sum(times) / len(times)
-            var = sum((t - mean) ** 2 for t in times) / len(times)
-            std = var ** 0.5
-            if std > 0:
-                z = (elapsed - mean) / std
+            z = _window_z([r.elapsed_s for r in self.window], elapsed)
+            if z is not None:
                 # z-score into the shared telemetry registry (ISSUE 12):
                 # the straggler signal becomes scrapeable at /metrics
                 # alongside the step-time histogram, instead of living
@@ -80,6 +88,32 @@ class StragglerDetector:
         if outlier is None:
             self.window.append(rec)
         return outlier
+
+
+class RollingZ:
+    """Windowed z-score of the latest sample against the trailing window
+    — the per-(stage, vstage) complement of StragglerDetector's per-step
+    z. The pipeline planner (parallel/schedule.Planner) keys one per
+    stage timeline so per-stage slowdowns are visible at /metrics even
+    when the aggregate step time hides them."""
+
+    def __init__(self, window: int = 64, min_samples: int = 8,
+                 z_threshold: float = 3.0):
+        self.window: Deque[float] = deque(maxlen=window)
+        self.min_samples = min_samples
+        self.z_threshold = z_threshold
+        self.last_z: Optional[float] = None
+
+    def observe(self, x: float) -> Optional[float]:
+        z = None
+        if len(self.window) >= self.min_samples:
+            z = _window_z(self.window, x)
+        # Outliers stay out of the baseline window (same discipline as
+        # StragglerDetector.stop).
+        if z is None or z <= self.z_threshold:
+            self.window.append(x)
+        self.last_z = z
+        return z
 
 
 def probe_chip_rtts(devices=None, size: int = 256, repeats: int = 3,

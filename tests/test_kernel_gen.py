@@ -623,9 +623,15 @@ class TestEligibilityReasons:
         assert tp_stage_ineligible_reason(cfg, Ctx(), 64) is None
         assert tp_stage_eligible(cfg, Ctx(), 64)
         assert "seq_len" in tp_stage_ineligible_reason(cfg, Ctx(), 63)
+        # cp > 1 composes since ISSUE 15 (dense non-MLA/non-MoE on the
+        # p2p cp ring); seq must divide by cp*tp, and the excluded
+        # layouts name their predicate.
         c2 = Ctx()
         c2.cp = 2
-        assert "cp ==" in tp_stage_ineligible_reason(cfg, c2, 64)
+        assert tp_stage_ineligible_reason(cfg, c2, 64) is None
+        assert "cp*tp" in tp_stage_ineligible_reason(cfg, c2, 34)
+        a2a = dataclasses.replace(cfg, cp_comm_type="a2a")
+        assert "p2p" in tp_stage_ineligible_reason(a2a, c2, 64)
         off = dataclasses.replace(cfg, tp_sharded_stage=False)
         assert "kill-switch" in tp_stage_ineligible_reason(off, Ctx(), 64)
         assert "ffn_hidden_size" in tp_stage_ineligible_reason(

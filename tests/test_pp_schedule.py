@@ -1,0 +1,939 @@
+"""Pipeline schedule layer tests (ISSUE 15): instruction-program
+correctness (counts, dependency/ring alignment, the W-deferral fence),
+the simulated-timeline bubble model (zero-bubble strictly below 1F1B at
+the bench shapes), the trace-driven planner (EWMA ingestion, hysteresis
+re-planning, /metrics gauges), the MegaScan span mining bridge, exact
+zero-bubble parity pins for every schedule x axis combo (pp2, pp2 x vpp2,
+pp2 x tp2, pp2 x cp2 x tp2), the pp x cp x tp sharded-stage composition
+(parity + compiled per-device FLOPs ratio), and the --pp-schedule /
+--tp-comm-overlap cp>1 CLI accept/reject matrix."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from megatronapp_tpu.config.parallel_config import ParallelConfig
+from megatronapp_tpu.config.transformer_config import TransformerConfig
+from megatronapp_tpu.models.gpt import (
+    gpt_loss, gpt_pipeline_loss, init_gpt_params,
+)
+from megatronapp_tpu.parallel import schedule as schedlib
+from megatronapp_tpu.parallel.mesh import build_mesh
+from megatronapp_tpu.parallel.schedule import (
+    KIND_B, KIND_NOP, KIND_W, Planner, analytic_vpp_bubble,
+    combined_programs, forward_tables, simulate_timeline,
+    stage_cost_model, validate_programs, zb_backward_tables,
+)
+from megatronapp_tpu.utils import metrics
+
+
+def _cfg(**kw):
+    d = dict(num_layers=4, hidden_size=64, num_attention_heads=4,
+             vocab_size=128, max_position_embeddings=64,
+             remat_policy="none", compute_dtype=jnp.float32)
+    d.update(kw)
+    return TransformerConfig(**d)
+
+
+def _data(M=4, mb=2, s=16, vocab=128):
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (M, mb, s), 0,
+                                vocab)
+    return tokens, jnp.roll(tokens, -1, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Program tables
+# ---------------------------------------------------------------------------
+
+class TestForwardTables:
+    @pytest.mark.parametrize("pp,M,vpp", [(2, 4, 1), (4, 8, 1), (2, 4, 2),
+                                          (4, 8, 2), (3, 6, 1)])
+    def test_matches_closed_form(self, pp, M, vpp):
+        """The clocked tables reproduce the unified closed-form schedule
+        the scan used to compute inline (u = t - s, r = u // (pp*vpp),
+        c = (u % (pp*vpp)) // pp, m = r*pp + u % pp) bit for bit."""
+        active, mb_t, ck_t = forward_tables(pp, M, vpp)
+        T = M * vpp + pp - 1
+        assert active.shape == (T, pp)
+        cycle = pp * vpp
+        for t in range(T):
+            for s in range(pp):
+                u = t - s
+                r, w = divmod(u, cycle)
+                m = r * pp + (w % pp)
+                want = (u >= 0) and (0 <= m < M)
+                assert bool(active[t, s]) == want, (t, s)
+                if want:
+                    assert int(mb_t[t, s]) == m
+                    assert int(ck_t[t, s]) == w // pp
+
+    @pytest.mark.parametrize("pp,M,vpp", [(2, 4, 1), (4, 8, 2), (2, 2, 4)])
+    def test_validates(self, pp, M, vpp):
+        validate_programs(pp, M, vpp, forward_tables(pp, M, vpp))
+
+
+class TestZeroBubbleTables:
+    @pytest.mark.parametrize("pp,M,vpp", [(2, 4, 1), (4, 8, 1), (2, 4, 2),
+                                          (4, 4, 1), (3, 6, 1)])
+    def test_counts_and_fence(self, pp, M, vpp):
+        """Exactly M*vpp B and M*vpp W instructions per stage, every W
+        strictly after its same-(m, chunk) B, and every W INSIDE the
+        program — the optimizer fence is structural (a missing W would
+        silently drop a wgrad)."""
+        kind, mb_t, ck_t = zb_backward_tables(pp, M, vpp)
+        for s in range(pp):
+            b_at, w_at = {}, {}
+            for t in range(kind.shape[0]):
+                k = int(kind[t, s])
+                if k == KIND_NOP:
+                    continue
+                key = (int(mb_t[t, s]), int(ck_t[t, s]))
+                (b_at if k == KIND_B else w_at)[key] = t
+            assert len(b_at) == M * vpp
+            assert len(w_at) == M * vpp
+            for key, tw in w_at.items():
+                assert b_at[key] < tw, (s, key)
+
+    @pytest.mark.parametrize("pp,M,vpp", [(2, 4, 1), (4, 8, 1), (2, 4, 2)])
+    def test_validates_with_forward(self, pp, M, vpp):
+        validate_programs(pp, M, vpp, forward_tables(pp, M, vpp),
+                          zb_backward_tables(pp, M, vpp))
+
+    @pytest.mark.parametrize("pp,M,vpp", [(2, 4, 1), (4, 8, 1), (2, 4, 2)])
+    def test_w_deferral_is_compact(self, pp, M, vpp):
+        """The greedy wavefront packing leaves each stage's B slots dense,
+        and the FIFO W fill wastes no idle slot: every stage's first W
+        lands one slot after its last B, and the program ends at the last
+        W (no trailing padding). The bubble win itself is a property of
+        the COMBINED timeline — simulate_timeline measures it above."""
+        kind, _, _ = zb_backward_tables(pp, M, vpp)
+        last_w_all = 0
+        for s in range(pp):
+            w_slots = [t for t in range(kind.shape[0])
+                       if kind[t, s] == KIND_W]
+            b_slots = [t for t in range(kind.shape[0])
+                       if kind[t, s] == KIND_B]
+            assert min(w_slots) == max(b_slots) + 1, s
+            assert max(w_slots) - min(w_slots) == len(w_slots) - 1, s
+            last_w_all = max(last_w_all, max(w_slots))
+        assert kind.shape[0] == last_w_all + 1
+
+
+class TestProgramValidation:
+    def test_duplicate_f_rejected(self):
+        fwd = forward_tables(2, 4, 1)
+        active, mb_t, ck_t = (a.copy() for a in fwd)
+        dup_t = [t for t in range(active.shape[0]) if active[t, 0]][:2]
+        mb_t[dup_t[1], 0] = mb_t[dup_t[0], 0]
+        with pytest.raises(ValueError, match="duplicate F"):
+            validate_programs(2, 4, 1, (active, mb_t, ck_t))
+
+    def test_ring_misalignment_rejected(self):
+        """An F consuming a ring value its producer did not emit one slot
+        earlier must be rejected — the executor would silently read a
+        stale activation."""
+        active, mb_t, ck_t = (a.copy() for a in forward_tables(2, 4, 1))
+        # Swap stage-1's first two microbatches: F(m=1, s=1) now sits one
+        # slot after F(m=0, s=0).
+        ts = [t for t in range(active.shape[0]) if active[t, 1]]
+        mb_t[ts[0], 1], mb_t[ts[1], 1] = mb_t[ts[1], 1], mb_t[ts[0], 1]
+        with pytest.raises(ValueError, match="misaligned"):
+            validate_programs(2, 4, 1, (active, mb_t, ck_t))
+
+    def test_missing_w_rejected(self):
+        fwd = forward_tables(2, 4, 1)
+        kind, mb_t, ck_t = (a.copy() for a in zb_backward_tables(2, 4, 1))
+        tw = [t for t in range(kind.shape[0]) if kind[t, 0] == KIND_W]
+        kind[tw[0], 0] = KIND_NOP
+        with pytest.raises(ValueError, match="missing W|expected"):
+            validate_programs(2, 4, 1, fwd, (kind, mb_t, ck_t))
+
+    def test_w_before_b_rejected(self):
+        """W reordered ahead of its dgrad B (across the fence the
+        deferral must respect) is rejected."""
+        fwd = forward_tables(2, 4, 1)
+        kind, mb_t, ck_t = (a.copy() for a in zb_backward_tables(2, 4, 1))
+        s = 0
+        b_at = {(int(mb_t[t, s]), int(ck_t[t, s])): t
+                for t in range(kind.shape[0]) if kind[t, s] == KIND_B}
+        w_at = {(int(mb_t[t, s]), int(ck_t[t, s])): t
+                for t in range(kind.shape[0]) if kind[t, s] == KIND_W}
+        # Move the LAST microbatch's W to the slot before its B.
+        key = max(w_at)
+        told = w_at[key]
+        tnew = b_at[key] - 1
+        assert kind[tnew, s] == KIND_NOP or tnew != told
+        kind[told, s] = KIND_NOP
+        # Overwrite whatever occupies tnew (duplicate checks fire first
+        # otherwise) — target an empty slot.
+        empties = [t for t in range(kind.shape[0])
+                   if kind[t, s] == KIND_NOP and t < b_at[key]]
+        assert empties, "no idle slot before the B to corrupt into"
+        kind[empties[-1], s] = KIND_W
+        mb_t[empties[-1], s], ck_t[empties[-1], s] = key
+        with pytest.raises(ValueError, match="runs before its dgrad"):
+            validate_programs(2, 4, 1, fwd, (kind, mb_t, ck_t))
+
+
+# ---------------------------------------------------------------------------
+# Bubble model
+# ---------------------------------------------------------------------------
+
+class TestBubbleModel:
+    @pytest.mark.parametrize("pp,M", [(2, 4), (4, 8), (4, 16)])
+    def test_instruction_counts(self, pp, M):
+        for sched, kinds in (("1f1b", {"F": M, "BW": M}),
+                             ("zero-bubble", {"F": M, "B": M, "W": M})):
+            progs = combined_programs(sched, pp, M)
+            assert len(progs) == pp
+            for prog in progs:
+                for k, n in kinds.items():
+                    assert sum(1 for i in prog if i.kind == k) == n
+
+    @pytest.mark.parametrize("pp,M", [(2, 4), (4, 8), (4, 16)])
+    def test_zero_bubble_strictly_below_1f1b_uniform(self, pp, M):
+        """The bench gate's core claim at the bench shapes."""
+        b1 = simulate_timeline("1f1b", pp, M)["bubble_fraction"]
+        bz = simulate_timeline("zero-bubble", pp, M)["bubble_fraction"]
+        assert bz < b1, (bz, b1)
+        # 1F1B's analytic bubble at uniform cost is (pp-1)/(M+pp-1).
+        assert b1 == pytest.approx((pp - 1) / (M + pp - 1), abs=1e-9)
+
+    def test_zero_bubble_below_1f1b_heterogeneous(self):
+        """The 2x-slow-stage bench shape: a straggling stage inflates
+        both bubbles, zero-bubble still wins."""
+        costs = [1.0, 2.0, 1.0, 1.0]
+        b1 = simulate_timeline("1f1b", 4, 8,
+                               stage_costs=costs)["bubble_fraction"]
+        bz = simulate_timeline("zero-bubble", 4, 8,
+                               stage_costs=costs)["bubble_fraction"]
+        assert bz < b1, (bz, b1)
+
+    def test_unequal_bwd_wgrad_ratios(self):
+        bz = simulate_timeline("zero-bubble", 4, 8, bwd_ratio=2.0,
+                               wgrad_ratio=1.0)["bubble_fraction"]
+        b1 = simulate_timeline("1f1b", 4, 8, bwd_ratio=2.0,
+                               wgrad_ratio=1.0)["bubble_fraction"]
+        assert 0.0 <= bz < b1 < 1.0
+
+    def test_busy_conserved(self):
+        """Total busy time is schedule-invariant (same work, different
+        placement): sum over stages of per-stage busy must match."""
+        r1 = simulate_timeline("1f1b", 4, 8)
+        rz = simulate_timeline("zero-bubble", 4, 8)
+        assert sum(r1["per_stage_busy"]) == pytest.approx(
+            sum(rz["per_stage_busy"]))
+        assert rz["makespan"] < r1["makespan"]
+
+    def test_analytic_vpp_bubble(self):
+        # Uniform stages: 1 - (M*vpp)/(M*vpp + pp - 1).
+        assert analytic_vpp_bubble(4, 8, 2, [1.0] * 4) == pytest.approx(
+            1 - 16 / 19)
+        # A 2x-slow stage halves the mean/max imbalance factor.
+        assert analytic_vpp_bubble(2, 4, 2, [1.0, 1.0]) < \
+            analytic_vpp_bubble(2, 4, 2, [1.0, 2.0])
+
+    def test_unknown_schedule_rejected(self):
+        with pytest.raises(ValueError, match="unknown schedule"):
+            combined_programs("gpipe", 2, 4)
+
+
+# ---------------------------------------------------------------------------
+# Planner + signal plumbing
+# ---------------------------------------------------------------------------
+
+class TestPlanner:
+    def test_stage_cost_model_uniform(self):
+        assert stage_cost_model(_cfg(), 4) == [1.0] * 4
+        assert stage_cost_model(None, 2) == [1.0] * 2
+
+    def test_stage_cost_model_heterogeneous(self):
+        """Nemotron-style stack with no_op halves on late layers: the
+        planner's static table must weight the all-normal stage heavier."""
+        cfg = _cfg(heterogeneous_layers_config_json="""
+        {"block_configs": [
+          {"attention": {"no_op": false, "replace_with_linear": false,
+                         "num_query_groups": null},
+           "ffn": {"no_op": false, "replace_with_linear": false,
+                   "ffn_hidden_size": null}},
+          {"attention": {"no_op": false, "replace_with_linear": false,
+                         "num_query_groups": null},
+           "ffn": {"no_op": false, "replace_with_linear": false,
+                   "ffn_hidden_size": null}},
+          {"attention": {"no_op": true, "replace_with_linear": false,
+                         "num_query_groups": null},
+           "ffn": {"no_op": true, "replace_with_linear": false,
+                   "ffn_hidden_size": null}},
+          {"attention": {"no_op": true, "replace_with_linear": false,
+                         "num_query_groups": null},
+           "ffn": {"no_op": true, "replace_with_linear": false,
+                   "ffn_hidden_size": null}}]}
+        """)
+        costs = stage_cost_model(cfg, 2)
+        assert costs[0] > 1.0 > costs[1] >= 0.0
+        assert sum(costs) / 2 == pytest.approx(1.0)
+
+    def test_ewma_and_static_fallback(self):
+        pl = Planner(2, model_cfg=None)
+        # No signal yet -> static table.
+        assert pl.stage_costs() == [1.0, 1.0]
+        pl.observe_stage_time(0, 0.1)
+        # Partial signal (stage 1 unseen) still -> static.
+        assert pl.stage_costs() == [1.0, 1.0]
+        pl.observe_stage_time(1, 0.3)
+        c = pl.stage_costs()
+        assert c[1] > c[0] and sum(c) / 2 == pytest.approx(1.0)
+
+    def test_plan_prefers_zero_bubble_and_validates(self):
+        pl = Planner(4)
+        plan = pl.plan(8)
+        assert plan.schedule == "zero-bubble"
+        assert plan.candidates["zero-bubble"] < plan.candidates["1f1b"]
+
+    def test_vpp_planner_stays_on_vpp(self):
+        plan = Planner(2, vpp=2).plan(4)
+        assert plan.schedule == "vpp"
+        assert set(plan.candidates) == {"vpp"}
+
+    def test_maybe_replan_hysteresis(self, caplog):
+        import dataclasses as dc
+        import logging
+        pl = Planner(4, replan_margin=0.02)
+        plan0 = pl.plan(8)
+        # Pin current to 1f1b (what's "running").
+        pl.current = dc.replace(plan0, schedule="1f1b",
+                                bubble_fraction=plan0.candidates["1f1b"])
+        with caplog.at_level(logging.WARNING,
+                             logger="megatronapp_tpu.parallel.schedule"):
+            new = pl.maybe_replan(8)
+        assert new is not None and new.schedule == "zero-bubble"
+        assert pl.replans == 1
+        assert any("RE-PLAN" in r.message for r in caplog.records)
+        # Already on the winner: no further replan.
+        assert pl.maybe_replan(8) is None
+        assert pl.replans == 1
+
+    def test_maybe_replan_never_fabricates_current_bubble(self):
+        """A running schedule the model cannot price (zero-bubble under
+        vpp > 1 — only 'vpp' is a candidate there) must NOT be switched
+        away from on a fabricated comparison; state stays untouched."""
+        import dataclasses as dc
+        pl = Planner(2, vpp=2)
+        plan0 = pl.plan(4)
+        pl.current = dc.replace(plan0, schedule="zero-bubble")
+        assert pl.maybe_replan(4) is None
+        assert pl.current.schedule == "zero-bubble"
+        assert pl.replans == 0
+
+    def test_maybe_replan_margin_blocks_marginal_switch(self):
+        import dataclasses as dc
+        pl = Planner(4, replan_margin=1.0)   # absurd margin
+        plan0 = pl.plan(8)
+        pl.current = dc.replace(plan0, schedule="1f1b",
+                                bubble_fraction=plan0.candidates["1f1b"])
+        assert pl.maybe_replan(8) is None
+        assert pl.replans == 0
+
+    def test_export_metrics_gauges(self):
+        metrics.enable()
+        try:
+            pl = Planner(2)
+            for _ in range(3):
+                pl.observe_stage_time(0, 0.1)
+                pl.observe_stage_time(1, 0.2, vstage=0)
+            pl.plan(4)
+            pl.export_metrics()
+            text = metrics.render_prometheus()
+            assert 'pp_stage_step_time_ewma_ms{stage="0",vstage="0"}' \
+                in text
+            assert 'pp_stage_step_time_ewma_ms{stage="1",vstage="0"}' \
+                in text
+            assert "pp_plan_bubble_fraction" in text
+            assert "pp_plan_schedule_index" in text
+        finally:
+            metrics.disable()
+
+    def test_observe_step_keeps_plan_alive(self):
+        pl = Planner(2)
+        for _ in range(4):
+            pl.observe_step(0.5)
+        c = pl.stage_costs()
+        assert c == pytest.approx([1.0, 1.0])
+
+    def test_no_switch_still_refreshes_telemetry(self):
+        """Within-margin no-switch must still adopt the just-computed
+        costs/candidates under the running schedule — otherwise the
+        /metrics gauges freeze at the startup snapshot."""
+        import dataclasses
+        pl = Planner(2, replan_margin=10.0)   # margin: never switches
+        p0 = pl.plan(8)
+        # Seed with the CONFIGURED schedule (as train.py does), not the
+        # modeled winner.
+        pl.current = dataclasses.replace(
+            p0, schedule="1f1b", bubble_fraction=p0.candidates["1f1b"])
+        before = list(pl.current.stage_costs)
+        pl.observe_stage_time(0, 0.1)
+        pl.observe_stage_time(1, 0.3)
+        assert pl.maybe_replan(8) is None
+        assert pl.current.schedule == "1f1b"
+        assert list(pl.current.stage_costs) != before
+
+    def test_zero_bubble_candidate_gated(self):
+        """allow_zero_bubble=False (masked-dispatch meshes, where the
+        executor pays ~2x backward for the modeled bubble win): the
+        candidate set excludes zero-bubble and a configured zero-bubble
+        current is never force-switched away (no modeled comparison)."""
+        pl = Planner(2, allow_zero_bubble=False)
+        plan = pl.plan(8)
+        assert set(plan.candidates) == {"1f1b"}
+        assert pl.maybe_replan(8) is None
+        import dataclasses
+        pl.current = dataclasses.replace(plan, schedule="zero-bubble")
+        assert pl.maybe_replan(8) is None
+        assert pl.current.schedule == "zero-bubble"
+
+
+class TestSignalMining:
+    def _events(self, gaps_by_stage, hop_us=50.0):
+        """Synthetic pp-overlap-permute B/E pairs: on each stage timeline
+        hop E(t) .. hop B(t+1) is the stage-body compute gap."""
+        events = []
+        for stage, gaps in gaps_by_stage.items():
+            ts = 1000.0
+            tid = stage + 1
+            for g_us in gaps:
+                events.append({"name": "pp-overlap-permute", "ph": "B",
+                               "ts": ts, "pid": 0, "tid": tid,
+                               "args": {"op": "pp-schedule",
+                                        "rank": stage}})
+                events.append({"name": "pp-overlap-permute", "ph": "E",
+                               "ts": ts + hop_us, "pid": 0, "tid": tid,
+                               "args": {"op": "pp-schedule",
+                                        "rank": stage}})
+                ts += hop_us + g_us
+        return events
+
+    def test_stage_step_gaps(self):
+        from megatronapp_tpu.trace.detect import stage_step_gaps
+        ev = self._events({0: [100.0, 100.0, 100.0],
+                           1: [300.0, 300.0, 300.0]})
+        gaps = stage_step_gaps(ev)
+        assert set(gaps) == {0, 1}
+        assert np.allclose(gaps[0], 100e-6)
+        assert np.allclose(gaps[1], 300e-6)
+
+    def test_other_ring_domains_ignored(self):
+        from megatronapp_tpu.trace.detect import stage_step_gaps
+        ev = self._events({0: [100.0]})
+        for e in ev:
+            e["args"]["op"] = "tp-ag-mm"
+        assert stage_step_gaps(ev) == {}
+
+    def test_planner_ingests_skew(self):
+        pl = Planner(2)
+        ev = self._events({0: [100.0] * 8, 1: [300.0] * 8})
+        # First hop of each timeline has no preceding E: 7 gaps/stage.
+        n = pl.ingest_trace_events(ev)
+        assert n == 14
+        c = pl.stage_costs()
+        assert c[1] / c[0] == pytest.approx(3.0, rel=0.05)
+
+    def test_trace_samples_supersede_synthetic_split(self):
+        """observe_step's per-step split (~step/pp) and the ring-gap
+        samples (~step/slots) are DIFFERENT units: once trace samples
+        arrive they clear the synthetic history and observe_step becomes
+        a no-op — mixing the two would oscillate the EWMA gauges and
+        flag phantom stragglers on uniform stages."""
+        pl = Planner(2)
+        for _ in range(4):
+            pl.observe_step(0.5)          # 0.25 s/stage synthetic
+        ev = self._events({0: [100.0] * 8, 1: [100.0] * 8})
+        pl.ingest_trace_events(ev)        # 100 us/slot measured
+        ewma_after_trace = dict(pl._ewma)
+        # Synthetic history is gone: EWMAs are at the per-slot scale.
+        assert all(v < 1e-3 for v in ewma_after_trace.values())
+        pl.observe_step(0.5)              # must NOT pollute
+        assert pl._ewma == ewma_after_trace
+        # No phantom straggler z from the unit mix.
+        assert all(z.last_z is None or z.last_z < 3.0
+                   for z in pl._z.values())
+
+    def test_rolling_z(self):
+        from megatronapp_tpu.utils.straggler import RollingZ
+        rz = RollingZ(window=16, min_samples=4)
+        # Small deterministic jitter — a zero-variance window yields no
+        # z at all (std == 0 guard).
+        for i in range(8):
+            rz.observe(1.0 + 0.01 * (i % 2))
+        z_mid = rz.observe(1.005)
+        assert z_mid is not None and abs(z_mid) < 1.0
+        z_hi = rz.observe(100.0)       # clear outlier
+        assert z_hi is not None and z_hi > 3.0
+        # Outlier stayed OUT of the baseline window.
+        z_back = rz.observe(1.005)
+        assert z_back is not None and abs(z_back) < 1.0
+
+
+# ---------------------------------------------------------------------------
+# Exact parity: zero-bubble == 1F1B for every axis combo
+# ---------------------------------------------------------------------------
+
+def _schedule_parity(cfg, par, ndev, devices8, M=4, mb=1, s=16,
+                     grad_atol=1e-6):
+    """loss(zb) == loss(1f1b) bitwise-close and grads within atol on one
+    mesh, identical params/data."""
+    ctx = build_mesh(par, devices=devices8[:ndev])
+    vpp = par.virtual_pipeline_parallel
+    rng = jax.random.PRNGKey(0)
+    p_pipe, _ = init_gpt_params(rng, cfg, pp=ctx.pp, vpp=vpp)
+    tokens, labels = _data(M, mb, s, cfg.vocab_size)
+    mask = jnp.ones(labels.shape, jnp.float32)
+
+    def loss_of(schedule):
+        with ctx.mesh:
+            return jax.jit(lambda p: gpt_pipeline_loss(
+                p, tokens, labels, mask, cfg, ctx, vpp=vpp,
+                schedule=schedule)[0])
+
+    l1 = float(loss_of("1f1b")(p_pipe))
+    lz = float(loss_of("zero-bubble")(p_pipe))
+    assert abs(l1 - lz) <= 1e-6, (l1, lz)
+
+    with ctx.mesh:
+        g1 = jax.jit(jax.grad(lambda p: gpt_pipeline_loss(
+            p, tokens, labels, mask, cfg, ctx, vpp=vpp,
+            schedule="1f1b")[0]))(p_pipe)
+        gz = jax.jit(jax.grad(lambda p: gpt_pipeline_loss(
+            p, tokens, labels, mask, cfg, ctx, vpp=vpp,
+            schedule="zero-bubble")[0]))(p_pipe)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(gz)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=grad_atol)
+    return l1
+
+
+class TestZeroBubbleParity:
+    def test_pp2(self, devices8):
+        _schedule_parity(_cfg(), ParallelConfig(pipeline_parallel=2), 2,
+                         devices8)
+
+    def test_pp2_vpp2(self, devices8):
+        _schedule_parity(
+            _cfg(num_layers=8),
+            ParallelConfig(pipeline_parallel=2,
+                           virtual_pipeline_parallel=2), 2, devices8)
+
+    def test_pp2_dp2(self, devices8):
+        # dp shards only the microbatch dim and its wgrad psum lives at
+        # the region transpose, OUTSIDE the per-slot branches — so the
+        # efficient lax.switch backward must run (and not deadlock)
+        # with dp in the mesh.
+        _schedule_parity(_cfg(), ParallelConfig(pipeline_parallel=2), 4,
+                         devices8, mb=2)
+
+    def test_pp2_tp2_replicated_stage(self, devices8):
+        # tp>1 with the REPLICATED stage body (kill switch off): each tp
+        # rank redundantly computes the stage with no collectives inside
+        # — same switch-path eligibility as plain dp.
+        _schedule_parity(
+            _cfg(tp_sharded_stage=False),
+            ParallelConfig(pipeline_parallel=2, tensor_parallel=2), 4,
+            devices8)
+
+    def test_pp2_tp2_sharded_stage(self, devices8):
+        _schedule_parity(
+            _cfg(tp_comm_overlap=True),
+            ParallelConfig(pipeline_parallel=2, tensor_parallel=2), 4,
+            devices8)
+
+    def test_pp2_cp2_tp2(self, devices8):
+        _schedule_parity(
+            _cfg(tp_comm_overlap=True),
+            ParallelConfig(pipeline_parallel=2, tensor_parallel=2,
+                           context_parallel=2), 8, devices8, mb=2, s=32)
+
+    def test_zero_bubble_rejects_packed_sequences(self, devices8):
+        cfg = _cfg()
+        par = ParallelConfig(pipeline_parallel=2)
+        ctx = build_mesh(par, devices=devices8[:2])
+        p_pipe, _ = init_gpt_params(jax.random.PRNGKey(0), cfg, pp=2)
+        tokens, labels = _data()
+        seg = jnp.ones(tokens.shape, jnp.int32)
+        with pytest.raises(NotImplementedError, match="zero-bubble"):
+            gpt_pipeline_loss(p_pipe, tokens, labels, None, cfg, ctx,
+                              segment_ids_mb=seg, schedule="zero-bubble")
+
+    def test_vpp_alias_requires_vpp(self, devices8):
+        from megatronapp_tpu.parallel.pipeline import spmd_pipeline
+        par = ParallelConfig(pipeline_parallel=2)
+        ctx = build_mesh(par, devices=devices8[:2])
+        with pytest.raises(ValueError, match="requires vpp > 1"):
+            spmd_pipeline(lambda p, x, o: (x, 0.0), {}, jnp.zeros((2,)),
+                          ctx, 2, schedule="vpp")
+
+
+# ---------------------------------------------------------------------------
+# pp x cp x tp composition (the tp_stage_eligible cp>1 lift)
+# ---------------------------------------------------------------------------
+
+class TestPpCpTpComposition:
+    def _setup(self, devices8, tp_sharded=True, s=32):
+        cfg = _cfg(tp_comm_overlap=True, tp_sharded_stage=tp_sharded)
+        par = ParallelConfig(pipeline_parallel=2, tensor_parallel=2,
+                             context_parallel=2)
+        ctx = build_mesh(par, devices=devices8[:8])
+        return cfg, ctx, s
+
+    def test_eligible_under_cp2(self, devices8):
+        from megatronapp_tpu.parallel.overlap import (
+            tp_stage_eligible, tp_stage_ineligible_reason,
+        )
+        cfg, ctx, s = self._setup(devices8)
+        assert tp_stage_eligible(cfg, ctx, s)
+        # The excluded layouts name their predicate.
+        mla = dataclasses.replace(
+            cfg, multi_latent_attention=True, q_lora_rank=None,
+            kv_lora_rank=32, qk_head_dim=16, qk_pos_emb_head_dim=8,
+            v_head_dim=16)
+        assert "MLA" in tp_stage_ineligible_reason(mla, ctx, s)
+        moe = dataclasses.replace(cfg, num_moe_experts=4)
+        assert "MoE" in tp_stage_ineligible_reason(moe, ctx, s)
+        a2a = dataclasses.replace(cfg, cp_comm_type="a2a")
+        assert "p2p" in tp_stage_ineligible_reason(a2a, ctx, s)
+        # seq must divide by cp*tp now, not just tp (34 % 2 == 0 but
+        # 34 % 4 != 0 — the joint check catches what tp alone missed).
+        assert "cp*tp" in tp_stage_ineligible_reason(cfg, ctx, 34)
+
+    def test_sharded_matches_dense(self, devices8):
+        """pp2 x cp2 x tp2 with tp-sharded stage bodies == single-device
+        dense loss (parity <=1e-5, the acceptance pin)."""
+        cfg, ctx, s = self._setup(devices8)
+        rng = jax.random.PRNGKey(0)
+        p_flat, _ = init_gpt_params(rng, cfg)
+        p_pipe, _ = init_gpt_params(rng, cfg, pp=2)
+        M, mb = 4, 2
+        tokens, labels = _data(M, mb, s, cfg.vocab_size)
+        mask = jnp.ones(labels.shape, jnp.float32)
+        ref = float(jnp.mean(jnp.stack([
+            gpt_loss(p_flat, tokens[i], labels[i], mask[i], cfg)[0]
+            for i in range(M)])))
+        with ctx.mesh:
+            loss, _ = jax.jit(lambda p: gpt_pipeline_loss(
+                p, tokens, labels, mask, cfg, ctx))(p_pipe)
+        assert abs(float(loss) - ref) <= 1e-5, (float(loss), ref)
+
+    def test_sharded_grads_match_dense(self, devices8):
+        cfg, ctx, s = self._setup(devices8)
+        from megatronapp_tpu.parallel.pipeline import (
+            reshape_params_for_pipeline,
+        )
+        rng = jax.random.PRNGKey(0)
+        p_flat, _ = init_gpt_params(rng, cfg)
+        p_pipe, _ = init_gpt_params(rng, cfg, pp=2)
+        M, mb = 4, 1
+        tokens, labels = _data(M, mb, s, cfg.vocab_size)
+        mask = jnp.ones(labels.shape, jnp.float32)
+
+        def dense(p):
+            return jnp.mean(jnp.stack([
+                gpt_loss(p, tokens[i], labels[i], mask[i], cfg)[0]
+                for i in range(M)]))
+
+        g_dense = jax.grad(dense)(p_flat)
+        with ctx.mesh:
+            g_pipe = jax.jit(jax.grad(lambda p: gpt_pipeline_loss(
+                p, tokens, labels, mask, cfg, ctx)[0]))(p_pipe)
+        g_dense_block = reshape_params_for_pipeline(
+            g_dense["block"], pp=2, vpp=1)
+        for a, b in zip(jax.tree.leaves(g_dense_block),
+                        jax.tree.leaves(g_pipe["block"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
+
+    def test_two_step_train_matches_single_device(self, devices8):
+        """pp2 x cp2 x tp2 TRAINS with sharded stage bodies: 2-step loss
+        trajectory matches single-device training <=1e-5 (the acceptance
+        pin's end-to-end half)."""
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.training.train import pretrain_gpt
+        cfg = _cfg(tp_comm_overlap=True)
+        tc = TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                            seq_length=32, train_iters=2, log_interval=1)
+        oc = OptimizerConfig(lr=1e-3, lr_decay_iters=2)
+
+        def run(par, ndev):
+            ctx = build_mesh(par, devices=devices8[:ndev])
+            return [float(x) for x in
+                    pretrain_gpt(cfg, par, tc, oc, ctx=ctx).losses]
+
+        single = run(ParallelConfig(), 1)
+        composed = run(ParallelConfig(pipeline_parallel=2,
+                                      tensor_parallel=2,
+                                      context_parallel=2), 8)
+        assert single == pytest.approx(composed, abs=1e-5), (single,
+                                                             composed)
+
+    def test_flops_ratio_vs_replicated(self, devices8):
+        """Compiled per-device FLOPs: replicated / sharded > 1.8 at tp2
+        (the acceptance gate's deterministic half)."""
+        cfg_sh, ctx, s = self._setup(devices8, tp_sharded=True)
+        cfg_rep = dataclasses.replace(cfg_sh, tp_sharded_stage=False)
+        p_pipe, _ = init_gpt_params(jax.random.PRNGKey(0), cfg_sh, pp=2)
+        M, mb = 4, 2
+        tokens, labels = _data(M, mb, s, cfg_sh.vocab_size)
+        mask = jnp.ones(labels.shape, jnp.float32)
+
+        def flops_of(cfg):
+            f = jax.jit(lambda p: gpt_pipeline_loss(
+                p, tokens, labels, mask, cfg, ctx)[0])
+            with ctx.mesh:
+                comp = f.lower(p_pipe).compile()
+            ca = comp.cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0]
+            return float(ca["flops"])
+
+        ratio = flops_of(cfg_rep) / flops_of(cfg_sh)
+        assert ratio > 1.8, ratio
+
+
+# ---------------------------------------------------------------------------
+# CLI accept/reject matrix (--pp-schedule / cp>1 --tp-comm-overlap)
+# ---------------------------------------------------------------------------
+
+class TestScheduleArgs:
+    BASE = ("--num-layers 4 --hidden-size 64 --num-attention-heads 4 "
+            "--vocab-size 128 --seq-length 32 "
+            "--max-position-embeddings 64 --micro-batch-size 1 "
+            "--global-batch-size 8 --train-iters 1").split()
+
+    def _parse(self, extra):
+        from megatronapp_tpu.config.arguments import (
+            build_parser, configs_from_args,
+        )
+        return configs_from_args(
+            build_parser().parse_args(self.BASE + extra.split()))
+
+    def test_schedule_flags_land_in_config(self):
+        _, p, *_ = self._parse("--pipeline-model-parallel-size 2 "
+                               "--pp-schedule zero-bubble "
+                               "--pp-plan-from-trace")
+        assert p.pp_schedule == "zero-bubble"
+        assert p.pp_plan_from_trace
+
+    def test_default_schedule(self):
+        _, p, *_ = self._parse("--pipeline-model-parallel-size 2")
+        assert p.pp_schedule == "1f1b" and not p.pp_plan_from_trace
+
+    def test_vpp_alias_needs_vpp(self):
+        with pytest.raises(ValueError, match="requires "
+                           "virtual_pipeline_parallel"):
+            self._parse("--pipeline-model-parallel-size 2 "
+                        "--pp-schedule vpp")
+
+    def test_vpp_alias_accepts_with_vpp(self):
+        _, p, *_ = self._parse(
+            "--pipeline-model-parallel-size 2 --pp-schedule vpp "
+            "--num-layers-per-virtual-pipeline-stage 1")
+        assert p.pp_schedule == "vpp"
+        assert p.virtual_pipeline_parallel == 2
+
+    def test_use_dpp_conflicts(self):
+        with pytest.raises(ValueError, match="use-dpp"):
+            self._parse("--pipeline-model-parallel-size 2 --use-dpp "
+                        "--pp-schedule zero-bubble")
+        with pytest.raises(ValueError, match="use-dpp"):
+            self._parse("--pipeline-model-parallel-size 2 --use-dpp "
+                        "--pp-plan-from-trace")
+
+    def test_fbd_conflicts(self):
+        # The FBD executor runs its own schedule — same
+        # silently-ignored-is-worse-than-an-error policy as --use-dpp.
+        with pytest.raises(ValueError, match="disaggregating"):
+            self._parse("--pipeline-model-parallel-size 2 "
+                        "--forward-backward-disaggregating "
+                        "--pp-schedule zero-bubble")
+        with pytest.raises(ValueError, match="disaggregating"):
+            self._parse("--pipeline-model-parallel-size 2 "
+                        "--forward-backward-disaggregating "
+                        "--pp-plan-from-trace")
+
+    def test_fbd_conflict_caught_programmatically(self):
+        # Programmatic callers bypass the parser; pretrain_gpt re-checks
+        # before the FBD early-return.
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.training.train import pretrain_gpt
+        par = ParallelConfig(pipeline_parallel=2,
+                             forward_backward_disaggregating=True,
+                             pp_schedule="zero-bubble")
+        with pytest.raises(ValueError, match="disaggregating"):
+            pretrain_gpt(_cfg(), par,
+                         TrainingConfig(micro_batch_size=1,
+                                        global_batch_size=8,
+                                        seq_length=32, train_iters=1),
+                         OptimizerConfig(lr=1e-3, lr_decay_iters=1))
+
+    def test_bad_schedule_rejected_by_config(self):
+        with pytest.raises(ValueError, match="pp_schedule"):
+            ParallelConfig(pipeline_parallel=2, pp_schedule="gpipe")
+
+    # cp>1 tp-stage candidate matrix (the un-gated validation).
+    def test_cp2_tp2_divisible_accepts(self):
+        self._parse("--pipeline-model-parallel-size 2 "
+                    "--tensor-model-parallel-size 2 "
+                    "--context-parallel-size 2 --tp-comm-overlap")
+
+    def test_cp2_tp2_seq_indivisible_rejects(self):
+        # 34 divides by cp (2) and tp (2) alone but not cp*tp (4) — the
+        # joint divisibility the composed stream needs.
+        with pytest.raises(ValueError, match=r"cp\*tp"):
+            self._parse("--pipeline-model-parallel-size 2 "
+                        "--tensor-model-parallel-size 2 "
+                        "--context-parallel-size 2 --tp-comm-overlap "
+                        "--seq-length 34 --max-position-embeddings 64")
+
+    def test_cp2_whole_heads_rejects(self):
+        """cp>1 is now a candidate: odd heads at tp4 must fail parse."""
+        with pytest.raises(ValueError, match="WHOLE heads"):
+            self._parse("--pipeline-model-parallel-size 2 "
+                        "--tensor-model-parallel-size 4 "
+                        "--context-parallel-size 2 --tp-comm-overlap "
+                        "--num-attention-heads 6 --hidden-size 96 "
+                        "--num-query-groups 2 --ffn-hidden-size 384 "
+                        "--seq-length 64 --max-position-embeddings 64")
+
+    def test_cp2_mla_not_a_candidate(self):
+        """MLA keeps the replicated body under cp>1 — whole-head rules
+        must NOT reject it."""
+        self._parse("--pipeline-model-parallel-size 2 "
+                    "--tensor-model-parallel-size 4 "
+                    "--context-parallel-size 2 --tp-comm-overlap "
+                    "--multi-latent-attention --num-attention-heads 6 "
+                    "--hidden-size 96 --ffn-hidden-size 384 "
+                    "--seq-length 64 --max-position-embeddings 64")
+
+    def test_no_tp_sharded_stage_still_downgrades(self):
+        self._parse("--pipeline-model-parallel-size 2 "
+                    "--tensor-model-parallel-size 2 "
+                    "--context-parallel-size 2 --tp-comm-overlap "
+                    "--no-tp-sharded-stage --seq-length 34 "
+                    "--max-position-embeddings 64")
+
+
+# ---------------------------------------------------------------------------
+# Planner-in-training integration
+# ---------------------------------------------------------------------------
+
+class TestPlannerTraining:
+    def test_plan_from_trace_replans_and_preserves_losses(self, devices8,
+                                                          capsys):
+        """--pp-plan-from-trace on a uniform pp2 run: the planner models
+        zero-bubble's lower bubble, re-plans, rebuilds the step, and the
+        loss trajectory is IDENTICAL to the static 1f1b run (grads are
+        schedule-invariant)."""
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.training.train import pretrain_gpt
+        cfg = _cfg()
+        tc = TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                            seq_length=32, train_iters=2, log_interval=1)
+        oc = OptimizerConfig(lr=1e-3, lr_decay_iters=2)
+
+        def run(**kw):
+            par = ParallelConfig(pipeline_parallel=2, **kw)
+            ctx = build_mesh(par, devices=devices8[:2])
+            return [float(x) for x in
+                    pretrain_gpt(cfg, par, tc, oc, ctx=ctx).losses]
+
+        base = run()
+        planned = run(pp_plan_from_trace=True)
+        out = capsys.readouterr().out
+        assert "pp-planner: active" in out
+        assert "APPLYING schedule 'zero-bubble'" in out
+        assert base == pytest.approx(planned, abs=1e-6)
+
+    def test_packed_batch_freezes_planning_and_reverts(self, devices8,
+                                                       capsys):
+        """A stream that MIXES unpacked and packed batches: the planner
+        re-plans to zero-bubble on the unpacked prefix, then the first
+        packed batch (segment_ids) must freeze planning and revert the
+        schedule to 1f1b BEFORE the step — not crash on zero-bubble's
+        packed-sequence rejection."""
+        import numpy as np
+
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.data.mock import mock_batches
+        from megatronapp_tpu.training.train import pretrain_gpt
+        cfg = _cfg()
+        tc = TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                            seq_length=32, train_iters=3, log_interval=1)
+        oc = OptimizerConfig(lr=1e-3, lr_decay_iters=3)
+        par = ParallelConfig(pipeline_parallel=2, pp_plan_from_trace=True)
+        ctx = build_mesh(par, devices=devices8[:2])
+
+        def stream():
+            # gbs == stream batch size, so yield i is exactly iter i+1's
+            # batch: iter 1 unpacked (re-plan fires at its log step),
+            # iters 2..3 packed.
+            seg = np.repeat(np.arange(2, dtype=np.int32), 16)[None]
+            for i, b in enumerate(mock_batches(32, cfg.vocab_size, 8)):
+                if i >= 1:
+                    b = dict(b)
+                    b["segment_ids"] = np.tile(seg, (8, 1))
+                yield b
+
+        res = pretrain_gpt(cfg, par, tc, oc, batch_iter=stream(),
+                           ctx=ctx)
+        out = capsys.readouterr().out
+        assert "APPLYING schedule 'zero-bubble'" in out
+        assert "planning frozen" in out
+        assert "APPLYING schedule '1f1b'" in out
+        assert len(res.losses) == 3
+        assert all(np.isfinite(l) for l in res.losses)
+
+    def test_static_zero_bubble_reverts_on_packed_batch(self, devices8,
+                                                        capsys):
+        """--pp-schedule zero-bubble WITHOUT the planner: a packed batch
+        mid-stream reverts to 1f1b loudly (grads are schedule-invariant)
+        instead of crashing hours into a run."""
+        import numpy as np
+
+        from megatronapp_tpu.config.training_config import (
+            OptimizerConfig, TrainingConfig,
+        )
+        from megatronapp_tpu.data.mock import mock_batches
+        from megatronapp_tpu.training.train import pretrain_gpt
+        cfg = _cfg()
+        tc = TrainingConfig(micro_batch_size=2, global_batch_size=8,
+                            seq_length=32, train_iters=2, log_interval=1)
+        oc = OptimizerConfig(lr=1e-3, lr_decay_iters=2)
+        par = ParallelConfig(pipeline_parallel=2,
+                             pp_schedule="zero-bubble")
+        ctx = build_mesh(par, devices=devices8[:2])
+
+        def stream():
+            seg = np.repeat(np.arange(2, dtype=np.int32), 16)[None]
+            for i, b in enumerate(mock_batches(32, cfg.vocab_size, 8)):
+                if i >= 1:
+                    b = dict(b)
+                    b["segment_ids"] = np.tile(seg, (8, 1))
+                yield b
+
+        res = pretrain_gpt(cfg, par, tc, oc, batch_iter=stream(),
+                           ctx=ctx)
+        out = capsys.readouterr().out
+        assert "reverting to 1f1b" in out
+        assert len(res.losses) == 2
+        assert all(np.isfinite(l) for l in res.losses)

@@ -94,6 +94,13 @@ MANUAL_REGION_MODULES = (
     "megatronapp_tpu/inference/dynamic_engine.py",
     "megatronapp_tpu/inference/disagg.py",
     "megatronapp_tpu/inference/paged_cache.py",
+    # ISSUE 15 (pipeline schedule layer): the planner/program module is
+    # pure host-side numpy today, but it emits the instruction tables
+    # the manual pipeline region EXECUTES — future planner features
+    # (e.g. emitting comm plans) sit one step from region-creating
+    # code, so any GSPMD construct landing here must carry an audited
+    # `manual-ok:` note from day one.
+    "megatronapp_tpu/parallel/schedule.py",
 )
 
 GSPMD_RE = re.compile(
