@@ -68,16 +68,36 @@ def add_serving_args(ap: argparse.ArgumentParser):
                         "MLA latent pools are bf16-only; quantized "
                         "pools cost ~(D+4)/2D of the bf16 bytes)")
     g.add_argument("--megakernel-decode", action="store_true",
-                   help="fused (megakernel) decode step (ISSUE 11, "
+                   help="fused (megakernel) decode step (ISSUE 11/16, "
                         "ops/pallas/kernel_gen.py): the per-token layer "
-                        "body runs as three fat Pallas kernels around "
-                        "the paged-attention kernel instead of the "
+                        "body runs as fat Pallas kernels around the "
+                        "paged-attention kernel instead of the "
                         "~15-fusion unfused tail (needs --engine "
                         "dynamic --paged-kv-cache; streams stay "
-                        "token-exact). Ineligible configs (MLA, MoE, "
-                        "--serve-tp>1, MegaScope hooks, oversized "
-                        "weights) keep the unfused step with a logged "
+                        "token-exact). Large H/FFN shapes grid-tile "
+                        "their weight columns to fit "
+                        "--megakernel-vmem-budget; resident "
+                        "--quantized-weights dequantize in-register; "
+                        "speculative verify and chunked prefill run "
+                        "the fused ragged step; composes with "
+                        "--serve-disagg and --serve-fleet. Ineligible "
+                        "configs (MLA, MoE, --serve-tp>1, MegaScope "
+                        "hooks) keep the unfused step with a logged "
                         "reason")
+    g.add_argument("--megakernel-vmem-budget", type=int, default=None,
+                   metavar="BYTES",
+                   help="per-kernel operand budget (bytes) for the "
+                        "fused decode kernels — tile counts are chosen "
+                        "as the smallest grid that fits it (default: "
+                        "MEGAKERNEL_VMEM_BUDGET env or 12 MiB; values "
+                        "above ~16 MiB/core exceed real TPU VMEM and "
+                        "are warned). The fallback log names this flag "
+                        "when even the finest tiling cannot fit")
+    g.add_argument("--scan-unroll", type=int, default=1,
+                   help="lax.scan unroll factor for the layer stack "
+                        "(PERF.md lever #3): unrolls the training "
+                        "layer scan AND the serving decode/multi-query "
+                        "step scans — pairs with --megakernel-decode")
     g.add_argument("--quantized-weights", action="store_true",
                    help="serve from int8 weights kept RESIDENT (per-"
                         "channel dequant fused at matmul entry, param "
@@ -214,13 +234,12 @@ def validate_serving_args(args, multi_latent_attention: bool = False):
                 "--megakernel-decode requires --paged-kv-cache (the "
                 "fused step is built around the paged-attention "
                 "kernel)")
-        if getattr(args, "serve_disagg", False):
-            raise SystemExit(
-                "--megakernel-decode does not support --serve-disagg "
-                "yet (the disagg coordinator does not thread "
-                "fused_decode into its decode engine) — drop one of "
-                "the two flags; silently serving the unfused step "
-                "would violate the loud-fallback contract")
+    budget = getattr(args, "megakernel_vmem_budget", None)
+    if budget is not None and budget <= 0:
+        raise SystemExit(
+            f"--megakernel-vmem-budget must be a positive byte count "
+            f"(got {budget}); the tiling planner divides weight "
+            "columns until each kernel's operands fit it")
     # Fleet serving (ISSUE 14): parse-time validation in the usual
     # first-failed-predicate style — each impossible combination gets
     # its own actionable message.
@@ -241,14 +260,6 @@ def validate_serving_args(args, multi_latent_attention: bool = False):
                 "--serve-fleet N>1 requires --paged-kv-cache (affinity "
                 "scoring rides the pool's rolling block hashes and "
                 "migration ships pool blocks)")
-        if getattr(args, "megakernel_decode", False):
-            raise SystemExit(
-                "--serve-fleet does not support --megakernel-decode "
-                "yet (the fused decode step is gated per engine build "
-                "and the fleet router does not thread fused_decode "
-                "into its replicas) — drop one of the two flags; "
-                "silently serving the unfused step would violate the "
-                "loud-fallback contract")
     if getattr(args, "fleet_migrate", False) and fleet < 2:
         raise SystemExit(
             "--fleet-migrate needs --serve-fleet >= 2 (live session "
@@ -472,10 +483,8 @@ def build_parser(title: str = "megatronapp-tpu") -> argparse.ArgumentParser:
                    help="auto = flash above --flash-min-seq, dense below")
     g.add_argument("--flash-min-seq", type=int, default=2048,
                    help="flash/dense crossover sequence length (PERF.md)")
-    g.add_argument("--scan-unroll", type=int, default=1,
-                   help="lax.scan unroll factor for the layer stack "
-                        "(PERF.md lever #3; also unrolls the serving "
-                        "decode-step layer scan)")
+    # --scan-unroll lives in add_serving_args (single source of truth
+    # for both the training layer scan and the serving step scans).
     g.add_argument("--flash-head-fold", action="store_true",
                    help="fold q-head pairs into the trailing block dim "
                         "of the flash BACKWARD kernels (D=64 -> 128 "

@@ -266,7 +266,8 @@ def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
 
 def _paged_multiquery_step(params, tokens, pages, page_table, starts,
                            q_lens, active, cfg: TransformerConfig,
-                           max_seq_len: int, ctx=None, scales=None):
+                           max_seq_len: int, ctx=None, scales=None,
+                           fused: bool = False):
     """Ragged multi-token step against the paged pool — the UNIFIED
     prefill/decode primitive (speculative verify + chunked prefill).
 
@@ -275,7 +276,9 @@ def _paged_multiquery_step(params, tokens, pages, page_table, starts,
     outputs are garbage); active [B] bool. Row b's token i lands at
     position starts[b] + i and attends the paged context plus the new
     tail causally. Returns (logits [B, S, V], hidden [B, S, H] pre-head,
-    new pages) — hidden feeds the MTP self-draft proposer."""
+    new pages) — hidden feeds the MTP self-draft proposer. fused: run
+    each layer as kernel_gen.fused_layer_multiquery (megakernel verify/
+    chunked-prefill; callers gate on megakernel_ineligible_reason)."""
     b, s = tokens.shape
     positions = starts[:, None] + jnp.arange(s)[None, :]       # [B, S]
     positions = jnp.minimum(positions, max_seq_len - 1)
@@ -308,7 +311,8 @@ def _paged_multiquery_step(params, tokens, pages, page_table, starts,
                 layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
                 kv_cache=(a_l, b_l), cache_index=None,
                 cache_positions=starts, page_table=page_table,
-                active=active, chunk_counts=q_lens, ctx=ctx)
+                active=active, chunk_counts=q_lens, ctx=ctx,
+                fused_decode=fused)
             return hh, new_cache
 
         xs = (params["block"], pa, pb, lids)
@@ -323,7 +327,7 @@ def _paged_multiquery_step(params, tokens, pages, page_table, starts,
                 kv_cache=(a_l, b_l), cache_index=None,
                 cache_positions=starts, page_table=page_table,
                 active=active, chunk_counts=q_lens, ctx=ctx,
-                kv_scales=(sa_l, sb_l))
+                kv_scales=(sa_l, sb_l), fused_decode=fused)
             return hh, new_cache
 
         xs = (params["block"], pa, pb, sa, sb, lids)
@@ -582,9 +586,17 @@ class DynamicInferenceEngine:
                 from megatronapp_tpu.ops.pallas.kernel_gen import (
                     megakernel_ineligible_reason,
                 )
+                # Tile plans are sized for the widest flattened row
+                # count any fused step sees: decode runs [B, 1],
+                # chunked prefill [1, prefill_chunk], speculative
+                # verify [B, K+1] — the mq rows flatten to B·S.
+                mq_rows = max(
+                    self.max_batch, self.prefill_chunk,
+                    self.max_batch * (self.spec_k + 1)
+                    if self.spec_method else 0)
                 reason = megakernel_ineligible_reason(
                     cfg, batch=self.max_batch, tp_paged=self.tp_paged,
-                    params=self.params)
+                    params=self.params, mq_rows=mq_rows)
                 if reason is None:
                     self.megakernel = True
                 else:
@@ -610,7 +622,8 @@ class DynamicInferenceEngine:
                 self.mq_traces += 1
                 return _paged_multiquery_step(p, t, pages, tbl, starts,
                                               qlens, act, cfg, msl,
-                                              ctx=step_ctx, scales=scales)
+                                              ctx=step_ctx, scales=scales,
+                                              fused=fused)
 
             self._mq_step = jax.jit(_mq_traced, donate_argnums=(2, 3))
             from megatronapp_tpu.ops.pallas.paged_attention import (

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 import os
 from typing import Optional
 
@@ -63,6 +64,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+logger = logging.getLogger(__name__)
 
 _NEG_INF = -1e30
 
@@ -449,24 +452,172 @@ def _tp_place(q, k_pages, v_pages, page_table, kv_lens, q_lens,
 # One decode token's layer body is ~15 small XLA fusions (two norms, two
 # projection matmuls + biases, rope, GQA reshapes, out-proj, fc1/act/
 # fc2, two residual adds) — each a separate dispatch inside the scan
-# body. The three kernels below fold that tail into fat single-program
-# Pallas kernels around the generated paged-attention kernel. Math is
-# op-for-op the unfused path's (same norm/rope/activation formulas, same
+# body. The kernels below fold that tail into fat single-program Pallas
+# kernels around the generated paged-attention kernel. Math is op-for-op
+# the unfused path's (same norm/rope/activation formulas, same
 # dtypes/casts), so greedy streams stay token-exact — pinned in
 # tests/test_kernel_gen.py. Shapes: decode x is [B, H] with B = a
-# handful of slots, so whole-operand (no-grid) kernels are the right
-# granularity; weights must fit the VMEM budget
-# (megakernel_ineligible_reason gates "where shapes allow"; a
-# grid-tiled variant for big models is the ROADMAP follow-up).
+# handful of slots, so whole-operand (no-grid) kernels are the small-
+# shape fast path; when the operand set would blow the VMEM budget, the
+# SAME kernels re-emit with a grid over OUTPUT COLUMNS (kv-head groups
+# for QKV, H columns for out-proj/fc2, ffn columns for fc1). Column
+# tiling keeps the contraction dimension whole per tile, so every tiled
+# output column is BITWISE the no-grid one (an accumulator-carrying
+# contraction split would reorder the fp32 sums and break the stream
+# pins). Resident-quantized weights ({"qint8","qscale"} leaves) stay
+# int8 kernel operands and dequantize in-register at matmul entry —
+# exactly resolve_param's formula — so --quantized-weights and
+# --megakernel-decode stack.
 # ---------------------------------------------------------------------------
 
-# Per-kernel operand budget for the no-grid fused kernels. Real TPU
-# VMEM is ~16 MB/core; interpret mode (CPU) has no limit but keeps the
-# same gate so eligibility is platform-independent. Operators can
-# override via MEGAKERNEL_VMEM_BUDGET (bytes) — e.g. raise it on CPU
-# engines or chips with more VMEM; the fallback log names the budget.
+# Per-kernel operand budget for the fused kernels: tile counts are
+# chosen as the smallest grid whose per-step operand blocks fit it.
+# Real TPU VMEM is ~16 MB/core; interpret mode (CPU) has no limit but
+# keeps the same gate so eligibility is platform-independent. The env
+# var seeds the initial default; serving entry points override it at
+# runtime via --megakernel-vmem-budget / set_megakernel_vmem_budget.
 MEGAKERNEL_VMEM_BUDGET = int(os.environ.get(
     "MEGAKERNEL_VMEM_BUDGET", 12 * 1024 * 1024))
+
+_vmem_budget = MEGAKERNEL_VMEM_BUDGET
+
+# Above this, the per-kernel operand blocks cannot all be VMEM-resident
+# on today's chips (~16 MiB/core) — allowed (useful on CPU engines),
+# but warned, because on-chip the compiler would spill.
+_VMEM_BUDGET_WARN = 16 * 1024 * 1024
+
+
+def get_megakernel_vmem_budget() -> int:
+    """The active per-kernel operand budget (bytes) for the fused
+    decode kernels — tile planning and eligibility both read this."""
+    return _vmem_budget
+
+
+def set_megakernel_vmem_budget(nbytes) -> int:
+    """Set the per-kernel operand budget (--megakernel-vmem-budget).
+    Positive int; values above ~16 MiB/core exceed real TPU VMEM and
+    are warned (fine for CPU/interpret engines). Returns the value."""
+    global _vmem_budget
+    n = int(nbytes)
+    if n <= 0:
+        raise ValueError(
+            f"megakernel VMEM budget must be a positive byte count, "
+            f"got {nbytes}")
+    if n > _VMEM_BUDGET_WARN:
+        logger.warning(
+            "megakernel VMEM budget %d B exceeds ~16 MiB/core of real "
+            "TPU VMEM — fused kernels planned against it will spill "
+            "on-chip (harmless for CPU/interpret engines)", n)
+    _vmem_budget = n
+    return n
+
+
+def _weight_itemsize(leaf) -> int:
+    """Per-element bytes a weight operand costs in VMEM: resident-
+    quantized leaves ship their int8 buffer (scales are counted
+    separately by the tile planners)."""
+    from megatronapp_tpu.inference.quantization import is_resident_leaf
+    if is_resident_leaf(leaf):
+        return 1
+    return jnp.dtype(leaf.dtype).itemsize if hasattr(leaf, "dtype") \
+        else jnp.dtype(jnp.float32).itemsize
+
+
+def _weight_operands(leaf):
+    """Kernel operand list for one weight leaf: [w] for a plain array,
+    [qint8, qscale] for a resident-quantized pair (dequantized
+    in-register by _dequant_weight)."""
+    from megatronapp_tpu.inference.quantization import is_resident_leaf
+    if is_resident_leaf(leaf):
+        return [leaf["qint8"], leaf["qscale"]]
+    return [leaf]
+
+
+def _dequant_weight(w_ref, s_ref, cdt):
+    """Matmul-entry weight read. Plain: cast to compute dtype (the
+    no-grid kernels' original `w_ref[...].astype(cdt)`). Resident int8
+    (or fp8) with per-output-channel fp32 scales: the exact
+    resolve_param formula — int8 → fp32 × scale → compute dtype — so
+    fused streams stay token-exact vs the resident unfused engine."""
+    w = w_ref[...]
+    if s_ref is None:
+        return w.astype(cdt)
+    return (w.astype(jnp.float32) * s_ref[...]).astype(cdt)
+
+
+def _pick_grid(n_units, fixed_bytes, unit_bytes, budget, align=1):
+    """Smallest divisor T of `n_units` such that one grid step's
+    operands (fixed + per-unit × n_units/T) fit `budget`, preferring
+    tile widths that stay `align`-divisible (128-lane layouts). 1 means
+    the no-grid body fits; 0 means even one unit per tile does not."""
+    first = 0
+    for t in range(1, n_units + 1):
+        if n_units % t:
+            continue
+        per = n_units // t
+        if fixed_bytes + per * unit_bytes > budget:
+            continue
+        if not first:
+            first = t
+        if per % align == 0:
+            return t
+    return first
+
+
+def _qkv_tiles(h, nq, nkv, d, rows, wq_item, wkv_item, act_item,
+               q_scaled, kv_scaled, budget):
+    """Tile count for _fused_qkv: the grid unit is one kv-head GROUP
+    (its nq/nkv query heads + its K and V head), so GQA q/k/v column
+    blocks stay aligned. Byte math is shared with
+    megakernel_ineligible_reason — eligibility and emission cannot
+    drift."""
+    group = nq // nkv
+    unit = (group * h * d * wq_item + 2 * h * d * wkv_item
+            + (group + 2) * d * (4 + rows * act_item))
+    if q_scaled:
+        unit += group * d * 4
+    if kv_scaled:
+        unit += 2 * d * 4
+    fixed = rows * h * (act_item + 4)
+    return _pick_grid(nkv, fixed, unit, budget)
+
+
+def _out_tiles(h, nqd, rows, w_item, act_item, scaled, budget):
+    """Tile count for _fused_out_proj: the grid unit is one output (H)
+    column — full-nqd contraction per tile."""
+    unit = nqd * w_item + 2 * rows * act_item + 4 + (4 if scaled else 0)
+    fixed = rows * nqd * act_item
+    return _pick_grid(h, fixed, unit, budget, align=128)
+
+
+def _mlp_tiles(h, ffn, gated, rows, w1_item, w2_item, act_item,
+               s1, s2, budget):
+    """MLP plan: None = the whole norm+fc1+act+fc2+residual body fits
+    one no-grid kernel (the original fast path); otherwise (t1, t2) =
+    tile counts for the two-kernel split (fc1+activation over ffn
+    columns, then fc2+residual over H columns — the intermediate
+    y [rows, ffn] lives in compute dtype, which apply_activation
+    preserves, so the store/reload between the two kernels is lossless
+    vs the single-kernel body). A 0 in the tuple means even one column
+    per tile does not fit."""
+    gm = 2 if gated else 1
+    fc1_out = gm * ffn
+    whole = (h * fc1_out * w1_item + ffn * h * w2_item
+             + rows * (2 * h + fc1_out) * act_item)
+    if s1:
+        whole += fc1_out * 4
+    if s2:
+        whole += h * 4
+    if whole <= budget:
+        return None
+    unit1 = gm * (h * w1_item + 4) + rows * act_item + (gm * 4 if s1
+                                                        else 0)
+    fixed1 = rows * h * (act_item + 4)
+    t1 = _pick_grid(ffn, fixed1, unit1, budget)
+    unit2 = ffn * w2_item + 2 * rows * act_item + 4 + (4 if s2 else 0)
+    fixed2 = rows * ffn * act_item
+    t2 = _pick_grid(h, fixed2, unit2, budget, align=128)
+    return (t1, t2)
 
 
 def _rope_rows(x, cos, sin):
@@ -487,20 +638,39 @@ def _rope_rows(x, cos, sin):
     return out
 
 
-def _fused_qkv(x, attn_p, cfg, cos, sin):
+def _full_spec(a):
+    """BlockSpec mapping the WHOLE array into every grid step."""
+    return pl.BlockSpec(a.shape, lambda i, _n=a.ndim: (0,) * _n)
+
+
+def _fused_qkv(x, attn_p, cfg, cos, sin, tiles=None):
     """Norm + QKV projection + (optional) QK-layernorm + rope in ONE
     kernel — the attention kernel's entry, fused.
 
-    x [B, H] (residual dtype); returns (q, k, v) as [B, nq, D] /
-    [B, nkv, D] in compute dtype, exactly as the unfused
-    layer_forward → attention_forward prologue produces them."""
+    Small shapes run the original no-grid body; when the whole operand
+    set would exceed get_megakernel_vmem_budget(), the kernel re-emits
+    with a grid over kv-head GROUPS: each grid step reads the full x
+    block plus 1/T of the Q/K/V weight columns (the packed KV weight is
+    passed twice — K block at column-block t, V block at t + T, valid
+    because nkv*D == T*(nkv_t*D)) and writes 1/T of the heads. The
+    contraction stays whole per tile, and the norm recomputes from the
+    full x block (row statistics are tile-independent), so tiled heads
+    are BITWISE the no-grid ones. Resident-quantized weights dequantize
+    in-register (_dequant_weight).
+
+    x [B*, H] (residual dtype; B* = decode batch rows, or B·S flattened
+    ragged rows for the fused multiquery step); returns (q, k, v) as
+    [B*, nq, D] / [B*, nkv, D] in compute dtype, exactly as the unfused
+    layer_forward → attention_forward prologue produces them. tiles:
+    test/tuning override of the planned tile count (must divide nkv)."""
     from megatronapp_tpu.config.transformer_config import NormKind
-    from megatronapp_tpu.inference.quantization import resolve_param
+    from megatronapp_tpu.inference.quantization import is_resident_leaf
     from megatronapp_tpu.ops.normalization import apply_norm, rms_norm
 
     b, h = x.shape
     nq, nkv, d = (cfg.num_attention_heads, cfg.num_query_groups,
                   cfg.head_dim)
+    group = nq // nkv
     cdt = cfg.compute_dtype
     eps = cfg.layernorm_epsilon
     kind = cfg.normalization
@@ -509,26 +679,136 @@ def _fused_qkv(x, attn_p, cfg, cos, sin):
     has_rope = cos is not None
     has_qk_ln = cfg.qk_layernorm
 
+    wq_leaf, wkv_leaf = attn_p["q_kernel"], attn_p["kv_kernel"]
+    q_res = is_resident_leaf(wq_leaf)
+    kv_res = is_resident_leaf(wkv_leaf)
+    t = tiles if tiles is not None else _qkv_tiles(
+        h, nq, nkv, d, b, _weight_itemsize(wq_leaf),
+        _weight_itemsize(wkv_leaf), jnp.dtype(cdt).itemsize,
+        q_res, kv_res, get_megakernel_vmem_budget())
+    if not t:
+        raise ValueError(
+            "fused QKV kernel exceeds the VMEM budget even at one "
+            "kv-head group per tile — megakernel_ineligible_reason "
+            "gates callers before tracing")
+    assert nkv % t == 0, f"qkv tile count {t} must divide nkv={nkv}"
+
+    if t == 1:
+        operands = [x, attn_p["ln1_scale"]]
+        if has_ln_bias:
+            operands.append(attn_p["ln1_bias"])
+        operands += _weight_operands(wq_leaf) + _weight_operands(wkv_leaf)
+        if has_bias:
+            operands += [attn_p["q_bias"], attn_p["kv_bias"]]
+        if has_rope:
+            operands += [cos, sin]
+        if has_qk_ln:
+            operands += [attn_p["q_ln_scale"], attn_p["k_ln_scale"]]
+
+        def kernel(*refs):
+            it = iter(refs)
+            x_ref = next(it)
+            ln_s = next(it)
+            ln_b = next(it) if has_ln_bias else None
+            wq_ref = next(it)
+            wqs_ref = next(it) if q_res else None
+            wkv_ref = next(it)
+            wkvs_ref = next(it) if kv_res else None
+            qb_ref = next(it) if has_bias else None
+            kvb_ref = next(it) if has_bias else None
+            cos_ref = next(it) if has_rope else None
+            sin_ref = next(it) if has_rope else None
+            qln_ref = next(it) if has_qk_ln else None
+            kln_ref = next(it) if has_qk_ln else None
+            q_out, k_out, v_out = next(it), next(it), next(it)
+
+            xn = apply_norm(kind, x_ref[...], ln_s[...],
+                            ln_b[...] if ln_b is not None else None, eps)
+            xn = xn.astype(cdt)
+            q = xn @ _dequant_weight(wq_ref, wqs_ref, cdt)
+            kv = xn @ _dequant_weight(wkv_ref, wkvs_ref, cdt)
+            if has_bias:
+                q = q + qb_ref[...].astype(cdt)
+                kv = kv + kvb_ref[...].astype(cdt)
+            q = q.reshape(b, nq, d)
+            k, v = jnp.split(kv.reshape(b, 2 * nkv, d), 2, axis=1)
+            if has_qk_ln:
+                q = rms_norm(q, qln_ref[...], eps)
+                k = rms_norm(k, kln_ref[...], eps)
+            if has_rope:
+                q = _rope_rows(q, cos_ref[...], sin_ref[...])
+                k = _rope_rows(k, cos_ref[...], sin_ref[...])
+            q_out[...] = q
+            k_out[...] = k
+            v_out[...] = v
+
+        return pl.pallas_call(
+            kernel,
+            out_shape=[jax.ShapeDtypeStruct((b, nq, d), cdt),
+                       jax.ShapeDtypeStruct((b, nkv, d), cdt),
+                       jax.ShapeDtypeStruct((b, nkv, d), cdt)],
+            interpret=_interpret(),
+        )(*operands)
+
+    # ---- tiled emission: grid over kv-head groups --------------------
+    nkv_t = nkv // t
+    nq_t = group * nkv_t
+
+    def col_w(width, off=0):
+        return pl.BlockSpec((h, width), lambda i, _o=off: (0, _o + i))
+
+    def col_s(width, off=0):
+        return pl.BlockSpec((1, width), lambda i, _o=off: (0, _o + i))
+
+    def col_b(width, off=0):
+        return pl.BlockSpec((width,), lambda i, _o=off: (_o + i,))
+
     operands = [x, attn_p["ln1_scale"]]
+    in_specs = [_full_spec(x), _full_spec(attn_p["ln1_scale"])]
     if has_ln_bias:
         operands.append(attn_p["ln1_bias"])
-    operands += [resolve_param(attn_p["q_kernel"]),
-                 resolve_param(attn_p["kv_kernel"])]
+        in_specs.append(_full_spec(attn_p["ln1_bias"]))
+    operands += _weight_operands(wq_leaf)
+    in_specs.append(col_w(nq_t * d))
+    if q_res:
+        in_specs.append(col_s(nq_t * d))
+    # KV weight columns are [K | V] packed: pass the leaf TWICE with
+    # the V block offset by T column-blocks (nkv*D == T * nkv_t*D).
+    kv_ops = _weight_operands(wkv_leaf)
+    operands += kv_ops + kv_ops
+    in_specs.append(col_w(nkv_t * d))
+    if kv_res:
+        in_specs.append(col_s(nkv_t * d))
+    in_specs.append(col_w(nkv_t * d, off=t))
+    if kv_res:
+        in_specs.append(col_s(nkv_t * d, off=t))
     if has_bias:
-        operands += [attn_p["q_bias"], attn_p["kv_bias"]]
+        operands += [attn_p["q_bias"], attn_p["kv_bias"],
+                     attn_p["kv_bias"]]
+        in_specs += [col_b(nq_t * d), col_b(nkv_t * d),
+                     col_b(nkv_t * d, off=t)]
     if has_rope:
         operands += [cos, sin]
+        in_specs += [_full_spec(cos), _full_spec(sin)]
     if has_qk_ln:
         operands += [attn_p["q_ln_scale"], attn_p["k_ln_scale"]]
+        in_specs += [_full_spec(attn_p["q_ln_scale"]),
+                     _full_spec(attn_p["k_ln_scale"])]
 
-    def kernel(*refs):
+    def tiled(*refs):
         it = iter(refs)
         x_ref = next(it)
         ln_s = next(it)
         ln_b = next(it) if has_ln_bias else None
-        wq_ref, wkv_ref = next(it), next(it)
+        wq_ref = next(it)
+        wqs_ref = next(it) if q_res else None
+        wk_ref = next(it)
+        wks_ref = next(it) if kv_res else None
+        wv_ref = next(it)
+        wvs_ref = next(it) if kv_res else None
         qb_ref = next(it) if has_bias else None
-        kvb_ref = next(it) if has_bias else None
+        kb_ref = next(it) if has_bias else None
+        vb_ref = next(it) if has_bias else None
         cos_ref = next(it) if has_rope else None
         sin_ref = next(it) if has_rope else None
         qln_ref = next(it) if has_qk_ln else None
@@ -538,13 +818,16 @@ def _fused_qkv(x, attn_p, cfg, cos, sin):
         xn = apply_norm(kind, x_ref[...], ln_s[...],
                         ln_b[...] if ln_b is not None else None, eps)
         xn = xn.astype(cdt)
-        q = xn @ wq_ref[...].astype(cdt)
-        kv = xn @ wkv_ref[...].astype(cdt)
+        q = xn @ _dequant_weight(wq_ref, wqs_ref, cdt)
+        k = xn @ _dequant_weight(wk_ref, wks_ref, cdt)
+        v = xn @ _dequant_weight(wv_ref, wvs_ref, cdt)
         if has_bias:
             q = q + qb_ref[...].astype(cdt)
-            kv = kv + kvb_ref[...].astype(cdt)
-        q = q.reshape(b, nq, d)
-        k, v = jnp.split(kv.reshape(b, 2 * nkv, d), 2, axis=1)
+            k = k + kb_ref[...].astype(cdt)
+            v = v + vb_ref[...].astype(cdt)
+        q = q.reshape(b, nq_t, d)
+        k = k.reshape(b, nkv_t, d)
+        v = v.reshape(b, nkv_t, d)
         if has_qk_ln:
             q = rms_norm(q, qln_ref[...], eps)
             k = rms_norm(k, kln_ref[...], eps)
@@ -556,7 +839,12 @@ def _fused_qkv(x, attn_p, cfg, cos, sin):
         v_out[...] = v
 
     return pl.pallas_call(
-        kernel,
+        tiled,
+        grid=(t,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((b, nq_t, d), lambda i: (0, i, 0)),
+                   pl.BlockSpec((b, nkv_t, d), lambda i: (0, i, 0)),
+                   pl.BlockSpec((b, nkv_t, d), lambda i: (0, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((b, nq, d), cdt),
                    jax.ShapeDtypeStruct((b, nkv, d), cdt),
                    jax.ShapeDtypeStruct((b, nkv, d), cdt)],
@@ -564,43 +852,92 @@ def _fused_qkv(x, attn_p, cfg, cos, sin):
     )(*operands)
 
 
-def _fused_out_proj(attn_flat, attn_p, cfg, residual):
+def _fused_out_proj(attn_flat, attn_p, cfg, residual, tiles=None):
     """Attention epilogue in ONE kernel: out projection + bias +
     residual add (the paged-attention output arrives head-flat
-    [B, nq*D] — the GQA transpose/reshape is folded into the caller's
-    free reshape). residual [B, H] keeps its dtype; returns [B, H]."""
-    from megatronapp_tpu.inference.quantization import resolve_param
+    [B*, nq*D] — the GQA transpose/reshape is folded into the caller's
+    free reshape). residual [B*, H] keeps its dtype; returns [B*, H].
+
+    Large H re-emits the same body over a grid of H-column tiles: each
+    step reads the full attn_flat block and 1/T of the weight columns
+    (full contraction per tile — tiled columns bitwise the no-grid
+    ones). Resident-quantized weights dequantize in-register. tiles:
+    test/tuning override (must divide H)."""
+    from megatronapp_tpu.inference.quantization import is_resident_leaf
 
     b, h = residual.shape
     cdt = cfg.compute_dtype
     has_bias = "out_bias" in attn_p
-    operands = [attn_flat, resolve_param(attn_p["out_kernel"]), residual]
-    if has_bias:
-        operands.append(attn_p["out_bias"])
+    w_leaf = attn_p["out_kernel"]
+    res = is_resident_leaf(w_leaf)
+    nqd = attn_flat.shape[1]
+    t = tiles if tiles is not None else _out_tiles(
+        h, nqd, b, _weight_itemsize(w_leaf), jnp.dtype(cdt).itemsize,
+        res, get_megakernel_vmem_budget())
+    if not t:
+        raise ValueError(
+            "fused out-proj kernel exceeds the VMEM budget even at one "
+            "output column per tile — megakernel_ineligible_reason "
+            "gates callers before tracing")
+    assert h % t == 0, f"out-proj tile count {t} must divide H={h}"
 
     def kernel(*refs):
-        if has_bias:
-            a_ref, w_ref, r_ref, b_ref, o_ref = refs
-        else:
-            a_ref, w_ref, r_ref, o_ref = refs
-        out = a_ref[...] @ w_ref[...].astype(cdt)
+        it = iter(refs)
+        a_ref = next(it)
+        w_ref = next(it)
+        ws_ref = next(it) if res else None
+        r_ref = next(it)
+        b_ref = next(it) if has_bias else None
+        o_ref = next(it)
+        out = a_ref[...] @ _dequant_weight(w_ref, ws_ref, cdt)
         if has_bias:
             out = out + b_ref[...].astype(cdt)
         r = r_ref[...]
         o_ref[...] = r + out.astype(r.dtype)
 
+    operands = [attn_flat] + _weight_operands(w_leaf) + [residual]
+    if has_bias:
+        operands.append(attn_p["out_bias"])
+
+    if t == 1:
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((b, h), residual.dtype),
+            interpret=_interpret(),
+        )(*operands)
+
+    h_t = h // t
+    in_specs = [_full_spec(attn_flat),
+                pl.BlockSpec((nqd, h_t), lambda i: (0, i))]
+    if res:
+        in_specs.append(pl.BlockSpec((1, h_t), lambda i: (0, i)))
+    in_specs.append(pl.BlockSpec((b, h_t), lambda i: (0, i)))
+    if has_bias:
+        in_specs.append(pl.BlockSpec((h_t,), lambda i: (i,)))
     return pl.pallas_call(
         kernel,
+        grid=(t,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((b, h_t), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((b, h), residual.dtype),
         interpret=_interpret(),
     )(*operands)
 
 
-def _fused_mlp(x, p, cfg):
+def _fused_mlp(x, p, cfg, tiles=None):
     """Pre-MLP norm + fc1 + activation (incl. gated) + fc2 + biases +
-    residual add in ONE kernel. x [B, H] (residual dtype) → [B, H]."""
+    residual add. x [B*, H] (residual dtype) → [B*, H].
+
+    When the whole operand set fits the VMEM budget this is the
+    original ONE no-grid kernel. Otherwise it splits into TWO tiled
+    kernels: fc1+activation over ffn-column tiles producing y
+    [B*, ffn] in compute dtype (apply_activation preserves its input
+    dtype, so the store/reload is lossless), then fc2+bias+residual
+    over H-column tiles with the full-ffn contraction — every output
+    bitwise the single-kernel body's. tiles: test/tuning override —
+    a (t1, t2) pair forces the split emission."""
     from megatronapp_tpu.config.transformer_config import NormKind
-    from megatronapp_tpu.inference.quantization import resolve_param
+    from megatronapp_tpu.inference.quantization import is_resident_leaf
     from megatronapp_tpu.ops.activations import apply_activation, is_gated
     from megatronapp_tpu.ops.normalization import apply_norm
 
@@ -613,12 +950,28 @@ def _fused_mlp(x, p, cfg):
     has_ln_bias = kind == NormKind.layernorm
     mlp_p = p["mlp"]
     has_bias = "fc1_bias" in mlp_p
+    w1_leaf, w2_leaf = mlp_p["fc1_kernel"], mlp_p["fc2_kernel"]
+    r1 = is_resident_leaf(w1_leaf)
+    r2 = is_resident_leaf(w2_leaf)
+    plan = tiles if tiles is not None else _mlp_tiles(
+        h, cfg.ffn_hidden_size, gated, b, _weight_itemsize(w1_leaf),
+        _weight_itemsize(w2_leaf), jnp.dtype(cdt).itemsize, r1, r2,
+        get_megakernel_vmem_budget())
+
+    if plan is not None:
+        t1, t2 = plan
+        if not t1 or not t2:
+            raise ValueError(
+                "fused MLP kernels exceed the VMEM budget even at one "
+                "column per tile — megakernel_ineligible_reason gates "
+                "callers before tracing")
+        y = _fused_mlp_fc1(x, p, cfg, t1)
+        return _fused_mlp_fc2(y, x, p, cfg, t2)
 
     operands = [x, p["ln2_scale"]]
     if has_ln_bias:
         operands.append(p["ln2_bias"])
-    operands += [resolve_param(mlp_p["fc1_kernel"]),
-                 resolve_param(mlp_p["fc2_kernel"])]
+    operands += _weight_operands(w1_leaf) + _weight_operands(w2_leaf)
     if has_bias:
         operands += [mlp_p["fc1_bias"], mlp_p["fc2_bias"]]
 
@@ -626,7 +979,10 @@ def _fused_mlp(x, p, cfg):
         it = iter(refs)
         x_ref, ln_s = next(it), next(it)
         ln_b = next(it) if has_ln_bias else None
-        w1_ref, w2_ref = next(it), next(it)
+        w1_ref = next(it)
+        w1s_ref = next(it) if r1 else None
+        w2_ref = next(it)
+        w2s_ref = next(it) if r2 else None
         b1_ref = next(it) if has_bias else None
         b2_ref = next(it) if has_bias else None
         o_ref = next(it)
@@ -634,7 +990,7 @@ def _fused_mlp(x, p, cfg):
         xn = apply_norm(kind, x_ref[...], ln_s[...],
                         ln_b[...] if ln_b is not None else None, eps)
         xn = xn.astype(cdt)
-        y = xn @ w1_ref[...].astype(cdt)
+        y = xn @ _dequant_weight(w1_ref, w1s_ref, cdt)
         if has_bias:
             y = y + b1_ref[...].astype(cdt)
         if gated:
@@ -642,7 +998,7 @@ def _fused_mlp(x, p, cfg):
             y = apply_activation(act, val, gate)
         else:
             y = apply_activation(act, y)
-        out = y @ w2_ref[...].astype(cdt)
+        out = y @ _dequant_weight(w2_ref, w2s_ref, cdt)
         if has_bias:
             out = out + b2_ref[...].astype(cdt)
         r = x_ref[...]
@@ -650,6 +1006,154 @@ def _fused_mlp(x, p, cfg):
 
     return pl.pallas_call(
         kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h), x.dtype),
+        interpret=_interpret(),
+    )(*operands)
+
+
+def _fused_mlp_fc1(x, p, cfg, t):
+    """Kernel A of the tiled MLP split: pre-MLP norm + fc1 + bias +
+    activation over a grid of ffn-column tiles. The gated variant reads
+    the packed [gate | value] fc1 weight TWICE (value block offset by T
+    column-blocks), so the activation sees exactly the columns the
+    single-kernel split(y, 2) produces. Returns y [B*, ffn] in compute
+    dtype."""
+    from megatronapp_tpu.config.transformer_config import NormKind
+    from megatronapp_tpu.inference.quantization import is_resident_leaf
+    from megatronapp_tpu.ops.activations import apply_activation, is_gated
+    from megatronapp_tpu.ops.normalization import apply_norm
+
+    b, h = x.shape
+    cdt = cfg.compute_dtype
+    eps = cfg.layernorm_epsilon
+    kind = cfg.normalization
+    act = cfg.activation
+    gated = is_gated(act)
+    has_ln_bias = kind == NormKind.layernorm
+    mlp_p = p["mlp"]
+    has_bias = "fc1_bias" in mlp_p
+    w1_leaf = mlp_p["fc1_kernel"]
+    r1 = is_resident_leaf(w1_leaf)
+    ffn = cfg.ffn_hidden_size
+    assert ffn % t == 0, f"fc1 tile count {t} must divide ffn={ffn}"
+    f_t = ffn // t
+
+    def col_w(off=0):
+        return pl.BlockSpec((h, f_t), lambda i, _o=off: (0, _o + i))
+
+    def col_s(off=0):
+        return pl.BlockSpec((1, f_t), lambda i, _o=off: (0, _o + i))
+
+    def col_b(off=0):
+        return pl.BlockSpec((f_t,), lambda i, _o=off: (_o + i,))
+
+    operands = [x, p["ln2_scale"]]
+    in_specs = [_full_spec(x), _full_spec(p["ln2_scale"])]
+    if has_ln_bias:
+        operands.append(p["ln2_bias"])
+        in_specs.append(_full_spec(p["ln2_bias"]))
+    w1_ops = _weight_operands(w1_leaf)
+    operands += w1_ops
+    in_specs.append(col_w())
+    if r1:
+        in_specs.append(col_s())
+    if gated:
+        operands += w1_ops
+        in_specs.append(col_w(off=t))
+        if r1:
+            in_specs.append(col_s(off=t))
+    if has_bias:
+        operands.append(mlp_p["fc1_bias"])
+        in_specs.append(col_b())
+        if gated:
+            operands.append(mlp_p["fc1_bias"])
+            in_specs.append(col_b(off=t))
+
+    def kernel(*refs):
+        it = iter(refs)
+        x_ref, ln_s = next(it), next(it)
+        ln_b = next(it) if has_ln_bias else None
+        wg_ref = next(it)
+        wgs_ref = next(it) if r1 else None
+        wv_ref = next(it) if gated else None
+        wvs_ref = next(it) if (gated and r1) else None
+        bg_ref = next(it) if has_bias else None
+        bv_ref = next(it) if (has_bias and gated) else None
+        y_out = next(it)
+
+        xn = apply_norm(kind, x_ref[...], ln_s[...],
+                        ln_b[...] if ln_b is not None else None, eps)
+        xn = xn.astype(cdt)
+        if gated:
+            gate = xn @ _dequant_weight(wg_ref, wgs_ref, cdt)
+            val = xn @ _dequant_weight(wv_ref, wvs_ref, cdt)
+            if has_bias:
+                gate = gate + bg_ref[...].astype(cdt)
+                val = val + bv_ref[...].astype(cdt)
+            y = apply_activation(act, val, gate)
+        else:
+            y = xn @ _dequant_weight(wg_ref, wgs_ref, cdt)
+            if has_bias:
+                y = y + bg_ref[...].astype(cdt)
+            y = apply_activation(act, y)
+        y_out[...] = y
+
+    return pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((b, f_t), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((b, ffn), cdt),
+        interpret=_interpret(),
+    )(*operands)
+
+
+def _fused_mlp_fc2(y, x, p, cfg, t):
+    """Kernel B of the tiled MLP split: fc2 + bias + residual add over
+    a grid of H-column tiles with the full-ffn contraction per tile.
+    y [B*, ffn] (compute dtype, from _fused_mlp_fc1), x [B*, H] the
+    pre-norm residual; returns [B*, H] in the residual dtype."""
+    from megatronapp_tpu.inference.quantization import is_resident_leaf
+
+    b, h = x.shape
+    cdt = cfg.compute_dtype
+    mlp_p = p["mlp"]
+    has_bias = "fc2_bias" in mlp_p
+    w2_leaf = mlp_p["fc2_kernel"]
+    r2 = is_resident_leaf(w2_leaf)
+    ffn = y.shape[1]
+    assert h % t == 0, f"fc2 tile count {t} must divide H={h}"
+    h_t = h // t
+
+    operands = [y] + _weight_operands(w2_leaf) + [x]
+    in_specs = [_full_spec(y),
+                pl.BlockSpec((ffn, h_t), lambda i: (0, i))]
+    if r2:
+        in_specs.append(pl.BlockSpec((1, h_t), lambda i: (0, i)))
+    in_specs.append(pl.BlockSpec((b, h_t), lambda i: (0, i)))
+    if has_bias:
+        operands.append(mlp_p["fc2_bias"])
+        in_specs.append(pl.BlockSpec((h_t,), lambda i: (i,)))
+
+    def kernel(*refs):
+        it = iter(refs)
+        y_ref = next(it)
+        w2_ref = next(it)
+        w2s_ref = next(it) if r2 else None
+        x_ref = next(it)
+        b2_ref = next(it) if has_bias else None
+        o_ref = next(it)
+        out = y_ref[...] @ _dequant_weight(w2_ref, w2s_ref, cdt)
+        if has_bias:
+            out = out + b2_ref[...].astype(cdt)
+        r = x_ref[...]
+        o_ref[...] = r + out.astype(r.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(t,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((b, h_t), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((b, h), x.dtype),
         interpret=_interpret(),
     )(*operands)
@@ -714,23 +1218,100 @@ def fused_layer_decode(p, x, cfg, rope_cos, rope_sin, kv_cache,
     return (x2[:, None], new_cache), None
 
 
+def fused_layer_multiquery(p, x, cfg, rope_cos, rope_sin, kv_cache,
+                           cache_positions, counts, page_table, active,
+                           kv_scales=None):
+    """One ragged multi-query layer (speculative verify rounds and
+    chunked prefill) as the SAME fused kernels around the generated
+    ragged paged-attention kernel: [fused norm+QKV+rope on the B·S
+    flattened rows] → [chunk append scatter] → [ragged paged attention,
+    q_lens scalar-prefetch path] → [fused out-proj + residual] →
+    [fused norm+MLP + residual].
+
+    Drop-in for transformer/block.layer_forward's chunk_counts paged
+    path: x [B, S, H] with rope tables [B, S, half] and counts [B]
+    (q_len ∈ [1, S] per row). Row-flattening is bitwise-safe — every
+    fused op is row-wise (norms, rope, activations) or contracts the
+    last dim only — so verify/prefill streams keep the PR 4 pins."""
+    from megatronapp_tpu.ops.pallas.paged_attention import (
+        append_chunk_pages, quantize_kv_rows,
+    )
+    b, s, h = x.shape
+    nq, nkv, d = (cfg.num_attention_heads, cfg.num_query_groups,
+                  cfg.head_dim)
+    attn_p = p["attention"]
+    xf = x.reshape(b * s, h)
+    cos = rope_cos.reshape(b * s, -1) if rope_cos is not None else None
+    sin = rope_sin.reshape(b * s, -1) if rope_sin is not None else None
+
+    q, k, v = _fused_qkv(xf, {**attn_p, "ln1_scale": p["ln1_scale"],
+                              **({"ln1_bias": p["ln1_bias"]}
+                                 if "ln1_bias" in p else {})},
+                         cfg, cos, sin)
+    q = q.reshape(b, s, nq, d)
+    k = k.reshape(b, s, nkv, d)
+    v = v.reshape(b, s, nkv, d)
+
+    ck, cv = kv_cache
+    if active is None:
+        active = jnp.ones((b,), bool)
+    if kv_scales is not None:
+        cks, cvs = kv_scales
+        k_q, k_s = quantize_kv_rows(k, dtype=ck.dtype)
+        v_q, v_s = quantize_kv_rows(v, dtype=cv.dtype)
+        ck = append_chunk_pages(ck, k_q, page_table, cache_positions,
+                                counts, active)
+        cv = append_chunk_pages(cv, v_q, page_table, cache_positions,
+                                counts, active)
+        cks = append_chunk_pages(cks, k_s, page_table, cache_positions,
+                                 counts, active)
+        cvs = append_chunk_pages(cvs, v_s, page_table, cache_positions,
+                                 counts, active)
+        new_cache = (ck, cv, cks, cvs)
+        sc_kw = {"k_scales": cks, "v_scales": cvs}
+    else:
+        ck = append_chunk_pages(ck, k, page_table, cache_positions,
+                                counts, active)
+        cv = append_chunk_pages(cv, v, page_table, cache_positions,
+                                counts, active)
+        new_cache = (ck, cv)
+        sc_kw = {}
+
+    attn = paged_attention(q, ck, cv, page_table,
+                           cache_positions + counts, q_lens=counts,
+                           **sc_kw)                    # [B, S, nq, D]
+    x2 = _fused_out_proj(attn.reshape(b * s, nq * d), attn_p, cfg, xf)
+    x2 = _fused_mlp(x2, p, cfg)
+    return (x2.reshape(b, s, h), new_cache), None
+
+
 def megakernel_ineligible_reason(cfg, *, batch, tp_paged=False,
-                                 paged=True, params=None) -> Optional[str]:
+                                 paged=True, params=None,
+                                 mq_rows=None) -> Optional[str]:
     """Why the fused (megakernel) decode step may NOT run — None when
     eligible, otherwise the FIRST failed predicate by name (same
     loud-fallback contract as tp_paged_ineligible_reason). params: the
-    engine's param pytree when available — resident int8 weights
-    (--quantized-weights) are ineligible because resolve_param runs
-    OUTSIDE the fused kernels, which would materialize dequantized
-    bf16 weight copies as kernel operands every step and give back
-    PR 10's halved kernel HBM (the unfused path fuses the per-channel
-    scale multiply into each consuming matmul)."""
+    engine's param pytree when available — resident-quantized leaves
+    change the weight-operand byte math (int8 blocks + fp32 scale rows
+    enter the kernels and dequantize in-register; they are NOT a
+    carve-out anymore). mq_rows: the widest flattened row count the
+    fused multiquery step will see (prefill_chunk / max_batch·(K+1));
+    tile plans are sized for the worse of batch and mq_rows.
+
+    Size no longer disqualifies a config outright: the fused kernels
+    grid-tile their weight columns to fit the VMEM budget
+    (get_megakernel_vmem_budget / --megakernel-vmem-budget), so the
+    size predicates below fail only when even ONE column/kv-head-group
+    per tile exceeds the budget. The same _qkv_tiles/_out_tiles/
+    _mlp_tiles byte math drives kernel emission — eligibility and
+    emission cannot drift."""
     if not paged:
         return "dense (non-paged) backend — the fused step is built " \
                "around the paged-attention kernel"
     if cfg.multi_latent_attention:
         return "multi_latent_attention: the MLA decode path gathers " \
-               "the latent run dense (no fused prologue yet)"
+               "the latent run dense (no fused prologue yet — the " \
+               "latent-space fused kernel is the recorded follow-up)"
     if cfg.is_moe:
         return "MoE layers: expert dispatch is not fused yet"
     if getattr(cfg, "hetero_block_specs", None):
@@ -750,32 +1331,42 @@ def megakernel_ineligible_reason(cfg, *, batch, tp_paged=False,
     if any(dist.active(s) for s in ("weight", "calculation", "system")):
         return "MegaScope disturbance sites active (fused kernels do " \
                "not trace perturbations)"
-    if params is not None:
-        from megatronapp_tpu.inference.quantization import is_resident_leaf
-        if any(is_resident_leaf(leaf) for leaf in jax.tree.leaves(
-                params, is_leaf=is_resident_leaf)):
-            return ("resident int8 weights (--quantized-weights): the "
-                    "fused kernels would materialize dequantized "
-                    "weight copies per step — in-kernel weight dequant "
-                    "is the recorded follow-up")
-    # "Where shapes allow": the no-grid fused kernels hold their whole
-    # operand set in VMEM — big models need the grid-tiled follow-up.
+    # Size: plan the tile grids at the engine's worst row count; a 0
+    # tile count means even the finest tiling cannot fit the budget.
+    from megatronapp_tpu.inference.quantization import is_resident_leaf
+    from megatronapp_tpu.ops.activations import is_gated
+    blk = params.get("block", {}) if isinstance(params, dict) else {}
+    attn = blk.get("attention", {}) if isinstance(blk, dict) else {}
+    mlp = blk.get("mlp", {}) if isinstance(blk, dict) else {}
     h = cfg.hidden_size
     nq, nkv, d = (cfg.num_attention_heads, cfg.num_query_groups,
                   cfg.head_dim)
-    fc1_out = mlp_bytes = 0
-    from megatronapp_tpu.ops.activations import is_gated
-    fc1_out = (2 * cfg.ffn_hidden_size if is_gated(cfg.activation)
-               else cfg.ffn_hidden_size)
-    itemsize = jnp.dtype(cfg.params_dtype).itemsize
-    act_itemsize = jnp.dtype(cfg.compute_dtype).itemsize
-    qkv_bytes = (h * nq * d + h * 2 * nkv * d) * itemsize \
-        + batch * (h + (nq + 2 * nkv) * d) * act_itemsize
-    mlp_bytes = (h * fc1_out + cfg.ffn_hidden_size * h) * itemsize \
-        + batch * (2 * h + fc1_out) * act_itemsize
-    worst = max(qkv_bytes, mlp_bytes)
-    if worst > MEGAKERNEL_VMEM_BUDGET:
-        return (f"fused-kernel operands ({worst} B) exceed the VMEM "
-                f"budget ({MEGAKERNEL_VMEM_BUDGET} B) — needs the "
-                f"grid-tiled megakernel follow-up")
+    rows = max(int(batch), int(mq_rows or 0))
+    act_item = jnp.dtype(cfg.compute_dtype).itemsize
+    default_item = jnp.dtype(cfg.params_dtype).itemsize
+
+    def _wi(leaf):
+        return 1 if is_resident_leaf(leaf) else default_item
+
+    budget = get_megakernel_vmem_budget()
+    flag = "raise --megakernel-vmem-budget to fuse anyway"
+    if not _qkv_tiles(h, nq, nkv, d, rows, _wi(attn.get("q_kernel")),
+                      _wi(attn.get("kv_kernel")), act_item,
+                      is_resident_leaf(attn.get("q_kernel")),
+                      is_resident_leaf(attn.get("kv_kernel")), budget):
+        return (f"fused QKV kernel: one kv-head group per tile still "
+                f"exceeds the VMEM budget ({budget} B) — {flag}")
+    if not _out_tiles(h, nq * d, rows, _wi(attn.get("out_kernel")),
+                      act_item, is_resident_leaf(attn.get("out_kernel")),
+                      budget):
+        return (f"fused out-proj kernel: one output column per tile "
+                f"still exceeds the VMEM budget ({budget} B) — {flag}")
+    plan = _mlp_tiles(h, cfg.ffn_hidden_size, is_gated(cfg.activation),
+                      rows, _wi(mlp.get("fc1_kernel")),
+                      _wi(mlp.get("fc2_kernel")), act_item,
+                      is_resident_leaf(mlp.get("fc1_kernel")),
+                      is_resident_leaf(mlp.get("fc2_kernel")), budget)
+    if plan is not None and (not plan[0] or not plan[1]):
+        return (f"fused MLP kernels: one ffn/output column per tile "
+                f"still exceeds the VMEM budget ({budget} B) — {flag}")
     return None

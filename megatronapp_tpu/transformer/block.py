@@ -99,13 +99,27 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     histories travel out through their cotangents."""
     if fused_decode:
         if (page_table is None or kv_cache is None
-                or chunk_counts is not None or x.shape[1] != 1
                 or cfg.multi_latent_attention or "moe" in p):
             raise ValueError(
-                "fused_decode covers the s == 1 non-MLA dense-MLP paged "
-                "decode body only — gate callers on "
+                "fused_decode covers the non-MLA dense-MLP paged "
+                "decode/multiquery bodies only — gate callers on "
                 "kernel_gen.megakernel_ineligible_reason")
-        from megatronapp_tpu.ops.pallas.kernel_gen import fused_layer_decode
+        from megatronapp_tpu.ops.pallas.kernel_gen import (
+            fused_layer_decode, fused_layer_multiquery,
+        )
+        if chunk_counts is not None:
+            # Ragged multi-token rows (speculative verify / chunked
+            # prefill): the fused kernels run on the flattened B·S rows
+            # around the ragged paged-attention kernel.
+            return fused_layer_multiquery(
+                p, x, cfg, rope_cos, rope_sin, kv_cache,
+                cache_positions, chunk_counts, page_table, active,
+                kv_scales=kv_scales)
+        if x.shape[1] != 1:
+            raise ValueError(
+                "fused_decode without chunk_counts is the s == 1 "
+                "decode body — pass chunk_counts for ragged "
+                "multi-token steps")
         return fused_layer_decode(p, x, cfg, rope_cos, rope_sin, kv_cache,
                                   cache_positions, page_table, active,
                                   kv_scales=kv_scales)

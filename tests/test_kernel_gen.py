@@ -516,6 +516,247 @@ class TestFusedDecode:
 
 
 # ---------------------------------------------------------------------------
+# Grid-tiled megakernel emission (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def _layer0(params):
+    """Layer-0 slice of the stacked block tree (resident {qint8,
+    qscale} leaves slice both members)."""
+    from megatronapp_tpu.inference.quantization import is_resident_leaf
+
+    def f(v):
+        if is_resident_leaf(v):
+            return {"qint8": v["qint8"][0], "qscale": v["qscale"][0]}
+        return v[0]
+
+    out = {}
+    for k, v in params["block"].items():
+        if isinstance(v, dict) and not is_resident_leaf(v):
+            out[k] = {k2: f(v2) for k2, v2 in v.items()}
+        else:
+            out[k] = f(v)
+    return out
+
+
+def _resident(params):
+    from megatronapp_tpu.inference.quantization import (
+        quantize_params, residentize_params,
+    )
+    q, _ = quantize_params(params, resident_only=True)
+    return residentize_params(q)
+
+
+class TestTiledMegakernel:
+    """Column-tiled emission is BITWISE the no-grid fast path: each
+    tile keeps the full contraction and recomputes the row norm from
+    the whole x block, so fp32 sums never reorder."""
+
+    @pytest.fixture(scope="class")
+    def kernel_inputs(self):
+        cfg = _engine_cfg()
+        params, _ = init_gpt_params(jax.random.PRNGKey(5), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1),
+                              (3, cfg.hidden_size), jnp.float32)
+        half = cfg.head_dim // 2
+        cos = jax.random.normal(jax.random.PRNGKey(2), (3, half),
+                                jnp.float32)
+        sin = jax.random.normal(jax.random.PRNGKey(3), (3, half),
+                                jnp.float32)
+        attn_flat = jax.random.normal(
+            jax.random.PRNGKey(4),
+            (3, cfg.num_attention_heads * cfg.head_dim), jnp.float32)
+        return cfg, params, x, cos, sin, attn_flat
+
+    @pytest.mark.parametrize("resident", [False, True],
+                             ids=["fp32", "resident-int8"])
+    def test_qkv_tiled_bitwise(self, kernel_inputs, resident):
+        from megatronapp_tpu.ops.pallas import kernel_gen as kg
+        cfg, params, x, cos, sin, _ = kernel_inputs
+        p0 = _layer0(_resident(params) if resident else params)
+        attn_p = {**p0["attention"], "ln1_scale": p0["ln1_scale"],
+                  **({"ln1_bias": p0["ln1_bias"]}
+                     if "ln1_bias" in p0 else {})}
+        ref = kg._fused_qkv(x, attn_p, cfg, cos, sin, tiles=1)
+        tiled = kg._fused_qkv(x, attn_p, cfg, cos, sin, tiles=2)
+        for a, b in zip(ref, tiled):
+            assert bool(jnp.all(a == b))
+
+    @pytest.mark.parametrize("resident", [False, True],
+                             ids=["fp32", "resident-int8"])
+    def test_out_proj_tiled_bitwise(self, kernel_inputs, resident):
+        from megatronapp_tpu.ops.pallas import kernel_gen as kg
+        cfg, params, x, _, _, attn_flat = kernel_inputs
+        p0 = _layer0(_resident(params) if resident else params)
+        attn_p = {**p0["attention"], "ln1_scale": p0["ln1_scale"]}
+        ref = kg._fused_out_proj(attn_flat, attn_p, cfg, x, tiles=1)
+        tiled = kg._fused_out_proj(attn_flat, attn_p, cfg, x, tiles=2)
+        assert bool(jnp.all(ref == tiled))
+
+    @pytest.mark.parametrize("resident", [False, True],
+                             ids=["fp32", "resident-int8"])
+    def test_mlp_tiled_bitwise(self, kernel_inputs, resident):
+        """The tiled MLP is a TWO-kernel split (fc1+act over ffn
+        columns, fc2+residual over H columns); the intermediate lives
+        in compute dtype, so store/reload is lossless vs the no-grid
+        single kernel."""
+        from megatronapp_tpu.ops.pallas import kernel_gen as kg
+        cfg, params, x, _, _, _ = kernel_inputs
+        p0 = _layer0(_resident(params) if resident else params)
+        ref = kg._fused_mlp(x, p0, cfg)
+        tiled = kg._fused_mlp(x, p0, cfg, tiles=(2, 2))
+        assert bool(jnp.all(ref == tiled))
+
+    def test_budget_setter_validates(self):
+        from megatronapp_tpu.ops.pallas import kernel_gen as kg
+        old = kg.get_megakernel_vmem_budget()
+        try:
+            with pytest.raises(ValueError, match="positive byte count"):
+                kg.set_megakernel_vmem_budget(0)
+            with pytest.raises(ValueError, match="positive byte count"):
+                kg.set_megakernel_vmem_budget(-4096)
+            assert kg.set_megakernel_vmem_budget(old) == old
+        finally:
+            kg.set_megakernel_vmem_budget(old)
+
+    def test_budget_setter_warns_above_vmem(self, caplog):
+        import logging
+        from megatronapp_tpu.ops.pallas import kernel_gen as kg
+        old = kg.get_megakernel_vmem_budget()
+        try:
+            with caplog.at_level(logging.WARNING,
+                                 "megatronapp_tpu.ops.pallas.kernel_gen"):
+                kg.set_megakernel_vmem_budget(32 * 1024 * 1024)
+            assert any("VMEM" in r.message for r in caplog.records)
+        finally:
+            kg.set_megakernel_vmem_budget(old)
+
+    def test_tiny_budget_stream_token_exact(self, engine_setup):
+        """Budget-driven tiling end to end: a budget small enough to
+        force qkv AND mlp grids (but large enough to stay eligible)
+        keeps the greedy stream token-exact."""
+        from megatronapp_tpu.ops.pallas import kernel_gen as kg
+        cfg, params, prompts = engine_setup
+        plain, _ = _stream(cfg, params, prompts)
+        old = kg.get_megakernel_vmem_budget()
+        try:
+            kg.set_megakernel_vmem_budget(192 * 1024)
+            # the plan actually tiles at this budget (qkv over both
+            # kv-head groups, mlp split)
+            rows = 32
+            assert kg._qkv_tiles(cfg.hidden_size, 4, 2, cfg.head_dim,
+                                 rows, 4, 4, 4, False, False,
+                                 192 * 1024) == 2
+            assert kg._mlp_tiles(cfg.hidden_size, cfg.ffn_hidden_size,
+                                 True, rows, 4, 4, 4, False, False,
+                                 192 * 1024) is not None
+            fused, eng = _stream(cfg, params, prompts, fused_decode=True)
+            assert eng.megakernel
+        finally:
+            kg.set_megakernel_vmem_budget(old)
+        assert plain == fused
+
+    @pytest.mark.slow
+    def test_large_shape_formerly_fallback_now_fused(self):
+        """THE ISSUE 16 acceptance gate: a shape whose fused MLP body
+        exceeds the VMEM budget (fc1 weights alone: 768*6144*4 ≈ 18.9
+        MB > 12 MiB) used to log the VMEM fallback; it now tiles, and
+        the traced decode step launches ≤0.85× the unfused engine's
+        kernels (launch_stats traces only — no AOT compile)."""
+        from megatronapp_tpu.ops.pallas import kernel_gen as kg
+        from megatronapp_tpu.utils.dispatch import launch_stats
+        cfg = _engine_cfg(num_layers=1, hidden_size=768,
+                          num_attention_heads=12, num_query_groups=4,
+                          ffn_hidden_size=3072)
+        # fused MLP body does NOT fit whole at the default budget...
+        assert kg._mlp_tiles(768, 3072, True, 32, 4, 4, 4, False, False,
+                             kg.get_megakernel_vmem_budget()) is not None
+        # ...but the shape is eligible (tiled), not a fallback:
+        assert kg.megakernel_ineligible_reason(cfg, batch=2) is None
+        params, _ = init_gpt_params(jax.random.PRNGKey(3), cfg)
+
+        def traced_launches(fused):
+            eng = DynamicInferenceEngine(params, cfg, max_batch=2,
+                                         max_seq_len=64, paged=True,
+                                         block_size=8,
+                                         fused_decode=fused)
+            assert eng.megakernel is fused
+            spec = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+                a.shape, a.dtype)
+            p_spec = jax.tree.map(spec, eng.params)
+            pages_spec = jax.tree.map(spec, eng.pool.pages)
+            scales_spec = jax.tree.map(spec, eng.pool.scales)
+            mb = eng.pool.page_table.shape[1]
+            args = (p_spec,
+                    jax.ShapeDtypeStruct((eng.max_batch, 1), jnp.int32),
+                    pages_spec, scales_spec,
+                    jax.ShapeDtypeStruct((eng.max_batch, mb), jnp.int32),
+                    jax.ShapeDtypeStruct((eng.max_batch,), jnp.int32),
+                    jax.ShapeDtypeStruct((eng.max_batch,), jnp.bool_))
+            return launch_stats(eng._decode, *args)
+
+        sp = traced_launches(False)
+        sf = traced_launches(True)
+        assert sf["dispatches_per_step"] <= 0.85 * sp["dispatches_per_step"]
+
+
+class TestMegakernelComposition:
+    """The fused step composes with the features it was carved out
+    from: resident int8 weights, speculation, and chunked prefill —
+    each pinned token-exact against the unfused engine."""
+
+    @pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+    def test_resident_int8_streams_token_exact(self, engine_setup,
+                                               kv_dtype):
+        cfg, params, prompts = engine_setup
+        res = _resident(params)
+        plain, _ = _stream(cfg, res, prompts, kv_cache_dtype=kv_dtype)
+        fused, eng = _stream(cfg, res, prompts, kv_cache_dtype=kv_dtype,
+                             fused_decode=True)
+        assert eng.megakernel
+        assert plain == fused
+        eng.pool.audit()
+
+    @pytest.mark.slow
+    def test_spec_ngram_streams_token_exact(self, engine_setup):
+        """Speculative verify rounds ride the FUSED ragged multiquery
+        step ([B, K+1] q rows) — streams keep the verifier's
+        bit-identity pin."""
+        cfg, params, prompts = engine_setup
+        plain, _ = _stream(cfg, params, prompts, spec_method="ngram",
+                           spec_k=3)
+        fused, eng = _stream(cfg, params, prompts, spec_method="ngram",
+                             spec_k=3, fused_decode=True)
+        assert eng.megakernel
+        assert plain == fused
+
+    @pytest.mark.slow
+    def test_chunked_prefill_streams_token_exact(self, engine_setup):
+        """Chunked prefill runs the fused multiquery step at
+        [1, prefill_chunk] — the 17-token prompt spans 3 chunks."""
+        cfg, params, prompts = engine_setup
+        plain, _ = _stream(cfg, params, prompts, prefill_chunk=8)
+        fused, eng = _stream(cfg, params, prompts, prefill_chunk=8,
+                             fused_decode=True)
+        assert eng.megakernel
+        assert plain == fused
+
+    @pytest.mark.slow
+    def test_quantized_spec_stack(self, engine_setup):
+        """The full stack at once: resident int8 weights + int8 KV +
+        ngram speculation under the fused step."""
+        cfg, params, prompts = engine_setup
+        res = _resident(params)
+        plain, _ = _stream(cfg, res, prompts, kv_cache_dtype="int8",
+                           spec_method="ngram", spec_k=3)
+        fused, eng = _stream(cfg, res, prompts, kv_cache_dtype="int8",
+                             spec_method="ngram", spec_k=3,
+                             fused_decode=True)
+        assert eng.megakernel
+        assert plain == fused
+
+
+# ---------------------------------------------------------------------------
 # PERF levers: flash backward head-fold + scan unroll
 # ---------------------------------------------------------------------------
 
@@ -638,25 +879,46 @@ class TestEligibilityReasons:
             _engine_cfg(ffn_hidden_size=511), Ctx(), 64)
 
     def test_megakernel_reasons(self):
-        from megatronapp_tpu.ops.pallas.kernel_gen import (
-            megakernel_ineligible_reason,
-        )
+        from megatronapp_tpu.ops.pallas import kernel_gen as kg
         cfg = _engine_cfg()
-        assert megakernel_ineligible_reason(cfg, batch=4) is None
-        assert "paged" in megakernel_ineligible_reason(cfg, batch=4,
-                                                       paged=False)
-        assert "tp head-sharded" in megakernel_ineligible_reason(
+        assert kg.megakernel_ineligible_reason(cfg, batch=4) is None
+        assert "paged" in kg.megakernel_ineligible_reason(cfg, batch=4,
+                                                          paged=False)
+        assert "tp head-sharded" in kg.megakernel_ineligible_reason(
             cfg, batch=4, tp_paged=True)
         moe = _engine_cfg(num_moe_experts=4, moe_router_topk=2)
-        assert "MoE" in megakernel_ineligible_reason(moe, batch=4)
+        assert "MoE" in kg.megakernel_ineligible_reason(moe, batch=4)
+        # Since ISSUE 16, large H/FFN shapes TILE into the budget
+        # instead of falling back — the formerly-ineligible 4096 shape
+        # is now fused.
         big = _engine_cfg(hidden_size=4096, num_attention_heads=32,
                           num_query_groups=32)
-        assert "VMEM" in megakernel_ineligible_reason(big, batch=4)
+        assert kg.megakernel_ineligible_reason(big, batch=4) is None
 
-    def test_megakernel_resident_weights_gate(self):
-        """Resident int8 weights keep the unfused step (resolve_param
-        runs outside the fused kernels — a dequantized copy per step
-        would negate the resident-HBM win) and the engine logs it."""
+    def test_megakernel_size_reasons_name_failed_kernel(self):
+        """When even the finest tiling cannot fit the budget, the
+        reason names the FIRST failed kernel and the flag that raises
+        the budget."""
+        from megatronapp_tpu.ops.pallas import kernel_gen as kg
+        big = _engine_cfg(hidden_size=4096, num_attention_heads=32,
+                          num_query_groups=32)
+        old = kg.get_megakernel_vmem_budget()
+        try:
+            kg.set_megakernel_vmem_budget(4096)
+            reason = kg.megakernel_ineligible_reason(big, batch=4)
+            assert reason is not None
+            assert "fused QKV kernel" in reason
+            assert "VMEM" in reason
+            assert "--megakernel-vmem-budget" in reason
+        finally:
+            kg.set_megakernel_vmem_budget(old)
+
+    def test_megakernel_resident_weights_eligible(self):
+        """Resident int8 weights are ELIGIBLE since ISSUE 16: the fused
+        kernels take {qint8, qscale} operand pairs and dequantize
+        in-register at matmul entry (exactly resolve_param's
+        arithmetic), so the resident-HBM win survives fusion. Eligible
+        byte math counts 1-byte weights + fp32 scale rows."""
         from megatronapp_tpu.inference.quantization import (
             quantize_params, residentize_params,
         )
@@ -667,19 +929,21 @@ class TestEligibilityReasons:
         params, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
         assert megakernel_ineligible_reason(cfg, batch=4,
                                             params=params) is None
-        q, _ = quantize_params(params)
+        q, _ = quantize_params(params, resident_only=True)
         res = residentize_params(q)
-        reason = megakernel_ineligible_reason(cfg, batch=4, params=res)
-        assert reason is not None and "resident int8" in reason
+        assert megakernel_ineligible_reason(cfg, batch=4,
+                                            params=res) is None
         eng = DynamicInferenceEngine(res, cfg, max_batch=2,
                                      max_seq_len=64, paged=True,
                                      block_size=8, fused_decode=True)
-        assert not eng.megakernel
+        assert eng.megakernel
 
-    def test_serving_args_reject_megakernel_combos(self):
-        """Parse-time rejection instead of a silent unfused fallback:
-        --megakernel-decode needs dynamic+paged and no --serve-disagg
-        (the coordinator does not thread fused_decode yet)."""
+    def test_serving_args_megakernel_combos(self):
+        """Parse-time validation: --megakernel-decode still needs
+        dynamic+paged, but composes with --serve-disagg and
+        --serve-fleet since ISSUE 16 (fused_decode is threaded through
+        both constructors); --megakernel-vmem-budget must be a
+        positive byte count."""
         import argparse
 
         from megatronapp_tpu.config.arguments import validate_serving_args
@@ -687,19 +951,31 @@ class TestEligibilityReasons:
         def ns(**kw):
             base = dict(engine="dynamic", paged_kv_cache=True,
                         megakernel_decode=True, serve_disagg=False,
-                        kv_cache_dtype="bf16", quantized_weights=False)
+                        serve_fleet=1, kv_cache_dtype="bf16",
+                        quantized_weights=False,
+                        megakernel_vmem_budget=None)
             base.update(kw)
             return argparse.Namespace(**base)
 
         validate_serving_args(ns(), multi_latent_attention=False)
-        with pytest.raises(SystemExit, match="serve-disagg"):
-            validate_serving_args(ns(serve_disagg=True),
-                                  multi_latent_attention=False)
+        # Deployment combos are accepted now — threading is real.
+        validate_serving_args(ns(serve_disagg=True),
+                              multi_latent_attention=False)
+        validate_serving_args(ns(serve_fleet=2),
+                              multi_latent_attention=False)
+        validate_serving_args(ns(quantized_weights=True),
+                              multi_latent_attention=False)
         with pytest.raises(SystemExit, match="paged"):
             validate_serving_args(ns(paged_kv_cache=False),
                                   multi_latent_attention=False)
         with pytest.raises(SystemExit, match="dynamic"):
             validate_serving_args(ns(engine="static"),
+                                  multi_latent_attention=False)
+        with pytest.raises(SystemExit, match="positive byte count"):
+            validate_serving_args(ns(megakernel_vmem_budget=0),
+                                  multi_latent_attention=False)
+        with pytest.raises(SystemExit, match="positive byte count"):
+            validate_serving_args(ns(megakernel_vmem_budget=-1),
                                   multi_latent_attention=False)
 
     def test_megakernel_hooks_gate(self):
@@ -731,6 +1007,24 @@ class TestBenchmarkSmoke:
         assert res["greedy_match"]
         assert res["within_gate"], res
         assert res["dispatch_ratio"] < 1.0
+
+    @pytest.mark.slow
+    def test_decode_ab_quantized_gates(self):
+        import tools.megakernel_benchmark as mb
+        res = mb.run_decode_ab(max_new=3, scan_unroll=2, quantized=True)
+        assert res["quantized_weights"]
+        assert res["greedy_match"]
+        assert res["within_gate"], res
+
+    @pytest.mark.slow
+    def test_tiled_ab_gates(self):
+        import tools.megakernel_benchmark as mb
+        res = mb.run_tiled_ab(max_new=2)
+        assert res["mlp_plan_tiled"], res   # the shape genuinely tiles
+        assert res["eligible"], res         # ...and is no longer a fallback
+        assert res["fused_engine_megakernel"], res
+        assert res["greedy_match"], res
+        assert res["within_gate"], res
 
     def test_train_levers_gates(self):
         import tools.megakernel_benchmark as mb

@@ -13,6 +13,11 @@ numbers are compiled-module facts):
            cannot be the gate) measurably REDUCED. The compiled
            cost-model flops/bytes and CPU tokens/s ride along for the
            record.
+  decode_quantized / decode_tiled (ISSUE 16): the same A/B on resident
+           int8 weights (fused leg dequantizes in-register), and a
+           large-shape leg whose fused MLP body exceeds the VMEM
+           budget — formerly a logged fallback, now grid-tiled, gated
+           on the trace-only launch ratio + stream parity.
   train:   fwd+bwd wall with the two staged PERF levers ON — flash
            backward head-fold (lever 1, --flash-head-fold) + a
            scan-unroll sweep (lever 3, --scan-unroll ∈ {1, 2, 4}) —
@@ -75,9 +80,11 @@ def _run_requests(engine, prompts, max_new):
 
 
 def run_decode_ab(max_new: int = 6, kv_dtype: str = "bf16",
-                  scan_unroll: int = 2):
+                  scan_unroll: int = 2, quantized: bool = False):
     """Plain vs fused decode step: dispatch-count gate + stream parity
-    + compiled cost model + CPU wall (record)."""
+    + compiled cost model + CPU wall (record). quantized=True runs BOTH
+    legs on resident int8 weights (the fused leg dequantizes in-register
+    — ISSUE 16)."""
     import jax
     import numpy as np
 
@@ -86,6 +93,12 @@ def run_decode_ab(max_new: int = 6, kv_dtype: str = "bf16",
     cfg = _make_cfg()
     fused_cfg = dataclasses.replace(cfg, scan_unroll=scan_unroll)
     params, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
+    if quantized:
+        from megatronapp_tpu.inference.quantization import (
+            quantize_params, residentize_params,
+        )
+        qp, _ = quantize_params(params, resident_only=True)
+        params = residentize_params(qp)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
                for n in (4, 9, 17, 26, 34, 41)]
@@ -102,6 +115,7 @@ def run_decode_ab(max_new: int = 6, kv_dtype: str = "bf16",
     ratio = sf["dispatches_per_step"] / sp["dispatches_per_step"]
     out = {
         "kv_dtype": kv_dtype,
+        "quantized_weights": quantized,
         "scan_unroll_fused": scan_unroll,
         "greedy_match": p_toks == f_toks,
         "dispatches_per_step": {"plain": sp["dispatches_per_step"],
@@ -121,6 +135,75 @@ def run_decode_ab(max_new: int = 6, kv_dtype: str = "bf16",
         if cost:
             out.setdefault("compiled_cost", {})[name] = cost
     return out
+
+
+def run_tiled_ab(max_new: int = 2):
+    """Large-shape leg (ISSUE 16): a shape whose fused MLP body exceeds
+    the VMEM budget (768*6144 fp32 fc1 weights ≈ 18.9 MB > 12 MiB) used
+    to fall back to the unfused step; it now grid-tiles. Gates: the
+    shape is ELIGIBLE at the default budget, the traced decode step
+    launches <= DISPATCH_RATIO_GATE x the unfused engine's kernels
+    (launch_stats traces only — no AOT compile at this size), and a
+    short greedy stream stays exact."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from megatronapp_tpu.models.gpt import init_gpt_params
+    from megatronapp_tpu.ops.pallas import kernel_gen as kg
+    from megatronapp_tpu.utils.dispatch import launch_stats
+
+    cfg = _make_cfg(num_layers=1, hidden_size=768,
+                    num_attention_heads=12, num_query_groups=4,
+                    ffn_hidden_size=3072)
+    budget = kg.get_megakernel_vmem_budget()
+    tiled_plan = kg._mlp_tiles(768, 3072, True, 32, 4, 4, 2, False,
+                               False, budget) is not None
+    eligible = kg.megakernel_ineligible_reason(cfg, batch=2) is None
+    params, _ = init_gpt_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in (4, 9)]
+
+    def leg(fused):
+        from megatronapp_tpu.inference.dynamic_engine import (
+            DynamicInferenceEngine,
+        )
+        eng = DynamicInferenceEngine(
+            params, cfg, max_batch=2, max_seq_len=64,
+            prefill_buckets=(16,), paged=True, block_size=8,
+            fused_decode=fused)
+        toks, _, _ = _run_requests(eng, prompts, max_new)
+        spec = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+            a.shape, a.dtype)
+        p_spec = jax.tree.map(spec, eng.params)
+        pages_spec = jax.tree.map(spec, eng.pool.pages)
+        scales_spec = jax.tree.map(spec, eng.pool.scales)
+        mb = eng.pool.page_table.shape[1]
+        args = (p_spec,
+                jax.ShapeDtypeStruct((eng.max_batch, 1), jnp.int32),
+                pages_spec, scales_spec,
+                jax.ShapeDtypeStruct((eng.max_batch, mb), jnp.int32),
+                jax.ShapeDtypeStruct((eng.max_batch,), jnp.int32),
+                jax.ShapeDtypeStruct((eng.max_batch,), jnp.bool_))
+        return toks, launch_stats(eng._decode, *args), eng.megakernel
+
+    p_toks, sp, _ = leg(False)
+    f_toks, sf, f_mk = leg(True)
+    ratio = sf["dispatches_per_step"] / sp["dispatches_per_step"]
+    return {
+        "hidden_size": 768, "ffn_hidden_size": 3072,
+        "vmem_budget": budget,
+        "mlp_plan_tiled": tiled_plan,
+        "eligible": eligible,
+        "fused_engine_megakernel": f_mk,
+        "greedy_match": p_toks == f_toks,
+        "dispatches_per_step": {"plain": sp["dispatches_per_step"],
+                                "fused": sf["dispatches_per_step"]},
+        "dispatch_ratio": round(ratio, 4),
+        "dispatch_ratio_gate": DISPATCH_RATIO_GATE,
+        "within_gate": ratio <= DISPATCH_RATIO_GATE,
+    }
 
 
 def run_train_levers(iters: int = 6, seq: int = 256, batch: int = 2,
@@ -204,6 +287,10 @@ def run(**kw):
         "decode_int8": run_decode_ab(
             max_new=kw.get("max_new", 6), kv_dtype="int8",
             scan_unroll=kw.get("scan_unroll", 2)),
+        "decode_quantized": run_decode_ab(
+            max_new=kw.get("max_new", 6),
+            scan_unroll=kw.get("scan_unroll", 2), quantized=True),
+        "decode_tiled": run_tiled_ab(max_new=kw.get("max_new_tiled", 2)),
         "train": run_train_levers(iters=kw.get("iters", 6)),
     }
 

@@ -40,18 +40,10 @@ def main():
     ap.add_argument("--port", type=int, default=5000)
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--max-seq-len", type=int, default=None)
-    # Serving-side copy of the training flag (same name/semantics; the
-    # main parser defines it in its training group, so it cannot live
-    # in add_serving_args without colliding there): unrolls the decode/
-    # multi-query layer scans — PERF lever 3, pairs with
-    # --megakernel-decode.
-    ap.add_argument("--scan-unroll", type=int, default=1,
-                    help="lax.scan unroll factor for the serving "
-                         "decode-step layer scans (PERF.md lever #3)")
     # Serving flags shared with the main parser (config/arguments.py
     # add_serving_args — single source of truth): --engine, --max-batch,
     # --paged-kv-cache, --kv-block-size, --num-kv-blocks,
-    # --no-prefix-caching.
+    # --scan-unroll, --megakernel-vmem-budget, --no-prefix-caching.
     from megatronapp_tpu.config.arguments import (
         add_serving_args, validate_serving_args,
     )
@@ -78,6 +70,11 @@ def main():
     cfg = PRESETS[args.preset]()
     validate_serving_args(
         args, multi_latent_attention=cfg.multi_latent_attention)
+    if args.megakernel_vmem_budget is not None:
+        from megatronapp_tpu.ops.pallas.kernel_gen import (
+            set_megakernel_vmem_budget,
+        )
+        set_megakernel_vmem_budget(args.megakernel_vmem_budget)
     if args.scan_unroll != 1:
         import dataclasses
         cfg = dataclasses.replace(cfg, scan_unroll=args.scan_unroll)
@@ -219,7 +216,8 @@ def main():
                         devices=devices[i * per:(i + 1) * per],
                         spec_method=spec, spec_k=args.spec_k,
                         draft_params=draft_params, draft_cfg=draft_cfg,
-                        kv_cache_dtype=args.kv_cache_dtype, **hints)
+                        kv_cache_dtype=args.kv_cache_dtype,
+                        fused_decode=args.megakernel_decode, **hints)
                 return DynamicInferenceEngine(
                     params, cfg, tokenizer=tok,
                     max_batch=args.max_batch,
@@ -230,7 +228,8 @@ def main():
                     spec_method=spec, spec_k=args.spec_k,
                     draft_params=draft_params, draft_cfg=draft_cfg,
                     prefill_chunk=args.prefill_chunk,
-                    kv_cache_dtype=args.kv_cache_dtype)
+                    kv_cache_dtype=args.kv_cache_dtype,
+                    fused_decode=args.megakernel_decode)
 
             engine = FleetRouter(
                 engine_factory=replica_engine, num_replicas=n,
@@ -242,7 +241,8 @@ def main():
                   f"replicas on {args.host}:{args.port} "
                   f"(policy=affinity, migrate={args.fleet_migrate}, "
                   f"autoscale={args.fleet_autoscale}, "
-                  f"kv={args.kv_cache_dtype})")
+                  f"kv={args.kv_cache_dtype}, "
+                  f"megakernel={args.megakernel_decode})")
             TextGenerationServer(engine, args.host, args.port).run()
             return
         if args.serve_disagg:
@@ -263,12 +263,14 @@ def main():
                 decode_slo_ms=args.decode_slo_ms, tp=args.serve_tp,
                 spec_method=spec, spec_k=args.spec_k,
                 draft_params=draft_params, draft_cfg=draft_cfg,
-                kv_cache_dtype=args.kv_cache_dtype)
+                kv_cache_dtype=args.kv_cache_dtype,
+                fused_decode=args.megakernel_decode)
             print(f"serving DISAGGREGATED on {args.host}:{args.port} "
                   f"(prefill {engine.prefill_ctx.num_devices}d / decode "
                   f"{engine.decode_ctx.num_devices}d, tp={args.serve_tp}, "
                   f"slo={args.decode_slo_ms} ms, "
                   f"kv={args.kv_cache_dtype}, "
+                  f"megakernel={engine.megakernel}, "
                   f"spec={spec or 'off'})")
             TextGenerationServer(engine, args.host, args.port).run()
             return
