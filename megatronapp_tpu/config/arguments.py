@@ -65,8 +65,9 @@ def add_serving_args(ap: argparse.ArgumentParser):
                    help="paged KV-pool storage dtype — "
                         + kv_cache_dtype_help()
                         + " (quantized dtypes need --paged-kv-cache; "
-                        "MLA latent pools are bf16-only; quantized "
-                        "pools cost ~(D+4)/2D of the bf16 bytes)")
+                        "MLA latent/pe pools quantize with per-row "
+                        "scalar scales; quantized pools cost "
+                        "~(D+4)/2D of the bf16 bytes)")
     g.add_argument("--megakernel-decode", action="store_true",
                    help="fused (megakernel) decode step (ISSUE 11/16, "
                         "ops/pallas/kernel_gen.py): the per-token layer "
@@ -80,10 +81,11 @@ def add_serving_args(ap: argparse.ArgumentParser):
                         "--quantized-weights dequantize in-register; "
                         "speculative verify and chunked prefill run "
                         "the fused ragged step; composes with "
-                        "--serve-disagg and --serve-fleet. Ineligible "
-                        "configs (MLA, MoE, --serve-tp>1, MegaScope "
-                        "hooks) keep the unfused step with a logged "
-                        "reason")
+                        "--serve-disagg and --serve-fleet; MLA runs "
+                        "the fused latent prologue + absorbed-q latent "
+                        "kernel. Ineligible configs (MoE, --serve-tp>1, "
+                        "MegaScope hooks) keep the unfused step with a "
+                        "logged reason")
     g.add_argument("--megakernel-vmem-budget", type=int, default=None,
                    metavar="BYTES",
                    help="per-kernel operand budget (bytes) for the "

@@ -216,17 +216,10 @@ def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
     else:
         cos = sin = None
 
-    if cfg.multi_latent_attention:
-        # The MLA paged path gathers each slot's latent run back to a
-        # contiguous [B, MB*bs, .] layout (kv_up reconstitution needs
-        # dense rows); gathered row index == sequence position, so the
-        # per-row mask is the same attend-up-to-length mask as dense.
-        mb, bs = page_table.shape[1], pages[0].shape[2]
-        kv_pos = jnp.arange(mb * bs)
-        attend = kv_pos[None, :] <= lengths[:, None]
-        mask = attend[:, None, None, :]
-    else:
-        mask = None      # the ragged kernel masks by per-row kv length
+    # The ragged kernels mask by per-row kv length themselves (MLA
+    # included since ISSUE 17 — the latent kernel attends through the
+    # page table, no dense gather and no host-built mask).
+    mask = None
 
     pa, pb = pages
     lids = jnp.arange(cfg.num_layers)
@@ -290,15 +283,10 @@ def _paged_multiquery_step(params, tokens, pages, page_table, starts,
     else:
         cos = sin = None
 
-    if cfg.multi_latent_attention:
-        # MLA gathers the latent run dense (mla.py paged path): build the
-        # per-(query, kv) causal mask over the gathered [MB*bs] layout.
-        mb, bs = page_table.shape[1], pages[0].shape[2]
-        kv_pos = jnp.arange(mb * bs)
-        attend = kv_pos[None, None, :] <= positions[:, :, None]
-        mask = attend[:, None]                                 # [B,1,S,K]
-    else:
-        mask = None          # the multi-query ragged kernel masks itself
+    # The multi-query ragged kernels mask themselves (MLA included since
+    # ISSUE 17 — the latent kernel's scalar-prefetched q_lens carries
+    # the causal tail mask).
+    mask = None
 
     pa, pb = pages
     lids = jnp.arange(cfg.num_layers)
@@ -478,15 +466,31 @@ class DynamicInferenceEngine:
                 # each device holds 1/tp of the pool; otherwise just
                 # commit them to this mesh (disagg decode sub-mesh). An
                 # int8 pool's scale pools [L, NB, bs, Hkv] shard on the
-                # same Hkv dim (their last).
-                pages_spec = (P(None, None, None, TP_AXIS, None)
-                              if self.tp_paged else P())
-                scales_spec = (P(None, None, None, TP_AXIS)
-                               if self.tp_paged else P())
+                # same Hkv dim (their last). MLA pools are rank-4 with
+                # no head axis — the latent pool [L, NB, bs, klat]
+                # shards on its COLUMN dim (kernel_gen._tp_place_latent
+                # contracts per-shard columns and psums the logits), the
+                # tiny pe pool and the per-row scalar scale pools
+                # replicate.
+                if not self.tp_paged:
+                    pages_spec = scales_spec = P()
+                elif cfg.multi_latent_attention:
+                    pages_spec = [P(None, None, None, TP_AXIS), P()]
+                    scales_spec = P()
+                else:
+                    pages_spec = P(None, None, None, TP_AXIS, None)
+                    scales_spec = P(None, None, None, TP_AXIS)
+
+                def _sh(spec):
+                    if isinstance(spec, list):
+                        # manual-ok: constructor-time placement, no manual region
+                        return [NamedSharding(ctx.mesh, s) for s in spec]
+                    return NamedSharding(ctx.mesh, spec)  # manual-ok: see above
+
                 # manual-ok: constructor-time placement, no manual region
                 self.pool.place_pages(
-                    NamedSharding(ctx.mesh, pages_spec),    # manual-ok: see above
-                    NamedSharding(ctx.mesh, scales_spec))   # manual-ok: see above
+                    _sh(pages_spec),    # manual-ok: see above
+                    _sh(scales_spec))   # manual-ok: see above
             else:
                 # manual-ok: constructor-time placement, no manual region
                 self.cache = jax.device_put(self.cache,
@@ -626,12 +630,6 @@ class DynamicInferenceEngine:
                                               fused=fused)
 
             self._mq_step = jax.jit(_mq_traced, donate_argnums=(2, 3))
-            from megatronapp_tpu.ops.pallas.paged_attention import (
-                gather_prefix_pages, write_prompt_pages,
-            )
-            self._write_pages = jax.jit(write_prompt_pages)
-            self._gather_prefix = jax.jit(gather_prefix_pages,
-                                          static_argnums=(2,))
             if self.spec_method:
                 from megatronapp_tpu.inference.speculative import (
                     build_verify_sampler,
@@ -1004,11 +1002,14 @@ class DynamicInferenceEngine:
         # NEXT token, exactly like a fresh admission.
         tokens = req.tokens
         p_len = len(tokens)
-        if self.paged and not self.cfg.multi_latent_attention:
+        if self.paged:
             # Chunked prefill through the unified multi-query step: ONE
             # trace per chunk shape instead of one per
             # (bucket, cached-length) pair, and prefix-cache hits are
-            # attended directly through the page table (no dense gather).
+            # attended directly through the page table (no dense gather;
+            # MLA rides the same path since ISSUE 17 — the latent kernel
+            # handles the ragged chunk, and quantized latent rows
+            # quantize inside the same _mq_step jit).
             logits_last = self._paged_prefill_chunked(req, tokens, p_len,
                                                       plan)
         else:
@@ -1019,21 +1020,17 @@ class DynamicInferenceEngine:
                     f"no prefill bucket covers length {p_len} (buckets "
                     f"{self.prefill_buckets}, max_seq_len "
                     f"{self.max_seq_len})")
-            if self.paged:
-                logits_last = self._paged_prefill(req, tokens, p_len,
-                                                  bucket, plan)
-            else:
-                padded = np.zeros((1, bucket), np.int32)
-                padded[0, :p_len] = tokens
-                tmp_cache = init_kv_cache(self.cfg, 1, bucket)
-                logits, tmp_cache = self._prefill(
-                    self.params, jnp.asarray(padded), tmp_cache, 0)
-                # Scatter the kv rows into this slot of the shared cache.
-                slot = req.slot
-                self.cache = tuple(
-                    c.at[:, slot, :bucket].set(t[:, 0]) for c, t in
-                    zip(self.cache, tmp_cache))
-                logits_last = logits[0, p_len - 1]
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :p_len] = tokens
+            tmp_cache = init_kv_cache(self.cfg, 1, bucket)
+            logits, tmp_cache = self._prefill(
+                self.params, jnp.asarray(padded), tmp_cache, 0)
+            # Scatter the kv rows into this slot of the shared cache.
+            slot = req.slot
+            self.cache = tuple(
+                c.at[:, slot, :bucket].set(t[:, 0]) for c, t in
+                zip(self.cache, tmp_cache))
+            logits_last = logits[0, p_len - 1]
         self.lengths[req.slot] = p_len
         # First generated token comes from the last PROMPT position.
         logits_last = mask_padded_vocab(logits_last, self.cfg)
@@ -1084,40 +1081,6 @@ class DynamicInferenceEngine:
             self._h_last[slot] = np.asarray(
                 jax.device_get(hid[0, count - 1]), np.float32)
             self._h_valid[slot] = True
-        return logits[0, count - 1]
-
-    def _paged_prefill(self, req: Request, tokens, p_len: int, bucket: int,
-                       plan) -> jnp.ndarray:
-        """Prefill through the block pool: only tokens past the cached
-        prefix are computed (through a bucket-sized dense temp cache,
-        never S_max), and the new KV rows are scattered page-table-aware
-        on device. Returns the last prompt position's logits [V]."""
-        assert plan is not None
-        slot = req.slot
-        pool = self.pool
-        cached = plan.cached_tokens
-        table_row = jnp.asarray(pool.page_table[slot])
-
-        tmp = init_kv_cache(self.cfg, 1, bucket)
-        if cached:
-            nblocks = cdiv(cached, pool.block_size)
-            tmp = tuple(
-                t.at[:, 0, :cached].set(
-                    self._gather_prefix(p, table_row, nblocks)[:, :cached])
-                for t, p in zip(tmp, pool.pages))
-
-        s_step = bucket - cached
-        padded = np.zeros((1, s_step), np.int32)
-        padded[0, :p_len - cached] = tokens[cached:]
-        logits, tmp = self._prefill(self.params, jnp.asarray(padded), tmp,
-                                    cached)
-        count = p_len - cached
-        pool.pages = tuple(
-            self._write_pages(p, t[:, 0, cached:], table_row, cached, count)
-            for p, t in zip(pool.pages, tmp))
-        # Register the prompt's full blocks so concurrent same-prefix
-        # requests hit them immediately.
-        pool.register_prefix(slot, np.asarray(tokens), p_len)
         return logits[0, count - 1]
 
     def _sample(self, logits, req: Request):

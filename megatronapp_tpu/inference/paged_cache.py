@@ -30,9 +30,12 @@ with a per-(row, kv-head) fp32 scale pool [L, NB, bs, Hkv] on
 prefix hashing, transfer, rewind) is UNCHANGED: block identity and
 sharing semantics never depend on the storage dtype. Capacity
 accounting (`bytes_total`, `bytes_per_block`) reads the addressable
-arrays, so it is dtype-aware by construction. The MLA latent pool stays
-bf16-only (the latent is already a compressed representation; int8
-rejection is explicit).
+arrays, so it is dtype-aware by construction. MLA pools (ISSUE 17)
+quantize the same way: the latent row [bs, klat] and roped-key row
+[bs, dpe] have no kv-head axis, so their scale pools are per-row
+SCALARS [L, NB, bs] — `quantize_kv_rows` over the trailing dim yields
+exactly that layout, and every pool-shaped operation here is generic
+over the per-pool trailing dims.
 
 fp8 pools (ISSUE 13, ``kv_cache_dtype="fp8"``): same scale-pool layout
 as int8 but the pages store e4m3 — quantize_kv_rows maps each row's
@@ -140,12 +143,10 @@ def validate_kv_cache_dtype(name: str, *, paged: bool = True,
             "(the per-block quantization scales live alongside the "
             "block pool; the dense slot cache has no block structure) "
             "— pass paged=True / --paged-kv-cache")
-    if spec.quantized and mla:
-        raise ValueError(
-            f"kv_cache_dtype={spec.name} is not supported for MLA: the "
-            "latent pool is already a compressed representation and "
-            "stays bf16-only for now — run with kv_cache_dtype=bf16 "
-            f"(or drop --kv-cache-dtype {spec.name})")
+    # mla is accepted (and kept in the signature) so call sites document
+    # the layout they validate for; quantized MLA pools are supported
+    # since ISSUE 17 (per-row scalar scales on the latent/pe pools).
+    del mla
     return spec
 
 
@@ -192,10 +193,16 @@ class PagedKVCache:
         # pools (same leading [L, NB, bs] dims).
         self.scales: Optional[Tuple[jnp.ndarray, ...]] = None
         if cfg.multi_latent_attention:
+            dt = (dtype_spec.page_dtype if self.quantized
+                  else cfg.compute_dtype)
             self.pages: Tuple[jnp.ndarray, ...] = (
-                jnp.zeros((l, nb, bs, cfg.kv_lora_rank), cfg.compute_dtype),
-                jnp.zeros((l, nb, bs, cfg.qk_pos_emb_head_dim),
-                          cfg.compute_dtype))
+                jnp.zeros((l, nb, bs, cfg.kv_lora_rank), dt),
+                jnp.zeros((l, nb, bs, cfg.qk_pos_emb_head_dim), dt))
+            if self.quantized:
+                # The latent/pe rows have no kv-head axis — the scales
+                # are one SCALAR per (layer, block, row).
+                self.scales = (jnp.ones((l, nb, bs), jnp.float32),
+                               jnp.ones((l, nb, bs), jnp.float32))
         else:
             shape = (l, nb, bs, cfg.num_query_groups, cfg.head_dim)
             dt = (dtype_spec.page_dtype if self.quantized
@@ -232,20 +239,34 @@ class PagedKVCache:
     # ---- placement -------------------------------------------------------
     def place_pages(self, sharding, scales_sharding=None):
         """Commit the page pools to an explicit device placement (tp
-        serving mesh: sharded on the Hkv dim so each device holds 1/tp
-        of the pool; disaggregated serving: the decode sub-mesh). int8
-        pools place their scale pools alongside (scales_sharding — same
-        mesh, Hkv on the last dim). Later jnp updates (CoW copy, the
-        engine's scatter/append jits) preserve the committed sharding by
-        propagation."""
+        serving mesh: sharded on the Hkv dim — MLA: latent columns —
+        so each device holds 1/tp of the pool; disaggregated serving:
+        the decode sub-mesh). Quantized pools place their scale pools
+        alongside (scales_sharding). `sharding` / `scales_sharding` may
+        each be a single sharding applied to every pool, OR a sequence
+        with one entry per pool (the MLA tp layout shards the latent
+        pool but replicates the pe pool). Later jnp updates (CoW copy,
+        the engine's scatter/append jits) preserve the committed
+        sharding by propagation."""
         import jax
+
+        def _per_pool(sh, n):
+            if isinstance(sh, (list, tuple)):
+                assert len(sh) == n, (len(sh), n)
+                return tuple(sh)
+            return (sh,) * n
+
+        data_sh = _per_pool(sharding, len(self.pages))
         # manual-ok: host-side pool placement, no manual region
-        self.pages = tuple(jax.device_put(p, sharding) for p in self.pages)
+        self.pages = tuple(jax.device_put(p, s)
+                           for p, s in zip(self.pages, data_sh))
         if self.scales is not None:
+            sc_sh = _per_pool(scales_sharding if scales_sharding is not None
+                              else sharding, len(self.scales))
             self.scales = tuple(
                 # manual-ok: host-side pool placement, no manual region
-                jax.device_put(s, scales_sharding or sharding)
-                for s in self.scales)
+                jax.device_put(s, sh)
+                for s, sh in zip(self.scales, sc_sh))
 
     # ---- sizing ----------------------------------------------------------
     def _arrays(self):

@@ -157,6 +157,16 @@ class PagedSpec:
     group: int
     scale: float
     kv_tile: tuple = (16, 128)
+    # MLA latent layout (ISSUE 17): pages hold [block, klat] latent +
+    # [block, dpe] roped-key blocks with NO per-head axis; hkv carries
+    # the QUERY head count (every head attends the one shared latent,
+    # group == 1) and the kernel contracts q_lat · latent^T + q_pe ·
+    # k_pe^T directly, re-expanding the value path per-tile through
+    # kv_up's v columns ([klat, nq, dv] kernel operand).
+    latent: bool = False
+    klat: int = 0
+    dpe: int = 0
+    dv: int = 0
 
     @property
     def quantized(self) -> bool:
@@ -176,6 +186,17 @@ class PagedSpec:
             raise ValueError(
                 f"kv_tile must be (sublane, lane) with lane a multiple "
                 f"of 128, got {self.kv_tile!r}")
+        if self.latent:
+            if self.klat <= 0 or self.dpe <= 0 or self.dv <= 0:
+                raise ValueError(
+                    f"latent specs need klat/dpe/dv > 0, got "
+                    f"({self.klat}, {self.dpe}, {self.dv})")
+            if self.group != 1:
+                raise ValueError(
+                    "latent specs have no GQA grouping (every query "
+                    f"head shares the one latent row): group="
+                    f"{self.group} must be 1, with hkv carrying the "
+                    "query head count")
 
 
 def emit_paged_kernel(spec: PagedSpec):
@@ -189,6 +210,8 @@ def emit_paged_kernel(spec: PagedSpec):
     tail; at q_len == 1 the math collapses to the decode body's exact
     block/accumulator order — the two legacy variants were the
     ragged=False / ragged=True points of this one template."""
+    if spec.latent:
+        return emit_latent_kernel(spec)
     bs = spec.block_size
     mbs = spec.num_blocks_seq
     hkv, group, s_q = spec.hkv, spec.group, spec.s_q
@@ -310,6 +333,467 @@ def emit_paged_kernel(spec: PagedSpec):
                 o_ref[0] = (acc[:] / l[:, None]).astype(o_ref.dtype)
 
     return kernel
+
+
+def emit_latent_kernel(spec: PagedSpec):
+    """Emit the MLA latent-space body for a latent `spec` (ISSUE 17).
+
+    Same grid / online-softmax / causal-tail scaffolding as the dense
+    template, but the pool blocks are the COMPRESSED run ([bs, klat]
+    latent + [bs, dpe] roped shared key, no per-head axis) and the
+    score contraction runs directly in latent space: the caller absorbs
+    q_nope through kv_up's k_nope columns so block scores are
+    q_lat · latent^T + q_pe · k_pe^T. The value path re-expands THIS
+    tile's v rows in-register (dequantized latent block × kv_up's v
+    columns) — the dense [B, S_kv, nq, dqk+dv] reconstitution the old
+    mla_forward gather paid every step never materializes. Rows are
+    s_q * nq with row = s*nq + h (group == 1: every head shares the
+    latent row, so no GQA fold)."""
+    bs = spec.block_size
+    mbs = spec.num_blocks_seq
+    nq, s_q = spec.hkv, spec.s_q
+    klat, dpe, dv = spec.klat, spec.dpe, spec.dv
+    rows = s_q * nq
+    ragged, quantized = spec.ragged, spec.quantized
+    scale = spec.scale
+
+    def kernel(*refs):
+        if ragged:
+            table_ref, lens_ref, qlens_ref = refs[:3]
+            rest = refs[3:]
+        else:
+            table_ref, lens_ref = refs[:2]
+            rest = refs[2:]
+        del table_ref  # indirection is consumed by the BlockSpec index maps
+        ql_ref, qp_ref, lat_ref, pe_ref = rest[:4]
+        rest = rest[4:]
+        if quantized:
+            ls_ref, ps_ref = rest[:2]
+            rest = rest[2:]
+        wv_ref, o_ref, acc, m_scr, l_scr = rest
+        b = pl.program_id(0)
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            acc[:] = jnp.zeros_like(acc)
+            m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+            l_scr[:] = jnp.zeros_like(l_scr)
+
+        kv_len = lens_ref[b]
+        if ragged:
+            q_len = qlens_ref[b]
+            q_start = kv_len - q_len   # absolute position of local query 0
+
+        @pl.when(j * bs < kv_len)
+        def _compute():
+            ql = ql_ref[0].astype(jnp.float32).reshape(rows, klat) * scale
+            qp = qp_ref[0].astype(jnp.float32).reshape(rows, dpe) * scale
+            if quantized:
+                # Per-ROW scalar scales ([bs] fp32): the whole latent
+                # row quantizes as one unit (quantize_kv_rows over the
+                # trailing dim — no head axis to split on).
+                lat = lat_ref[0].astype(jnp.float32) * ls_ref[0][:, None]
+                pe = pe_ref[0].astype(jnp.float32) * ps_ref[0][:, None]
+            else:
+                lat = lat_ref[0]                          # [bs, klat]
+                pe = pe_ref[0]                            # [bs, dpe]
+            s2 = (jnp.dot(ql.astype(lat.dtype), lat.T,   # [rows, bs]
+                          preferred_element_type=jnp.float32)
+                  + jnp.dot(qp.astype(pe.dtype), pe.T,
+                            preferred_element_type=jnp.float32))
+            pos = j * bs + jax.lax.broadcasted_iota(
+                jnp.int32, (1, bs), 1)[0]
+            if ragged:
+                row_q = jax.lax.broadcasted_iota(
+                    jnp.int32, (rows, 1), 0)[:, 0] // nq
+                abs_q = q_start + row_q                   # [rows]
+                valid = ((pos[None, :] <= abs_q[:, None])
+                         & (pos[None, :] < kv_len))       # [rows, bs]
+            else:
+                valid = jnp.broadcast_to(pos[None, :] < kv_len,
+                                         (rows, bs))
+            s2 = jnp.where(valid, s2, _NEG_INF)
+
+            m_prev = m_scr[:, 0]
+            m_new = jnp.maximum(m_prev, jnp.max(s2, axis=1))
+            m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+            p = jnp.exp(s2 - m_safe[:, None])
+            p = jnp.where(valid, p, 0.0)
+            corr = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+            corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
+            l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=1)
+            # Value path, re-expanded per-tile in-register: v rows of
+            # THIS block from the (dequantized) latent block through
+            # kv_up's v columns.
+            wv = wv_ref[...]
+            v_t = jax.lax.dot_general(                    # [bs, nq, dv]
+                lat, wv.astype(lat.dtype),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            v3 = jnp.swapaxes(v_t, 0, 1)                  # [nq, bs, dv]
+            p3 = jnp.transpose(p.reshape(s_q, nq, bs), (1, 0, 2))
+            pv = jax.lax.dot_general(                     # [nq, s_q, dv]
+                p3.astype(v3.dtype), v3,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            pv2 = jnp.transpose(pv, (1, 0, 2)).reshape(rows, dv)
+            acc[:] = acc[:] * corr[:, None] + pv2
+            m_scr[:, 0] = m_new
+
+        @pl.when(j == mbs - 1)
+        def _finalize():
+            l = jnp.maximum(l_scr[:, 0], 1e-20)
+            a = acc[:] / l[:, None]
+            if ragged:
+                o_ref[0] = a.reshape(s_q, nq, dv).astype(o_ref.dtype)
+            else:
+                o_ref[0] = a.reshape(nq, dv).astype(o_ref.dtype)
+
+    return kernel
+
+
+def paged_attention_latent(q_lat: jnp.ndarray, q_pe: jnp.ndarray,
+                           lat_pages: jnp.ndarray, pe_pages: jnp.ndarray,
+                           page_table: jnp.ndarray, kv_lens: jnp.ndarray,
+                           w_v: jnp.ndarray,
+                           q_lens: Optional[jnp.ndarray] = None,
+                           softmax_scale: Optional[float] = None,
+                           lat_scales: Optional[jnp.ndarray] = None,
+                           pe_scales: Optional[jnp.ndarray] = None,
+                           mesh=None) -> jnp.ndarray:
+    """MLA latent-space ragged paged attention with absorbed q weights
+    (ISSUE 17 tentpole) — the latent-family entry point.
+
+    q_lat [B, nq, klat] (decode) or [B, S_q, nq, klat] with q_lens [B]
+    (ragged multi-query): the ABSORBED query — q_nope (× YaRN mscale²
+    when active) contracted through kv_up's k_nope columns, so block
+    scores form directly in latent space. q_pe [..., nq, dpe]: the
+    roped decoupled heads. lat_pages [NB, bs, klat] / pe_pages
+    [NB, bs, dpe]: the compressed pool (NO per-head axis). w_v
+    [klat, nq, dv]: kv_up's v columns — the value path re-expands per
+    DMA'd tile in-register. lat_scales/pe_scales [NB, bs] fp32 mark
+    int8/fp8 pools (per-ROW scalar scales). softmax_scale is REQUIRED:
+    the MLA scale 1/sqrt(dqk + dpe) is not derivable from the latent
+    width. mesh: latent-COLUMN-shard over the tp axis (_tp_place_latent
+    — MLA has no KV heads to split); callers gate on tp_paged_eligible.
+    Returns [B(, S_q), nq, dv] in q_lat's dtype."""
+    ragged = q_lens is not None
+    if softmax_scale is None:
+        raise ValueError(
+            "paged_attention_latent requires softmax_scale: the MLA "
+            "scale is 1/sqrt(qk_head_dim + qk_pos_emb_head_dim), which "
+            "cannot be derived from the latent width")
+    if mesh is not None:
+        return _tp_place_latent(q_lat, q_pe, lat_pages, pe_pages,
+                                page_table, kv_lens, w_v, q_lens,
+                                softmax_scale, lat_scales, pe_scales,
+                                mesh)
+    if ragged:
+        b, s_q, nq, klat = q_lat.shape
+    else:
+        b, nq, klat = q_lat.shape
+        s_q = 1
+    dpe = q_pe.shape[-1]
+    dv = w_v.shape[-1]
+    nb, bs, _ = lat_pages.shape
+    mb = page_table.shape[1]
+    quantized = lat_scales is not None
+    quant_dtype = quant_dtype_of(lat_pages.dtype) if quantized else None
+    if quantized and quant_dtype is None:
+        raise ValueError(
+            f"scales passed but latent page dtype {lat_pages.dtype} is "
+            f"not a registered quantized storage format "
+            f"({sorted(QUANT_DTYPES)})")
+    spec = PagedSpec(ragged=ragged, quant_dtype=quant_dtype, s_q=s_q,
+                     block_size=bs, num_blocks_seq=mb, hkv=nq, group=1,
+                     scale=float(softmax_scale),
+                     kv_tile=default_kv_tile(quant_dtype),
+                     latent=True, klat=klat, dpe=dpe, dv=dv)
+    kernel = emit_paged_kernel(spec)
+
+    lat_spec = pl.BlockSpec((1, bs, klat),
+                            lambda b_, j, t, *_: (t[b_, j], 0, 0))
+    pe_spec = pl.BlockSpec((1, bs, dpe),
+                           lambda b_, j, t, *_: (t[b_, j], 0, 0))
+    if ragged:
+        ql_spec = pl.BlockSpec((1, s_q, nq, klat),
+                               lambda b_, j, *_: (b_, 0, 0, 0))
+        qp_spec = pl.BlockSpec((1, s_q, nq, dpe),
+                               lambda b_, j, *_: (b_, 0, 0, 0))
+        o_spec = pl.BlockSpec((1, s_q, nq, dv),
+                              lambda b_, j, *_: (b_, 0, 0, 0))
+        out_shape = (b, s_q, nq, dv)
+    else:
+        ql_spec = pl.BlockSpec((1, nq, klat),
+                               lambda b_, j, *_: (b_, 0, 0))
+        qp_spec = pl.BlockSpec((1, nq, dpe),
+                               lambda b_, j, *_: (b_, 0, 0))
+        o_spec = pl.BlockSpec((1, nq, dv), lambda b_, j, *_: (b_, 0, 0))
+        out_shape = (b, nq, dv)
+    in_specs = [ql_spec, qp_spec, lat_spec, pe_spec]
+    operands = [q_lat, q_pe, lat_pages, pe_pages]
+    if quantized:
+        sc_spec = pl.BlockSpec((1, bs),
+                               lambda b_, j, t, *_: (t[b_, j], 0))
+        in_specs += [sc_spec, sc_spec]
+        operands += [lat_scales, pe_scales]
+    in_specs.append(pl.BlockSpec(w_v.shape, lambda b_, j, *_: (0, 0, 0)))
+    operands.append(w_v)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3 if ragged else 2,
+        grid=(b, mb),
+        in_specs=in_specs,
+        out_specs=o_spec,
+        scratch_shapes=[
+            pltpu.VMEM((s_q * nq, dv), jnp.float32),
+            pltpu.VMEM((s_q * nq, 1), jnp.float32),
+            pltpu.VMEM((s_q * nq, 1), jnp.float32),
+        ],
+    )
+    prefetch = [page_table.astype(jnp.int32), kv_lens.astype(jnp.int32)]
+    if ragged:
+        prefetch.append(q_lens.astype(jnp.int32))
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(out_shape, q_lat.dtype),
+        interpret=_interpret(),
+    )(*prefetch, *operands)
+
+
+def _latent_block_scores(q, pages, page_table, kv_lens, scales=None):
+    """Phase 1 of the latent-column tp path: ALL block scores
+    q · pages^T over the page table — q [B, rows, d] × pages [NB, bs, d]
+    → [B, rows, MB*bs] fp32, NO softmax. Out-of-range blocks write 0 so
+    the cross-shard psum of klat-column partials stays finite; the
+    caller masks before its fp32 softmax. scales [NB, bs] fp32 mark a
+    quantized pool (per-row scalar scales compose multiplicatively with
+    column shards, so per-shard dequant partials sum exactly)."""
+    b, rows, d = q.shape
+    nb, bs, _ = pages.shape
+    mb = page_table.shape[1]
+    quantized = scales is not None
+
+    def kernel(*refs):
+        table_ref, lens_ref, q_ref, kv_ref = refs[:4]
+        rest = refs[4:]
+        if quantized:
+            sc_ref, o_ref = rest
+        else:
+            o_ref, = rest
+        del table_ref
+        b_ = pl.program_id(0)
+        j = pl.program_id(1)
+        kv_len = lens_ref[b_]
+
+        @pl.when(j * bs < kv_len)
+        def _compute():
+            if quantized:
+                kv = kv_ref[0].astype(jnp.float32) * sc_ref[0][:, None]
+            else:
+                kv = kv_ref[0]
+            o_ref[0] = jnp.dot(q_ref[0].astype(kv.dtype), kv.T,
+                               preferred_element_type=jnp.float32)
+
+        @pl.when(j * bs >= kv_len)
+        def _zero():
+            o_ref[0] = jnp.zeros_like(o_ref)[0]
+
+    kv_spec = pl.BlockSpec((1, bs, d),
+                           lambda b_, j, t, *_: (t[b_, j], 0, 0))
+    q_spec = pl.BlockSpec((1, rows, d), lambda b_, j, *_: (b_, 0, 0))
+    in_specs = [q_spec, kv_spec]
+    operands = [q, pages]
+    if quantized:
+        in_specs.append(pl.BlockSpec((1, bs),
+                                     lambda b_, j, t, *_: (t[b_, j], 0)))
+        operands.append(scales)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, rows, bs), lambda b_, j, *_: (b_, 0, j)),
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, rows, mb * bs), jnp.float32),
+        interpret=_interpret(),
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), *operands)
+
+
+def _latent_block_wsum(p, pages, page_table, kv_lens, w_v, scales=None):
+    """Phase 2 of the latent-column tp path: probability-weighted value
+    sum over the page table with the per-tile in-register re-expansion
+    — p [B, rows, MB*bs] fp32 (masked softmax, zeros past each row's
+    run) × pages [NB, bs, klat_local] through w_v [klat_local, nq, dv]
+    → [B, rows, dv] fp32 partials (the caller psums over the klat
+    shards)."""
+    b, rows, _ = p.shape
+    nb, bs, _ = pages.shape
+    mb = page_table.shape[1]
+    nq, dv = w_v.shape[1], w_v.shape[2]
+    s_q = rows // nq
+    quantized = scales is not None
+    mbs_ = mb
+
+    def kernel(*refs):
+        table_ref, lens_ref, p_ref, kv_ref = refs[:4]
+        rest = refs[4:]
+        if quantized:
+            sc_ref, wv_ref, o_ref, acc = rest
+        else:
+            wv_ref, o_ref, acc = rest
+        del table_ref
+        b_ = pl.program_id(0)
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            acc[:] = jnp.zeros_like(acc)
+
+        kv_len = lens_ref[b_]
+
+        @pl.when(j * bs < kv_len)
+        def _compute():
+            if quantized:
+                lat = kv_ref[0].astype(jnp.float32) * sc_ref[0][:, None]
+            else:
+                lat = kv_ref[0]
+            wv = wv_ref[...]
+            v_t = jax.lax.dot_general(                    # [bs, nq, dv]
+                lat, wv.astype(lat.dtype),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            v3 = jnp.swapaxes(v_t, 0, 1)                  # [nq, bs, dv]
+            p3 = jnp.transpose(p_ref[0].reshape(s_q, nq, bs), (1, 0, 2))
+            pv = jax.lax.dot_general(                     # [nq, s_q, dv]
+                p3.astype(v3.dtype), v3,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            acc[:] += jnp.transpose(pv, (1, 0, 2)).reshape(rows, dv)
+
+        @pl.when(j == mbs_ - 1)
+        def _finalize():
+            o_ref[0] = acc[:]
+
+    kv_spec = pl.BlockSpec((1, bs, pages.shape[-1]),
+                           lambda b_, j, t, *_: (t[b_, j], 0, 0))
+    p_spec = pl.BlockSpec((1, rows, bs), lambda b_, j, *_: (b_, 0, j))
+    in_specs = [p_spec, kv_spec]
+    operands = [p, pages]
+    if quantized:
+        in_specs.append(pl.BlockSpec((1, bs),
+                                     lambda b_, j, t, *_: (t[b_, j], 0)))
+        operands.append(scales)
+    in_specs.append(pl.BlockSpec(w_v.shape, lambda b_, j, *_: (0, 0, 0)))
+    operands.append(w_v)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, rows, dv), lambda b_, j, *_: (b_, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((rows, dv), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, rows, dv), jnp.float32),
+        interpret=_interpret(),
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), *operands)
+
+
+def _tp_place_latent(q_lat, q_pe, lat_pages, pe_pages, page_table,
+                     kv_lens, w_v, q_lens, softmax_scale, lat_scales,
+                     pe_scales, mesh):
+    """Latent-COLUMN sharded placement of the MLA kernel family: MLA
+    has no KV heads to split, so the tp axis shards the klat dim of the
+    latent pool, the absorbed query, and kv_up's v rows (q_pe / pe
+    pages / per-row scales / table / lens stay replicated — the rope
+    head and the scalar scales have no latent columns). The softmax
+    couples every latent column, so the body runs TWO emitted kernels
+    around a replicated fp32 softmax: block scores (nope partials
+    psum'd over shards + replicated pe scores) → host mask/softmax →
+    weighted value sum (dv partials psum'd). The latent pool is read
+    once per phase; the output is fully replicated (the psum), so the
+    out-projection runs identically on every device and per-request
+    streams stay engine-exact."""
+    from jax.sharding import PartitionSpec as P
+
+    from megatronapp_tpu.config.parallel_config import TP_AXIS
+    from megatronapp_tpu.parallel.collectives import psum, shard_map_compat
+
+    ragged = q_lens is not None
+    if ragged:
+        b, s_q, nq, klat = q_lat.shape
+    else:
+        b, nq, klat = q_lat.shape
+        s_q = 1
+    dv = w_v.shape[-1]
+    rows = s_q * nq
+    mb = page_table.shape[1]
+    bs = lat_pages.shape[1]
+    quantized = lat_scales is not None
+    out_dtype = q_lat.dtype
+
+    q_sh = (P(None, None, None, TP_AXIS) if ragged
+            else P(None, None, TP_AXIS))
+    q_rep = (P(None, None, None, None) if ragged else P(None, None, None))
+    pool_sh = P(None, None, TP_AXIS)
+    pool_rep = P(None, None, None)
+    rep2, rep1 = P(None, None), P(None)
+    out_sh = (P(None, None, None, None) if ragged else P(None, None, None))
+
+    in_specs = [q_sh, q_rep, pool_sh, pool_rep, rep2, rep1,
+                P(TP_AXIS, None, None)]
+    operands = [q_lat, q_pe, lat_pages, pe_pages, page_table, kv_lens,
+                w_v]
+    if ragged:
+        in_specs.append(rep1)
+        operands.append(q_lens)
+    if quantized:
+        in_specs += [rep2, rep2]
+        operands += [lat_scales, pe_scales]
+
+    def body(*args):
+        it = iter(args)
+        ql_, qp_, lat_, pe_, t_, l_, wv_ = (next(it) for _ in range(7))
+        qlens_ = next(it) if ragged else None
+        ls_ = ps_ = None
+        if quantized:
+            ls_, ps_ = next(it), next(it)
+        # fp32 flat rows with the softmax scale applied up front (the
+        # shard partials must carry it identically).
+        qlf = (ql_.astype(jnp.float32) * softmax_scale).reshape(
+            b, rows, -1)
+        qpf = (qp_.astype(jnp.float32) * softmax_scale).reshape(
+            b, rows, -1)
+        s_nope = _latent_block_scores(qlf, lat_, t_, l_, ls_)
+        s_nope = psum(s_nope, TP_AXIS)
+        # pe scores are replicated work (dpe is tiny) — identical on
+        # every shard, no psum.
+        s = s_nope + _latent_block_scores(qpf, pe_, t_, l_, ps_)
+        pos = jnp.arange(mb * bs, dtype=jnp.int32)[None, None, :]
+        if ragged:
+            row_q = (jnp.arange(rows, dtype=jnp.int32)
+                     // nq)[None, :, None]
+            abs_q = (l_ - qlens_)[:, None, None] + row_q
+        else:
+            abs_q = (l_ - 1)[:, None, None]
+        valid = (pos <= abs_q) & (pos < l_[:, None, None])
+        s = jnp.where(valid, s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        pr = jnp.exp(s - jnp.maximum(m, _NEG_INF / 2))
+        pr = jnp.where(valid, pr, 0.0)
+        pr = pr / jnp.maximum(jnp.sum(pr, axis=-1, keepdims=True), 1e-20)
+        out = _latent_block_wsum(pr, lat_, t_, l_, wv_, ls_)
+        out = psum(out, TP_AXIS).astype(out_dtype)
+        return (out.reshape(b, s_q, nq, dv) if ragged
+                else out.reshape(b, nq, dv))
+
+    # manual-ok: full-manual kernel placement; the only collectives are
+    # the two psums over the klat shards. tp_paged_eligible callers
+    # gate on no ambient manual axes.
+    return shard_map_compat(body, mesh, in_specs=tuple(in_specs),
+                            out_specs=out_sh)(*operands)
 
 
 def paged_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
@@ -852,6 +1336,140 @@ def _fused_qkv(x, attn_p, cfg, cos, sin, tiles=None):
     )(*operands)
 
 
+def _mla_qkv_bytes(cfg, rows, w_item, act_item):
+    """Operand bytes of the no-grid fused MLA QKV prologue — shared by
+    _fused_mla_qkv's budget check and megakernel_ineligible_reason so
+    eligibility and emission cannot drift. MLA prologue weights are
+    never resident-quantized (quantization.RESIDENT_KERNELS is
+    name-gated and carries none of q_down/q_up/q_proj/kv_down/kv_up),
+    so one itemsize covers them all."""
+    h = cfg.hidden_size
+    nq = cfg.num_attention_heads
+    dqk, dpe, dv = cfg.qk_head_dim, cfg.qk_pos_emb_head_dim, cfg.v_head_dim
+    klat, qlr = cfg.kv_lora_rank, cfg.q_lora_rank
+    if qlr:
+        wb = h * qlr + qlr + qlr * nq * (dqk + dpe)
+    else:
+        wb = h * nq * (dqk + dpe)
+    wb += h * (klat + dpe) + klat + klat * nq * (dqk + dv)
+    ins = rows * h
+    outs = rows * (nq * klat + nq * dpe + klat + dpe)
+    rope = 2 * rows * (dpe // 2) * 4
+    return wb * w_item + (ins + outs) * act_item + h * 4 + rope
+
+
+def _fused_mla_qkv(x, attn_p, cfg, cos, sin):
+    """The MLA megakernel prologue (ISSUE 17 carve-out c), ONE no-grid
+    kernel: pre-attention norm → q path (q_proj, or q_down → rms →
+    q_up) → split/rope the decoupled q_pe heads → ABSORB q_nope through
+    kv_up's k_nope columns (× YaRN mscale² when active — the cached
+    latent is unscaled, so the absorbed query carries both factors) →
+    kv_down → split → rms-normed latent → roped shared k_pe row.
+
+    x [B*, H] (residual dtype; B* = decode batch rows or B·S flattened
+    ragged rows) with per-row rope tables [B*, dpe/2]; returns
+    (q_lat [B*, nq, klat], q_pe [B*, nq, dpe], latent [B*, klat],
+    k_pe [B*, dpe]) in compute dtype — exactly the operands
+    paged_attention_latent and the append scatter consume. Math is
+    op-for-op the unfused mla_forward paged prologue (same einsum
+    absorption, same norm/rope formulas), so fused MLA streams stay
+    token-exact. No grid: megakernel_ineligible_reason gates callers
+    on _mla_qkv_bytes before tracing."""
+    from megatronapp_tpu.config.transformer_config import (
+        NormKind, PositionEmbeddingKind,
+    )
+    from megatronapp_tpu.ops import rotary
+    from megatronapp_tpu.ops.normalization import apply_norm, rms_norm
+
+    b, h = x.shape
+    nq = cfg.num_attention_heads
+    dqk, dpe, dv = cfg.qk_head_dim, cfg.qk_pos_emb_head_dim, cfg.v_head_dim
+    klat = cfg.kv_lora_rank
+    cdt = cfg.compute_dtype
+    eps = cfg.layernorm_epsilon
+    kind = cfg.normalization
+    has_ln_bias = kind == NormKind.layernorm
+    has_rope = cos is not None
+    has_q_lora = "q_down" in attn_p
+    m2 = 1.0
+    if cfg.position_embedding == PositionEmbeddingKind.yarn:
+        m = rotary.yarn_mscale(cfg.rope_scaling_factor,
+                               cfg.yarn_mscale_coeff)
+        m2 = m * m
+
+    budget = get_megakernel_vmem_budget()
+    need = _mla_qkv_bytes(cfg, b, jnp.dtype(cfg.params_dtype).itemsize,
+                          jnp.dtype(cdt).itemsize)
+    if need > budget:
+        raise ValueError(
+            "fused MLA QKV prologue exceeds the VMEM budget — "
+            "megakernel_ineligible_reason gates callers before tracing")
+
+    operands = [x, attn_p["ln1_scale"]]
+    if has_ln_bias:
+        operands.append(attn_p["ln1_bias"])
+    if has_q_lora:
+        operands += [attn_p["q_down"], attn_p["q_ln_scale"],
+                     attn_p["q_up"]]
+    else:
+        operands.append(attn_p["q_proj"])
+    operands += [attn_p["kv_down"], attn_p["kv_ln_scale"],
+                 attn_p["kv_up"]]
+    if has_rope:
+        operands += [cos, sin]
+
+    def kernel(*refs):
+        it = iter(refs)
+        x_ref = next(it)
+        ln_s = next(it)
+        ln_b = next(it) if has_ln_bias else None
+        if has_q_lora:
+            qd_ref, qln_ref, qu_ref = next(it), next(it), next(it)
+        else:
+            qp_ref = next(it)
+        kvd_ref, kvln_ref, kvu_ref = next(it), next(it), next(it)
+        cos_ref = next(it) if has_rope else None
+        sin_ref = next(it) if has_rope else None
+        ql_out, qpe_out, lat_out, pe_out = (next(it), next(it),
+                                            next(it), next(it))
+
+        xn = apply_norm(kind, x_ref[...], ln_s[...],
+                        ln_b[...] if ln_b is not None else None, eps)
+        xn = xn.astype(cdt)
+        if has_q_lora:
+            q0 = xn @ qd_ref[...].astype(cdt)
+            q0 = rms_norm(q0, qln_ref[...], eps)
+            qf = q0 @ qu_ref[...].astype(cdt)
+        else:
+            qf = xn @ qp_ref[...].astype(cdt)
+        qf = qf.reshape(b, nq, dqk + dpe)
+        q_nope, q_pe = qf[..., :dqk], qf[..., dqk:]
+        if has_rope:
+            q_pe = _rope_rows(q_pe, cos_ref[...], sin_ref[...])
+        kv = xn @ kvd_ref[...].astype(cdt)
+        lat_row, pe_row = kv[..., :klat], kv[..., klat:]
+        lat_row = rms_norm(lat_row, kvln_ref[...], eps)
+        if has_rope:
+            pe_row = _rope_rows(pe_row[:, None, :], cos_ref[...],
+                                sin_ref[...])[:, 0]
+        wk = kvu_ref[...].astype(cdt).reshape(
+            klat, nq, dqk + dv)[..., :dqk]
+        q_abs = q_nope * m2 if m2 != 1.0 else q_nope
+        ql_out[...] = jnp.einsum("bnd,knd->bnk", q_abs, wk)
+        qpe_out[...] = q_pe
+        lat_out[...] = lat_row
+        pe_out[...] = pe_row
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((b, nq, klat), cdt),
+                   jax.ShapeDtypeStruct((b, nq, dpe), cdt),
+                   jax.ShapeDtypeStruct((b, klat), cdt),
+                   jax.ShapeDtypeStruct((b, dpe), cdt)],
+        interpret=_interpret(),
+    )(*operands)
+
+
 def _fused_out_proj(attn_flat, attn_p, cfg, residual, tiles=None):
     """Attention epilogue in ONE kernel: out projection + bias +
     residual add (the paged-attention output arrives head-flat
@@ -1159,6 +1777,90 @@ def _fused_mlp_fc2(y, x, p, cfg, t):
     )(*operands)
 
 
+def _fused_mla_layer(p, x, cfg, rope_cos, rope_sin, kv_cache,
+                     cache_positions, counts, page_table, active,
+                     kv_scales=None):
+    """One MLA layer as fused kernels (ISSUE 17 carve-out c): [fused
+    MLA prologue — norm + q path + rope + absorption + latent/k_pe] →
+    [compressed append scatter] → [generated latent-space paged kernel]
+    → [fused out-proj + residual] → [fused norm+MLP + residual].
+
+    Handles BOTH the s == 1 decode body (counts=None) and the ragged
+    multiquery body (counts [B]) — the prologue is row-wise, so the
+    B·S flattening is bitwise-safe exactly like fused_layer_multiquery.
+    kv_scales: int8/fp8 latent pool scale pools ([L-sliced NB, bs] per
+    pool) — the new rows quantize per-row right here (ONE fused jit
+    covers prologue + quantize + scatter + attend) and new_cache
+    carries four pools."""
+    from megatronapp_tpu.ops.pallas.paged_attention import (
+        append_chunk_pages, append_token_pages, quantize_kv_rows,
+    )
+    b, s, h = x.shape
+    nq = cfg.num_attention_heads
+    dqk, dpe, dv = cfg.qk_head_dim, cfg.qk_pos_emb_head_dim, cfg.v_head_dim
+    klat = cfg.kv_lora_rank
+    dt = cfg.compute_dtype
+    attn_p = p["attention"]
+    ragged = counts is not None
+    xf = x.reshape(b * s, h)
+    cos = rope_cos.reshape(b * s, -1) if rope_cos is not None else None
+    sin = rope_sin.reshape(b * s, -1) if rope_sin is not None else None
+
+    q_lat, q_pe, lat, pe = _fused_mla_qkv(
+        xf, {**attn_p, "ln1_scale": p["ln1_scale"],
+             **({"ln1_bias": p["ln1_bias"]} if "ln1_bias" in p else {})},
+        cfg, cos, sin)
+    w_v = attn_p["kv_up"].astype(dt).reshape(
+        klat, nq, dqk + dv)[..., dqk:]
+
+    c_lat, c_pe = kv_cache
+    if active is None:
+        active = jnp.ones((b,), bool)
+    if ragged:
+        lat_r, pe_r = lat.reshape(b, s, klat), pe.reshape(b, s, dpe)
+
+        def _append(pool, rows_):
+            return append_chunk_pages(pool, rows_, page_table,
+                                      cache_positions, counts, active)
+    else:
+        lat_r, pe_r = lat[:, None], pe[:, None]
+
+        def _append(pool, rows_):
+            return append_token_pages(pool, rows_[:, 0], page_table,
+                                      cache_positions, active)
+
+    if kv_scales is not None:
+        ls_p, ps_p = kv_scales
+        lat_q, lat_s = quantize_kv_rows(lat_r, dtype=c_lat.dtype)
+        pe_q, pe_s = quantize_kv_rows(pe_r, dtype=c_pe.dtype)
+        c_lat = _append(c_lat, lat_q)
+        c_pe = _append(c_pe, pe_q)
+        ls_p = _append(ls_p, lat_s)
+        ps_p = _append(ps_p, pe_s)
+        new_cache = (c_lat, c_pe, ls_p, ps_p)
+        sc_kw = {"lat_scales": ls_p, "pe_scales": ps_p}
+    else:
+        c_lat = _append(c_lat, lat_r.astype(c_lat.dtype))
+        c_pe = _append(c_pe, pe_r.astype(c_pe.dtype))
+        new_cache = (c_lat, c_pe)
+        sc_kw = {}
+
+    scale = 1.0 / float((dqk + dpe) ** 0.5)
+    if ragged:
+        attn = paged_attention_latent(
+            q_lat.reshape(b, s, nq, klat), q_pe.reshape(b, s, nq, dpe),
+            c_lat, c_pe, page_table, cache_positions + counts, w_v,
+            q_lens=counts, softmax_scale=scale, **sc_kw)
+    else:
+        attn = paged_attention_latent(
+            q_lat, q_pe, c_lat, c_pe, page_table, cache_positions + 1,
+            w_v, softmax_scale=scale, **sc_kw)        # [B, nq, dv]
+    x2 = _fused_out_proj(attn.reshape(b * s, nq * dv), attn_p, cfg, xf)
+    x2 = _fused_mlp(x2, p, cfg)
+    out = x2[:, None] if not ragged else x2.reshape(b, s, h)
+    return (out, new_cache), None
+
+
 def fused_layer_decode(p, x, cfg, rope_cos, rope_sin, kv_cache,
                        cache_positions, page_table, active,
                        kv_scales=None):
@@ -1177,6 +1879,10 @@ def fused_layer_decode(p, x, cfg, rope_cos, rope_sin, kv_cache,
     )
     b = x.shape[0]
     assert x.shape[1] == 1, "fused_layer_decode is the s == 1 decode body"
+    if cfg.multi_latent_attention:
+        return _fused_mla_layer(p, x, cfg, rope_cos, rope_sin, kv_cache,
+                                cache_positions, None, page_table,
+                                active, kv_scales=kv_scales)
     nq, d = cfg.num_attention_heads, cfg.head_dim
     attn_p = p["attention"]
     x2 = x[:, 0]
@@ -1237,6 +1943,10 @@ def fused_layer_multiquery(p, x, cfg, rope_cos, rope_sin, kv_cache,
         append_chunk_pages, quantize_kv_rows,
     )
     b, s, h = x.shape
+    if cfg.multi_latent_attention:
+        return _fused_mla_layer(p, x, cfg, rope_cos, rope_sin, kv_cache,
+                                cache_positions, counts, page_table,
+                                active, kv_scales=kv_scales)
     nq, nkv, d = (cfg.num_attention_heads, cfg.num_query_groups,
                   cfg.head_dim)
     attn_p = p["attention"]
@@ -1308,10 +2018,6 @@ def megakernel_ineligible_reason(cfg, *, batch, tp_paged=False,
     if not paged:
         return "dense (non-paged) backend — the fused step is built " \
                "around the paged-attention kernel"
-    if cfg.multi_latent_attention:
-        return "multi_latent_attention: the MLA decode path gathers " \
-               "the latent run dense (no fused prologue yet — the " \
-               "latent-space fused kernel is the recorded follow-up)"
     if cfg.is_moe:
         return "MoE layers: expert dispatch is not fused yet"
     if getattr(cfg, "hetero_block_specs", None):
@@ -1339,8 +2045,8 @@ def megakernel_ineligible_reason(cfg, *, batch, tp_paged=False,
     attn = blk.get("attention", {}) if isinstance(blk, dict) else {}
     mlp = blk.get("mlp", {}) if isinstance(blk, dict) else {}
     h = cfg.hidden_size
-    nq, nkv, d = (cfg.num_attention_heads, cfg.num_query_groups,
-                  cfg.head_dim)
+    mla = cfg.multi_latent_attention
+    nq = cfg.num_attention_heads
     rows = max(int(batch), int(mq_rows or 0))
     act_item = jnp.dtype(cfg.compute_dtype).itemsize
     default_item = jnp.dtype(cfg.params_dtype).itemsize
@@ -1350,13 +2056,28 @@ def megakernel_ineligible_reason(cfg, *, batch, tp_paged=False,
 
     budget = get_megakernel_vmem_budget()
     flag = "raise --megakernel-vmem-budget to fuse anyway"
-    if not _qkv_tiles(h, nq, nkv, d, rows, _wi(attn.get("q_kernel")),
-                      _wi(attn.get("kv_kernel")), act_item,
-                      is_resident_leaf(attn.get("q_kernel")),
-                      is_resident_leaf(attn.get("kv_kernel")), budget):
-        return (f"fused QKV kernel: one kv-head group per tile still "
-                f"exceeds the VMEM budget ({budget} B) — {flag}")
-    if not _out_tiles(h, nq * d, rows, _wi(attn.get("out_kernel")),
+    if mla:
+        # The MLA prologue (q path + absorption + latent projection +
+        # rope, _fused_mla_qkv) has no column-tiling axis — the kv_up
+        # absorption couples every head to the whole latent — so it
+        # runs no-grid only and fails as one predicate.
+        if _mla_qkv_bytes(cfg, rows, default_item, act_item) > budget:
+            return (f"fused MLA QKV prologue (q path + kv_up absorption "
+                    f"+ latent projection) exceeds the VMEM budget "
+                    f"({budget} B) as one no-grid kernel — {flag}")
+        nqd = nq * cfg.v_head_dim
+    else:
+        nkv, d = cfg.num_query_groups, cfg.head_dim
+        if not _qkv_tiles(h, nq, nkv, d, rows, _wi(attn.get("q_kernel")),
+                          _wi(attn.get("kv_kernel")), act_item,
+                          is_resident_leaf(attn.get("q_kernel")),
+                          is_resident_leaf(attn.get("kv_kernel")),
+                          budget):
+            return (f"fused QKV kernel: one kv-head group per tile "
+                    f"still exceeds the VMEM budget ({budget} B) — "
+                    f"{flag}")
+        nqd = nq * d
+    if not _out_tiles(h, nqd, rows, _wi(attn.get("out_kernel")),
                       act_item, is_resident_leaf(attn.get("out_kernel")),
                       budget):
         return (f"fused out-proj kernel: one output column per tile "

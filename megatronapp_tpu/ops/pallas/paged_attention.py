@@ -48,6 +48,7 @@ import jax.numpy as jnp
 
 from megatronapp_tpu.ops.pallas.kernel_gen import (  # noqa: F401 (re-export)
     _NEG_INF, _dequant_block, _interpret, paged_attention,
+    paged_attention_latent,
 )
 
 
@@ -238,6 +239,72 @@ def paged_attention_reference(q: jnp.ndarray, k_pages: jnp.ndarray,
     return out.astype(q.dtype)
 
 
+def dequantize_latent_pages(pages: jnp.ndarray, scales: jnp.ndarray
+                            ) -> jnp.ndarray:
+    """Dense dequant of a quantized LATENT pool [NB, bs, d] with per-row
+    scalar scales [NB, bs] → fp32 (the latent row has no kv-head axis, so
+    the scale is one scalar per (block, row) — `quantize_kv_rows` over a
+    [..., d] row produces exactly this layout)."""
+    return pages.astype(jnp.float32) * scales[..., None]
+
+
+def paged_attention_latent_reference(
+        q_lat: jnp.ndarray, q_pe: jnp.ndarray, lat_pages: jnp.ndarray,
+        pe_pages: jnp.ndarray, page_table: jnp.ndarray,
+        kv_lens: jnp.ndarray, w_v: jnp.ndarray,
+        q_lens: Optional[jnp.ndarray] = None,
+        softmax_scale: Optional[float] = None,
+        lat_scales: Optional[jnp.ndarray] = None,
+        pe_scales: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Pure-jnp oracle for the latent kernel: gathers the latent/pe runs
+    DENSE through the page table (the pre-ISSUE-17 `mla_forward` decode
+    path: `gather_pages_batched` + `kv_up` re-expansion), masks, and
+    applies a plain softmax. Same signature and semantics as
+    `paged_attention_latent` — q_lat is already ABSORBED through
+    `kv_up`'s k_nope columns (and carries the YaRN mscale² if any), so
+    scores are `q_lat·latᵀ + q_pe·peᵀ` and values re-expand dense as
+    `lat @ w_v`. Quantized pools dequantize dense (per-row scalar
+    scales)."""
+    if softmax_scale is None:
+        raise ValueError(
+            "paged_attention_latent_reference requires softmax_scale — the "
+            "MLA scale is 1/sqrt(dqk + dpe), which cannot be derived from "
+            "the latent width")
+    decode = q_lens is None
+    if decode:
+        q_lat = q_lat[:, None]
+        q_pe = q_pe[:, None]
+    b, s_q, nq, klat = q_lat.shape
+    bs = lat_pages.shape[1]
+    mb = page_table.shape[1]
+    dv = w_v.shape[-1]
+    if lat_scales is not None:
+        lat_pages = dequantize_latent_pages(lat_pages, lat_scales)
+        pe_pages = dequantize_latent_pages(pe_pages, pe_scales)
+    lat = lat_pages[page_table].reshape(b, mb * bs, klat)
+    pe = pe_pages[page_table].reshape(b, mb * bs, -1)
+    s = (jnp.einsum("bqnk,bsk->bqns", q_lat.astype(jnp.float32),
+                    lat.astype(jnp.float32))
+         + jnp.einsum("bqnp,bsp->bqns", q_pe.astype(jnp.float32),
+                      pe.astype(jnp.float32))) * softmax_scale
+    pos = jnp.arange(mb * bs)
+    if decode:
+        mask = pos[None, None, :] < kv_lens[:, None, None]      # [B,1,S]
+        mask = mask[:, :, None, :]
+    else:
+        abs_q = (kv_lens - q_lens)[:, None] + jnp.arange(s_q)[None, :]
+        mask = ((pos[None, None, :] <= abs_q[:, :, None])
+                & (pos[None, None, :] < kv_lens[:, None, None]))
+        mask = mask[:, :, None, :]
+    s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    v = jnp.einsum("bsk,knd->bsnd", lat.astype(jnp.float32),
+                   w_v.astype(jnp.float32))
+    out = jnp.einsum("bqns,bsnd->bqnd", p, v)
+    out = out.astype(q_lat.dtype)
+    return out[:, 0] if decode else out
+
+
 # ---------------------------------------------------------------------------
 # Page write / gather helpers (jit-able; `mode="drop"` keeps every invalid
 # position out of the pool instead of clamping onto live blocks)
@@ -331,21 +398,24 @@ def gather_pages_batched(pages: jnp.ndarray, page_table: jnp.ndarray
 
 
 def tp_paged_ineligible_reason(cfg, ctx) -> Optional[str]:
-    """Why the paged kernels may NOT run head-sharded on ctx's tp axis —
+    """Why the paged kernels may NOT run sharded on ctx's tp axis —
     None when eligible, otherwise the FIRST failed predicate by name (so
     fallback logs say what to fix instead of a generic "ineligible").
-    Eligibility: tp > 1, standard (non-MLA) paged layout, and both head
-    counts divide by tp so each shard owns whole, matched GQA groups
-    (q head h reads kv head h // group — contiguous slicing of BOTH by
-    tp preserves the grouping per shard, the same rule as the flash
-    wrapper)."""
+    Standard layout: both head counts divide by tp so each shard owns
+    whole, matched GQA groups (q head h reads kv head h // group —
+    contiguous slicing of BOTH by tp preserves the grouping per shard,
+    the same rule as the flash wrapper). MLA: the latent pool has no
+    kv-head axis, so the shard axis is the latent COLUMN dim instead
+    (kernel_gen._tp_place_latent) — eligibility is kv_lora_rank % tp."""
     if ctx is None:
         return "no mesh context (ctx is None)"
     if ctx.tp <= 1:
-        return f"tp == {ctx.tp} (needs tp > 1 to shard heads)"
+        return f"tp == {ctx.tp} (needs tp > 1 to shard)"
     if cfg.multi_latent_attention:
-        return ("multi_latent_attention: the latent pool has no per-head "
-                "dim to shard")
+        if cfg.kv_lora_rank % ctx.tp:
+            return (f"kv_lora_rank ({cfg.kv_lora_rank}) % tp ({ctx.tp}) "
+                    f"!= 0 (the latent pool shards on latent columns)")
+        return None
     if cfg.num_attention_heads % ctx.tp:
         return (f"num_attention_heads ({cfg.num_attention_heads}) % tp "
                 f"({ctx.tp}) != 0")

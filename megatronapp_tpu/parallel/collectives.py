@@ -64,6 +64,16 @@ def axis_size(axis_name) -> int:
     return lax.psum(1, axis_name)
 
 
+def psum(x, axis_name):
+    """All-reduce sum over a bound manual mesh axis — the designated
+    entry point for shard-partial reductions in full-manual bodies
+    (tools/check_vma.py gate 1), e.g. the latent-column score/value
+    partials of kernel_gen._tp_place_latent. Keep operands fp32 at the
+    call sites: bf16 manual all-reduces crash this XLA:CPU build
+    (README known constraints)."""
+    return lax.psum(x, axis_name)
+
+
 def pvary(x, axes: Tuple[str, ...]):
     """Mark a replicated-over-``axes`` input as varying inside a manual
     region, so its cotangent is psummed over ``axes`` exactly once.

@@ -78,9 +78,9 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     page_table/active: paged-KV decode (inference/paged_cache.py) —
     kv_cache is then the per-layer block pool and each batch row appends
     at its own page-table position (see attention.py / mla.py).
-    kv_scales: per-layer fp32 scale pools marking an int8 paged pool
-    (see attention.py); new_cache then carries four pools. Non-MLA only
-    — the MLA latent pool is bf16-only (PagedKVCache rejects int8+MLA).
+    kv_scales: per-layer fp32 scale pools marking a quantized paged pool
+    (see attention.py; MLA: per-row scalar scales on the latent/pe
+    pools, see mla.py); new_cache then carries four pools.
 
     tp_sharded: ambient-manual tp-sharded stage body (pp pipeline) — x is
     the local [B, S/tp, H] seq chunk; norms/residuals run on it directly
@@ -98,10 +98,9 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     sub-dicts threaded into the tp-overlap ring GEMMs; the updated
     histories travel out through their cotangents."""
     if fused_decode:
-        if (page_table is None or kv_cache is None
-                or cfg.multi_latent_attention or "moe" in p):
+        if page_table is None or kv_cache is None or "moe" in p:
             raise ValueError(
-                "fused_decode covers the non-MLA dense-MLP paged "
+                "fused_decode covers the dense-MLP paged "
                 "decode/multiquery bodies only — gate callers on "
                 "kernel_gen.megakernel_ineligible_reason")
         from megatronapp_tpu.ops.pallas.kernel_gen import (
@@ -135,18 +134,13 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                         == segment_ids[:, None, None, :])
             attention_mask = (seg_mask if attention_mask is None
                               else attention_mask & seg_mask)
-        if kv_scales is not None:
-            raise NotImplementedError(
-                "int8 KV pages are not supported for MLA (latent pool "
-                "is bf16-only); PagedKVCache rejects this at "
-                "construction")
         if kv_cache is not None:
             attn_out, new_cache = mla_forward(
                 p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,
                 layer_id=layer_id, ctx=ctx, kv_cache=kv_cache,
                 cache_index=cache_index, cache_positions=cache_positions,
                 page_table=page_table, active=active,
-                chunk_counts=chunk_counts)
+                chunk_counts=chunk_counts, kv_scales=kv_scales)
         else:
             attn_out = mla_forward(
                 p["attention"], h, cfg, rope_cos, rope_sin, attention_mask,

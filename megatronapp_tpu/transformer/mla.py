@@ -72,7 +72,8 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                 attention_mask: Optional[jnp.ndarray] = None,
                 layer_id=None, ctx=None, kv_cache=None, cache_index=None,
                 cache_positions=None, page_table=None, active=None,
-                chunk_counts=None, tp_sharded: bool = False):
+                chunk_counts=None, tp_sharded: bool = False,
+                kv_scales=None):
     """kv_cache: optional (latent_cache [B, Smax, kv_lora_rank],
     kpe_cache [B, Smax, dpe]) — the COMPRESSED decode cache (the latent +
     shared roped key; reference MLA's defining cache shape). Returns
@@ -83,9 +84,19 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     appends its latent/k_pe at ITS OWN position; causality must then come
     from the caller's per-row attention_mask.
 
-    Decode recomputes k_nope/v from the cached latent via kv_up each step
-    (the storage-optimal variant; weight absorption into q is a further
-    flop optimization).
+    Dense-cache decode recomputes k_nope/v from the cached latent via
+    kv_up each step (the storage-optimal variant). The PAGED path
+    (page_table is not None) instead absorbs kv_up's k_nope columns into
+    the query and attends IN LATENT SPACE through the generated ragged
+    paged kernel (ops/pallas/kernel_gen.paged_attention_latent,
+    ISSUE 17): scores are q_lat·latentᵀ + q_pe·k_peᵀ over the page
+    table, values re-expand per-tile in-register — no dense gather and
+    no per-step kv_up over the whole history.
+
+    kv_scales: optional (lat_scales, pe_scales) per-row scalar fp32
+    scale pools [NB, bs] marking a QUANTIZED latent/pe pool (paged path
+    only); new rows quantize on insert (quantize_kv_rows) and new_cache
+    then carries four pools.
 
     tp_sharded: ambient-manual tp-sharded stage body (see
     transformer/attention.py docstring) — training path only."""
@@ -137,45 +148,183 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                 "not supported (each shard would attend only local KV)")
         c_lat, c_pe = kv_cache
         if page_table is not None:
-            # Paged continuous-batching decode: kv_cache is the shared
-            # latent/k_pe block pool ([num_blocks, block_size, klat/dpe],
-            # inference/paged_cache.py). Each row appends at its own
-            # (block, offset); the latent run is then GATHERED back to a
-            # contiguous [B, max_blocks*bs, .] layout because the kv_up
-            # reconstitution below needs dense rows — rows past a slot's
-            # length are garbage, so the caller's per-row mask over the
-            # gathered run is mandatory.
-            from megatronapp_tpu.ops.pallas.paged_attention import (
-                append_chunk_pages, append_token_pages,
+            # Paged continuous-batching decode (ISSUE 17): kv_cache is
+            # the shared latent/k_pe block pool ([num_blocks, block_size,
+            # klat/dpe], inference/paged_cache.py). Each row appends at
+            # its own (block, offset); attention then runs IN LATENT
+            # SPACE through the generated ragged paged kernel — q
+            # absorbed through kv_up's k_nope columns, values
+            # re-expanded per-tile in-register — so the history is never
+            # gathered dense nor re-expanded through kv_up per step.
+            from megatronapp_tpu.config.transformer_config import (
+                PositionEmbeddingKind,
             )
-            if attention_mask is None:
-                raise ValueError(
-                    "paged MLA decode requires an explicit per-row "
-                    "attention_mask over the gathered page run; see "
-                    "inference/dynamic_engine.py's paged decode")
+            from megatronapp_tpu.inference.quantization import (
+                resolve_param,
+            )
+            from megatronapp_tpu.ops.pallas.kernel_gen import (
+                paged_attention_latent,
+            )
+            from megatronapp_tpu.ops.pallas.paged_attention import (
+                append_chunk_pages, append_token_pages, quantize_kv_rows,
+                tp_paged_eligible,
+            )
+            from megatronapp_tpu.scope import hooks as scope_hooks
             if active is None:
                 active = jnp.ones((b,), bool)
-            if s > 1 or chunk_counts is not None:
+            new_scales = None
+            ragged = s > 1 or chunk_counts is not None
+            if ragged:
                 # Multi-token paged append (speculative verify / chunked
                 # prefill): ragged per-row chunk starting at
-                # cache_positions; the caller's mask must be per-(query,
-                # kv) causal over the gathered run ([B, 1, S, MB*bs]).
+                # cache_positions; the kernel's scalar-prefetched q_lens
+                # carries the causal tail mask.
                 counts = (chunk_counts if chunk_counts is not None
                           else jnp.full((b,), s, jnp.int32))
-                c_lat = append_chunk_pages(
-                    c_lat, latent.astype(c_lat.dtype), page_table,
-                    cache_positions, counts, active)
-                c_pe = append_chunk_pages(
-                    c_pe, k_pe.astype(c_pe.dtype), page_table,
-                    cache_positions, counts, active)
+                if kv_scales is not None:
+                    # Quantized latent/pe pools: per-row SCALAR scales
+                    # (the rows have no kv-head axis) quantized on
+                    # insert and scattered through the same page table.
+                    c_ls, c_ps = kv_scales
+                    lat_q, lat_s = quantize_kv_rows(latent,
+                                                    dtype=c_lat.dtype)
+                    pe_q, pe_s = quantize_kv_rows(k_pe, dtype=c_pe.dtype)
+                    c_lat = append_chunk_pages(c_lat, lat_q, page_table,
+                                               cache_positions, counts,
+                                               active)
+                    c_pe = append_chunk_pages(c_pe, pe_q, page_table,
+                                              cache_positions, counts,
+                                              active)
+                    c_ls = append_chunk_pages(c_ls, lat_s, page_table,
+                                              cache_positions, counts,
+                                              active)
+                    c_ps = append_chunk_pages(c_ps, pe_s, page_table,
+                                              cache_positions, counts,
+                                              active)
+                    new_scales = (c_ls, c_ps)
+                    sc_kw = {"lat_scales": c_ls, "pe_scales": c_ps}
+                else:
+                    c_lat = append_chunk_pages(
+                        c_lat, latent.astype(c_lat.dtype), page_table,
+                        cache_positions, counts, active)
+                    c_pe = append_chunk_pages(
+                        c_pe, k_pe.astype(c_pe.dtype), page_table,
+                        cache_positions, counts, active)
+                    sc_kw = {}
+                kv_lens = cache_positions + counts
             else:
-                c_lat = append_token_pages(
-                    c_lat, latent[:, 0].astype(c_lat.dtype), page_table,
-                    cache_positions, active)
-                c_pe = append_token_pages(
-                    c_pe, k_pe[:, 0].astype(c_pe.dtype), page_table,
-                    cache_positions, active)
-            mask_type = AttnMaskType.bidirectional
+                if kv_scales is not None:
+                    c_ls, c_ps = kv_scales
+                    lat_q, lat_s = quantize_kv_rows(latent[:, 0],
+                                                    dtype=c_lat.dtype)
+                    pe_q, pe_s = quantize_kv_rows(k_pe[:, 0],
+                                                  dtype=c_pe.dtype)
+                    c_lat = append_token_pages(c_lat, lat_q, page_table,
+                                               cache_positions, active)
+                    c_pe = append_token_pages(c_pe, pe_q, page_table,
+                                              cache_positions, active)
+                    c_ls = append_token_pages(c_ls, lat_s, page_table,
+                                              cache_positions, active)
+                    c_ps = append_token_pages(c_ps, pe_s, page_table,
+                                              cache_positions, active)
+                    new_scales = (c_ls, c_ps)
+                    sc_kw = {"lat_scales": c_ls, "pe_scales": c_ps}
+                else:
+                    c_lat = append_token_pages(
+                        c_lat, latent[:, 0].astype(c_lat.dtype),
+                        page_table, cache_positions, active)
+                    c_pe = append_token_pages(
+                        c_pe, k_pe[:, 0].astype(c_pe.dtype), page_table,
+                        cache_positions, active)
+                    sc_kw = {}
+                kv_lens = cache_positions + 1
+            new_cache = ((c_lat, c_pe) if new_scales is None
+                         else (c_lat, c_pe) + new_scales)
+
+            # YaRN: the rope tables already carry mscale, so the pe
+            # logits get mscale² for free; the cached latent is
+            # UNSCALED, so the absorbed query must carry the whole m²
+            # the dense path splits as (q_nope·m)·(k_nope·m).
+            m = 1.0
+            if cfg.position_embedding == PositionEmbeddingKind.yarn:
+                m = rotary.yarn_mscale(cfg.rope_scaling_factor,
+                                       cfg.yarn_mscale_coeff)
+            q_full = jnp.concatenate(
+                [q_nope * m if m != 1.0 else q_nope, q_pe], axis=-1)
+            q_full = scope_capture("qkv_q", q_full, layer_id)
+            q_nope_y, q_pe = q_full[..., :dqk], q_full[..., dqk:]
+
+            kvu = p["kv_up"].astype(dt).reshape(klat, nq, dqk + dv)
+            wk, w_v = kvu[..., :dqk], kvu[..., dqk:]
+            rows = q_nope_y.reshape(b * s, nq, dqk)
+            if m != 1.0:
+                rows = rows * m                    # second m factor
+            q_abs = jnp.einsum("bnd,knd->bnk", rows, wk)
+            q_abs = q_abs.reshape(b, s, nq, klat)
+
+            scale = 1.0 / float((dqk + dpe) ** 0.5)
+            tp_paged = False
+            if ctx is not None:
+                from megatronapp_tpu.parallel.collectives import (
+                    current_manual_axes,
+                )
+                tp_paged = (tp_paged_eligible(cfg, ctx)
+                            and not current_manual_axes())
+            mesh = ctx.shard_map_mesh if tp_paged else None
+            if ragged:
+                attn = paged_attention_latent(
+                    q_abs, q_pe, c_lat, c_pe, page_table, kv_lens, w_v,
+                    q_lens=counts, softmax_scale=scale, mesh=mesh,
+                    **sc_kw)
+            else:
+                attn = paged_attention_latent(
+                    q_abs[:, 0], q_pe[:, 0], c_lat, c_pe, page_table,
+                    kv_lens, w_v, softmax_scale=scale, mesh=mesh,
+                    **sc_kw)[:, None]
+            if tp_paged:
+                from jax.sharding import NamedSharding, PartitionSpec
+                # manual-ok: replicate the kernel output so the
+                # out-projection runs identically on every device (the
+                # latent shard_map already emits replicated output; the
+                # constraint pins it for GSPMD).
+                attn = jax.lax.with_sharding_constraint(
+                    attn, NamedSharding(ctx.mesh, PartitionSpec()))  # manual-ok: see above
+
+            if (scope_hooks.is_enabled("qkv_k")
+                    or scope_hooks.is_enabled("qkv_v")):
+                # MegaScope parity (debug-only, gated off the hot path):
+                # reconstitute the dense k/v views the pre-kernel path
+                # captured — gather the history and expand through
+                # kv_up, exactly the work the kernel path avoids.
+                from megatronapp_tpu.ops.pallas.paged_attention import (
+                    gather_pages_batched,
+                )
+                g_lat = gather_pages_batched(c_lat, page_table)
+                g_pe = gather_pages_batched(c_pe, page_table)
+                if new_scales is not None:
+                    g_ls = gather_pages_batched(new_scales[0], page_table)
+                    g_ps = gather_pages_batched(new_scales[1], page_table)
+                    g_lat = g_lat.astype(jnp.float32) * g_ls[..., None]
+                    g_pe = g_pe.astype(jnp.float32) * g_ps[..., None]
+                g_lat, g_pe = g_lat.astype(dt), g_pe.astype(dt)
+                s_g = g_lat.shape[1]
+                kvu_g = (g_lat @ p["kv_up"].astype(dt)).reshape(
+                    b, s_g, nq, dqk + dv)
+                k_nope_g, v_g = kvu_g[..., :dqk], kvu_g[..., dqk:]
+                if m != 1.0:
+                    k_nope_g = k_nope_g * m
+                k_full_g = jnp.concatenate(
+                    [k_nope_g, jnp.broadcast_to(g_pe[:, :, None, :],
+                                                (b, s_g, nq, dpe))],
+                    axis=-1)
+                scope_capture("qkv_k", k_full_g, layer_id)
+                scope_capture("qkv_v", v_g, layer_id)
+
+            attn = scope_capture("context", attn, layer_id)
+            out = attn.reshape(b, s, nq * dv) @ _dist.apply(
+                "weight", resolve_param(p["out_kernel"]),
+                layer_id).astype(dt)
+            return out, new_cache
         elif cache_positions is not None:
             # Continuous-batching decode: per-row append positions.
             # Causality MUST come from the caller's per-row mask — the
@@ -201,14 +350,7 @@ def mla_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                 c_pe, k_pe.astype(c_pe.dtype), cache_index, axis=1)
             q_offset = cache_index
         new_cache = (c_lat, c_pe)
-        if page_table is not None:
-            from megatronapp_tpu.ops.pallas.paged_attention import (
-                gather_pages_batched,
-            )
-            latent = gather_pages_batched(c_lat, page_table).astype(dt)
-            k_pe = gather_pages_batched(c_pe, page_table).astype(dt)
-        else:
-            latent, k_pe = c_lat.astype(dt), c_pe.astype(dt)
+        latent, k_pe = c_lat.astype(dt), c_pe.astype(dt)
         s_kv = latent.shape[1]
 
     kv_up = (latent @ p["kv_up"].astype(dt)).reshape(b, s_kv, nq, dqk + dv)

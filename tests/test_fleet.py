@@ -55,6 +55,23 @@ def gqa_params():
     return cfg, params
 
 
+def _mla_cfg(max_pos=64):
+    return TransformerConfig(
+        num_layers=2, hidden_size=64, num_attention_heads=4,
+        num_query_groups=2, vocab_size=128,
+        max_position_embeddings=max_pos,
+        compute_dtype=jnp.float32, remat_policy="none",
+        multi_latent_attention=True, kv_lora_rank=32,
+        qk_head_dim=16, qk_pos_emb_head_dim=8, v_head_dim=16)
+
+
+@pytest.fixture(scope="module")
+def mla_params():
+    cfg = _mla_cfg()
+    params, _ = init_gpt_params(jax.random.PRNGKey(9), cfg)
+    return cfg, params
+
+
 def _greedy_oracle(params, cfg, prompt, n):
     toks = np.asarray(prompt)[None].copy()
     for _ in range(n):
@@ -207,6 +224,50 @@ class TestMigratedStreams:
         fr = _fleet(params, cfg)
         rid = fr.add_request(prompt, 10, sp)
         assert rid == r0, "fleet rid space must mirror the single engine"
+        src = fr._owner[rid]
+        while len(fr.replicas[src].engine.requests[rid].generated) < 5:
+            fr.step()
+        assert fr.migrate_request(rid, 1 - src)
+        out = fr.run_to_completion()[rid].tolist()
+        assert out == base
+
+    @pytest.mark.parametrize("dt", ["bf16", "int8"])
+    def test_mla_greedy_stream_token_exact(self, mla_params, dt):
+        """ISSUE 17: MLA latent pools migrate token-exact too — the
+        export payload ships [klat] latent + [dpe] roped-key rows (and
+        per-row SCALAR scales when quantized) verbatim; nothing in the
+        hop re-expands through kv_up."""
+        cfg, params = mla_params
+        rng = np.random.default_rng(4)
+        prompt = rng.integers(0, 128, 13).astype(np.int32)
+        base_eng = _engine(params, cfg, dt=dt)
+        r0 = base_eng.add_request(prompt, 10, SamplingParams(greedy=True))
+        base = base_eng.run_to_completion()[r0].tolist()
+        fr = _fleet(params, cfg, dt=dt)
+        rid = fr.add_request(prompt, 10, SamplingParams(greedy=True))
+        src = fr._owner[rid]
+        while len(fr.replicas[src].engine.requests[rid].generated) < 4:
+            fr.step()
+        dst = 1 - src
+        assert fr.migrate_request(rid, dst)
+        assert fr._owner[rid] == dst
+        out = fr.run_to_completion()[rid].tolist()
+        assert out == base
+        for rep in fr.replicas:
+            rep.engine.pool.audit()
+        assert fr.replicas[src].engine.pool.blocks_in_use() == 0
+        assert fr.router_stats["migrations"] == 1
+
+    def test_mla_sampled_stream_token_exact(self, mla_params):
+        cfg, params = mla_params
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(0, 128, 11).astype(np.int32)
+        sp = SamplingParams(temperature=0.8, top_k=20, seed=5)
+        base_eng = _engine(params, cfg)
+        r0 = base_eng.add_request(prompt, 10, sp)
+        base = base_eng.run_to_completion()[r0].tolist()
+        fr = _fleet(params, cfg)
+        rid = fr.add_request(prompt, 10, sp)
         src = fr._owner[rid]
         while len(fr.replicas[src].engine.requests[rid].generated) < 5:
             fr.step()

@@ -231,21 +231,28 @@ class TestFp8Pool:
         args = ap.parse_args(["--kv-cache-dtype", "fp8"])
         with pytest.raises(SystemExit, match="paged"):
             validate_serving_args(args)
-        # MLA rejection comes from the same registry function.
-        with pytest.raises(ValueError, match="MLA"):
-            validate_kv_cache_dtype("fp8", paged=True, mla=True)
+        # fp8 + MLA validates since ISSUE 17 (quantized latent pool).
+        validate_kv_cache_dtype("fp8", paged=True, mla=True)  # no raise
         with pytest.raises(ValueError, match="one of"):
             validate_kv_cache_dtype("int4")
 
-    def test_fp8_rejected_for_mla_and_dense(self):
+    def test_fp8_mla_latent_pool_and_dense_rejected(self):
+        """fp8 MLA pools quantize since ISSUE 17 (per-row scalar scale
+        pools [L, NB, bs], same layout as int8); the dense backend still
+        rejects fp8."""
         cfg = TransformerConfig(
             num_layers=2, hidden_size=64, num_attention_heads=4,
             vocab_size=128, max_position_embeddings=64,
             multi_latent_attention=True, kv_lora_rank=32, qk_head_dim=16,
             qk_pos_emb_head_dim=8, v_head_dim=16,
             compute_dtype=jnp.float32, remat_policy="none")
-        with pytest.raises(ValueError, match="MLA"):
-            PagedKVCache(cfg, 2, 32, kv_cache_dtype="fp8")
+        pool = PagedKVCache(cfg, 2, 32, num_blocks=8, block_size=4,
+                            kv_cache_dtype="fp8")
+        assert pool.quantized
+        assert pool.pages[0].shape == (2, 8, 4, cfg.kv_lora_rank)
+        assert pool.scales is not None
+        assert all(s.shape == (2, 8, 4) and s.dtype == jnp.float32
+                   for s in pool.scales)
         cfg2 = _gqa_cfg()
         params, _ = init_gpt_params(jax.random.PRNGKey(0), cfg2)
         with pytest.raises(ValueError, match="paged"):

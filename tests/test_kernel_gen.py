@@ -35,8 +35,11 @@ from megatronapp_tpu.inference.engine import SamplingParams
 from megatronapp_tpu.models.gpt import gpt_forward, init_gpt_params
 from megatronapp_tpu.ops.pallas.kernel_gen import (
     _NEG_INF, _dequant_block, _interpret, paged_attention,
+    paged_attention_latent,
 )
-from megatronapp_tpu.ops.pallas.paged_attention import quantize_kv_rows
+from megatronapp_tpu.ops.pallas.paged_attention import (
+    paged_attention_latent_reference, quantize_kv_rows,
+)
 from megatronapp_tpu.parallel.mesh import build_mesh
 
 # ---------------------------------------------------------------------------
@@ -399,6 +402,289 @@ class TestGeneratorBitwise:
 
 
 # ---------------------------------------------------------------------------
+# MLA latent kernel pins (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+def _mk_latent_inputs(rng, b, s_q, nq, klat, dpe, dv, bs, mb, quant,
+                      dtype):
+    nb = b * mb + 1
+    if s_q:
+        q_lat = jnp.asarray(rng.normal(size=(b, s_q, nq, klat)), dtype)
+        q_pe = jnp.asarray(rng.normal(size=(b, s_q, nq, dpe)), dtype)
+    else:
+        q_lat = jnp.asarray(rng.normal(size=(b, nq, klat)), dtype)
+        q_pe = jnp.asarray(rng.normal(size=(b, nq, dpe)), dtype)
+    lat = jnp.asarray(rng.normal(size=(nb, bs, klat)), dtype)
+    pe = jnp.asarray(rng.normal(size=(nb, bs, dpe)), dtype)
+    w_v = jnp.asarray(rng.normal(size=(klat, nq, dv)), dtype)
+    tbl = jnp.asarray(
+        rng.permutation(nb - 1)[: b * mb].reshape(b, mb) + 1, jnp.int32)
+    lens = jnp.asarray(rng.integers(1, bs * mb, b), jnp.int32)
+    ls = ps = None
+    if quant:
+        lat, ls = quantize_kv_rows(lat)
+        pe, ps = quantize_kv_rows(pe)
+    return q_lat, q_pe, lat, pe, w_v, tbl, lens, ls, ps
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "ragged",
+                                             "quantized"))
+def _latent_sim_jit(q_lat, q_pe, lat_pages, pe_pages, tbl, kv_lens, w_v,
+                    q_lens, lat_scales, pe_scales, *, scale, ragged,
+                    quantized):
+    """jnp replay of emit_latent_kernel's EXACT block loop (same op
+    sequence per tile: scaled-q dots, mask, online-softmax rescale,
+    per-tile v re-expansion). The replay must be jitted as ONE
+    computation so XLA applies the same fusions (mul+add → FMA) it
+    applies to the interpreted kernel body — op-by-op eager replay
+    drifts by one ulp on multi-block accumulators. Skipped blocks
+    (j*bs >= kv_len) keep the prior accumulator via where-select, which
+    is value-identical to the kernel's pl.when skip. Do not "simplify"
+    the arithmetic here: its order is the pin."""
+    if ragged:
+        b, s_q, nq, klat = q_lat.shape
+    else:
+        b, nq, klat = q_lat.shape
+        s_q = 1
+    dpe = q_pe.shape[-1]
+    dv = w_v.shape[-1]
+    bs = lat_pages.shape[1]
+    mb = tbl.shape[1]
+    rows = s_q * nq
+    outs = []
+    for bi in range(b):
+        acc = jnp.zeros((rows, dv), jnp.float32)
+        m_scr = jnp.full((rows,), _NEG_INF, jnp.float32)
+        l_scr = jnp.zeros((rows,), jnp.float32)
+        kv_len = kv_lens[bi]
+        if ragged:
+            q_start = kv_len - q_lens[bi]
+        for j in range(mb):
+            live = j * bs < kv_len
+            pg = tbl[bi, j]
+            ql = (q_lat[bi].astype(jnp.float32).reshape(rows, klat)
+                  * scale)
+            qp = q_pe[bi].astype(jnp.float32).reshape(rows, dpe) * scale
+            if quantized:
+                lat = (lat_pages[pg].astype(jnp.float32)
+                       * lat_scales[pg][:, None])
+                pe = (pe_pages[pg].astype(jnp.float32)
+                      * pe_scales[pg][:, None])
+            else:
+                lat = lat_pages[pg]
+                pe = pe_pages[pg]
+            s2 = (jnp.dot(ql.astype(lat.dtype), lat.T,
+                          preferred_element_type=jnp.float32)
+                  + jnp.dot(qp.astype(pe.dtype), pe.T,
+                            preferred_element_type=jnp.float32))
+            pos = j * bs + jnp.arange(bs, dtype=jnp.int32)
+            if ragged:
+                row_q = jnp.arange(rows, dtype=jnp.int32) // nq
+                abs_q = q_start + row_q
+                valid = ((pos[None, :] <= abs_q[:, None])
+                         & (pos[None, :] < kv_len))
+            else:
+                valid = jnp.broadcast_to(pos[None, :] < kv_len,
+                                         (rows, bs))
+            s2 = jnp.where(valid, s2, _NEG_INF)
+            m_prev = m_scr
+            m_new = jnp.maximum(m_prev, jnp.max(s2, axis=1))
+            m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+            p = jnp.exp(s2 - m_safe[:, None])
+            p = jnp.where(valid, p, 0.0)
+            corr = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+            corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
+            l_new = l_scr * corr + jnp.sum(p, axis=1)
+            v_t = jax.lax.dot_general(
+                lat, w_v.astype(lat.dtype),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            v3 = jnp.swapaxes(v_t, 0, 1)
+            p3 = jnp.transpose(p.reshape(s_q, nq, bs), (1, 0, 2))
+            pv = jax.lax.dot_general(
+                p3.astype(v3.dtype), v3,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            pv2 = jnp.transpose(pv, (1, 0, 2)).reshape(rows, dv)
+            acc = jnp.where(live, acc * corr[:, None] + pv2, acc)
+            m_scr = jnp.where(live, m_new, m_scr)
+            l_scr = jnp.where(live, l_new, l_scr)
+        l = jnp.maximum(l_scr, 1e-20)
+        a = acc / l[:, None]
+        if ragged:
+            outs.append(a.reshape(s_q, nq, dv).astype(q_lat.dtype))
+        else:
+            outs.append(a.reshape(nq, dv).astype(q_lat.dtype))
+    return jnp.stack(outs)
+
+
+def _latent_blockwise_sim(q_lat, q_pe, lat_pages, pe_pages, tbl, kv_lens,
+                          w_v, q_lens=None, softmax_scale=None,
+                          lat_scales=None, pe_scales=None):
+    return _latent_sim_jit(q_lat, q_pe, lat_pages, pe_pages, tbl,
+                           kv_lens, w_v, q_lens, lat_scales, pe_scales,
+                           scale=float(softmax_scale),
+                           ragged=q_lens is not None,
+                           quantized=lat_scales is not None)
+
+
+class TestLatentKernelPins:
+    """ISSUE 17 tentpole pins: the MLA latent-space kernel is held two
+    ways — BITWISE vs a test-local jnp replay of its exact block loop
+    (the op order IS the contract), and allclose vs the dense
+    gather + kv_up re-expansion oracle it replaced
+    (paged_attention_latent_reference: plain softmax, different
+    contraction order, so bitwise is not expected there)."""
+
+    SCALE = 1.0 / ((16 + 8) ** 0.5)   # 1/sqrt(dqk + dpe) at test dims
+
+    def _tol(self, dtype):
+        return dict(atol=2e-5, rtol=2e-5) if dtype == jnp.float32 \
+            else dict(atol=3e-2, rtol=3e-2)
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_decode_bitwise_vs_blockwise_sim(self, dtype, quant):
+        rng = np.random.default_rng(17)
+        q_lat, q_pe, lat, pe, w_v, tbl, lens, ls, ps = _mk_latent_inputs(
+            rng, 3, 0, 4, 32, 8, 16, 8, 4, quant, dtype)
+        out = paged_attention_latent(q_lat, q_pe, lat, pe, tbl, lens,
+                                     w_v, softmax_scale=self.SCALE,
+                                     lat_scales=ls, pe_scales=ps)
+        sim = _latent_blockwise_sim(q_lat, q_pe, lat, pe, tbl, lens,
+                                    w_v, softmax_scale=self.SCALE,
+                                    lat_scales=ls, pe_scales=ps)
+        assert bool(jnp.all(out == sim))
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_ragged_bitwise_vs_blockwise_sim(self, dtype, quant):
+        rng = np.random.default_rng(18)
+        s_q = 5
+        q_lat, q_pe, lat, pe, w_v, tbl, lens, ls, ps = _mk_latent_inputs(
+            rng, 3, s_q, 4, 32, 8, 16, 8, 4, quant, dtype)
+        lens = jnp.maximum(lens, s_q)
+        qlens = jnp.asarray([s_q, 2, 1], jnp.int32)
+        out = paged_attention_latent(q_lat, q_pe, lat, pe, tbl, lens,
+                                     w_v, q_lens=qlens,
+                                     softmax_scale=self.SCALE,
+                                     lat_scales=ls, pe_scales=ps)
+        sim = _latent_blockwise_sim(q_lat, q_pe, lat, pe, tbl, lens,
+                                    w_v, q_lens=qlens,
+                                    softmax_scale=self.SCALE,
+                                    lat_scales=ls, pe_scales=ps)
+        assert bool(jnp.all(out == sim))
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_decode_matches_dense_reference(self, dtype, quant):
+        rng = np.random.default_rng(19)
+        q_lat, q_pe, lat, pe, w_v, tbl, lens, ls, ps = _mk_latent_inputs(
+            rng, 3, 0, 4, 32, 8, 16, 8, 4, quant, dtype)
+        out = paged_attention_latent(q_lat, q_pe, lat, pe, tbl, lens,
+                                     w_v, softmax_scale=self.SCALE,
+                                     lat_scales=ls, pe_scales=ps)
+        ref = paged_attention_latent_reference(
+            q_lat, q_pe, lat, pe, tbl, lens, w_v,
+            softmax_scale=self.SCALE, lat_scales=ls, pe_scales=ps)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            **self._tol(dtype))
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_ragged_matches_dense_reference(self, dtype, quant):
+        rng = np.random.default_rng(20)
+        s_q = 5
+        q_lat, q_pe, lat, pe, w_v, tbl, lens, ls, ps = _mk_latent_inputs(
+            rng, 3, s_q, 4, 32, 8, 16, 8, 4, quant, dtype)
+        lens = jnp.maximum(lens, s_q)
+        qlens = jnp.asarray([s_q, 3, 1], jnp.int32)
+        out = paged_attention_latent(q_lat, q_pe, lat, pe, tbl, lens,
+                                     w_v, q_lens=qlens,
+                                     softmax_scale=self.SCALE,
+                                     lat_scales=ls, pe_scales=ps)
+        ref = paged_attention_latent_reference(
+            q_lat, q_pe, lat, pe, tbl, lens, w_v, q_lens=qlens,
+            softmax_scale=self.SCALE, lat_scales=ls, pe_scales=ps)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            **self._tol(dtype))
+
+    def test_qlen1_ragged_bitwise_vs_decode(self):
+        """At q_len == 1 the ragged latent emission collapses bitwise
+        to the decode emission (one template, two points — same pin the
+        dense family carries)."""
+        rng = np.random.default_rng(21)
+        q_lat, q_pe, lat, pe, w_v, tbl, lens, _, _ = _mk_latent_inputs(
+            rng, 3, 0, 4, 32, 8, 16, 8, 4, False, jnp.float32)
+        dec = paged_attention_latent(q_lat, q_pe, lat, pe, tbl, lens,
+                                     w_v, softmax_scale=self.SCALE)
+        mq = paged_attention_latent(q_lat[:, None], q_pe[:, None], lat,
+                                    pe, tbl, lens, w_v,
+                                    q_lens=jnp.ones((3,), jnp.int32),
+                                    softmax_scale=self.SCALE)
+        assert bool(jnp.all(dec == mq[:, 0]))
+
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_tp2_latent_columns_allclose(self, devices8, quant):
+        """Carve-out (b): the latent-COLUMN tp placement (two-phase
+        psum'd scores + host softmax) matches the single-device kernel.
+        allclose, not bitwise: the tp algorithm reassociates the
+        latent contraction across shards."""
+        rng = np.random.default_rng(22)
+        q_lat, q_pe, lat, pe, w_v, tbl, lens, ls, ps = _mk_latent_inputs(
+            rng, 3, 0, 4, 32, 8, 16, 8, 4, quant, jnp.float32)
+        ref = paged_attention_latent(q_lat, q_pe, lat, pe, tbl, lens,
+                                     w_v, softmax_scale=self.SCALE,
+                                     lat_scales=ls, pe_scales=ps)
+        ctx = build_mesh(ParallelConfig(tensor_parallel=2),
+                         devices=jax.devices()[:2])
+        tp = paged_attention_latent(q_lat, q_pe, lat, pe, tbl, lens,
+                                    w_v, softmax_scale=self.SCALE,
+                                    lat_scales=ls, pe_scales=ps,
+                                    mesh=ctx.mesh)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(tp),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("quant", [False, True])
+    def test_tp2_ragged_latent_columns_allclose(self, devices8, quant):
+        rng = np.random.default_rng(23)
+        s_q = 5
+        q_lat, q_pe, lat, pe, w_v, tbl, lens, ls, ps = _mk_latent_inputs(
+            rng, 3, s_q, 4, 32, 8, 16, 8, 4, quant, jnp.float32)
+        lens = jnp.maximum(lens, s_q)
+        qlens = jnp.asarray([s_q, 2, 1], jnp.int32)
+        ref = paged_attention_latent(q_lat, q_pe, lat, pe, tbl, lens,
+                                     w_v, q_lens=qlens,
+                                     softmax_scale=self.SCALE,
+                                     lat_scales=ls, pe_scales=ps)
+        ctx = build_mesh(ParallelConfig(tensor_parallel=2),
+                         devices=jax.devices()[:2])
+        tp = paged_attention_latent(q_lat, q_pe, lat, pe, tbl, lens,
+                                    w_v, q_lens=qlens,
+                                    softmax_scale=self.SCALE,
+                                    lat_scales=ls, pe_scales=ps,
+                                    mesh=ctx.mesh)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(tp),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_softmax_scale_required(self):
+        """The MLA scale is 1/sqrt(dqk + dpe) — NOT derivable from the
+        latent width, so both the kernel and the dense reference refuse
+        to guess."""
+        rng = np.random.default_rng(24)
+        q_lat, q_pe, lat, pe, w_v, tbl, lens, _, _ = _mk_latent_inputs(
+            rng, 1, 0, 2, 16, 8, 8, 8, 2, False, jnp.float32)
+        with pytest.raises(ValueError, match="softmax_scale"):
+            paged_attention_latent(q_lat, q_pe, lat, pe, tbl, lens, w_v)
+        with pytest.raises(ValueError, match="softmax_scale"):
+            paged_attention_latent_reference(q_lat, q_pe, lat, pe, tbl,
+                                             lens, w_v)
+
+
+# ---------------------------------------------------------------------------
 # Fused (megakernel) decode step
 # ---------------------------------------------------------------------------
 
@@ -491,12 +777,11 @@ class TestFusedDecode:
         assert "compiled" in snap["decode_dispatch"]
 
     def test_ineligible_fallback_is_loud_and_unfused(self, caplog):
-        """MLA config: the engine keeps the unfused step and logs the
-        SPECIFIC predicate."""
+        """MoE config (still a carve-out): the engine keeps the unfused
+        step and logs the SPECIFIC predicate. (MLA left this list in
+        ISSUE 17 — see TestMLAFusedDecode.)"""
         import logging
-        cfg = _engine_cfg(multi_latent_attention=True, kv_lora_rank=16,
-                          qk_head_dim=16, qk_pos_emb_head_dim=16,
-                          v_head_dim=16)
+        cfg = _engine_cfg(num_moe_experts=4, moe_router_topk=2)
         params, _ = init_gpt_params(jax.random.PRNGKey(0), cfg)
         with caplog.at_level(logging.WARNING,
                              "megatronapp_tpu.inference.dynamic_engine"):
@@ -504,8 +789,7 @@ class TestFusedDecode:
                                          max_seq_len=64, paged=True,
                                          block_size=8, fused_decode=True)
         assert not eng.megakernel
-        assert any("multi_latent_attention" in r.message
-                   for r in caplog.records)
+        assert any("MoE" in r.message for r in caplog.records)
 
     def test_fused_requires_paged(self, engine_setup):
         cfg, params, _ = engine_setup
@@ -513,6 +797,97 @@ class TestFusedDecode:
             DynamicInferenceEngine(params, cfg, max_batch=2,
                                    max_seq_len=64, paged=False,
                                    fused_decode=True)
+
+
+# ---------------------------------------------------------------------------
+# MLA fused decode (ISSUE 17 carve-out c)
+# ---------------------------------------------------------------------------
+
+
+def _mla_cfg(**over):
+    kw = dict(multi_latent_attention=True, kv_lora_rank=32,
+              qk_head_dim=16, qk_pos_emb_head_dim=8, v_head_dim=16)
+    kw.update(over)
+    return _engine_cfg(**kw)
+
+
+@pytest.fixture(scope="module")
+def mla_setup():
+    cfg = _mla_cfg()
+    params, _ = init_gpt_params(jax.random.PRNGKey(11), cfg)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in (4, 9, 17)]
+    return cfg, params, prompts
+
+
+class TestMLAFusedDecode:
+    """ISSUE 17 carve-out (c): --megakernel-decode no longer rejects
+    multi_latent_attention — the fused MLA prologue (q path + kv_up
+    absorption) feeds the absorbed-q latent kernel inside one fused
+    layer body. Streams pinned token-exact vs the unfused engine (which
+    runs the SAME latent kernel via mla_forward) and the dense greedy
+    oracle."""
+
+    @pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+    def test_streams_token_exact_vs_plain(self, mla_setup, kv_dtype):
+        cfg, params, prompts = mla_setup
+        plain, _ = _stream(cfg, params, prompts, kv_cache_dtype=kv_dtype)
+        fused, eng = _stream(cfg, params, prompts,
+                             kv_cache_dtype=kv_dtype, fused_decode=True)
+        assert eng.megakernel
+        assert plain == fused
+        eng.pool.audit()
+
+    def test_streams_match_dense_oracle(self, mla_setup):
+        cfg, params, prompts = mla_setup
+        fused, eng = _stream(cfg, params, prompts, fused_decode=True)
+        assert eng.megakernel
+        for p, out in zip(prompts, fused):
+            assert out == _greedy_oracle(params, cfg, p, 8)
+
+    def test_sampled_streams_token_exact(self, mla_setup):
+        """Sampled streams too: fused and unfused MLA steps produce the
+        same logits into the same per-request key chain."""
+        cfg, params, prompts = mla_setup
+        sp = SamplingParams(temperature=0.8, top_k=20, seed=9)
+
+        def run(**kw):
+            eng = DynamicInferenceEngine(params, cfg, max_batch=3,
+                                         max_seq_len=64, paged=True,
+                                         block_size=8, **kw)
+            ids = [eng.add_request(p, 8, sp) for p in prompts]
+            res = eng.run_to_completion()
+            return [res[i].tolist() for i in ids], eng
+
+        plain, _ = run()
+        fused, eng = run(fused_decode=True)
+        assert eng.megakernel
+        assert plain == fused
+
+    def test_dispatch_count_reduced(self, mla_setup):
+        """The ISSUE 17 launch gate on the real engine: the fused MLA
+        decode step traces ≤0.85× the unfused step's kernel launches."""
+        cfg, params, prompts = mla_setup
+        _, plain = _stream(cfg, params, prompts[:1], max_new=2)
+        _, fused = _stream(dataclasses.replace(cfg, scan_unroll=2),
+                           params, prompts[:1], max_new=2,
+                           fused_decode=True)
+        sp = plain.dispatch_stats()
+        sf = fused.dispatch_stats()
+        assert sf["dispatches_per_step"] <= 0.85 * sp["dispatches_per_step"]
+
+    @pytest.mark.slow
+    def test_chunked_prefill_streams_token_exact(self, mla_setup):
+        """MLA chunked prefill (the only paged MLA prefill path since
+        ISSUE 17) rides the fused ragged multiquery step chunk by
+        chunk."""
+        cfg, params, prompts = mla_setup
+        plain, _ = _stream(cfg, params, prompts, prefill_chunk=8)
+        fused, eng = _stream(cfg, params, prompts, prefill_chunk=8,
+                             fused_decode=True)
+        assert eng.megakernel
+        assert plain == fused
 
 
 # ---------------------------------------------------------------------------
@@ -851,6 +1226,46 @@ class TestEligibilityReasons:
         assert tp_paged_ineligible_reason(cfg, Ctx()) is None
         assert tp_paged_eligible(cfg, Ctx())
 
+    def test_tp_paged_mla_reasons(self):
+        """ISSUE 17 carve-out (b): MLA shards the latent pool on latent
+        COLUMNS — eligibility is kv_lora_rank % tp, never the head
+        counts (MLA has no kv heads to split), and the reason names the
+        failed predicate."""
+        from megatronapp_tpu.ops.pallas.paged_attention import (
+            tp_paged_eligible, tp_paged_ineligible_reason,
+        )
+
+        class Ctx:
+            tp = 2
+
+        assert tp_paged_ineligible_reason(_mla_cfg(), Ctx()) is None
+        assert tp_paged_eligible(_mla_cfg(), Ctx())
+        reason = tp_paged_ineligible_reason(_mla_cfg(kv_lora_rank=33),
+                                            Ctx())
+        assert "kv_lora_rank" in reason and "latent columns" in reason
+        # Head counts never gate MLA: one query group would reject a
+        # standard layout, but the latent pool has no head axis.
+        assert tp_paged_ineligible_reason(
+            _mla_cfg(num_query_groups=1), Ctx()) is None
+
+    def test_megakernel_mla_reasons(self):
+        """Satellite 1: MLA is ELIGIBLE at the default budget (the
+        multi_latent_attention rejection predicate is gone), and when
+        the fused MLA prologue cannot fit, the reason names it plus the
+        flag that raises the budget."""
+        from megatronapp_tpu.ops.pallas import kernel_gen as kg
+        assert kg.megakernel_ineligible_reason(_mla_cfg(),
+                                               batch=4) is None
+        old = kg.get_megakernel_vmem_budget()
+        try:
+            kg.set_megakernel_vmem_budget(4096)
+            reason = kg.megakernel_ineligible_reason(_mla_cfg(), batch=4)
+            assert reason is not None
+            assert "MLA" in reason
+            assert "--megakernel-vmem-budget" in reason
+        finally:
+            kg.set_megakernel_vmem_budget(old)
+
     def test_tp_stage_reasons(self):
         from megatronapp_tpu.parallel.overlap import (
             tp_stage_eligible, tp_stage_ineligible_reason,
@@ -1015,6 +1430,27 @@ class TestBenchmarkSmoke:
         assert res["quantized_weights"]
         assert res["greedy_match"]
         assert res["within_gate"], res
+
+    def test_mla_ab_gates(self):
+        """ISSUE 17 acceptance: the MLA leg gates launch ratio <=0.85x
+        AND the latent-vs-dense byte ratio <=0.25x (analytically ~0.14x
+        at klat=512/dpe=64/nq=16)."""
+        import tools.megakernel_benchmark as mb
+        res = mb.run_mla_ab(max_new=3)
+        assert res["greedy_match"], res
+        assert res["within_gate"], res
+        assert res["bytes_within_gate"], res
+        assert res["bytes_ratio"] < 0.15          # analytical ~0.14
+        assert res["dispatch_ratio"] < 1.0
+
+    @pytest.mark.slow
+    def test_mla_ab_int8_gates(self):
+        import tools.megakernel_benchmark as mb
+        res = mb.run_mla_ab(max_new=3, kv_dtype="int8")
+        assert res["kv_dtype"] == "int8"
+        assert res["greedy_match"], res
+        assert res["within_gate"], res
+        assert res["bytes_within_gate"], res
 
     @pytest.mark.slow
     def test_tiled_ab_gates(self):

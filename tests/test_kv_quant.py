@@ -180,15 +180,25 @@ class TestQuantizedPool:
         assert abs(ratio - expect) < 1e-6, (ratio, expect)
         assert i8.bytes_per_block * i8.num_blocks == i8.bytes_total
 
-    def test_int8_rejected_for_mla(self):
+    def test_int8_mla_latent_pool(self):
+        """MLA pools quantize since ISSUE 17: int8 latent/pe pools with
+        per-row SCALAR scale pools [L, NB, bs] (the rows have no kv-head
+        axis)."""
         cfg = TransformerConfig(
             num_layers=2, hidden_size=64, num_attention_heads=4,
             vocab_size=128, max_position_embeddings=64,
             multi_latent_attention=True, kv_lora_rank=32, qk_head_dim=16,
             qk_pos_emb_head_dim=8, v_head_dim=16,
             compute_dtype=jnp.float32, remat_policy="none")
-        with pytest.raises(ValueError, match="MLA"):
-            PagedKVCache(cfg, 2, 32, kv_cache_dtype="int8")
+        pool = PagedKVCache(cfg, 2, 32, num_blocks=8, block_size=4,
+                            kv_cache_dtype="int8")
+        assert pool.quantized
+        assert pool.pages[0].dtype == jnp.int8
+        assert pool.pages[0].shape == (2, 8, 4, cfg.kv_lora_rank)
+        assert pool.pages[1].shape == (2, 8, 4, cfg.qk_pos_emb_head_dim)
+        assert pool.scales is not None
+        assert all(s.shape == (2, 8, 4) and s.dtype == jnp.float32
+                   for s in pool.scales)
 
     def test_int8_requires_paged_backend(self):
         cfg = _gqa_cfg()
@@ -481,12 +491,12 @@ class TestServingArgsValidation:
         with pytest.raises(SystemExit, match="paged-kv-cache"):
             validate_serving_args(args)
 
-    def test_int8_rejected_for_mla_preset(self):
+    def test_int8_accepted_for_mla_preset(self):
+        """int8 + MLA validates since ISSUE 17 (quantized latent pool)."""
         from megatronapp_tpu.config.arguments import validate_serving_args
         args = self._args(engine="dynamic", kv_cache_dtype="int8",
                           paged_kv_cache=True)
-        with pytest.raises(SystemExit, match="MLA"):
-            validate_serving_args(args, multi_latent_attention=True)
+        validate_serving_args(args, multi_latent_attention=True)  # no raise
 
     def test_quantized_weights_rejected_for_mamba(self):
         from megatronapp_tpu.config.arguments import validate_serving_args

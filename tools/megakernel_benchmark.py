@@ -18,6 +18,10 @@ numbers are compiled-module facts):
            large-shape leg whose fused MLP body exceeds the VMEM
            budget — formerly a logged fallback, now grid-tiled, gated
            on the trace-only launch ratio + stream parity.
+  mla / mla_int8 (ISSUE 17): the A/B on a multi-latent config — fused
+           latent prologue + absorbed-q latent kernel vs the unfused
+           step — plus the latent-vs-dense attention byte gate at the
+           paper shape (klat=512/dpe=64/nq=16: ~0.14x, gate 0.25x).
   train:   fwd+bwd wall with the two staged PERF levers ON — flash
            backward head-fold (lever 1, --flash-head-fold) + a
            scan-unroll sweep (lever 3, --scan-unroll ∈ {1, 2, 4}) —
@@ -44,6 +48,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 DISPATCH_RATIO_GATE = 0.85   # fused launches must be <= 0.85x plain
+MLA_BYTES_GATE = 0.25        # latent layout <= 0.25x dense-gather bytes
 TRAIN_RATIO_GATE = 1.0       # levers-on fwd+bwd must not be slower
 LOSS_ATOL = 1e-6
 
@@ -206,6 +211,117 @@ def run_tiled_ab(max_new: int = 2):
     }
 
 
+def run_mla_ab(max_new: int = 6, kv_dtype: str = "bf16"):
+    """MLA leg (ISSUE 17): plain vs FUSED decode on a multi-latent
+    config — the fused latent prologue + absorbed-q latent kernel vs
+    the unfused mla_forward step (which runs the SAME latent kernel, so
+    streams gate EXACT). Gates: greedy parity, launch ratio <=
+    DISPATCH_RATIO_GATE, and the latent-vs-dense attention byte ratio
+    at the paper shape (klat=512, dpe=64, nq=16, dqk=dv=128) <=
+    MLA_BYTES_GATE — the latent pool reads klat+dpe per cached token
+    where the replaced dense gather materialized nq*(dqk+dv)+dpe.
+    Compiled cost-model bytes of both kernels ride along for the
+    record (totals include the shared w_v operand, so the layout ratio
+    is the gate)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from megatronapp_tpu.models.gpt import init_gpt_params
+
+    cfg = _make_cfg(multi_latent_attention=True, kv_lora_rank=32,
+                    qk_head_dim=16, qk_pos_emb_head_dim=8,
+                    v_head_dim=16)
+    fused_cfg = dataclasses.replace(cfg, scan_unroll=2)
+    params, _ = init_gpt_params(jax.random.PRNGKey(1), cfg)
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+               for n in (4, 9, 17, 26)]
+
+    plain = _build(cfg, params, fused=False, kv_cache_dtype=kv_dtype)
+    p_toks, p_dt, n_new = _run_requests(plain, prompts, max_new)
+    fused = _build(fused_cfg, params, fused=True,
+                   kv_cache_dtype=kv_dtype)
+    f_toks, f_dt, _ = _run_requests(fused, prompts, max_new)
+    fused.pool.audit()
+    assert fused.megakernel, \
+        "MLA fused engine fell back to the unfused step"
+
+    sp = plain.dispatch_stats()
+    sf = fused.dispatch_stats()
+    ratio = sf["dispatches_per_step"] / sp["dispatches_per_step"]
+
+    # Per-cached-token attention byte table at the paper shape. This is
+    # a layout fact: the latent pool holds [klat] + [dpe] per token; the
+    # dense path the kernel replaced re-expanded through kv_up to
+    # nq*(dqk+dv) (+ the shared roped key) every decode step.
+    klat, dpe, nq, dqk, dv = 512, 64, 16, 128, 128
+    item = 2 if kv_dtype != "int8" else 1
+    scale_bytes = 2 * 4 if kv_dtype == "int8" else 0  # per-row fp32 x2
+    lat_tok = (klat + dpe) * item + scale_bytes
+    dense_tok = (nq * (dqk + dv) + dpe) * 2   # compute dtype (bf16)
+    layout_ratio = lat_tok / dense_tok
+
+    # Compiled cost-model cross-check at the same shape, one decode
+    # token over 128 cached tokens (record, not gate — totals fold in
+    # the shared w_v read).
+    from megatronapp_tpu.ops.pallas.kernel_gen import (
+        paged_attention_latent,
+    )
+    from megatronapp_tpu.ops.pallas.paged_attention import (
+        paged_attention_latent_reference,
+    )
+    from megatronapp_tpu.utils.dispatch import compiled_stats
+    b, bs, mb = 1, 16, 8
+    nb = b * mb + 1
+    ks = jax.random.split(jax.random.PRNGKey(2), 5)
+    scale = 1.0 / ((dqk + dpe) ** 0.5)
+    args = (jax.random.normal(ks[0], (b, nq, klat), jnp.bfloat16),
+            jax.random.normal(ks[1], (b, nq, dpe), jnp.bfloat16),
+            jax.random.normal(ks[2], (nb, bs, klat), jnp.bfloat16),
+            jax.random.normal(ks[3], (nb, bs, dpe), jnp.bfloat16),
+            jnp.arange(1, b * mb + 1, dtype=jnp.int32).reshape(b, mb),
+            jnp.full((b,), bs * mb, jnp.int32),
+            jax.random.normal(ks[4], (klat, nq, dv), jnp.bfloat16))
+    cost = {}
+    for name, fn in (("latent_kernel", paged_attention_latent),
+                     ("dense_reference",
+                      paged_attention_latent_reference)):
+        st = compiled_stats(
+            jax.jit(lambda *a, _f=fn: _f(*a, softmax_scale=scale)),
+            *args)
+        if st.get("cost"):
+            cost[name] = st["cost"]
+
+    out = {
+        "kv_dtype": kv_dtype,
+        "kv_lora_rank": cfg.kv_lora_rank,
+        "greedy_match": p_toks == f_toks,
+        "dispatches_per_step": {"plain": sp["dispatches_per_step"],
+                                "fused": sf["dispatches_per_step"]},
+        "pallas_kernels_per_step": {"plain": sp["kernels"],
+                                    "fused": sf["kernels"]},
+        "dispatch_ratio": round(ratio, 4),
+        "dispatch_ratio_gate": DISPATCH_RATIO_GATE,
+        "within_gate": ratio <= DISPATCH_RATIO_GATE,
+        "bytes_per_token": {"latent": lat_tok, "dense": dense_tok,
+                            "shape": {"klat": klat, "dpe": dpe,
+                                      "nq": nq, "dqk": dqk, "dv": dv}},
+        "bytes_ratio": round(layout_ratio, 4),
+        "bytes_ratio_gate": MLA_BYTES_GATE,
+        "bytes_within_gate": layout_ratio <= MLA_BYTES_GATE,
+        "plain_tok_s": round(n_new / p_dt, 1),
+        "fused_tok_s": round(n_new / f_dt, 1),
+    }
+    if cost:
+        out["compiled_cost"] = cost
+    for name, st in (("plain", sp), ("fused", sf)):
+        c = st.get("compiled", {}).get("cost")
+        if c:
+            out.setdefault("compiled_step_cost", {})[name] = c
+    return out
+
+
 def run_train_levers(iters: int = 6, seq: int = 256, batch: int = 2,
                      unrolls=(1, 2, 4)):
     """fwd+bwd wall: baseline kernels/unroll=1 vs head-fold + each
@@ -291,6 +407,9 @@ def run(**kw):
             max_new=kw.get("max_new", 6),
             scan_unroll=kw.get("scan_unroll", 2), quantized=True),
         "decode_tiled": run_tiled_ab(max_new=kw.get("max_new_tiled", 2)),
+        "mla": run_mla_ab(max_new=kw.get("max_new", 6)),
+        "mla_int8": run_mla_ab(max_new=kw.get("max_new", 6),
+                               kv_dtype="int8"),
         "train": run_train_levers(iters=kw.get("iters", 6)),
     }
 
