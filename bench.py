@@ -211,6 +211,19 @@ def _fleet_hook():
     return r if r.get("affinity") else None
 
 
+def _fleet_proc_hook():
+    """Cross-process fleet A/B (tools/fleet_proc_benchmark.py) on the
+    CPU backend — stream parity vs the in-process fleet on the same
+    seeded loadgen trace, exact RPC frame/byte accounting, forced
+    cross-process migration parity, histogram-backed SLO attainment,
+    and the merged multi-process Chrome trace gate tracked round over
+    round like the other hooks."""
+    if os.environ.get("BENCH_FLEET_PROC", "1") != "1":
+        return None
+    r = _run_child("--fleet-proc", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("cross_process") else None
+
+
 def _pipeline_hook():
     """Zero-bubble-vs-1F1B pipeline schedule A/B
     (tools/pipeline_benchmark.py) on the CPU mesh — the simulated-
@@ -274,6 +287,9 @@ def _attach_overlap_hooks(res):
     flt = _fleet_hook()
     if flt:
         res.setdefault("extra", {})["fleet"] = flt
+    fpr = _fleet_proc_hook()
+    if fpr:
+        res.setdefault("extra", {})["fleet_proc"] = fpr
     ppl = _pipeline_hook()
     if ppl:
         res.setdefault("extra", {})["pipeline"] = ppl
@@ -353,6 +369,7 @@ def parent_main(local_only: bool = False):
     tel = _telemetry_hook()
     f8 = _fp8_hook()
     flt = _fleet_hook()
+    fpr = _fleet_proc_hook()
     ppl = _pipeline_hook()
     last = _load_last_good()
     if last is not None:
@@ -392,6 +409,8 @@ def parent_main(local_only: bool = False):
             last["extra"]["fp8"] = f8
         if flt:
             last["extra"]["fleet"] = flt
+        if fpr:
+            last["extra"]["fleet_proc"] = fpr
         if ppl:
             last["extra"]["pipeline"] = ppl
         print(json.dumps(last))
@@ -422,6 +441,8 @@ def parent_main(local_only: bool = False):
             cpu.setdefault("extra", {})["fp8"] = f8
         if flt:
             cpu.setdefault("extra", {})["fleet"] = flt
+        if fpr:
+            cpu.setdefault("extra", {})["fleet_proc"] = fpr
         if ppl:
             cpu.setdefault("extra", {})["pipeline"] = ppl
         print(json.dumps(cpu))
@@ -598,6 +619,15 @@ def fleet_main():
                          prefix_len=32, max_new=8)))
 
 
+def fleet_proc_main():
+    """cross-process fleet A/B child (CPU env set by the parent):
+    2 real replica worker processes replay the seeded loadgen trace
+    against the in-process fleet baseline."""
+    from tools.fleet_proc_benchmark import run
+    print(json.dumps(run(n_replicas=2, requests=10, tenants=2,
+                         max_new=8)))
+
+
 def disagg_main():
     """colocated-vs-disaggregated serving A/B child (CPU env set by the
     parent; virtual sub-mesh devices set here, pre-jax-import)."""
@@ -749,6 +779,8 @@ if __name__ == "__main__":
         telemetry_main()
     elif "--fp8" in sys.argv:
         fp8_main()
+    elif "--fleet-proc" in sys.argv:
+        fleet_proc_main()
     elif "--fleet" in sys.argv:
         fleet_main()
     else:

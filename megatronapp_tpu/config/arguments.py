@@ -184,6 +184,33 @@ def add_serving_args(ap: argparse.ArgumentParser):
                         "persistent prefill-queue depth grows it; "
                         "applied by drain + rebuild (needs "
                         "--serve-disagg)")
+    # Cross-process fleet (ISSUE 18, inference/fleet_rpc.py).
+    g.add_argument("--fleet-procs", type=int, default=0, metavar="N",
+                   help="promote the fleet to N replica WORKER "
+                        "PROCESSES behind the process router "
+                        "(inference/fleet_rpc.py): each replica is a "
+                        "spawned `python -m megatronapp_tpu.inference"
+                        ".fleet_rpc` worker serving its engine over a "
+                        "length-prefixed socket RPC; the router keeps "
+                        "the same rid space, affinity admission, and "
+                        "token-exact migration across the process "
+                        "boundary. 0 keeps fleet serving in-process "
+                        "(mutually exclusive with --serve-fleet N>1)")
+    g.add_argument("--replica-rpc-port", type=int, default=0,
+                   metavar="PORT",
+                   help="base TCP port for replica workers (replica i "
+                        "binds PORT+i on 127.0.0.1); 0 = ephemeral "
+                        "ports published via each replica's addr.json")
+    g.add_argument("--supervisor", choices=("off", "thread", "process"),
+                   default="off",
+                   help="replica supervisor mode (inference/"
+                        "supervisor.py): 'thread' polls worker "
+                        "heartbeats from a router thread, 'process' "
+                        "runs `python -m megatronapp_tpu.inference"
+                        ".supervisor` as its own OS process — either "
+                        "detects a wedged/killed worker, SIGKILLs and "
+                        "relaunches it, and the router fails sessions "
+                        "over losslessly (needs --fleet-procs)")
     # Telemetry spine (ISSUE 12).
     g.add_argument("--serving-metrics", action="store_true",
                    help="enable the telemetry registry "
@@ -278,6 +305,44 @@ def validate_serving_args(args, multi_latent_attention: bool = False):
             raise SystemExit(
                 "--fleet-autoscale needs --engine dynamic (it is a "
                 "fleet-router policy)")
+    # Cross-process fleet (ISSUE 18): same first-failed-predicate style.
+    procs = getattr(args, "fleet_procs", 0)
+    if procs < 0:
+        raise SystemExit(
+            f"--fleet-procs must be >= 0 (got {procs}); 0 = in-process "
+            "serving, N > 0 = N replica worker processes")
+    if procs > 0:
+        if fleet > 1:
+            raise SystemExit(
+                "--fleet-procs and --serve-fleet N>1 are mutually "
+                "exclusive: the process router OWNS its replica "
+                "workers (one fleet, one router — pick in-process OR "
+                "cross-process)")
+        if getattr(args, "engine", "static") != "dynamic":
+            raise SystemExit(
+                "--fleet-procs requires --engine dynamic (replica "
+                "workers serve DynamicInferenceEngine step loops)")
+        if not getattr(args, "paged_kv_cache", False):
+            raise SystemExit(
+                "--fleet-procs requires --paged-kv-cache (cross-"
+                "process migration ships pool blocks; affinity rides "
+                "the pool's rolling block hashes)")
+    port = getattr(args, "replica_rpc_port", 0)
+    if port and not procs:
+        raise SystemExit(
+            "--replica-rpc-port needs --fleet-procs (it is the replica "
+            "workers' base port; in-process replicas have no sockets)")
+    if port and not (1024 <= port <= 65535 - max(procs, 1)):
+        raise SystemExit(
+            f"--replica-rpc-port {port} out of range: need 1024 <= "
+            f"PORT and PORT+{procs} <= 65535 (replica i binds PORT+i), "
+            "or 0 for ephemeral ports")
+    if getattr(args, "supervisor", "off") != "off" and not procs:
+        raise SystemExit(
+            "--supervisor needs --fleet-procs (it watches worker "
+            "heartbeats and relaunches worker PROCESSES; the in-process "
+            "fleet's kill/revive drills already route through the same "
+            "supervisor code path internally)")
     if (getattr(args, "quantized_weights", False)
             and getattr(args, "engine", "static") == "mamba"):
         raise SystemExit(

@@ -476,11 +476,13 @@ class DisaggServingEngine:
     def add_request(self, prompt_tokens, max_new_tokens: int,
                     sampling: Optional[SamplingParams] = None,
                     eod_id: Optional[int] = None, priority: int = 0,
-                    deadline_s: Optional[float] = None) -> int:
+                    deadline_s: Optional[float] = None,
+                    request_id: Optional[int] = None) -> int:
         """Same contract/validation as the engine's add_request (the
         shared `validate_admission`); requests enter the PREFILL queue
         (served in (priority, request_id) order — SLO-aware admission)
-        instead of the decode waiting queue."""
+        instead of the decode waiting queue. `request_id` lets the
+        cross-process fleet router (inference/fleet_rpc.py) mint the id."""
         try:
             prompt = validate_admission(prompt_tokens, max_new_tokens,
                                         self.max_seq_len, pool=self.pool,
@@ -489,7 +491,11 @@ class DisaggServingEngine:
             self.slo_stats["rejected_at_admission"] += 1
             raise
         now = time.monotonic()
-        req = Request(next(self.engine._ids), prompt, max_new_tokens,
+        if request_id is None:
+            request_id = next(self.engine._ids)
+        elif request_id in self.requests:
+            raise ValueError(f"request id {request_id} already admitted")
+        req = Request(request_id, prompt, max_new_tokens,
                       sampling or SamplingParams(), eod_id=eod_id,
                       priority=priority, deadline_s=deadline_s,
                       admit_t=now, queued_t=now)

@@ -666,13 +666,23 @@ class DynamicInferenceEngine:
                     sampling: Optional[SamplingParams] = None,
                     eod_id: Optional[int] = None,
                     priority: int = 0,
-                    deadline_s: Optional[float] = None) -> int:
+                    deadline_s: Optional[float] = None,
+                    request_id: Optional[int] = None) -> int:
         prompt = validate_admission(prompt_tokens, max_new_tokens,
                                     self.max_seq_len,
                                     pool=self.pool if self.paged else None,
                                     deadline_s=deadline_s)
         now = time.monotonic()
-        req = Request(next(self._ids), prompt, max_new_tokens,
+        # An explicit request_id is the cross-process fleet's admission
+        # shape (inference/fleet_rpc.py): the ROUTER owns the one rid
+        # space spanning every replica worker, so the engine must accept
+        # a caller-minted id — the sampler's fold_in chain keys off it,
+        # which is what makes a stream's tokens placement-independent.
+        if request_id is None:
+            request_id = next(self._ids)
+        elif request_id in self.requests:
+            raise ValueError(f"request id {request_id} already admitted")
+        req = Request(request_id, prompt, max_new_tokens,
                       sampling or SamplingParams(), eod_id=eod_id,
                       priority=priority, deadline_s=deadline_s,
                       admit_t=now, queued_t=now)

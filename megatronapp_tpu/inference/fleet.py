@@ -285,6 +285,7 @@ class FleetRouter:
         # mark the hop) — label the rows so trace readers know.
         self._rt.set_process_name(DECODE_PID, "decode-mesh (fleet)")
         self._rt.set_process_name(PREFILL_PID, "prefill-mesh (fleet)")
+        self._supervisor = None             # lazy (see .supervisor)
 
     # ---- replica wiring --------------------------------------------------
     def _wire(self, rep: Replica):
@@ -724,21 +725,46 @@ class FleetRouter:
                 self._rt.instant("failover", rid, dead_replica=rep.idx)
             eng.requests.clear()
 
+    @property
+    def supervisor(self):
+        """The ONE supervisor code path (inference/supervisor.py):
+        manual drills (`kill_replica`/`revive_replica`) and the
+        threaded poll loop (`.supervisor.start()`) both run the same
+        Supervisor policy over an in-process backend, so "playing
+        supervisor by hand" and the real watcher cannot drift. The
+        cross-process fleet wires the SAME Supervisor over a process
+        backend (inference/fleet_rpc.py)."""
+        if self._supervisor is None:
+            from megatronapp_tpu.inference.supervisor import Supervisor
+            self._supervisor = Supervisor(_InProcessBackend(self),
+                                          interval=0.5)
+        return self._supervisor
+
     def kill_replica(self, idx: int):
         """Operator/drill entry: treat replica `idx` as dead right now
-        (same path a step() exception takes)."""
+        (same path a step() exception takes) — routed through the one
+        supervisor code path."""
+        self.supervisor.kill(idx)
+
+    def revive_replica(self, idx: int, **hints):
+        """Replace a DEAD (or rebuild a live, drained) replica —
+        routed through the one supervisor code path (the backend's
+        relaunch mechanism is `_revive_impl`)."""
+        self.supervisor.revive(idx, **hints)
+
+    def _kill_impl(self, idx: int):
         rep = self.replicas[idx]
         if rep.state == DEAD:
             return
         self._fail_replica(rep, RuntimeError("killed by operator"))
 
-    def revive_replica(self, idx: int, **hints):
-        """Replace a DEAD (or rebuild a live, drained) replica through
-        the engine_factory. The factory builds with its captured
-        (startup) params, so when the fleet has since rolled to newer
-        weights the rebuilt engine is swapped onto them before it
-        serves — a revived replica may never claim the current version
-        while holding factory-stale weights."""
+    def _revive_impl(self, idx: int, **hints):
+        """Rebuild replica `idx` through the engine_factory. The
+        factory builds with its captured (startup) params, so when the
+        fleet has since rolled to newer weights the rebuilt engine is
+        swapped onto them before it serves — a revived replica may
+        never claim the current version while holding factory-stale
+        weights."""
         assert self.engine_factory is not None, (
             "revive_replica needs an engine_factory")
         # Router lock across the swap: add_request could otherwise
@@ -1021,6 +1047,9 @@ class FleetRouter:
                 "params_version": self._version,
                 "reload_pending": self._reload is not None,
                 "affinity_entries": len(self._affinity),
+                "supervisor_restarts": (
+                    self._supervisor.total_restarts
+                    if self._supervisor is not None else 0),
                 "prefix_hit_rate": (round(hit / seen, 4) if seen
                                     else 0.0),
                 **self.router_stats,
@@ -1058,3 +1087,28 @@ class FleetRouter:
                 new_ids = new_ids[: new_ids.index(eod)]
             texts.append(self.tokenizer.detokenize(new_ids))
         return texts
+
+
+class _InProcessBackend:
+    """Supervisor backend over an in-process FleetRouter: alive = the
+    replica is not DEAD, kill = the step-exception failover path
+    (`_fail_replica` — zero lost sessions), relaunch = the
+    engine_factory rebuild. The cross-process twin lives in
+    inference/fleet_rpc.py; both feed the SAME Supervisor policy
+    (inference/supervisor.py), so thread mode and process mode cannot
+    drift."""
+
+    def __init__(self, router: "FleetRouter"):
+        self.router = router
+
+    def indices(self) -> List[int]:
+        return [rep.idx for rep in self.router.replicas]
+
+    def alive(self, idx: int) -> bool:
+        return self.router.replicas[idx].state != DEAD
+
+    def kill(self, idx: int):
+        self.router._kill_impl(idx)
+
+    def relaunch(self, idx: int, **hints):
+        self.router._revive_impl(idx, **hints)

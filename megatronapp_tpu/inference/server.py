@@ -331,10 +331,12 @@ class TextGenerationServer:
             DynamicInferenceEngine,
         )
         from megatronapp_tpu.inference.fleet import FleetRouter
+        from megatronapp_tpu.inference.fleet_rpc import ProcessFleetRouter
         self._driver = (DynamicBatchingDriver(engine)
                         if isinstance(engine, (DynamicInferenceEngine,
                                                DisaggServingEngine,
-                                               FleetRouter))
+                                               FleetRouter,
+                                               ProcessFleetRouter))
                         else None)
 
     # ------------------------------------------------------------------
@@ -701,6 +703,11 @@ class TextGenerationServer:
                     "reload_pending": f["reload_pending"],
                     "migrations": f["migrations"],
                     "failovers": f["failovers"],
+                    # Cross-process fleets (inference/fleet_rpc.py)
+                    # report supervisor restart accounting; in-process
+                    # fleets report 0 until their supervisor runs.
+                    "supervisor_restarts": f.get(
+                        "supervisor_restarts", 0),
                     "replicas": [
                         {k: r.get(k) for k in
                          ("idx", "state", "active", "waiting",
@@ -754,6 +761,13 @@ class TextGenerationServer:
             telemetry.set_gauge("paged_blocks_free", pool.free_blocks())
             telemetry.set_gauge("paged_blocks_evictable",
                                 pool.evictable_blocks())
+        if hasattr(eng, "export_fleet_gauges"):
+            # Cross-process fleet (inference/fleet_rpc.py): the router
+            # exports its own per-replica labeled gauges + supervisor
+            # restart counts — the replica engines live in OTHER
+            # processes, so their state is only reachable through the
+            # router's last step replies. One scrape covers the fleet.
+            eng.export_fleet_gauges(telemetry)
         reps = getattr(eng, "replicas", None)
         if reps is not None:
             # Per-replica labeled series (one metric family, N labeled
@@ -787,6 +801,17 @@ class TextGenerationServer:
                 telemetry.set_gauge(
                     lab("fleet_replica_blocks_in_use", replica=r),
                     reng.pool.blocks_in_use())
+            sup = getattr(eng, "_supervisor", None)
+            if sup is not None:
+                # Same restart-accounting series the cross-process
+                # router exports — kill/revive drills route through the
+                # one Supervisor, so the counters exist in-process too.
+                for idx, n in sup.restarts.items():
+                    telemetry.set_gauge(
+                        lab("fleet_supervisor_restarts",
+                            replica=str(idx)), n)
+                telemetry.set_gauge("fleet_supervisor_restarts_total",
+                                    sup.total_restarts)
         if self._driver is not None:
             st = self._driver.stats()
             telemetry.set_gauge("serving_stepper_alive",
@@ -814,8 +839,13 @@ class TextGenerationServer:
     def dump_request_trace(self, path: Optional[str] = None) -> dict:
         """Driver hook: render the request-trace ring as one merged
         Chrome trace (prefill + decode mesh rows); optionally write it
-        to `path` for chrome://tracing / Perfetto."""
-        trace = get_request_tracer().chrome_trace()
+        to `path` for chrome://tracing / Perfetto. A process-backed
+        fleet merges every replica worker's ring over RPC into the
+        same trace (one pid row per process)."""
+        if hasattr(self.engine, "merged_trace"):
+            trace = self.engine.merged_trace()
+        else:
+            trace = get_request_tracer().chrome_trace()
         if path is not None:
             with open(path, "w") as f:
                 json.dump(trace, f)

@@ -1,0 +1,271 @@
+"""Replica supervisor: ONE detection/relaunch code path (ISSUE 18).
+
+PR 14's fleet carried replica death and replacement as two router
+methods (`kill_replica`/`revive_replica`) that tests and operators
+called "by hand" — and the cross-process fleet (inference/fleet_rpc.py)
+needs a REAL supervisor: something that watches replica worker
+processes through the long-carried `read_heartbeat` view
+(training/ft_integration.py — the on-disk heartbeat written exactly so
+an EXTERNAL supervisor can see a hung process from outside), SIGKILLs a
+wedged or dead worker, and relaunches it. If those were two separate
+code paths they would drift; this module is the single one.
+
+`Supervisor` owns the POLICY (poll → detect → kill → relaunch →
+account a restart) and delegates the MECHANISM to a backend object:
+
+- ``FleetRouter.supervisor`` (inference/fleet.py) wires an in-process
+  backend: alive = replica not DEAD, kill = the step-exception failover
+  path (`_fail_replica` — zero lost sessions), relaunch = the
+  engine_factory rebuild. Manual drills (`kill_replica`,
+  `revive_replica`) route through the SAME Supervisor methods the poll
+  loop uses, so "playing supervisor by hand" and the real watcher
+  cannot diverge.
+- ``ProcessFleetRouter`` (inference/fleet_rpc.py) wires a process
+  backend: alive = worker pid running AND heartbeat fresh, kill =
+  SIGKILL + router-side session failover, relaunch = respawn the worker
+  entrypoint with a bumped incarnation (the router reattaches off the
+  worker's addr file).
+- ``python -m megatronapp_tpu.inference.supervisor --state-dir D``
+  runs the same policy as a STANDALONE OS process against the state
+  directory alone (addr/heartbeat files), so the router and the
+  supervisor can live in different processes: the supervisor respawns,
+  the router notices the incarnation bump and reconnects.
+
+Restart accounting (`restarts` per replica) is persisted to
+``<state_dir>/supervisor.json`` when a state dir is given, so the
+router's /stats // /metrics aggregation reports supervisor restarts no
+matter which process did the restarting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+SUPERVISOR_FILE = "supervisor.json"
+
+
+class Supervisor:
+    """Detection/relaunch policy over a pluggable backend.
+
+    Backend protocol (duck-typed):
+      indices() -> List[int]          replicas under supervision
+      alive(idx) -> bool              liveness probe
+      kill(idx)                       force-fail (sessions fail over)
+      relaunch(idx, **hints)          bring a replacement up
+    """
+
+    def __init__(self, backend, interval: float = 1.0,
+                 state_dir: Optional[str] = None):
+        self.backend = backend
+        self.interval = interval
+        self.state_dir = state_dir
+        self.restarts: Dict[int, int] = {
+            i: 0 for i in backend.indices()}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.RLock()
+        self._load_state()
+
+    # -- accounting ---------------------------------------------------------
+    @property
+    def total_restarts(self) -> int:
+        return sum(self.restarts.values())
+
+    def _load_state(self):
+        """Adopt restart counts from a previous supervisor incarnation
+        (router restart recovery keeps the counters monotonic)."""
+        if not self.state_dir:
+            return
+        path = os.path.join(self.state_dir, SUPERVISOR_FILE)
+        try:
+            with open(path) as f:
+                prev = json.load(f).get("restarts", {})
+            for k, v in prev.items():
+                self.restarts[int(k)] = max(
+                    self.restarts.get(int(k), 0), int(v))
+        except (OSError, ValueError):
+            pass
+
+    def _write_state(self):
+        if not self.state_dir:
+            return
+        path = os.path.join(self.state_dir, SUPERVISOR_FILE)
+        tmp = path + ".tmp"
+        payload = {"pid": os.getpid(), "ts": time.time(),
+                   "restarts": {str(k): v
+                                for k, v in self.restarts.items()}}
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:
+            logger.warning("supervisor state write failed", exc_info=True)
+
+    # -- the one code path --------------------------------------------------
+    def kill(self, idx: int):
+        """Force-fail replica `idx` (manual drills and the poll loop
+        both land here): the backend fails its sessions over — zero
+        lost — and the replica is DEAD until `revive`."""
+        with self._lock:
+            self.backend.kill(idx)
+
+    def revive(self, idx: int, **hints):
+        """Bring a replacement for replica `idx` up through the
+        backend's relaunch mechanism (engine_factory rebuild in-process;
+        worker respawn cross-process). Counts a restart — a manual
+        revive IS a restart, so drills and the poll loop report through
+        the same accounting."""
+        with self._lock:
+            self.backend.relaunch(idx, **hints)
+            self.restarts[idx] = self.restarts.get(idx, 0) + 1
+        self._write_state()
+
+    def poll_once(self) -> List[int]:
+        """One detection round: every dead/wedged replica is killed
+        (idempotent — failover already ran if the router saw the death
+        first), relaunched, and counted. Returns recovered indices."""
+        recovered: List[int] = []
+        for idx in self.backend.indices():
+            try:
+                if self.backend.alive(idx):
+                    continue
+            except Exception:  # noqa: BLE001 — probe failure = dead
+                pass
+            logger.warning(
+                "supervisor: replica %d dead/wedged — SIGKILL + "
+                "relaunch", idx)
+            with self._lock:
+                try:
+                    self.backend.kill(idx)
+                except Exception:  # noqa: BLE001 — already dead is fine
+                    logger.debug("supervisor kill(%d) raised", idx,
+                                 exc_info=True)
+                try:
+                    self.backend.relaunch(idx)
+                except Exception:  # noqa: BLE001 — retried next poll
+                    logger.warning("supervisor relaunch(%d) failed — "
+                                   "retrying next poll", idx,
+                                   exc_info=True)
+                    continue
+                self.restarts[idx] = self.restarts.get(idx, 0) + 1
+            recovered.append(idx)
+        self._write_state()
+        return recovered
+
+    # -- thread mode --------------------------------------------------------
+    def start(self) -> "Supervisor":
+        """Run the poll loop in a daemon thread (the in-process
+        supervisor mode; the standalone process mode runs main())."""
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-supervisor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval * 4)
+            self._thread = None
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — supervisor must survive
+                logger.warning("supervisor poll failed", exc_info=True)
+
+
+class StateDirBackend:
+    """Backend for the STANDALONE supervisor process: everything it
+    knows comes from the fleet state directory (worker addr files +
+    heartbeats), so it shares no memory with the router. Relaunched
+    workers become children of the supervisor process; the router
+    notices the addr file's incarnation bump and reconnects."""
+
+    def __init__(self, state_dir: str, stale_after: float = 15.0):
+        self.state_dir = state_dir
+        self.stale_after = stale_after
+        self._procs: Dict[int, object] = {}   # idx -> Popen we spawned
+
+    def indices(self) -> List[int]:
+        from megatronapp_tpu.inference.fleet_rpc import replica_dirs
+        return replica_dirs(self.state_dir)
+
+    def _addr(self, idx: int) -> Optional[dict]:
+        from megatronapp_tpu.inference.fleet_rpc import read_addr
+        return read_addr(self.state_dir, idx)
+
+    def alive(self, idx: int) -> bool:
+        from megatronapp_tpu.training.ft_integration import read_heartbeat
+        addr = self._addr(idx)
+        if addr is None:
+            return False
+        try:
+            os.kill(addr["pid"], 0)
+        except (OSError, ProcessLookupError):
+            return False
+        from megatronapp_tpu.inference.fleet_rpc import heartbeat_dir
+        hb = read_heartbeat(heartbeat_dir(self.state_dir, idx),
+                            stale_after=self.stale_after)
+        return bool(hb["alive"])
+
+    def kill(self, idx: int):
+        addr = self._addr(idx)
+        if addr is None:
+            return
+        try:
+            os.kill(addr["pid"], signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            pass
+
+    def relaunch(self, idx: int, **hints):
+        from megatronapp_tpu.inference.fleet_rpc import (
+            spawn_worker, wait_for_addr,
+        )
+        addr = self._addr(idx) or {"incarnation": -1}
+        incarnation = addr["incarnation"] + 1
+        proc = spawn_worker(self.state_dir, idx, incarnation)
+        self._procs[idx] = proc
+        wait_for_addr(self.state_dir, idx, incarnation)
+
+
+def main(argv=None) -> int:
+    """Standalone supervisor process entrypoint:
+
+      python -m megatronapp_tpu.inference.supervisor --state-dir D
+    """
+    ap = argparse.ArgumentParser(
+        description="fleet replica supervisor (ISSUE 18)")
+    ap.add_argument("--state-dir", required=True)
+    ap.add_argument("--stale-after", type=float, default=15.0,
+                    help="heartbeat age past which a worker counts as "
+                         "wedged (SIGKILL + relaunch)")
+    ap.add_argument("--interval", type=float, default=1.0)
+    args = ap.parse_args(argv)
+    backend = StateDirBackend(args.state_dir,
+                              stale_after=args.stale_after)
+    sup = Supervisor(backend, interval=args.interval,
+                     state_dir=args.state_dir)
+    print(f"supervisor pid {os.getpid()} watching {args.state_dir} "
+          f"(stale_after={args.stale_after}s)", flush=True)
+    sup._write_state()
+    try:
+        while True:
+            sup.poll_once()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

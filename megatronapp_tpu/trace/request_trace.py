@@ -206,6 +206,50 @@ class RequestTracer:
         return path
 
 
+def merge_process_traces(procs: List[tuple]) -> dict:
+    """Merge per-PROCESS request-trace rings into ONE Chrome trace —
+    the MegaScan per-rank-merge story applied to serving (ISSUE 18):
+    each replica worker dumps its ring over RPC and the router renders
+    one timeline with a process row per (worker, logical mesh).
+
+    `procs` is ``[(label, records, pid_names), ...]`` where `records`
+    is a ring dump (RequestTracer.dump()) and `pid_names` that
+    process's pid→row-label map. Each process's ring has its OWN
+    perf_counter epoch, so timestamps are normalized per ring (min →
+    0); pids are offset by 100·i so rows never collide, and labels
+    compose as "label name" ("replica-1 decode-mesh"). Empty rings are
+    skipped. B/E pairing is per-(pid, tid, name), and the pid offset
+    keeps every process's spans in their own rows, so pairing never
+    crosses a process boundary."""
+    from megatronapp_tpu.trace.aggregate import (
+        chrome_trace as _chrome, transform_to_complete_events,
+    )
+    merged: List[dict] = []
+    names: Dict[int, str] = {}
+    for i, (label, records, pid_names) in enumerate(procs):
+        if not records:
+            continue
+        base = 100 * i
+        t_min = min(r["ts"] for r in records)
+        t_end = max(r["ts"] for r in records) - t_min + 1.0
+        pids = sorted({r["pid"] for r in records})
+        for pid in pids:
+            row = (pid_names or {}).get(pid, f"pid-{pid}")
+            names[base + pid] = f"{label} {row}"
+            merged.append({"name": "iteration", "ph": "B", "ts": 0.0,
+                           "pid": base + pid, "tid": 0, "iteration": 0,
+                           "args": {}})
+        for r in records:
+            merged.append(dict(r, ts=r["ts"] - t_min,
+                               pid=base + r["pid"]))
+        for pid in pids:
+            merged.append({"name": "iteration", "ph": "E", "ts": t_end,
+                           "pid": base + pid, "tid": 0, "iteration": 0,
+                           "args": {}})
+    merged.sort(key=lambda r: (r["ts"], r["pid"]))
+    return _chrome(transform_to_complete_events(merged), names)
+
+
 _TRACER = RequestTracer()
 
 

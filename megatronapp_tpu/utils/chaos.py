@@ -76,6 +76,17 @@ tests/test_resilience.py pins this registry against its drill list):
                              source slot stays intact, both pools
                              audit() clean, and the retried stream is
                              bit-identical).
+- ``fleet-rpc``              a cross-process fleet RPC reply is lost
+                             AFTER the replica serialized + sent it and
+                             the router deserialized it, but BEFORE the
+                             router commits it (inference/fleet_rpc
+                             .ReplicaClient.call) — the lost-
+                             acknowledgement window: exercises the
+                             router's rollback verbs (idempotent evict
+                             + resubmit for admission, destination
+                             evict for migration, sessions-resync for a
+                             lost step reply) — zero sessions lost,
+                             pools audit() clean, streams unchanged.
 
 Simulated whole-process faults (hang / exit) are flag-driven rather than
 registry-driven: --simulated-fault KIND:DELAY routes through
@@ -99,6 +110,7 @@ SITES = (
     "spec-verify",
     "kv-quant-write",
     "fleet-migrate",
+    "fleet-rpc",
 )
 
 

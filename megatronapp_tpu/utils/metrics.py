@@ -147,6 +147,42 @@ class Histogram:
             "p99": round(self.percentile(99), 6),
         }
 
+    # -- cross-process transport (inference/fleet_rpc.py) -----------------
+    # A Histogram carries a lock, so the object itself cannot cross a
+    # process boundary; its STATE can. Replica workers ship state dicts
+    # in step/stats replies and the router reconstructs or merges —
+    # percentiles and attainment then read identically on either side.
+    def state(self) -> Dict:
+        """Picklable full state (bounds + counts + sum)."""
+        with self._lock:
+            return {"bounds": list(self.bounds), "growth": self.growth,
+                    "counts": list(self.counts), "count": self.count,
+                    "sum": self.sum}
+
+    @classmethod
+    def from_state(cls, st: Dict) -> "Histogram":
+        h = cls.__new__(cls)
+        h.bounds = list(st["bounds"])
+        h.growth = st["growth"]
+        h.counts = list(st["counts"])
+        h.count = st["count"]
+        h.sum = st["sum"]
+        h._lock = threading.Lock()
+        return h
+
+    def merge_state(self, st: Dict):
+        """Accumulate another histogram's state into this one (the
+        router's fleet-wide attainment view). Bucket layouts must match
+        — both sides build from the same (lo, hi, growth)."""
+        if list(st["bounds"]) != list(self.bounds):
+            raise ValueError("histogram bucket layouts differ; cannot "
+                             "merge")
+        with self._lock:
+            for i, c in enumerate(st["counts"]):
+                self.counts[i] += c
+            self.count += st["count"]
+            self.sum += st["sum"]
+
 
 class Ewma:
     """Exponentially-weighted moving average (the SLO-budget smoothing

@@ -60,11 +60,13 @@ class TestChaosRegistry:
         TestPagedAllocatorChaos, spec-verify →
         TestSpeculativeVerifierChaos, kv-quant-write →
         TestKvQuantWriteChaos, fleet-migrate →
-        TestFleetMigrateChaos)."""
+        TestFleetMigrateChaos, fleet-rpc →
+        tests/test_fleet_rpc.py::TestChaosRpc)."""
         assert chaos.SITES == ("checkpoint-save", "local-checkpoint-save",
                                "step-nan", "stepper-step",
                                "paged-evict", "paged-cow", "spec-verify",
-                               "kv-quant-write", "fleet-migrate")
+                               "kv-quant-write", "fleet-migrate",
+                               "fleet-rpc")
 
     def test_arm_fire_bounded_and_auto_disarm(self):
         chaos.arm("stepper-step", times=2, after=1)

@@ -1,0 +1,236 @@
+"""Deterministic trace-replay load generator for fleet serving
+(ISSUE 18; the production-traffic harness for inference/fleet_rpc.py).
+
+A seeded trace models the traffic shapes the fleet machinery exists
+for, all from one RNG so two runs of the same seed replay the SAME
+requests in the SAME arrival order:
+
+- **arrival bursts**: a base arrival gap punctuated every
+  ``burst_every`` steps by ``burst_size`` simultaneous arrivals (the
+  queue-depth spikes admission scoring and SLO attainment are scored
+  under);
+- **length mixes**: per-request prompt tails and decode budgets drawn
+  from seeded ranges (short chat turns next to long completions — the
+  continuous-batching case);
+- **shared-system-prompt tenant groups**: ``tenants`` groups, each with
+  its own ``prefix_len``-token system prefix shared by every request in
+  the group (the KV-affinity signal: followers should land where their
+  tenant's prefix blocks live);
+- **abort/timeout rates**: a seeded fraction of requests cancels after
+  a seeded number of emitted tokens (client disconnects mid-stream —
+  the abort path under load).
+
+``replay()`` drives any engine-shaped router (in-process FleetRouter,
+cross-process ProcessFleetRouter, or a bare engine — anything with
+add_request/step/abort_request/pop_request) through the trace on a
+VIRTUAL clock (one router step = one tick, arrivals keyed to ticks), so
+the submitted workload is identical across legs regardless of wall
+speed; wall-clock TTFT and token intervals are measured into the
+PR-12 ``utils/metrics.Histogram`` primitive and the SLO gates read
+p99 / attainment off those histograms — the same estimator /metrics
+exports.
+
+Standalone CLI (spawns a cross-process fleet, replays, one JSON line):
+
+  python tools/loadgen.py --fleet-procs 2 --requests 24 --seed 0
+
+bench.py's `extra.fleet_proc` gate imports make_trace/replay instead of
+shelling out twice (tools/fleet_proc_benchmark.py).
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def make_trace(seed: int = 0, n_requests: int = 24, tenants: int = 3,
+               prefix_len: int = 24, tail_min: int = 2,
+               tail_max: int = 8, max_new_min: int = 4,
+               max_new_max: int = 12, arrival_gap: int = 2,
+               burst_every: int = 8, burst_size: int = 3,
+               abort_rate: float = 0.0, abort_after_min: int = 2,
+               vocab: int = 128):
+    """Build the seeded event list. Each event:
+    {id, arrive_step, tenant, prompt, max_new, abort_after} — prompts
+    are tenant_prefix + per-request tail; abort_after is None or the
+    emitted-token count after which the client cancels."""
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(0, vocab, size=prefix_len).astype(np.int32)
+                for _ in range(tenants)]
+    events = []
+    step = 0
+    k = 0
+    while k < n_requests:
+        burst = (burst_size if burst_every and k
+                 and k % burst_every == 0 else 1)
+        for _ in range(min(burst, n_requests - k)):
+            tenant = int(rng.integers(0, tenants))
+            tail = rng.integers(
+                0, vocab,
+                size=int(rng.integers(tail_min, tail_max + 1)))
+            max_new = int(rng.integers(max_new_min, max_new_max + 1))
+            abort_after = None
+            if abort_rate > 0 and rng.random() < abort_rate:
+                abort_after = int(rng.integers(
+                    abort_after_min, max(abort_after_min + 1, max_new)))
+            events.append({
+                "id": k, "arrive_step": step, "tenant": tenant,
+                "prompt": np.concatenate(
+                    [prefixes[tenant], tail.astype(np.int32)]),
+                "max_new": max_new, "abort_after": abort_after,
+            })
+            k += 1
+        step += arrival_gap
+    return events
+
+
+def replay(router, trace, slo_ttft_ms=None, slo_interval_ms=None,
+           max_steps: int = 100_000):
+    """Replay `trace` against `router` on the virtual step clock.
+    Returns {streams, ttft_hist, interval_hist, report} — streams maps
+    trace id -> emitted token list (the cross-leg parity surface),
+    histograms are live Histogram objects (the /metrics estimator), and
+    report is the JSON-ready gate summary."""
+    from megatronapp_tpu.utils.metrics import Histogram
+
+    ttft_hist = Histogram(lo=1e-2, hi=1e6, growth=1.25)
+    interval_hist = Histogram(lo=1e-2, hi=1e6, growth=1.25)
+    pending = sorted(trace, key=lambda e: (e["arrive_step"], e["id"]))
+    rid_to_ev = {}
+    submit_t = {}
+    last_tok_t = {}
+    streams = {}
+    aborted = set()
+    finished = set()
+    step = 0
+    while pending or any(
+            rid not in finished for rid in rid_to_ev):
+        if step >= max_steps:
+            raise RuntimeError(
+                f"loadgen replay did not drain within {max_steps} "
+                f"steps ({len(finished)}/{len(rid_to_ev)} finished)")
+        while pending and pending[0]["arrive_step"] <= step:
+            ev = pending.pop(0)
+            rid = router.add_request(ev["prompt"], ev["max_new"])
+            rid_to_ev[rid] = ev
+            submit_t[rid] = time.monotonic()
+            streams[ev["id"]] = []
+        events = router.step()
+        now = time.monotonic()
+        for rid, tok in events["tokens"]:
+            ev = rid_to_ev.get(rid)
+            if ev is None:
+                continue
+            toks = streams[ev["id"]]
+            if not toks:
+                ttft_hist.observe((now - submit_t[rid]) * 1e3)
+            elif rid in last_tok_t:
+                interval_hist.observe((now - last_tok_t[rid]) * 1e3)
+            last_tok_t[rid] = now
+            toks.append(int(tok))
+            if (ev["abort_after"] is not None and rid not in aborted
+                    and len(toks) >= ev["abort_after"]):
+                aborted.add(rid)
+                router.abort_request(rid)
+        for rid in events["finished"] + events["expired"]:
+            if rid in rid_to_ev:
+                finished.add(rid)
+        step += 1
+    for rid, ev in rid_to_ev.items():
+        req = router.pop_request(rid)
+        if req is not None and len(req.generated) > len(
+                streams[ev["id"]]):
+            streams[ev["id"]] = [int(t) for t in req.generated]
+    report = {
+        "requests": len(rid_to_ev),
+        "steps": step,
+        "aborted": len(aborted),
+        "tokens_out": sum(len(s) for s in streams.values()),
+        "ttft_p50_ms": round(ttft_hist.percentile(50), 3),
+        "ttft_p99_ms": round(ttft_hist.percentile(99), 3),
+        "interval_p99_ms": round(interval_hist.percentile(99), 3),
+    }
+    if slo_ttft_ms is not None:
+        report["ttft_attainment"] = round(
+            ttft_hist.fraction_below(slo_ttft_ms), 4)
+    if slo_interval_ms is not None:
+        report["interval_attainment"] = round(
+            interval_hist.fraction_below(slo_interval_ms), 4)
+    return {"streams": streams, "ttft_hist": ttft_hist,
+            "interval_hist": interval_hist, "report": report}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="deterministic trace-replay load generator "
+                    "(ISSUE 18)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--tenants", type=int, default=3)
+    ap.add_argument("--prefix-len", type=int, default=24)
+    ap.add_argument("--arrival-gap", type=int, default=2)
+    ap.add_argument("--burst-every", type=int, default=8)
+    ap.add_argument("--burst-size", type=int, default=3)
+    ap.add_argument("--abort-rate", type=float, default=0.0)
+    ap.add_argument("--slo-ttft-ms", type=float, default=None)
+    ap.add_argument("--slo-interval-ms", type=float, default=None)
+    ap.add_argument("--fleet-procs", type=int, default=2,
+                    help="replica worker processes to spawn (0 = "
+                         "replay against one in-process engine)")
+    ap.add_argument("--supervisor", choices=("off", "thread",
+                                             "process"), default="off")
+    ap.add_argument("--state-dir", default=None,
+                    help="fleet state dir (default: a temp dir)")
+    ap.add_argument("--trace-out", default=None,
+                    help="write the merged multi-process Chrome trace "
+                         "here (cross-process mode)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from megatronapp_tpu.inference.fleet_rpc import (
+        ProcessFleetRouter, build_engine_from_spec, default_engine_spec,
+    )
+
+    trace = make_trace(
+        seed=args.seed, n_requests=args.requests,
+        tenants=args.tenants, prefix_len=args.prefix_len,
+        arrival_gap=args.arrival_gap, burst_every=args.burst_every,
+        burst_size=args.burst_size, abort_rate=args.abort_rate)
+    spec = default_engine_spec(max_seq_len=64, max_batch=2)
+    if args.fleet_procs > 0:
+        state_dir = args.state_dir or tempfile.mkdtemp(
+            prefix="fleet-loadgen-")
+        router = ProcessFleetRouter.launch(
+            state_dir, spec, num_replicas=args.fleet_procs,
+            supervise=None if args.supervisor == "off"
+            else args.supervisor)
+        try:
+            out = replay(router, trace,
+                         slo_ttft_ms=args.slo_ttft_ms,
+                         slo_interval_ms=args.slo_interval_ms)
+            out["report"]["rpc"] = router.rpc_totals()
+            out["report"]["supervisor_restarts"] = sum(
+                router.supervisor_restarts().values())
+            if args.trace_out:
+                with open(args.trace_out, "w") as f:
+                    json.dump(router.merged_trace(), f)
+        finally:
+            router.shutdown()
+    else:
+        engine = build_engine_from_spec(spec)
+        out = replay(engine, trace, slo_ttft_ms=args.slo_ttft_ms,
+                     slo_interval_ms=args.slo_interval_ms)
+    print(json.dumps(out["report"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -164,6 +164,62 @@ def main():
                       "(--draft-load-dir not given) — acceptance will be "
                       "poor; outputs stay exact either way")
         spec = None if args.spec_method == "none" else args.spec_method
+        if getattr(args, "fleet_procs", 0) > 0:
+            # Cross-process fleet (ISSUE 18): N replica WORKER
+            # PROCESSES behind the RPC router
+            # (inference/fleet_rpc.py). Workers build deterministic
+            # seed-params from the spec; this process then pushes ITS
+            # params (checkpoint-restored / PTQ-quantized above) over
+            # the set_params verb so the fleet serves the loaded
+            # weights.
+            import tempfile
+
+            from megatronapp_tpu.inference.fleet_rpc import (
+                ProcessFleetRouter, default_engine_spec,
+            )
+            proc_spec = default_engine_spec(
+                num_layers=cfg.num_layers,
+                hidden_size=cfg.hidden_size,
+                num_attention_heads=cfg.num_attention_heads,
+                num_query_groups=(cfg.num_query_groups
+                                  or cfg.num_attention_heads),
+                vocab_size=cfg.vocab_size,
+                max_position_embeddings=cfg.max_position_embeddings,
+                max_batch=args.max_batch,
+                max_seq_len=args.max_seq_len,
+                block_size=args.kv_block_size,
+                num_blocks=args.num_kv_blocks,
+                kv_cache_dtype=args.kv_cache_dtype)
+            state_dir = tempfile.mkdtemp(prefix="fleet-state-")
+            # Workers are fresh processes: telemetry / request tracing
+            # opt-ins ride the env (utils/metrics.py MEGATRON_METRICS,
+            # trace/request_trace.py MEGATRON_REQUEST_TRACE enable at
+            # import) so /metrics and the merged /trace see them.
+            worker_env = {}
+            if args.serving_metrics:
+                worker_env["MEGATRON_METRICS"] = "1"
+            if args.request_trace:
+                worker_env["MEGATRON_REQUEST_TRACE"] = "1"
+            router = ProcessFleetRouter.launch(
+                state_dir, proc_spec, num_replicas=args.fleet_procs,
+                slo_ms=args.decode_slo_ms,
+                base_port=args.replica_rpc_port,
+                supervise=(None if args.supervisor == "off"
+                           else args.supervisor),
+                extra_env=worker_env)
+            router.set_params(params)
+            router.tokenizer = tok
+            print(f"serving CROSS-PROCESS fleet of {args.fleet_procs} "
+                  f"replica workers on {args.host}:{args.port} "
+                  f"(state_dir={state_dir}, "
+                  f"supervisor={args.supervisor}, "
+                  f"kv={args.kv_cache_dtype})")
+            try:
+                TextGenerationServer(router, args.host,
+                                     args.port).run()
+            finally:
+                router.shutdown()
+            return
         if args.serve_fleet > 1 or args.fleet_autoscale:
             # Fleet serving (ISSUE 14): N replicas behind the
             # KV-affinity router. Disagg replicas divide the device
