@@ -224,6 +224,18 @@ def _fleet_proc_hook():
     return r if r.get("cross_process") else None
 
 
+def _lora_hook():
+    """Multi-tenant batched-LoRA serving A/B (tools/lora_benchmark.py)
+    on the CPU backend — batched-vs-serial tokens/s at 8 distinct
+    adapters (gate >= 1.5x with token-exact streams), the rank-exact
+    HBM bank byte pin, and the zero-B bitwise parity gate tracked
+    round over round like the other hooks."""
+    if os.environ.get("BENCH_LORA", "1") != "1":
+        return None
+    r = _run_child("--lora", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("batched") else None
+
+
 def _pipeline_hook():
     """Zero-bubble-vs-1F1B pipeline schedule A/B
     (tools/pipeline_benchmark.py) on the CPU mesh — the simulated-
@@ -293,6 +305,9 @@ def _attach_overlap_hooks(res):
     ppl = _pipeline_hook()
     if ppl:
         res.setdefault("extra", {})["pipeline"] = ppl
+    lra = _lora_hook()
+    if lra:
+        res.setdefault("extra", {})["lora"] = lra
     return res
 
 
@@ -628,6 +643,12 @@ def fleet_proc_main():
                          max_new=8)))
 
 
+def lora_main():
+    """batched-LoRA serving A/B child (CPU env set by the parent)."""
+    from tools.lora_benchmark import run
+    print(json.dumps(run(n_adapters=8, rank=8, max_new=8)))
+
+
 def disagg_main():
     """colocated-vs-disaggregated serving A/B child (CPU env set by the
     parent; virtual sub-mesh devices set here, pre-jax-import)."""
@@ -781,6 +802,8 @@ if __name__ == "__main__":
         fp8_main()
     elif "--fleet-proc" in sys.argv:
         fleet_proc_main()
+    elif "--lora" in sys.argv:
+        lora_main()
     elif "--fleet" in sys.argv:
         fleet_main()
     else:

@@ -211,6 +211,31 @@ def add_serving_args(ap: argparse.ArgumentParser):
                         "detects a wedged/killed worker, SIGKILLs and "
                         "relaunches it, and the router fails sessions "
                         "over losslessly (needs --fleet-procs)")
+    # Multi-tenant batched-LoRA serving (ISSUE 19, inference/lora.py).
+    g.add_argument("--lora-dir", type=str, default=None, metavar="DIR",
+                   help="serve per-request LoRA adapters from DIR "
+                        "(<DIR>/<adapter_id>.npz, LoraAdapter.save "
+                        "format): requests submit with an adapter_id, "
+                        "the engine pins it into the HBM adapter cache "
+                        "(inference/lora.py AdapterCache — refcount/"
+                        "LRU-evict, PagedKVCache discipline), and every "
+                        "decode step applies the per-row low-rank "
+                        "deltas via the segmented batched-LoRA kernel "
+                        "(needs --engine dynamic --paged-kv-cache; "
+                        "incompatible with --multi-latent-attention: "
+                        "MLA has no q/kv projection leaves to adapt)")
+    g.add_argument("--lora-rank", type=int, default=8, metavar="R",
+                   help="adapter rank the HBM banks are sized for "
+                        "(every served adapter must match; DISTINCT "
+                        "from the MLA latent dims --q-lora-rank/"
+                        "--kv-lora-rank)")
+    g.add_argument("--max-resident-adapters", type=int, default=8,
+                   metavar="N",
+                   help="HBM adapter cache capacity: N adapters resident "
+                        "at once (plus the permanent all-zero NULL "
+                        "slot); misses load from --lora-dir, evicting "
+                        "the LRU unpinned resident — admission waits "
+                        "when all N are pinned by in-flight requests")
     # Telemetry spine (ISSUE 12).
     g.add_argument("--serving-metrics", action="store_true",
                    help="enable the telemetry registry "
@@ -343,6 +368,42 @@ def validate_serving_args(args, multi_latent_attention: bool = False):
             "heartbeats and relaunches worker PROCESSES; the in-process "
             "fleet's kill/revive drills already route through the same "
             "supervisor code path internally)")
+    # Multi-tenant LoRA serving (ISSUE 19): same first-failed-predicate
+    # style — the adapter banks ride the dynamic paged decode step.
+    if getattr(args, "lora_dir", None):
+        if getattr(args, "engine", "static") != "dynamic":
+            raise SystemExit(
+                "--lora-dir requires --engine dynamic (the adapter "
+                "banks join the dynamic engine's decode scan; the "
+                "static engine has no per-row adapter plumbing)")
+        if not getattr(args, "paged_kv_cache", False):
+            raise SystemExit(
+                "--lora-dir requires --paged-kv-cache (the segmented "
+                "LoRA delta rides the paged decode/multi-query steps)")
+        if multi_latent_attention:
+            raise SystemExit(
+                "--lora-dir is incompatible with "
+                "--multi-latent-attention: MLA factors attention "
+                "through latent kernels with no q_kernel/kv_kernel "
+                "leaves to adapt — serve MLA models without LoRA")
+        if getattr(args, "serve_disagg", False):
+            raise SystemExit(
+                "--lora-dir does not compose with --serve-disagg yet: "
+                "the adapter banks join the unified dynamic engine's "
+                "decode scan; the disagg facade's split prefill/decode "
+                "meshes would need per-mesh bank replicas (serve LoRA "
+                "from the colocated dynamic engine or a fleet of them)")
+    rank = getattr(args, "lora_rank", 8)
+    if rank < 1:
+        raise SystemExit(
+            f"--lora-rank must be >= 1 (got {rank}); the HBM banks "
+            "are sized A[L, slots, din, R] / B[L, slots, R, dout]")
+    max_res = getattr(args, "max_resident_adapters", 8)
+    if max_res < 1:
+        raise SystemExit(
+            f"--max-resident-adapters must be >= 1 (got {max_res}); "
+            "slot 0 is the reserved NULL adapter, so at least one "
+            "managed slot is needed to serve any adapter at all")
     if (getattr(args, "quantized_weights", False)
             and getattr(args, "engine", "static") == "mamba"):
         raise SystemExit(

@@ -118,7 +118,14 @@ class Request:
 
     deadline_s: absolute time.monotonic() deadline; overdue requests are
     aborted by step()'s expiry sweep (event key "expired") and their
-    cache/pool resources reclaimed."""
+    cache/pool resources reclaimed.
+
+    adapter_id/tenant: multi-tenant LoRA serving (inference/lora.py,
+    ISSUE 19) — adapter_id names the tenant's low-rank adapter in the
+    engine's AdapterCache registry (None = the base model); tenant is a
+    free-form accounting label for per-tenant telemetry/SLO classes.
+    Both ride the Request itself, so fleet migration carries them and a
+    migrated stream stays token-exact under the same adapter."""
     request_id: int
     prompt: np.ndarray                  # [P] int32
     max_new_tokens: int
@@ -126,6 +133,8 @@ class Request:
     eod_id: Optional[int] = None
     priority: int = 0
     deadline_s: Optional[float] = None
+    adapter_id: Optional[str] = None
+    tenant: Optional[str] = None
     # Filled by the engine:
     slot: int = -1
     generated: list = dataclasses.field(default_factory=list)
@@ -192,7 +201,7 @@ def _decode_step(params, tokens, cache, lengths, active,
 
 def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
                        cfg: TransformerConfig, max_seq_len: int, ctx=None,
-                       scales=None, fused: bool = False):
+                       scales=None, fused: bool = False, lora=None):
     """One-token decode for every slot against the paged block pool.
 
     pages: ([L, NB, bs, Hkv, D], same) K/V pools (MLA: latent + k_pe
@@ -204,6 +213,12 @@ def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
     body (ISSUE 11) — each scanned layer runs the fused Pallas kernels
     of kernel_gen.fused_layer_decode instead of the unfused op tail
     (callers gate on megakernel_ineligible_reason; streams token-exact).
+    lora: batched adapter deltas (inference/lora.py) — {"row_adapter":
+    [B] int32 bank slots, "banks": {target: (a [L, slots, din, r],
+    b [L, slots, r, dout])}}; the banks join the layer scan's xs (the
+    leading L dim slices per layer) and each projection matmul grows a
+    per-row low-rank delta (slot 0 = the all-zero null adapter, so the
+    trace is identical whether or not any row has a real adapter).
     The layer scan honors cfg.scan_unroll (PERF lever 3: unrolling
     removes the while-loop dispatch overhead and lets XLA fuse across
     layer boundaries). Returns (last_logits [B,V], new pages[, new
@@ -224,35 +239,38 @@ def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
     pa, pb = pages
     lids = jnp.arange(cfg.num_layers)
 
-    if scales is None:
-        def body(carry, layer_in):
-            hh = carry
-            layer_p, a_l, b_l, lid = layer_in
-            (hh, new_cache), _ = layer_forward(
-                layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
-                kv_cache=(a_l, b_l), cache_index=None,
-                cache_positions=lengths, page_table=page_table,
-                active=active, ctx=ctx, fused_decode=fused)
-            return hh, new_cache
+    # xs layout: block params, kv pools, [kv scale pools,] [lora factor
+    # banks (a, b per target, sorted),] layer ids. The body re-parses by
+    # the same flags so one body covers all four pool/lora combinations.
+    xs = [params["block"], pa, pb]
+    if scales is not None:
+        xs += list(scales)
+    lora_targets = tuple(sorted(lora["banks"])) if lora is not None else ()
+    for t in lora_targets:
+        xs += [lora["banks"][t][0], lora["banks"][t][1]]
+    xs.append(lids)
 
-        xs = (params["block"], pa, pb, lids)
-    else:
-        sa, sb = scales
+    def body(carry, layer_in):
+        hh = carry
+        it = iter(layer_in)
+        layer_p, a_l, b_l = next(it), next(it), next(it)
+        kvs = (next(it), next(it)) if scales is not None else None
+        ll = None
+        if lora is not None:
+            ll = {"row_adapter": lora["row_adapter"],
+                  "banks": {t: (next(it), next(it))
+                            for t in lora_targets}}
+        lid = next(it)
+        (hh, new_cache), _ = layer_forward(
+            layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
+            kv_cache=(a_l, b_l), cache_index=None,
+            cache_positions=lengths, page_table=page_table,
+            active=active, ctx=ctx, kv_scales=kvs,
+            fused_decode=fused, lora=ll)
+        return hh, new_cache
 
-        def body(carry, layer_in):
-            hh = carry
-            layer_p, a_l, b_l, sa_l, sb_l, lid = layer_in
-            (hh, new_cache), _ = layer_forward(
-                layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
-                kv_cache=(a_l, b_l), cache_index=None,
-                cache_positions=lengths, page_table=page_table,
-                active=active, ctx=ctx, kv_scales=(sa_l, sb_l),
-                fused_decode=fused)
-            return hh, new_cache
-
-        xs = (params["block"], pa, pb, sa, sb, lids)
-
-    h, new_pages = jax.lax.scan(body, h, xs, unroll=cfg.scan_unroll)
+    h, new_pages = jax.lax.scan(body, h, tuple(xs),
+                                unroll=cfg.scan_unroll)
     logits = gpt_head(params, h, cfg)[:, -1]
     return logits, new_pages
 
@@ -260,7 +278,7 @@ def _paged_decode_step(params, tokens, pages, page_table, lengths, active,
 def _paged_multiquery_step(params, tokens, pages, page_table, starts,
                            q_lens, active, cfg: TransformerConfig,
                            max_seq_len: int, ctx=None, scales=None,
-                           fused: bool = False):
+                           fused: bool = False, lora=None):
     """Ragged multi-token step against the paged pool — the UNIFIED
     prefill/decode primitive (speculative verify + chunked prefill).
 
@@ -291,36 +309,37 @@ def _paged_multiquery_step(params, tokens, pages, page_table, starts,
     pa, pb = pages
     lids = jnp.arange(cfg.num_layers)
 
-    if scales is None:
-        def body(carry, layer_in):
-            hh = carry
-            layer_p, a_l, b_l, lid = layer_in
-            (hh, new_cache), _ = layer_forward(
-                layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
-                kv_cache=(a_l, b_l), cache_index=None,
-                cache_positions=starts, page_table=page_table,
-                active=active, chunk_counts=q_lens, ctx=ctx,
-                fused_decode=fused)
-            return hh, new_cache
+    # Same xs layout as _paged_decode_step: optional scale pools then
+    # optional lora factor banks, parsed back by the closed-over flags.
+    xs = [params["block"], pa, pb]
+    if scales is not None:
+        xs += list(scales)
+    lora_targets = tuple(sorted(lora["banks"])) if lora is not None else ()
+    for t in lora_targets:
+        xs += [lora["banks"][t][0], lora["banks"][t][1]]
+    xs.append(lids)
 
-        xs = (params["block"], pa, pb, lids)
-    else:
-        sa, sb = scales
+    def body(carry, layer_in):
+        hh = carry
+        it = iter(layer_in)
+        layer_p, a_l, b_l = next(it), next(it), next(it)
+        kvs = (next(it), next(it)) if scales is not None else None
+        ll = None
+        if lora is not None:
+            ll = {"row_adapter": lora["row_adapter"],
+                  "banks": {t: (next(it), next(it))
+                            for t in lora_targets}}
+        lid = next(it)
+        (hh, new_cache), _ = layer_forward(
+            layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
+            kv_cache=(a_l, b_l), cache_index=None,
+            cache_positions=starts, page_table=page_table,
+            active=active, chunk_counts=q_lens, ctx=ctx,
+            kv_scales=kvs, fused_decode=fused, lora=ll)
+        return hh, new_cache
 
-        def body(carry, layer_in):
-            hh = carry
-            layer_p, a_l, b_l, sa_l, sb_l, lid = layer_in
-            (hh, new_cache), _ = layer_forward(
-                layer_p, hh, cfg, cos, sin, mask, layer_id=lid,
-                kv_cache=(a_l, b_l), cache_index=None,
-                cache_positions=starts, page_table=page_table,
-                active=active, chunk_counts=q_lens, ctx=ctx,
-                kv_scales=(sa_l, sb_l), fused_decode=fused)
-            return hh, new_cache
-
-        xs = (params["block"], pa, pb, sa, sb, lids)
-
-    h, new_pages = jax.lax.scan(body, h, xs, unroll=cfg.scan_unroll)
+    h, new_pages = jax.lax.scan(body, h, tuple(xs),
+                                unroll=cfg.scan_unroll)
     logits = gpt_head(params, h, cfg)
     return logits, h, new_pages
 
@@ -400,7 +419,8 @@ class DynamicInferenceEngine:
                  draft_params=None, draft_cfg=None,
                  prefill_chunk: int = 32, ctx=None, pool=None,
                  kv_cache_dtype: str = "bf16",
-                 fused_decode: bool = False):
+                 fused_decode: bool = False,
+                 adapter_cache=None):
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -510,6 +530,28 @@ class DynamicInferenceEngine:
         # metrics registry is off.
         from megatronapp_tpu.utils.metrics import Histogram
         self.interval_hist = Histogram(lo=1e-2, hi=1e6, growth=1.25)
+        # Multi-tenant LoRA serving (inference/lora.py, ISSUE 19):
+        # adapter_cache is an AdapterCache pinning each running slot's
+        # low-rank factors resident in HBM banks. row_adapter maps each
+        # engine slot to its adapter's BANK slot (0 = the permanent
+        # all-zero null adapter, so the step trace is identical whether
+        # or not any row carries a real adapter). Acquire/release rides
+        # the slot lifecycle: _admit acquires, _free_slot releases — an
+        # in-use adapter can never be evicted.
+        self.adapters = adapter_cache
+        if adapter_cache is not None and not paged:
+            raise ValueError(
+                "adapter_cache requires the paged backend (batched LoRA "
+                "serves over the paged decode step) — pass paged=True")
+        self.row_adapter = np.zeros((max_batch,), np.int32)
+        # Optional lora.TenantSLO: the serving driver composes each
+        # submit's (priority, deadline) through it when set.
+        self.tenant_slo = None
+        # Per-tenant serving counters (bounded cardinality: at most
+        # _TENANT_LABEL_CAP distinct tenants get their own label; the
+        # rest fold into "_other" — same discipline as the fleet's
+        # per-replica /metrics labels).
+        self._tenant_stats: Dict[str, Dict[str, int]] = {}
         self.lengths = np.zeros((max_batch,), np.int32)
         self.last_tokens = np.zeros((max_batch, 1), np.int32)
         self.slots: List[Optional[Request]] = [None] * max_batch
@@ -600,7 +642,9 @@ class DynamicInferenceEngine:
                     if self.spec_method else 0)
                 reason = megakernel_ineligible_reason(
                     cfg, batch=self.max_batch, tp_paged=self.tp_paged,
-                    params=self.params, mq_rows=mq_rows)
+                    params=self.params, mq_rows=mq_rows,
+                    lora_rank=(self.adapters.rank
+                               if self.adapters is not None else None))
                 if reason is None:
                     self.megakernel = True
                 else:
@@ -611,23 +655,29 @@ class DynamicInferenceEngine:
 
             # `scales` is the int8 pool's fp32 scale-pool pair (None for
             # bf16 pools — an empty pytree, so the same jit signature
-            # serves both dtypes and donation is a no-op there).
-            def _decode_traced(p, t, pages, scales, tbl, l, a):
+            # serves both dtypes and donation is a no-op there). `lora`
+            # follows the same trick: None without an adapter cache,
+            # else {"row_adapter", "banks"} (the banks are NOT donated —
+            # they are the cache's resident HBM arrays and outlive the
+            # step).
+            def _decode_traced(p, t, pages, scales, tbl, l, a, lora):
                 # Python side-effect: runs only while TRACING.
                 self.decode_traces += 1
                 return _paged_decode_step(p, t, pages, tbl, l, a, cfg,
                                           msl, ctx=step_ctx,
-                                          scales=scales, fused=fused)
+                                          scales=scales, fused=fused,
+                                          lora=lora)
 
             self._decode = jax.jit(_decode_traced, donate_argnums=(2, 3))
 
-            def _mq_traced(p, t, pages, scales, tbl, starts, qlens, act):
+            def _mq_traced(p, t, pages, scales, tbl, starts, qlens, act,
+                           lora):
                 # Python side-effect: runs only while TRACING.
                 self.mq_traces += 1
                 return _paged_multiquery_step(p, t, pages, tbl, starts,
                                               qlens, act, cfg, msl,
                                               ctx=step_ctx, scales=scales,
-                                              fused=fused)
+                                              fused=fused, lora=lora)
 
             self._mq_step = jax.jit(_mq_traced, donate_argnums=(2, 3))
             if self.spec_method:
@@ -661,17 +711,69 @@ class DynamicInferenceEngine:
         else:
             self.pool.pages = tuple(new)
 
+    # Bounded per-tenant label cardinality (/metrics + /stats): beyond
+    # this many distinct tenants, new ones fold into "_other".
+    _TENANT_LABEL_CAP = 32
+
+    def _tenant_label(self, tenant: Optional[str]) -> Optional[str]:
+        if tenant is None:
+            return None
+        if tenant in self._tenant_stats:
+            return tenant
+        if len(self._tenant_stats) >= self._TENANT_LABEL_CAP:
+            return "_other"
+        return tenant
+
+    def _tenant_inc(self, tenant: Optional[str], key: str, n: int = 1):
+        """Per-tenant serving counters, mirrored to labeled /metrics
+        counters at bounded cardinality."""
+        label = self._tenant_label(tenant)
+        if label is None:
+            return
+        st = self._tenant_stats.setdefault(
+            label, {"requests": 0, "tokens": 0, "finished": 0,
+                    "expired": 0})
+        st[key] = st.get(key, 0) + n
+        telemetry.inc(telemetry.labeled(f"serving_tenant_{key}",
+                                        tenant=label), n)
+
+    def _lora_args(self, rows: Optional[np.ndarray] = None):
+        """The step jits' `lora` operand: None without an adapter cache
+        (an empty pytree — same jit signature), else the per-slot bank
+        slots + the cache's resident factor banks. `rows` overrides the
+        full per-slot map for single-row calls (chunked prefill)."""
+        if self.adapters is None:
+            return None
+        if rows is None:
+            rows = self.row_adapter
+        return {"row_adapter": jnp.asarray(np.asarray(rows, np.int32)),
+                "banks": self.adapters.banks}
+
     # ---- request lifecycle ------------------------------------------------
     def add_request(self, prompt_tokens, max_new_tokens: int,
                     sampling: Optional[SamplingParams] = None,
                     eod_id: Optional[int] = None,
                     priority: int = 0,
                     deadline_s: Optional[float] = None,
-                    request_id: Optional[int] = None) -> int:
+                    request_id: Optional[int] = None,
+                    adapter_id: Optional[str] = None,
+                    tenant: Optional[str] = None) -> int:
         prompt = validate_admission(prompt_tokens, max_new_tokens,
                                     self.max_seq_len,
                                     pool=self.pool if self.paged else None,
                                     deadline_s=deadline_s)
+        # Unknown adapters are a PERMANENT submit-time error (the
+        # registry names what it knows) — transient all-slots-pinned
+        # pressure is handled at admission instead.
+        if adapter_id is not None:
+            if self.adapters is None:
+                raise ValueError(
+                    "adapter_id requires an engine adapter cache — "
+                    "construct with adapter_cache= / --lora-dir")
+            if adapter_id not in self.adapters.registry:
+                raise KeyError(
+                    f"unknown adapter {adapter_id!r}; known: "
+                    f"{sorted(self.adapters.registry.ids())}")
         now = time.monotonic()
         # An explicit request_id is the cross-process fleet's admission
         # shape (inference/fleet_rpc.py): the ROUTER owns the one rid
@@ -685,10 +787,12 @@ class DynamicInferenceEngine:
         req = Request(request_id, prompt, max_new_tokens,
                       sampling or SamplingParams(), eod_id=eod_id,
                       priority=priority, deadline_s=deadline_s,
+                      adapter_id=adapter_id, tenant=tenant,
                       admit_t=now, queued_t=now)
         self.waiting.append(req)
         self.requests[req.request_id] = req
         telemetry.inc("serving_requests_admitted")
+        self._tenant_inc(tenant, "requests")
         rt = self._rt
         if rt.enabled:
             rt.instant("admit", req.request_id,
@@ -766,11 +870,13 @@ class DynamicInferenceEngine:
             req.finished = True
             self._aborted.append(req)    # finish event fires this step
             expired.append(req.request_id)
+            self._tenant_inc(req.tenant, "expired")
             self._rt.finish(req.request_id, "expire")
         for req in self.slots:
             if req is not None and overdue(req):
                 req.finished = True      # retired (blocks released) below
                 expired.append(req.request_id)
+                self._tenant_inc(req.tenant, "expired")
                 # Spans close when the same step's retire pass reclaims
                 # the slot (the one finish funnel).
                 self._rt.instant("expire", req.request_id)
@@ -816,6 +922,11 @@ class DynamicInferenceEngine:
         self.slots[slot] = None
         self.lengths[slot] = 0
         self._h_valid[slot] = False
+        if self.adapters is not None:
+            # Unpin the slot's adapter (slot 0 = null adapter, a no-op);
+            # rc==0 residents park in the cache's LRU, still hittable.
+            self.adapters.release(int(self.row_adapter[slot]))
+            self.row_adapter[slot] = 0
         if self.proposer is not None:
             self.proposer.on_release(slot)
 
@@ -858,6 +969,8 @@ class DynamicInferenceEngine:
         assert self.paged, "adoption requires the paged backend"
         slot = next(i for i in range(self.max_batch)
                     if self.slots[i] is None)
+        if self.adapters is not None:
+            self.row_adapter[slot] = self.adapters.acquire(req.adapter_id)
         self.pool.transfer_slot(src_slot, slot)
         req.slot = slot
         self.slots[slot] = req
@@ -911,8 +1024,25 @@ class DynamicInferenceEngine:
                      if self.slots[i] is None), None)
         if slot is None:
             return False
+        aslot = 0
+        if self.adapters is not None:
+            from megatronapp_tpu.inference.lora import AdapterSlotsPinned
+            try:
+                # The adapter ID rides the Request in the payload — the
+                # destination re-acquires from ITS registry/cache, so a
+                # migrated stream decodes under the same factors
+                # (token-exact; drilled in tests).
+                aslot = self.adapters.acquire(req.adapter_id)
+            except (AdapterSlotsPinned, KeyError):
+                # Can't host the adapter here (pinned-full / not in this
+                # replica's registry): refuse with nothing touched — the
+                # router treats False like a full destination.
+                return False
         if not self.pool.import_slot(slot, payload):
+            if self.adapters is not None:
+                self.adapters.release(aslot)
             return False
+        self.row_adapter[slot] = aslot
         valid_len = payload["valid_len"]
         req.slot = slot
         self.slots[slot] = req
@@ -966,6 +1096,31 @@ class DynamicInferenceEngine:
                 if plan is None:
                     self.waiting.appendleft(req)
                     break
+            if self.adapters is not None:
+                from megatronapp_tpu.inference.lora import (
+                    AdapterSlotsPinned)
+                try:
+                    aslot = self.adapters.acquire(req.adapter_id)
+                except AdapterSlotsPinned:
+                    # Every adapter bank slot is pinned by running
+                    # requests — a transient capacity condition exactly
+                    # like pool-full admit: keep FIFO order and wait for
+                    # a retirement to unpin one.
+                    if self.paged:
+                        self.pool.release(slot, np.asarray(req.tokens), 0)
+                    self.waiting.appendleft(req)
+                    break
+                except Exception:
+                    # Load fault (the "lora-load" chaos drill): the
+                    # cache mutated nothing — release the admitted
+                    # blocks, requeue at the head, re-raise for the
+                    # stepper watchdog. The retry costs one step.
+                    if self.paged:
+                        self.pool.release(slot, np.asarray(req.tokens), 0)
+                    req.queued_t = time.monotonic()
+                    self.waiting.appendleft(req)
+                    raise
+                self.row_adapter[slot] = aslot
             req.slot = slot
             self.slots[slot] = req
             rid = req.request_id
@@ -1081,7 +1236,8 @@ class DynamicInferenceEngine:
                 self.params, jnp.asarray(chunk), self.pool.pages,
                 self.pool.scales,
                 table_row, jnp.asarray([pos], jnp.int32),
-                jnp.asarray([count], jnp.int32), jnp.ones((1,), bool))
+                jnp.asarray([count], jnp.int32), jnp.ones((1,), bool),
+                self._lora_args(rows=self.row_adapter[slot:slot + 1]))
             self._commit_pools(new)
             pos += count
         # Register the prompt's full blocks so concurrent same-prefix
@@ -1147,6 +1303,7 @@ class DynamicInferenceEngine:
     def _record_token(self, req: Request, tok: int):
         req.generated.append(tok)
         self.last_tokens[req.slot, 0] = tok
+        self._tenant_inc(req.tenant, "tokens")
         if (tok == req.eod_id or
                 len(req.generated) >= req.max_new_tokens):
             req.finished = True
@@ -1204,6 +1361,7 @@ class DynamicInferenceEngine:
                                       int(self.lengths[slot]))
                 self._free_slot(slot)
                 telemetry.inc("serving_requests_retired")
+                self._tenant_inc(req.tenant, "finished")
                 self._rt.finish(req.request_id, "retire",
                                 generated=len(req.generated))
         return done
@@ -1268,7 +1426,7 @@ class DynamicInferenceEngine:
                     self.params, jnp.asarray(self.last_tokens),
                     self.pool.pages, self.pool.scales,
                     jnp.asarray(self.pool.page_table[:self.max_batch]),
-                    lengths, active_mask)
+                    lengths, active_mask, self._lora_args())
                 self._commit_pools(new)
             else:
                 logits, self.cache = self._decode(
@@ -1364,7 +1522,8 @@ class DynamicInferenceEngine:
             self.pool.scales,
             jnp.asarray(self.pool.page_table[:self.max_batch]),
             jnp.asarray(self.lengths),
-            jnp.asarray(q_lens), jnp.asarray(active_np))
+            jnp.asarray(q_lens), jnp.asarray(active_np),
+            self._lora_args())
         self._commit_pools(new)
         logits = mask_padded_vocab(logits, self.cfg)
         # Chaos site "spec-verify": fires at the WORST point — the
@@ -1471,7 +1630,8 @@ class DynamicInferenceEngine:
                 pages_spec, scales_spec,
                 jax.ShapeDtypeStruct((self.max_batch, mb), jnp.int32),
                 jax.ShapeDtypeStruct((self.max_batch,), jnp.int32),
-                jax.ShapeDtypeStruct((self.max_batch,), jnp.bool_))
+                jax.ShapeDtypeStruct((self.max_batch,), jnp.bool_),
+                jax.tree.map(spec, self._lora_args()))
         try:
             # Gate metric: estimated kernel launches per executed step
             # off the traced module (pallas_call == ONE TPU custom
@@ -1549,6 +1709,19 @@ class DynamicInferenceEngine:
                     else 0.0),
                 **st,
             }
+        if self.adapters is not None:
+            out["lora"] = self.adapters.stats_snapshot()
+        if self._tenant_stats:
+            # Per-tenant serving counters (bounded cardinality, see
+            # _tenant_inc). slo_attainment = finished / closed requests
+            # (deadline expiries are the misses).
+            tenants = {}
+            for t, st in self._tenant_stats.items():
+                closed = st["finished"] + st["expired"]
+                tenants[t] = dict(
+                    st, slo_attainment=(round(st["finished"] / closed, 4)
+                                        if closed else 1.0))
+            out["tenants"] = tenants
         if self.spec_method:
             ss = dict(self.spec_stats)
             out["speculative"] = {

@@ -265,6 +265,16 @@ class FleetRouter:
         # Bounded hash→replica affinity map (LRU past capacity).
         self.affinity_capacity = affinity_capacity
         self._affinity: OrderedDict = OrderedDict()
+        # Bounded tenant/adapter→replica affinity (ISSUE 19): steering a
+        # tenant's requests back to the replica whose AdapterCache
+        # already holds its adapter avoids an HBM bank write (and a
+        # possible eviction of someone else's pinned working set) per
+        # admission. Same bounded-OrderedDict machinery as the prefix
+        # map; an adapter reload costs far more than a prefix-block
+        # re-prefill, so its weight defaults higher.
+        self.tenant_affinity_capacity = 1024
+        self._tenant_affinity: OrderedDict = OrderedDict()
+        self.tenant_weight = 8.0 * self.block_size
         self._owner: Dict[int, int] = {}    # rid -> replica idx
         self._lock = threading.RLock()
         self._rr = 0                        # round-robin cursor
@@ -277,7 +287,7 @@ class FleetRouter:
             "migrated_kv_bytes": 0, "failovers": 0, "replica_deaths": 0,
             "reloads": 0, "replica_reloads": 0, "autoscale_rebuilds": 0,
             "autoscale_aborts": 0, "affinity_admissions": 0,
-            "admissions": 0,
+            "tenant_affinity_admissions": 0, "admissions": 0,
         }
         self._rt = get_request_tracer()
         # Fleet process rows aggregate every replica's events (spans
@@ -315,6 +325,28 @@ class FleetRouter:
             for k in stale:
                 del self._affinity[k]
 
+    def _note_tenant(self, key: Optional[str], idx: int):
+        if key is None:
+            return
+        with self._lock:
+            self._tenant_affinity[key] = idx
+            self._tenant_affinity.move_to_end(key)
+            while (len(self._tenant_affinity)
+                   > self.tenant_affinity_capacity):
+                self._tenant_affinity.popitem(last=False)
+
+    def _drop_tenant_replica(self, idx: int):
+        """Drop tenant/adapter steering entries pointing at replica
+        `idx` — its AdapterCache is gone (death) or fresh (rebuild), so
+        steering there for residency "hits" would be stale. Prefix
+        flushes do NOT call this: the adapter banks survive a params
+        reload."""
+        with self._lock:
+            stale = [k for k, v in self._tenant_affinity.items()
+                     if v == idx]
+            for k in stale:
+                del self._tenant_affinity[k]
+
     # ---- admission -------------------------------------------------------
     def _replica_load(self, eng) -> int:
         load = len(eng.waiting)
@@ -324,7 +356,9 @@ class FleetRouter:
         load += len(getattr(eng, "_parked", ()))
         return load
 
-    def _admit_target(self, prompt: np.ndarray) -> Optional[Replica]:
+    def _admit_target(self, prompt: np.ndarray,
+                      affinity_key: Optional[str] = None
+                      ) -> Optional[Replica]:
         live = [r for r in self.replicas if r.state == ACTIVE]
         if not live:
             # Drain window (rolling reload / rebuild with every replica
@@ -346,35 +380,54 @@ class FleetRouter:
             return rep
         keys = prefix_block_keys(prompt, self.block_size, len(prompt))
         owners = [self._affinity.get(k) for k in keys]
+        tenant_home = (None if affinity_key is None
+                       else self._tenant_affinity.get(affinity_key))
         best = best_key = None
         best_aff = 0.0
+        best_tenant = False
         for rep in live:
             aff = 0.0
             for o in owners:
                 if o != rep.idx:
                     break
                 aff += self.block_size
+            taff = self.tenant_weight if tenant_home == rep.idx else 0.0
             eng = rep.engine
             load = self._replica_load(eng)
             pool = eng.pool
             pressure = pool.blocks_in_use() / pool.num_blocks
-            score = (aff
+            score = (aff + taff
                      - self.queue_weight * load
                      - self.pressure_weight * pressure
                      + self.slo_weight * rep.attainment(self.slo_ms))
             # Deterministic tie-break: least loaded, then lowest index.
             key = (score, -load, -rep.idx)
             if best_key is None or key > best_key:
-                best, best_key, best_aff = rep, key, aff
+                best, best_key = rep, key
+                best_aff, best_tenant = aff, taff > 0
         if best_aff > 0:
             self.router_stats["affinity_admissions"] += 1
+        if best_tenant:
+            self.router_stats["tenant_affinity_admissions"] += 1
         return best
 
     def add_request(self, prompt_tokens, max_new_tokens: int,
                     sampling=None, eod_id: Optional[int] = None,
                     priority: int = 0,
-                    deadline_s: Optional[float] = None) -> int:
+                    deadline_s: Optional[float] = None,
+                    adapter_id: Optional[str] = None,
+                    tenant: Optional[str] = None) -> int:
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        # Steering key: the ADAPTER is what's expensive to move between
+        # replicas (an HBM bank write on a miss), so it keys the
+        # affinity map; a tenant without an adapter still benefits from
+        # sticking to one replica (its prefix blocks live there).
+        affinity_key = adapter_id if adapter_id is not None else tenant
+        extra = {}
+        if adapter_id is not None:
+            extra["adapter_id"] = adapter_id
+        if tenant is not None:
+            extra["tenant"] = tenant
         # The WHOLE admission holds the router lock: _fail_replica (the
         # stepper thread) also holds it for its whole failover, so a
         # request can never land in a replica's books between the
@@ -383,15 +436,16 @@ class FleetRouter:
         # (Engine add_request is cheap — validation + a deque append —
         # and the driver already serializes submits under its own cv.)
         with self._lock:
-            rep = self._admit_target(prompt)
+            rep = self._admit_target(prompt, affinity_key)
             if rep is None:
                 raise RuntimeError(
                     "fleet has no live replica to admit into (every "
                     "replica is dead — drain windows queue instead)")
             rid = rep.engine.add_request(
                 prompt, max_new_tokens, sampling, eod_id=eod_id,
-                priority=priority, deadline_s=deadline_s)
+                priority=priority, deadline_s=deadline_s, **extra)
             self._owner[rid] = rep.idx
+            self._note_tenant(affinity_key, rep.idx)
         self.router_stats["admissions"] += 1
         telemetry.inc("fleet_admissions")
         return rid
@@ -688,6 +742,7 @@ class FleetRouter:
             self.router_stats["replica_deaths"] += 1
             telemetry.inc("fleet_replica_deaths")
             self._flush_replica(rep.idx)
+            self._drop_tenant_replica(rep.idx)
             eng = rep.engine
             orphans = list(eng.requests.items())
             # Failover targets: ACTIVE first, else DRAINING survivors
@@ -776,6 +831,7 @@ class FleetRouter:
             old = rep.engine
             rep.engine = self.engine_factory(idx, **hints)
             self._wire(rep)
+            self._drop_tenant_replica(idx)   # fresh AdapterCache
             # Finished-but-unfetched results must survive the engine
             # swap (a client whose done event fired but who has not
             # yet called result_tokens would otherwise get None back)
@@ -1047,6 +1103,7 @@ class FleetRouter:
                 "params_version": self._version,
                 "reload_pending": self._reload is not None,
                 "affinity_entries": len(self._affinity),
+                "tenant_affinity_entries": len(self._tenant_affinity),
                 "supervisor_restarts": (
                     self._supervisor.total_restarts
                     if self._supervisor is not None else 0),

@@ -145,6 +145,13 @@ def default_engine_spec(**overrides) -> dict:
         "block_size": 8, "num_blocks": None,
         "kv_cache_dtype": "bf16",
         "platform": "cpu",          # worker JAX_PLATFORMS
+        # Multi-tenant LoRA serving (ISSUE 19): a lora_dir of .npz
+        # adapters gives every worker an AdapterCache over the same
+        # on-disk registry — cross-process fleets serve adapters with
+        # identical banks because the npz bytes are the shared truth.
+        "lora_dir": None,
+        "lora_rank": 8,
+        "max_resident_adapters": 8,
     }
     spec.update(overrides)
     return spec
@@ -176,13 +183,23 @@ def build_engine_from_spec(spec: dict):
             compute_dtype=jnp.float32, remat_policy="none")
     params, _ = init_gpt_params(
         jax.random.PRNGKey(spec.get("seed", 0)), cfg)
+    adapter_cache = None
+    if spec.get("lora_dir"):
+        from megatronapp_tpu.inference.lora import (
+            AdapterCache, AdapterRegistry,
+        )
+        adapter_cache = AdapterCache(
+            cfg, AdapterRegistry(spec["lora_dir"]),
+            max_resident=spec.get("max_resident_adapters", 8),
+            rank=spec.get("lora_rank", 8))
     return DynamicInferenceEngine(
         params, cfg, max_batch=spec["max_batch"],
         max_seq_len=spec["max_seq_len"],
         prefill_buckets=tuple(spec.get("prefill_buckets") or (16,)),
         paged=True, block_size=spec["block_size"],
         num_blocks=spec.get("num_blocks"),
-        kv_cache_dtype=spec.get("kv_cache_dtype", "bf16"))
+        kv_cache_dtype=spec.get("kv_cache_dtype", "bf16"),
+        adapter_cache=adapter_cache)
 
 
 # ---------------------------------------------------------------------------
@@ -437,7 +454,9 @@ class ReplicaServer:
                 msg.get("sampling"), eod_id=msg.get("eod_id"),
                 priority=msg.get("priority", 0),
                 deadline_s=msg.get("deadline_s"),
-                request_id=rid)
+                request_id=rid,
+                adapter_id=msg.get("adapter_id"),
+                tenant=msg.get("tenant"))
             assert got == rid
             return {"rid": rid}
         now = time.monotonic()
@@ -448,6 +467,8 @@ class ReplicaServer:
             eod_id=msg.get("eod_id"),
             priority=msg.get("priority", 0),
             deadline_s=msg.get("deadline_s"),
+            adapter_id=msg.get("adapter_id"),
+            tenant=msg.get("tenant"),
             admit_t=now, queued_t=now)
         req.generated = list(generated)
         req.slot = -1
@@ -651,6 +672,8 @@ class _Session:
     eod_id: Optional[int] = None
     priority: int = 0
     deadline_s: Optional[float] = None
+    adapter_id: Optional[str] = None
+    tenant: Optional[str] = None
     generated: list = dataclasses.field(default_factory=list)
     finished: bool = False
     running: bool = False
@@ -702,6 +725,12 @@ class ProcessFleetRouter:
         self.base_port = base_port
         self._extra_env = dict(extra_env or {})
         self._affinity: OrderedDict = OrderedDict()
+        # Tenant/adapter→replica steering (same bounded-map machinery
+        # as the in-process FleetRouter): keeping one tenant's requests
+        # on the worker whose AdapterCache already holds its adapter
+        # avoids a bank write per admission.
+        self._tenant_affinity: OrderedDict = OrderedDict()
+        self.tenant_affinity_capacity = 1024
         self._owner: Dict[int, Optional[int]] = {}
         self._sessions: Dict[int, _Session] = {}
         self._lock = threading.RLock()
@@ -796,10 +825,15 @@ class ProcessFleetRouter:
                     max_new_tokens=req.max_new_tokens,
                     sampling=req.sampling, eod_id=req.eod_id,
                     priority=req.priority, deadline_s=req.deadline_s,
+                    adapter_id=getattr(req, "adapter_id", None),
+                    tenant=getattr(req, "tenant", None),
                     generated=list(req.generated),
                     finished=bool(req.finished),
                     running=req.slot >= 0)
                 self._owner[rid] = rep.idx
+                self._note_tenant(
+                    getattr(req, "adapter_id", None)
+                    or getattr(req, "tenant", None), rep.idx)
                 max_rid = max(max_rid, rid)
                 for key in prefix_block_keys(
                         np.asarray(req.prompt, np.int32), block_size,
@@ -857,11 +891,25 @@ class ProcessFleetRouter:
         for k in stale:
             del self._affinity[k]
 
+    def _note_tenant(self, key: Optional[str], idx: int):
+        if key is None:
+            return
+        self._tenant_affinity[key] = idx
+        self._tenant_affinity.move_to_end(key)
+        while len(self._tenant_affinity) > self.tenant_affinity_capacity:
+            self._tenant_affinity.popitem(last=False)
+
+    def _drop_tenant_replica(self, idx: int):
+        stale = [k for k, v in self._tenant_affinity.items() if v == idx]
+        for k in stale:
+            del self._tenant_affinity[k]
+
     # -- admission ------------------------------------------------------------
     def _live(self) -> List[_ProcReplica]:
         return [r for r in self._reps if r.state == ACTIVE]
 
-    def _admit_target(self, prompt: np.ndarray) -> _ProcReplica:
+    def _admit_target(self, prompt: np.ndarray,
+                      affinity_key: Optional[str] = None) -> _ProcReplica:
         from megatronapp_tpu.inference.paged_cache import (
             prefix_block_keys,
         )
@@ -876,10 +924,13 @@ class ProcessFleetRouter:
         block_size = self.spec["block_size"]
         keys = prefix_block_keys(prompt, block_size, len(prompt))
         owners = [self._affinity.get(k) for k in keys]
+        tenant_home = (None if affinity_key is None
+                       else self._tenant_affinity.get(affinity_key))
         # The in-process router's scoring, off last-step-reply signals.
-        queue_w, pressure_w, slo_w = (2.0 * block_size,
-                                      4.0 * block_size,
-                                      2.0 * block_size)
+        queue_w, pressure_w, slo_w, tenant_w = (2.0 * block_size,
+                                                4.0 * block_size,
+                                                2.0 * block_size,
+                                                8.0 * block_size)
         best = best_key = None
         best_aff = 0.0
         for rep in live:
@@ -888,8 +939,10 @@ class ProcessFleetRouter:
                 if o != rep.idx:
                     break
                 aff += block_size
+            taff = tenant_w if tenant_home == rep.idx else 0.0
             load = rep.waiting + rep.active
-            score = (aff - queue_w * load - pressure_w * rep.pressure
+            score = (aff + taff - queue_w * load
+                     - pressure_w * rep.pressure
                      + slo_w * rep.attainment(self.slo_ms))
             key = (score, -load, -rep.idx)
             if best_key is None or key > best_key:
@@ -910,9 +963,11 @@ class ProcessFleetRouter:
                 max_new_tokens=sess.max_new_tokens,
                 sampling=sess.sampling, eod_id=sess.eod_id,
                 priority=sess.priority, deadline_s=sess.deadline_s,
+                adapter_id=sess.adapter_id, tenant=sess.tenant,
                 generated=list(sess.generated) or None)
             rep.waiting += 1
             self._owner[sess.rid] = rep.idx
+            self._note_tenant(sess.adapter_id or sess.tenant, rep.idx)
             return
         except chaos.ChaosFault:
             # Ack lost AFTER the worker may have committed: undo
@@ -926,21 +981,29 @@ class ProcessFleetRouter:
         except (ConnectionError, EOFError, OSError, socket.timeout):
             self._fail_rep(rep, reassign=False)
         # Retry on the (possibly different) best live replica.
-        self._submit_to(self._admit_target(sess.prompt), sess)
+        self._submit_to(self._admit_target(
+            sess.prompt, affinity_key=sess.adapter_id or sess.tenant),
+            sess)
 
     def add_request(self, prompt_tokens, max_new_tokens: int,
                     sampling=None, eod_id: Optional[int] = None,
                     priority: int = 0,
-                    deadline_s: Optional[float] = None) -> int:
+                    deadline_s: Optional[float] = None,
+                    adapter_id: Optional[str] = None,
+                    tenant: Optional[str] = None) -> int:
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
         with self._lock:
             rid = next(self._ids)
             sess = _Session(rid=rid, prompt=prompt,
                             max_new_tokens=max_new_tokens,
                             sampling=sampling, eod_id=eod_id,
-                            priority=priority, deadline_s=deadline_s)
+                            priority=priority, deadline_s=deadline_s,
+                            adapter_id=adapter_id, tenant=tenant)
             self._sessions[rid] = sess
-            self._submit_to(self._admit_target(prompt), sess)
+            self._submit_to(
+                self._admit_target(prompt,
+                                   affinity_key=adapter_id or tenant),
+                sess)
         self.router_stats["admissions"] += 1
         telemetry.inc("fleet_admissions")
         return rid
@@ -999,7 +1062,8 @@ class ProcessFleetRouter:
         req = Request(rid, sess.prompt, sess.max_new_tokens,
                       sess.sampling or SamplingParams(),
                       eod_id=sess.eod_id, priority=sess.priority,
-                      deadline_s=sess.deadline_s)
+                      deadline_s=sess.deadline_s,
+                      adapter_id=sess.adapter_id, tenant=sess.tenant)
         req.generated = list(sess.generated)
         req.finished = sess.finished
         return req
@@ -1081,6 +1145,7 @@ class ProcessFleetRouter:
         if rep.client is not None:
             rep.client.close()
         self._drop_affinity(rep.idx)
+        self._drop_tenant_replica(rep.idx)
         self.router_stats["replica_deaths"] += 1
         telemetry.inc("fleet_replica_deaths")
         if not reassign:
@@ -1099,7 +1164,9 @@ class ProcessFleetRouter:
                 continue
             sess.running = False
             self._owner.pop(rid, None)
-            self._submit_to(self._admit_target(sess.prompt), sess)
+            self._submit_to(self._admit_target(
+                sess.prompt,
+                affinity_key=sess.adapter_id or sess.tenant), sess)
             self.router_stats["failovers"] += 1
             telemetry.inc("fleet_failovers")
 
@@ -1151,6 +1218,38 @@ class ProcessFleetRouter:
                 events["finished"].append(rid)
 
     # -- main loop --------------------------------------------------------------
+    def _fan_out_steps(self, live: List[_ProcReplica]) -> List:
+        """Issue the per-step RPCs to every live replica CONCURRENTLY
+        (one thread per in-flight verb) and return each reply or the
+        exception it raised, in replica order. N workers step in
+        parallel instead of serializing behind one socket round-trip
+        each — fleet step latency is max(replica step), not sum. The
+        byte accounting is untouched: each `ReplicaClient.call` counts
+        its own frames under the client's lock, and exactly one step
+        frame per replica goes on the wire either way (pinned by
+        tests/test_fleet_rpc.py). Replies are PROCESSED serially by the
+        caller under the router lock, so the failure handling
+        (resync / fail over) is byte-for-byte the sequential path's."""
+        results: List = [None] * len(live)
+
+        def run(i: int, rep: _ProcReplica):
+            try:
+                results[i] = rep.client.call("step")
+            except Exception as e:  # noqa: BLE001 — re-handled serially
+                results[i] = e
+
+        if len(live) == 1:
+            run(0, live[0])
+            return results
+        threads = [threading.Thread(target=run, args=(i, rep),
+                                    daemon=True)
+                   for i, rep in enumerate(live)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return results
+
     def step(self) -> Dict[str, List]:
         events: Dict[str, List] = {"admitted": [], "tokens": [],
                                    "finished": [], "preempted": [],
@@ -1159,21 +1258,22 @@ class ProcessFleetRouter:
             for rep in self._reps:
                 if rep.state == DEAD:
                     self._try_reattach(rep)
-            for rep in self._reps:
-                if rep.state == DEAD or rep.client is None:
-                    continue
-                try:
-                    r = rep.client.call("step")
-                except chaos.ChaosFault:
+            live = [rep for rep in self._reps
+                    if rep.state != DEAD and rep.client is not None]
+            replies = self._fan_out_steps(live)
+            for rep, r in zip(live, replies):
+                if isinstance(r, chaos.ChaosFault):
                     self._resync(rep, events)
                     continue
-                except (ConnectionError, EOFError, OSError,
-                        socket.timeout, ReplicaRpcError) as e:
-                    if isinstance(e, ReplicaRpcError):
+                if isinstance(r, (ConnectionError, EOFError, OSError,
+                                  socket.timeout, ReplicaRpcError)):
+                    if isinstance(r, ReplicaRpcError):
                         logger.warning("replica %d step raised: %s",
-                                       rep.idx, e)
+                                       rep.idx, r)
                     self._fail_rep(rep)
                     continue
+                if isinstance(r, Exception):
+                    raise r
                 rep.steps = r["steps"]
                 rep.waiting = r["waiting"]
                 rep.active = r["active"]
@@ -1344,6 +1444,7 @@ class ProcessFleetRouter:
                 "reload_pending": False,
                 "process_backed": True,
                 "affinity_entries": len(self._affinity),
+                "tenant_affinity_entries": len(self._tenant_affinity),
                 "supervisor_restarts": sum(restarts.values()),
                 "rpc": self.rpc_totals(),
                 **self.router_stats,

@@ -116,11 +116,19 @@ class DynamicBatchingDriver:
 
     def submit(self, prompt_ids, max_new_tokens, sampling, eod_id=None,
                token_cb=None, priority: int = 0,
-               timeout_s: Optional[float] = None):
+               timeout_s: Optional[float] = None,
+               adapter_id: Optional[str] = None,
+               tenant: Optional[str] = None):
         """timeout_s: per-request deadline in seconds from now. Already-
         expired work (timeout_s <= 0) is rejected at admission with
         DeadlineExceeded — a clean error frame instead of queueing work
-        the client has given up on."""
+        the client has given up on.
+
+        adapter_id/tenant: multi-tenant LoRA serving (ISSUE 19) —
+        adapter_id picks the tenant's adapter from the engine's cache
+        (unknown ids are rejected at submit), tenant labels per-tenant
+        telemetry and composes the tenant's SLO class (TenantSLO on the
+        engine, when configured) into (priority, deadline)."""
         deadline = None
         if timeout_s is not None:
             if timeout_s <= 0:
@@ -130,11 +138,22 @@ class DynamicBatchingDriver:
                     "request deadline expired at admission "
                     f"(timeout_s={timeout_s})")
             deadline = time.monotonic() + timeout_s
+        slo = getattr(self.engine, "tenant_slo", None)
+        if slo is not None:
+            priority, deadline = slo.compose(tenant, priority=priority,
+                                             deadline_s=deadline)
+        # Tenancy kwargs only when set: engines without the plumbing
+        # (the disagg facade) keep their add_request signature.
+        extra = {}
+        if adapter_id is not None:
+            extra["adapter_id"] = adapter_id
+        if tenant is not None:
+            extra["tenant"] = tenant
         with self._cv:
             rid = self.engine.add_request(prompt_ids, max_new_tokens,
                                           sampling, eod_id=eod_id,
                                           priority=priority,
-                                          deadline_s=deadline)
+                                          deadline_s=deadline, **extra)
             done = threading.Event()
             self._subs[rid] = {"cb": token_cb, "done": done}
             self._ensure_thread()
@@ -342,12 +361,16 @@ class TextGenerationServer:
     # ------------------------------------------------------------------
     def _submit_and_wait(self, prompts, n, sampling,
                          cancel: Optional[threading.Event] = None,
-                         token_cb=None, timeout_s: Optional[float] = None):
+                         token_cb=None, timeout_s: Optional[float] = None,
+                         adapter_id: Optional[str] = None,
+                         tenant: Optional[str] = None):
         """Driver path (dynamic engine): submit every prompt into the
         shared batch, wait for completion, detokenize. token_cb(rid, tok)
         streams tokens of the FIRST prompt (WS contract). timeout_s:
         per-request deadline (expired work is rejected/aborted with a
-        clean error surfaced through the normal error paths)."""
+        clean error surfaced through the normal error paths).
+        adapter_id/tenant: multi-tenant LoRA fields forwarded to
+        submit() (ISSUE 19)."""
         import numpy as np
         tok = self.engine.tokenizer
         assert tok is not None, "tokenizer required"
@@ -358,7 +381,8 @@ class TextGenerationServer:
             rid, done = self._driver.submit(
                 ids, n, sampling, eod_id=eod,
                 token_cb=token_cb if i == 0 else None,
-                timeout_s=timeout_s)
+                timeout_s=timeout_s, adapter_id=adapter_id,
+                tenant=tenant)
             subs.append((ids, rid, done))
         texts = []
         first_err = None
@@ -398,6 +422,8 @@ class TextGenerationServer:
             sampling = _sampling_from_request(req)
             timeout_s = req.get("timeout_s")
             timeout_s = None if timeout_s is None else float(timeout_s)
+            adapter_id = req.get("adapter_id")
+            tenant = req.get("tenant")
             loop = asyncio.get_running_loop()
 
             def run_api():
@@ -405,7 +431,9 @@ class TextGenerationServer:
                     # Continuous batching: concurrent /api calls share
                     # the decode batch instead of queueing on the lock.
                     return self._submit_and_wait(prompts, n, sampling,
-                                                 timeout_s=timeout_s)
+                                                 timeout_s=timeout_s,
+                                                 adapter_id=adapter_id,
+                                                 tenant=tenant)
                 with self._gen_lock:
                     return self.engine.generate_text(prompts, n, sampling)
 
@@ -519,7 +547,9 @@ class TextGenerationServer:
                         token_cb=driver_cb,
                         timeout_s=(float(req["timeout_s"])
                                    if req.get("timeout_s") is not None
-                                   else None))
+                                   else None),
+                        adapter_id=req.get("adapter_id"),
+                        tenant=req.get("tenant"))
                 # Capture hooks are thread-local and baked in at trace
                 # time: activate in THIS worker thread and re-trace the
                 # engine around the toggle. The lock serializes against
@@ -761,6 +791,29 @@ class TextGenerationServer:
             telemetry.set_gauge("paged_blocks_free", pool.free_blocks())
             telemetry.set_gauge("paged_blocks_evictable",
                                 pool.evictable_blocks())
+        adapters = getattr(eng, "adapters", None)
+        if adapters is not None:
+            # LoRA adapter cache occupancy: resident/pinned counts and
+            # rank-exact resident bytes. Hit/miss/eviction COUNTERS
+            # accumulate at the cache's instrumented sites.
+            lstats = adapters.stats_snapshot()
+            telemetry.set_gauge("lora_adapters_resident",
+                                lstats["resident"])
+            telemetry.set_gauge("lora_adapters_pinned", lstats["pinned"])
+            telemetry.set_gauge("lora_resident_bytes",
+                                adapters.resident_bytes())
+        tstats = getattr(eng, "_tenant_stats", None)
+        if tstats:
+            # Per-tenant SLO attainment gauges (bounded cardinality —
+            # the engine folds tenants past its label cap into
+            # "_other"); per-tenant request/token COUNTERS accumulate
+            # at the engine's _tenant_inc sites.
+            lab = telemetry.labeled
+            for t, st in list(tstats.items()):
+                closed = st["finished"] + st["expired"]
+                telemetry.set_gauge(
+                    lab("serving_tenant_slo_attainment", tenant=t),
+                    round(st["finished"] / closed, 4) if closed else 1.0)
         if hasattr(eng, "export_fleet_gauges"):
             # Cross-process fleet (inference/fleet_rpc.py): the router
             # exports its own per-replica labeled gauges + supervisor

@@ -1127,7 +1127,20 @@ def _full_spec(a):
     return pl.BlockSpec(a.shape, lambda i, _n=a.ndim: (0,) * _n)
 
 
-def _fused_qkv(x, attn_p, cfg, cos, sin, tiles=None):
+def _lora_epilogue(xv, a, b, out_dtype):
+    """In-kernel per-row LoRA delta (ISSUE 19 megakernel epilogue):
+    xv [B*, din] (the SAME block the base matmul consumed), per-row
+    gathered factors a [B*, din, rank] / b [B*, rank, dout] → the
+    fp32 two-step product (x_b @ A_b) @ B_b cast to out_dtype. Row-wise
+    by construction — batch composition cannot perturb a row's delta —
+    and an all-zero B factor contributes an exact +0.0."""
+    t = jnp.einsum("bi,bir->br", xv.astype(jnp.float32),
+                   a.astype(jnp.float32))
+    return jnp.einsum("br,bro->bo", t,
+                      b.astype(jnp.float32)).astype(out_dtype)
+
+
+def _fused_qkv(x, attn_p, cfg, cos, sin, tiles=None, lora=None):
     """Norm + QKV projection + (optional) QK-layernorm + rope in ONE
     kernel — the attention kernel's entry, fused.
 
@@ -1146,7 +1159,13 @@ def _fused_qkv(x, attn_p, cfg, cos, sin, tiles=None):
     ragged rows for the fused multiquery step); returns (q, k, v) as
     [B*, nq, D] / [B*, nkv, D] in compute dtype, exactly as the unfused
     layer_forward → attention_forward prologue produces them. tiles:
-    test/tuning override of the planned tile count (must divide nkv)."""
+    test/tuning override of the planned tile count (must divide nkv).
+    lora: optional (aq, bq, akv, bkv) per-row adapter factors
+    ([B*, H, rk], [B*, rk, nq·D], [B*, H, rk], [B*, rk, 2·nkv·D]) —
+    the no-grid body grows a LoRA epilogue adding each row's delta to
+    its projections between matmul and bias (the exact unfused
+    placement); megakernel_ineligible_reason(lora_rank=) gates the
+    tiled emission off."""
     from megatronapp_tpu.config.transformer_config import NormKind
     from megatronapp_tpu.inference.quantization import is_resident_leaf
     from megatronapp_tpu.ops.normalization import apply_norm, rms_norm
@@ -1176,6 +1195,10 @@ def _fused_qkv(x, attn_p, cfg, cos, sin, tiles=None):
             "kv-head group per tile — megakernel_ineligible_reason "
             "gates callers before tracing")
     assert nkv % t == 0, f"qkv tile count {t} must divide nkv={nkv}"
+    has_lora = lora is not None
+    assert not (has_lora and t != 1), (
+        "LoRA epilogue rides the no-grid fused QKV body only — "
+        "megakernel_ineligible_reason(lora_rank=) gates callers")
 
     if t == 1:
         operands = [x, attn_p["ln1_scale"]]
@@ -1188,6 +1211,8 @@ def _fused_qkv(x, attn_p, cfg, cos, sin, tiles=None):
             operands += [cos, sin]
         if has_qk_ln:
             operands += [attn_p["q_ln_scale"], attn_p["k_ln_scale"]]
+        if has_lora:
+            operands += list(lora)
 
         def kernel(*refs):
             it = iter(refs)
@@ -1204,6 +1229,9 @@ def _fused_qkv(x, attn_p, cfg, cos, sin, tiles=None):
             sin_ref = next(it) if has_rope else None
             qln_ref = next(it) if has_qk_ln else None
             kln_ref = next(it) if has_qk_ln else None
+            if has_lora:
+                aq_ref, bq_ref = next(it), next(it)
+                akv_ref, bkv_ref = next(it), next(it)
             q_out, k_out, v_out = next(it), next(it), next(it)
 
             xn = apply_norm(kind, x_ref[...], ln_s[...],
@@ -1211,6 +1239,10 @@ def _fused_qkv(x, attn_p, cfg, cos, sin, tiles=None):
             xn = xn.astype(cdt)
             q = xn @ _dequant_weight(wq_ref, wqs_ref, cdt)
             kv = xn @ _dequant_weight(wkv_ref, wkvs_ref, cdt)
+            if has_lora:
+                q = q + _lora_epilogue(xn, aq_ref[...], bq_ref[...], cdt)
+                kv = kv + _lora_epilogue(xn, akv_ref[...], bkv_ref[...],
+                                         cdt)
             if has_bias:
                 q = q + qb_ref[...].astype(cdt)
                 kv = kv + kvb_ref[...].astype(cdt)
@@ -1470,7 +1502,8 @@ def _fused_mla_qkv(x, attn_p, cfg, cos, sin):
     )(*operands)
 
 
-def _fused_out_proj(attn_flat, attn_p, cfg, residual, tiles=None):
+def _fused_out_proj(attn_flat, attn_p, cfg, residual, tiles=None,
+                    lora=None):
     """Attention epilogue in ONE kernel: out projection + bias +
     residual add (the paged-attention output arrives head-flat
     [B*, nq*D] — the GQA transpose/reshape is folded into the caller's
@@ -1480,7 +1513,9 @@ def _fused_out_proj(attn_flat, attn_p, cfg, residual, tiles=None):
     step reads the full attn_flat block and 1/T of the weight columns
     (full contraction per tile — tiled columns bitwise the no-grid
     ones). Resident-quantized weights dequantize in-register. tiles:
-    test/tuning override (must divide H)."""
+    test/tuning override (must divide H). lora: optional (a, b)
+    per-row factors ([B*, nq·D, rk], [B*, rk, H]) — the no-grid body
+    adds each row's delta between matmul and bias."""
     from megatronapp_tpu.inference.quantization import is_resident_leaf
 
     b, h = residual.shape
@@ -1498,6 +1533,10 @@ def _fused_out_proj(attn_flat, attn_p, cfg, residual, tiles=None):
             "output column per tile — megakernel_ineligible_reason "
             "gates callers before tracing")
     assert h % t == 0, f"out-proj tile count {t} must divide H={h}"
+    has_lora = lora is not None
+    assert not (has_lora and t != 1), (
+        "LoRA epilogue rides the no-grid fused out-proj body only — "
+        "megakernel_ineligible_reason(lora_rank=) gates callers")
 
     def kernel(*refs):
         it = iter(refs)
@@ -1506,8 +1545,13 @@ def _fused_out_proj(attn_flat, attn_p, cfg, residual, tiles=None):
         ws_ref = next(it) if res else None
         r_ref = next(it)
         b_ref = next(it) if has_bias else None
+        if has_lora:
+            la_ref, lb_ref = next(it), next(it)
         o_ref = next(it)
         out = a_ref[...] @ _dequant_weight(w_ref, ws_ref, cdt)
+        if has_lora:
+            out = out + _lora_epilogue(a_ref[...], la_ref[...],
+                                       lb_ref[...], cdt)
         if has_bias:
             out = out + b_ref[...].astype(cdt)
         r = r_ref[...]
@@ -1516,6 +1560,8 @@ def _fused_out_proj(attn_flat, attn_p, cfg, residual, tiles=None):
     operands = [attn_flat] + _weight_operands(w_leaf) + [residual]
     if has_bias:
         operands.append(attn_p["out_bias"])
+    if has_lora:
+        operands += list(lora)
 
     if t == 1:
         return pl.pallas_call(
@@ -1542,7 +1588,7 @@ def _fused_out_proj(attn_flat, attn_p, cfg, residual, tiles=None):
     )(*operands)
 
 
-def _fused_mlp(x, p, cfg, tiles=None):
+def _fused_mlp(x, p, cfg, tiles=None, lora=None):
     """Pre-MLP norm + fc1 + activation (incl. gated) + fc2 + biases +
     residual add. x [B*, H] (residual dtype) → [B*, H].
 
@@ -1553,7 +1599,12 @@ def _fused_mlp(x, p, cfg, tiles=None):
     dtype, so the store/reload is lossless), then fc2+bias+residual
     over H-column tiles with the full-ffn contraction — every output
     bitwise the single-kernel body's. tiles: test/tuning override —
-    a (t1, t2) pair forces the split emission."""
+    a (t1, t2) pair forces the split emission. lora: optional
+    (a1, b1, a2, b2) per-row factors ([B*, H, rk], [B*, rk, fc1_out],
+    [B*, ffn, rk], [B*, rk, H]) — the single-kernel body adds fc1's
+    delta (from the normed input) and fc2's delta (from the ACTIVATED
+    intermediate) between each matmul and its bias; the split emission
+    does not carry it."""
     from megatronapp_tpu.config.transformer_config import NormKind
     from megatronapp_tpu.inference.quantization import is_resident_leaf
     from megatronapp_tpu.ops.activations import apply_activation, is_gated
@@ -1576,7 +1627,11 @@ def _fused_mlp(x, p, cfg, tiles=None):
         _weight_itemsize(w2_leaf), jnp.dtype(cdt).itemsize, r1, r2,
         get_megakernel_vmem_budget())
 
+    has_lora = lora is not None
     if plan is not None:
+        assert not has_lora, (
+            "LoRA epilogue rides the one-kernel fused MLP body only — "
+            "megakernel_ineligible_reason(lora_rank=) gates callers")
         t1, t2 = plan
         if not t1 or not t2:
             raise ValueError(
@@ -1592,6 +1647,8 @@ def _fused_mlp(x, p, cfg, tiles=None):
     operands += _weight_operands(w1_leaf) + _weight_operands(w2_leaf)
     if has_bias:
         operands += [mlp_p["fc1_bias"], mlp_p["fc2_bias"]]
+    if has_lora:
+        operands += list(lora)
 
     def kernel(*refs):
         it = iter(refs)
@@ -1603,12 +1660,17 @@ def _fused_mlp(x, p, cfg, tiles=None):
         w2s_ref = next(it) if r2 else None
         b1_ref = next(it) if has_bias else None
         b2_ref = next(it) if has_bias else None
+        if has_lora:
+            a1_ref, b1l_ref = next(it), next(it)
+            a2_ref, b2l_ref = next(it), next(it)
         o_ref = next(it)
 
         xn = apply_norm(kind, x_ref[...], ln_s[...],
                         ln_b[...] if ln_b is not None else None, eps)
         xn = xn.astype(cdt)
         y = xn @ _dequant_weight(w1_ref, w1s_ref, cdt)
+        if has_lora:
+            y = y + _lora_epilogue(xn, a1_ref[...], b1l_ref[...], cdt)
         if has_bias:
             y = y + b1_ref[...].astype(cdt)
         if gated:
@@ -1617,6 +1679,8 @@ def _fused_mlp(x, p, cfg, tiles=None):
         else:
             y = apply_activation(act, y)
         out = y @ _dequant_weight(w2_ref, w2s_ref, cdt)
+        if has_lora:
+            out = out + _lora_epilogue(y, a2_ref[...], b2l_ref[...], cdt)
         if has_bias:
             out = out + b2_ref[...].astype(cdt)
         r = x_ref[...]
@@ -1861,9 +1925,29 @@ def _fused_mla_layer(p, x, cfg, rope_cos, rope_sin, kv_cache,
     return (out, new_cache), None
 
 
+def _lora_gathered(lora, s: int = 1):
+    """Gather per-row adapter factors from one layer's bank slices:
+    lora = {"row_adapter": [B] int32 bank slots, "banks":
+    {target: (a [slots, din, rk], b [slots, rk, dout])}} → the fused
+    bodies' operand tuples (qkv, out, mlp) with the batch's ids
+    repeated over S for flattened ragged rows (every token row wears
+    its slot's adapter). XLA gathers OUTSIDE the kernels; the bodies
+    see dense [B*, …] factor operands."""
+    ids = lora["row_adapter"]
+    if s > 1:
+        ids = jnp.repeat(ids, s)
+    g = {t: (a[ids], b[ids]) for t, (a, b) in lora["banks"].items()}
+    qkv = (g["q_kernel"][0], g["q_kernel"][1],
+           g["kv_kernel"][0], g["kv_kernel"][1])
+    out = g["out_kernel"]
+    mlp = (g["fc1_kernel"][0], g["fc1_kernel"][1],
+           g["fc2_kernel"][0], g["fc2_kernel"][1])
+    return qkv, out, mlp
+
+
 def fused_layer_decode(p, x, cfg, rope_cos, rope_sin, kv_cache,
                        cache_positions, page_table, active,
-                       kv_scales=None):
+                       kv_scales=None, lora=None):
     """One decode layer as fused kernels: [fused norm+QKV+rope] →
     [append scatter] → [generated paged-attention kernel] → [fused
     out-proj + residual] → [fused norm+MLP + residual].
@@ -1880,6 +1964,9 @@ def fused_layer_decode(p, x, cfg, rope_cos, rope_sin, kv_cache,
     b = x.shape[0]
     assert x.shape[1] == 1, "fused_layer_decode is the s == 1 decode body"
     if cfg.multi_latent_attention:
+        assert lora is None, (
+            "LoRA targets the GQA projections — "
+            "megakernel_ineligible_reason(lora_rank=) gates MLA off")
         return _fused_mla_layer(p, x, cfg, rope_cos, rope_sin, kv_cache,
                                 cache_positions, None, page_table,
                                 active, kv_scales=kv_scales)
@@ -1888,11 +1975,14 @@ def fused_layer_decode(p, x, cfg, rope_cos, rope_sin, kv_cache,
     x2 = x[:, 0]
     cos = rope_cos[:, 0] if rope_cos is not None else None
     sin = rope_sin[:, 0] if rope_sin is not None else None
+    qkv_lora = out_lora = mlp_lora = None
+    if lora is not None:
+        qkv_lora, out_lora, mlp_lora = _lora_gathered(lora)
 
     q, k, v = _fused_qkv(x2, {**attn_p, "ln1_scale": p["ln1_scale"],
                               **({"ln1_bias": p["ln1_bias"]}
                                  if "ln1_bias" in p else {})},
-                         cfg, cos, sin)
+                         cfg, cos, sin, lora=qkv_lora)
 
     ck, cv = kv_cache
     if active is None:
@@ -1919,14 +2009,15 @@ def fused_layer_decode(p, x, cfg, rope_cos, rope_sin, kv_cache,
 
     attn = paged_attention(q, ck, cv, page_table, cache_positions + 1,
                            **sc_kw)                       # [B, nq, D]
-    x2 = _fused_out_proj(attn.reshape(b, nq * d), attn_p, cfg, x2)
-    x2 = _fused_mlp(x2, p, cfg)
+    x2 = _fused_out_proj(attn.reshape(b, nq * d), attn_p, cfg, x2,
+                         lora=out_lora)
+    x2 = _fused_mlp(x2, p, cfg, lora=mlp_lora)
     return (x2[:, None], new_cache), None
 
 
 def fused_layer_multiquery(p, x, cfg, rope_cos, rope_sin, kv_cache,
                            cache_positions, counts, page_table, active,
-                           kv_scales=None):
+                           kv_scales=None, lora=None):
     """One ragged multi-query layer (speculative verify rounds and
     chunked prefill) as the SAME fused kernels around the generated
     ragged paged-attention kernel: [fused norm+QKV+rope on the B·S
@@ -1944,6 +2035,9 @@ def fused_layer_multiquery(p, x, cfg, rope_cos, rope_sin, kv_cache,
     )
     b, s, h = x.shape
     if cfg.multi_latent_attention:
+        assert lora is None, (
+            "LoRA targets the GQA projections — "
+            "megakernel_ineligible_reason(lora_rank=) gates MLA off")
         return _fused_mla_layer(p, x, cfg, rope_cos, rope_sin, kv_cache,
                                 cache_positions, counts, page_table,
                                 active, kv_scales=kv_scales)
@@ -1953,11 +2047,14 @@ def fused_layer_multiquery(p, x, cfg, rope_cos, rope_sin, kv_cache,
     xf = x.reshape(b * s, h)
     cos = rope_cos.reshape(b * s, -1) if rope_cos is not None else None
     sin = rope_sin.reshape(b * s, -1) if rope_sin is not None else None
+    qkv_lora = out_lora = mlp_lora = None
+    if lora is not None:
+        qkv_lora, out_lora, mlp_lora = _lora_gathered(lora, s)
 
     q, k, v = _fused_qkv(xf, {**attn_p, "ln1_scale": p["ln1_scale"],
                               **({"ln1_bias": p["ln1_bias"]}
                                  if "ln1_bias" in p else {})},
-                         cfg, cos, sin)
+                         cfg, cos, sin, lora=qkv_lora)
     q = q.reshape(b, s, nq, d)
     k = k.reshape(b, s, nkv, d)
     v = v.reshape(b, s, nkv, d)
@@ -1990,14 +2087,16 @@ def fused_layer_multiquery(p, x, cfg, rope_cos, rope_sin, kv_cache,
     attn = paged_attention(q, ck, cv, page_table,
                            cache_positions + counts, q_lens=counts,
                            **sc_kw)                    # [B, S, nq, D]
-    x2 = _fused_out_proj(attn.reshape(b * s, nq * d), attn_p, cfg, xf)
-    x2 = _fused_mlp(x2, p, cfg)
+    x2 = _fused_out_proj(attn.reshape(b * s, nq * d), attn_p, cfg, xf,
+                         lora=out_lora)
+    x2 = _fused_mlp(x2, p, cfg, lora=mlp_lora)
     return (x2.reshape(b, s, h), new_cache), None
 
 
 def megakernel_ineligible_reason(cfg, *, batch, tp_paged=False,
                                  paged=True, params=None,
-                                 mq_rows=None) -> Optional[str]:
+                                 mq_rows=None,
+                                 lora_rank=None) -> Optional[str]:
     """Why the fused (megakernel) decode step may NOT run — None when
     eligible, otherwise the FIRST failed predicate by name (same
     loud-fallback contract as tp_paged_ineligible_reason). params: the
@@ -2006,7 +2105,11 @@ def megakernel_ineligible_reason(cfg, *, batch, tp_paged=False,
     enter the kernels and dequantize in-register; they are NOT a
     carve-out anymore). mq_rows: the widest flattened row count the
     fused multiquery step will see (prefill_chunk / max_batch·(K+1));
-    tile plans are sized for the worse of batch and mq_rows.
+    tile plans are sized for the worse of batch and mq_rows. lora_rank:
+    the serving adapter rank when an AdapterCache is attached — the
+    LoRA epilogue rides only the NO-GRID fused bodies, so its
+    predicates re-plan each body with the per-row factor bytes charged
+    against the budget.
 
     Size no longer disqualifies a config outright: the fused kernels
     grid-tile their weight columns to fit the VMEM budget
@@ -2090,4 +2193,230 @@ def megakernel_ineligible_reason(cfg, *, batch, tp_paged=False,
     if plan is not None and (not plan[0] or not plan[1]):
         return (f"fused MLP kernels: one ffn/output column per tile "
                 f"still exceeds the VMEM budget ({budget} B) — {flag}")
+    if lora_rank:
+        if mla:
+            return ("LoRA serving targets the GQA projection kernels — "
+                    "the MLA megakernel has no q_kernel/kv_kernel to "
+                    "compose an adapter epilogue onto")
+        # LoRA epilogue (ISSUE 19): the fused bodies add per-row
+        # adapter factors as extra whole-array operands, which only the
+        # NO-GRID emissions carry (the tiled emissions' column blocks
+        # would have to split the B factor's dout dim in lockstep —
+        # not built). Re-plan each body with the budget reduced by its
+        # fp32 per-row factor bytes: still no-grid → base + LoRA fits.
+        rk = int(lora_rank)
+        d_qkv = nq * cfg.head_dim + 2 * cfg.num_query_groups * cfg.head_dim
+        lb = rows * rk * (2 * h + d_qkv) * 4
+        if _qkv_tiles(h, nq, cfg.num_query_groups, cfg.head_dim, rows,
+                      _wi(attn.get("q_kernel")),
+                      _wi(attn.get("kv_kernel")), act_item,
+                      is_resident_leaf(attn.get("q_kernel")),
+                      is_resident_leaf(attn.get("kv_kernel")),
+                      budget - lb) != 1:
+            return (f"LoRA epilogue (rank {rk}) needs the no-grid fused "
+                    f"QKV body with its per-row factors VMEM-resident — "
+                    f"over the budget ({budget} B) at rows={rows}; {flag}")
+        lb = rows * rk * (nqd + h) * 4
+        if _out_tiles(h, nqd, rows, _wi(attn.get("out_kernel")),
+                      act_item,
+                      is_resident_leaf(attn.get("out_kernel")),
+                      budget - lb) != 1:
+            return (f"LoRA epilogue (rank {rk}) needs the no-grid fused "
+                    f"out-proj body with its per-row factors "
+                    f"VMEM-resident — over the budget ({budget} B) at "
+                    f"rows={rows}; {flag}")
+        ffn = cfg.ffn_hidden_size
+        fc1_out = (2 if is_gated(cfg.activation) else 1) * ffn
+        lb = rows * rk * (h + fc1_out + ffn + h) * 4
+        if _mlp_tiles(h, ffn, is_gated(cfg.activation), rows,
+                      _wi(mlp.get("fc1_kernel")),
+                      _wi(mlp.get("fc2_kernel")), act_item,
+                      is_resident_leaf(mlp.get("fc1_kernel")),
+                      is_resident_leaf(mlp.get("fc2_kernel")),
+                      budget - lb) is not None:
+            return (f"LoRA epilogue (rank {rk}) needs the one-kernel "
+                    f"fused MLP body with its per-row factors "
+                    f"VMEM-resident — over the budget ({budget} B) at "
+                    f"rows={rows}; {flag}")
     return None
+
+
+# ---------------------------------------------------------------------------
+# Batched-LoRA delta kernels (ISSUE 19): one decode batch, many adapters
+# ---------------------------------------------------------------------------
+# The device half of inference/lora.py: a decode batch carries a per-row
+# bank-slot id (0 = the NULL adapter), and every LoRA-targeted matmul
+# adds delta[b] = (x[b] @ A_{id[b]}) @ B_{id[b]} to its base output.
+# Three interchangeable per-row-exact implementations:
+#
+#   - lora_delta_reference  the jnp oracle AND the eager fallback:
+#                           gather the per-row factors, two einsums in
+#                           fp32;
+#   - lora_segmented_delta  the emitted Pallas kernel: rows grouped into
+#                           adapter SEGMENTS in-trace, the segment's
+#                           adapter id scalar-prefetched like a page
+#                           table so each grid step DMAs exactly one
+#                           adapter's [din, rank]/[rank, dout] factors
+#                           from the bank (vs the reference's [rows, …]
+#                           gathered copies);
+#   - the megakernel epilogue (``lora=`` on the fused bodies above):
+#                           per-row gathered factors ride into the
+#                           no-grid fused kernels as extra operands.
+#
+# All three compute row b's delta from row b's x and factors ONLY —
+# never from batch composition — which is what makes a mixed-tenant
+# batch token-exact vs serving each tenant serially.
+
+
+def lora_segment_info(row_adapter):
+    """Group batch rows by adapter id, in-trace (no host sort, no
+    dynamic shapes — O(B²) compares on a decode-batch-sized B).
+
+    row_adapter [B] int32 bank slots → (seg_adapter [B], row_seg [B],
+    nseg): segments are numbered by FIRST occurrence order;
+    seg_adapter[s] is segment s's bank slot (0 for the unused tail
+    s >= nseg, so padding grid steps DMA the NULL adapter's block);
+    row_seg[b] is row b's segment."""
+    ids = row_adapter.astype(jnp.int32)
+    b = ids.shape[0]
+    iota = jnp.arange(b, dtype=jnp.int32)
+    first = jnp.argmax(ids[:, None] == ids[None, :], axis=1)  # [B]
+    is_first = first == iota
+    seg_of_first = jnp.cumsum(is_first.astype(jnp.int32)) - 1
+    row_seg = seg_of_first[first]
+    seg_adapter = jnp.zeros((b,), jnp.int32).at[row_seg].set(ids)
+    nseg = jnp.sum(is_first.astype(jnp.int32))
+    return seg_adapter, row_seg, nseg
+
+
+def lora_delta_reference(x, a_bank, b_bank, row_adapter):
+    """jnp oracle (and THE eager fallback for kernel-ineligible
+    shapes): per-row gathered two-step product in fp32.
+
+    x [B, din], a_bank [slots, din, rank], b_bank [slots, rank, dout],
+    row_adapter [B] int32 → delta [B, dout] fp32 (callers cast when
+    adding into the base matmul output)."""
+    a = a_bank[row_adapter].astype(jnp.float32)       # [B, din, rank]
+    b = b_bank[row_adapter].astype(jnp.float32)       # [B, rank, dout]
+    t = jnp.einsum("bi,bir->br", x.astype(jnp.float32), a)
+    return jnp.einsum("br,bro->bo", t, b)
+
+
+def lora_kernel_ineligible_reason(din: int, dout: int, rank: int,
+                                  rows: int) -> Optional[str]:
+    """Why the segmented Pallas kernel may NOT serve this delta — None
+    when eligible, else the FIRST failed predicate by name (the caller
+    falls back to the eager gather, which is the oracle itself, so
+    ineligible shapes lose speed, never correctness)."""
+    if rank > min(din, dout):
+        return (f"adapter rank {rank} exceeds min(din={din}, "
+                f"dout={dout}) — a low-rank delta this fat is an eager "
+                f"gather, not a segmented GEMM")
+    budget = get_megakernel_vmem_budget()
+    # One grid step holds x [rows, din], one adapter's factors, the
+    # rank-space intermediate and the fp32 accumulator + row_seg.
+    need = 4 * (rows * din + din * rank + rank * dout
+                + rows * rank + rows * dout + rows)
+    if need > budget:
+        return (f"segmented-LoRA kernel operands ({need} B at "
+                f"rows={rows}, din={din}, dout={dout}, rank={rank}) "
+                f"exceed the VMEM budget ({budget} B) — raise "
+                f"--megakernel-vmem-budget or take the eager fallback")
+    return None
+
+
+def lora_segmented_delta(x, a_bank, b_bank, row_adapter):
+    """The emitted segmented batched-LoRA GEMM.
+
+    Grid = one step per row-SEGMENT (rows sharing an adapter), with the
+    segment's bank slot scalar-prefetched (PrefetchScalarGridSpec —
+    exactly how the paged kernels prefetch page tables) so each step's
+    BlockSpec index map DMAs ONE adapter's A [din, rank] and
+    B [rank, dout] blocks from the HBM bank. The step computes the full
+    batch's delta through that adapter and accumulates only its own
+    rows (mask by row_seg) — per-row results never depend on which
+    OTHER rows share the batch. Unused tail segments (the grid is sized
+    B, the worst case of B distinct adapters) index the NULL slot-0
+    block and mask to nothing.
+
+    x [B, din], banks [slots, din, rank]/[slots, rank, dout],
+    row_adapter [B] int32 → delta [B, dout] fp32 — bit-for-bit the
+    jnp oracle's dtype contract (fp32 accumulate, caller casts)."""
+    b, din = x.shape
+    rank = a_bank.shape[-1]
+    dout = b_bank.shape[-1]
+    seg_adapter, row_seg, _ = lora_segment_info(row_adapter)
+
+    def kernel(seg_ref, rs_ref, x_ref, a_ref, b_ref, o_ref):
+        s = pl.program_id(0)
+
+        @pl.when(s == 0)
+        def _init():
+            o_ref[...] = jnp.zeros_like(o_ref)
+
+        xv = x_ref[...].astype(jnp.float32)
+        a = a_ref[0].astype(jnp.float32)              # [din, rank]
+        bf = b_ref[0].astype(jnp.float32)             # [rank, dout]
+        t = jax.lax.dot_general(xv, a, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        d = jax.lax.dot_general(t, bf, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        mine = (rs_ref[...] == s)[:, None]            # [B, 1]
+        o_ref[...] += jnp.where(mine, d, 0.0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((b, din), lambda s, *_: (0, 0)),
+            pl.BlockSpec((1, din, rank),
+                         lambda s, seg, rs: (seg[s], 0, 0)),
+            pl.BlockSpec((1, rank, dout),
+                         lambda s, seg, rs: (seg[s], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((b, dout), lambda s, *_: (0, 0)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, dout), jnp.float32),
+        interpret=_interpret(),
+    )(seg_adapter, row_seg, x, a_bank, b_bank)
+
+
+def lora_delta(x, a_bank, b_bank, row_adapter):
+    """THE batched-LoRA delta entry point: the segmented kernel when
+    eligible, else the eager gather fallback (= the oracle). Returns
+    [B, dout] fp32."""
+    b, din = x.shape
+    rank = a_bank.shape[-1]
+    dout = b_bank.shape[-1]
+    if lora_kernel_ineligible_reason(din, dout, rank, b) is None:
+        return lora_segmented_delta(x, a_bank, b_bank, row_adapter)
+    return lora_delta_reference(x, a_bank, b_bank, row_adapter)
+
+
+def _lora_rows_delta(x, bank_pair, row_adapter):
+    """Delta for possibly-[B, S, din] x against one target's per-layer
+    bank pair, broadcasting the per-SLOT adapter ids over S (the
+    engine's batch dim is slots; every token row of a slot wears its
+    slot's adapter). Returns x-shaped fp32 delta."""
+    a_bank, b_bank = bank_pair
+    if x.ndim == 2:
+        return lora_delta(x, a_bank, b_bank, row_adapter)
+    b, s, din = x.shape
+    ids = jnp.repeat(row_adapter, s)
+    flat = lora_delta(x.reshape(b * s, din), a_bank, b_bank, ids)
+    return flat.reshape(b, s, -1)
+
+
+def apply_lora_delta(y, x, lora, target):
+    """Add ``target``'s adapter delta to base output y (computed from
+    input x), when lora carries that target; no-op otherwise. The ONE
+    call-site helper the unfused forward passes use — delta in fp32,
+    cast into y's dtype at the add (zero-B adapters add an exact 0.0
+    and leave y's token stream bitwise unchanged)."""
+    if lora is None or target not in lora["banks"]:
+        return y
+    d = _lora_rows_delta(x, lora["banks"][target], lora["row_adapter"])
+    return y + d.astype(y.dtype)

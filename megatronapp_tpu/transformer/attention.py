@@ -91,6 +91,7 @@ def attention_forward(
     tp_sharded: bool = False,
     kv_scales=None,
     fp8=None,
+    lora=None,
 ) -> jnp.ndarray:
     """x: [B, S, H] → [B, S, H]. Returns (out, new_kv_cache).
 
@@ -162,6 +163,14 @@ def attention_forward(
             "eligible here (tp_overlap_eligible is False / decode "
             "path) — check fp8_ineligible_reason at wiring time")
     fp8_margin = int(getattr(cfg, "fp8_margin", 0))
+    # Batched-LoRA serving (inference/lora.py): per-row adapter deltas
+    # compose with the plain projection matmuls only — the tp-overlap
+    # rings and the tp-sharded stage body slice weights per shard and
+    # would need the delta ring-decomposed too.
+    if lora is not None and (overlap or tp_sharded):
+        raise ValueError(
+            "lora deltas are not composable with the tp-overlap rings "
+            "or the tp-sharded stage body — serving paths only")
     # Serving-resident int8 weights (inference/quantization.py
     # residentize_params): resolve_param dequantizes at matmul entry —
     # int8 stays in HBM, XLA fuses the per-channel scale multiply.
@@ -266,6 +275,10 @@ def attention_forward(
     else:
         q = x @ q_kernel.astype(cfg.compute_dtype)
         kv = x @ kv_kernel.astype(cfg.compute_dtype)
+    if lora is not None:
+        from megatronapp_tpu.ops.pallas.kernel_gen import apply_lora_delta
+        q = apply_lora_delta(q, x, lora, "q_kernel")
+        kv = apply_lora_delta(kv, x, lora, "kv_kernel")
     if "q_bias" in p:
         q = q + p["q_bias"].astype(cfg.compute_dtype)
         kv = kv + p["kv_bias"].astype(cfg.compute_dtype)
@@ -565,6 +578,11 @@ def attention_forward(
             fp8_margin=fp8_margin)
     else:
         out = attn_out.reshape(b, s, nq * d) @ out_kernel
+        if lora is not None:
+            from megatronapp_tpu.ops.pallas.kernel_gen import (
+                apply_lora_delta)
+            out = apply_lora_delta(out, attn_out.reshape(b, s, nq * d),
+                                   lora, "out_kernel")
     if "out_bias" in p:
         out = out + p["out_bias"].astype(cfg.compute_dtype)
     return (out, new_cache) if kv_cache is not None else (out, None)

@@ -72,7 +72,7 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                   zigzag: bool = False, segment_ids=None,
                   page_table=None, active=None, chunk_counts=None,
                   tp_sharded: bool = False, kv_scales=None,
-                  fused_decode: bool = False, fp8=None):
+                  fused_decode: bool = False, fp8=None, lora=None):
     """One transformer layer. x: [B,S,H] → ((out, new_cache), aux_losses).
 
     page_table/active: paged-KV decode (inference/paged_cache.py) —
@@ -96,7 +96,15 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
     fp8: this layer's delayed-scaling amax state (training/fp8.py,
     ISSUE 13) — {"attention": {"qkv", "out"}, "mlp": {"fc1", "fc2"}}
     sub-dicts threaded into the tp-overlap ring GEMMs; the updated
-    histories travel out through their cotangents."""
+    histories travel out through their cotangents.
+
+    lora: batched per-row adapter deltas (inference/lora.py, ISSUE 19) —
+    {"row_adapter": [B] int32 bank slots, "banks": {target: (a, b)}}
+    with THIS layer's factor banks a [slots, din, r] / b [slots, r, dout]
+    per RESIDENT_KERNELS target. Serving paths only: each projection
+    matmul grows a ``base(x) + B_i A_i x`` delta (unfused via
+    kernel_gen.apply_lora_delta, fused via the megakernel LoRA
+    epilogues); slot 0 is the all-zero null adapter."""
     if fused_decode:
         if page_table is None or kv_cache is None or "moe" in p:
             raise ValueError(
@@ -113,7 +121,7 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
             return fused_layer_multiquery(
                 p, x, cfg, rope_cos, rope_sin, kv_cache,
                 cache_positions, chunk_counts, page_table, active,
-                kv_scales=kv_scales)
+                kv_scales=kv_scales, lora=lora)
         if x.shape[1] != 1:
             raise ValueError(
                 "fused_decode without chunk_counts is the s == 1 "
@@ -121,11 +129,16 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
                 "multi-token steps")
         return fused_layer_decode(p, x, cfg, rope_cos, rope_sin, kv_cache,
                                   cache_positions, page_table, active,
-                                  kv_scales=kv_scales)
+                                  kv_scales=kv_scales, lora=lora)
     residual = x
     h = apply_norm(cfg.normalization, x, p["ln1_scale"], p.get("ln1_bias"),
                    cfg.layernorm_epsilon)
     if cfg.multi_latent_attention:
+        if lora is not None:
+            raise ValueError(
+                "lora serving targets the GQA projection kernels — MLA "
+                "has no q_kernel/kv_kernel (lora.AdapterCache rejects "
+                "MLA configs at construction)")
         from megatronapp_tpu.transformer.mla import mla_forward
         if segment_ids is not None:
             # MLA routes through the reference attention impl — packed
@@ -155,7 +168,8 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
             page_table=page_table, active=active,
             chunk_counts=chunk_counts, tp_sharded=tp_sharded,
             kv_scales=kv_scales,
-            fp8=None if fp8 is None else fp8["attention"])
+            fp8=None if fp8 is None else fp8["attention"],
+            lora=lora)
     # Tag for the 'selective_attn' remat policy (a no-op otherwise).
     attn_out = checkpoint_name(attn_out, "attn_out")
     x = residual + attn_out.astype(residual.dtype)
@@ -168,12 +182,16 @@ def layer_forward(p, x: jnp.ndarray, cfg: TransformerConfig,
         if fp8 is not None:
             raise ValueError("fp8 does not support MoE layers "
                              "(fp8_ineligible_reason gates this off)")
+        if lora is not None:
+            raise ValueError("lora serving targets the dense fc1/fc2 "
+                             "kernels — MoE layers are unsupported")
         mlp_out, aux = moe_forward(p["moe"], h, cfg, layer_id=layer_id,
                                    ctx=ctx, tp_sharded=tp_sharded)
     else:
         mlp_out = mlp_forward(p["mlp"], h, cfg, layer_id=layer_id, ctx=ctx,
                               tp_sharded=tp_sharded,
-                              fp8=None if fp8 is None else fp8["mlp"])
+                              fp8=None if fp8 is None else fp8["mlp"],
+                              lora=lora)
     x = residual + mlp_out.astype(residual.dtype)
     # MegaScope 'system' perturbation + capture site between layers
     # (transformer_block.py:542-544).

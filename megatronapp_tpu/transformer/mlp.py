@@ -43,7 +43,7 @@ def init_mlp_params(rng, cfg: TransformerConfig, out_std: float,
 
 
 def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
-                ctx=None, tp_sharded: bool = False, fp8=None):
+                ctx=None, tp_sharded: bool = False, fp8=None, lora=None):
     """fp8: this layer's delayed-scaling state for the fc1/fc2 ring
     sites ({"fc1": {hist, sat}, "fc2": ...} — training/fp8.py). Only
     legal when the tp-overlap rings actually run (fp8_ineligible_reason
@@ -54,6 +54,10 @@ def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
         all_gather_matmul, matmul_reduce_scatter, tp_overlap_eligible,
     )
     if tp_sharded:
+        if lora is not None:
+            raise ValueError(
+                "lora deltas are not composable with the tp-sharded "
+                "stage body — serving paths only")
         if fp8 is not None:
             raise ValueError(
                 "fp8 is not supported on the tp-sharded pipeline stage "
@@ -85,6 +89,12 @@ def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
             "GEMMs live inside the ring bodies; check "
             "fp8_ineligible_reason at wiring time")
     margin = int(getattr(cfg, "fp8_margin", 0))
+    # Batched-LoRA serving (inference/lora.py): per-row deltas compose
+    # with the plain matmuls only, not the ring-decomposed overlap path.
+    if lora is not None and overlap:
+        raise ValueError(
+            "lora deltas are not composable with the tp-overlap rings "
+            "— serving paths only")
     x = x.astype(cfg.compute_dtype)
     fc1_kernel = _dist.apply("weight", fc1_res, layer_id)
     fc1_kernel = fc1_kernel.astype(cfg.compute_dtype)
@@ -96,6 +106,10 @@ def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
                               fp8_margin=margin)
     else:
         y = x @ fc1_kernel
+        if lora is not None:
+            from megatronapp_tpu.ops.pallas.kernel_gen import (
+                apply_lora_delta)
+            y = apply_lora_delta(y, x, lora, "fc1_kernel")
     if "fc1_bias" in p:
         y = y + p["fc1_bias"].astype(cfg.compute_dtype)
     y = scope_capture("mlp1", y, layer_id)
@@ -116,6 +130,10 @@ def mlp_forward(p, x: jnp.ndarray, cfg: TransformerConfig, layer_id=None,
             fp8=None if fp8 is None else fp8["fc2"], fp8_margin=margin)
     else:
         out = y @ fc2_kernel
+        if lora is not None:
+            from megatronapp_tpu.ops.pallas.kernel_gen import (
+                apply_lora_delta)
+            out = apply_lora_delta(out, y, lora, "fc2_kernel")
     if "fc2_bias" in p:
         out = out + p["fc2_bias"].astype(cfg.compute_dtype)
     out = scope_capture("mlp2", out, layer_id)

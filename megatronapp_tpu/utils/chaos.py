@@ -87,6 +87,16 @@ tests/test_resilience.py pins this registry against its drill list):
                              evict for migration, sessions-resync for a
                              lost step reply) — zero sessions lost,
                              pools audit() clean, streams unchanged.
+- ``lora-load``              a LoRA adapter fetch dies between reading
+                             the adapter's weights from the registry
+                             and committing them into the HBM bank
+                             (inference/lora.AdapterCache.acquire) —
+                             exercises the cache's exception-safe
+                             rollback (no slot taken, no resident
+                             evicted, refcounts/LRU books unchanged,
+                             audit() clean) and the engine admission
+                             rollback (pool blocks released, request
+                             requeued, retry succeeds).
 
 Simulated whole-process faults (hang / exit) are flag-driven rather than
 registry-driven: --simulated-fault KIND:DELAY routes through
@@ -111,6 +121,7 @@ SITES = (
     "kv-quant-write",
     "fleet-migrate",
     "fleet-rpc",
+    "lora-load",
 )
 
 

@@ -47,7 +47,7 @@ def devices8():
 
 def pytest_collection_modifyitems(config, items):
     """Apply the 'slow' marker from tests/slow_manifest.txt (measured
-    >10s tests; reference pytest.ini's internal/flaky gating). The fast
+    >6s tests; reference pytest.ini's internal/flaky gating). The fast
     iteration lane is `pytest -m "not slow"` (~7 min); the full suite
     remains the default so `pytest tests/` still covers everything."""
     manifest = os.path.join(os.path.dirname(__file__), "slow_manifest.txt")

@@ -170,9 +170,13 @@ class TestStraggler:
     def test_flags_outlier(self):
         det = StragglerDetector(window=32, z_threshold=3.0, min_samples=4)
         det.enable()
-        for _ in range(8):
+        for i in range(8):
             det.start()
-            det._t0 -= 0.010  # simulate 10ms steps
+            # Alternate 7ms/13ms so the window std (~3ms) is dominated by
+            # the injected spread, not scheduler jitter: with uniform 10ms
+            # steps the std is microsecond-scale and a single preemption
+            # between start() and stop() trips the 3-sigma gate.
+            det._t0 -= 0.010 + (0.003 if i % 2 else -0.003)
             assert det.stop() is None
         det.start()
         # Outlier far beyond any load-induced noise in the baseline window

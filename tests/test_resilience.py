@@ -61,12 +61,13 @@ class TestChaosRegistry:
         TestSpeculativeVerifierChaos, kv-quant-write →
         TestKvQuantWriteChaos, fleet-migrate →
         TestFleetMigrateChaos, fleet-rpc →
-        tests/test_fleet_rpc.py::TestChaosRpc)."""
+        tests/test_fleet_rpc.py::TestChaosRpc, lora-load →
+        TestLoraLoadChaos)."""
         assert chaos.SITES == ("checkpoint-save", "local-checkpoint-save",
                                "step-nan", "stepper-step",
                                "paged-evict", "paged-cow", "spec-verify",
                                "kv-quant-write", "fleet-migrate",
-                               "fleet-rpc")
+                               "fleet-rpc", "lora-load")
 
     def test_arm_fire_bounded_and_auto_disarm(self):
         chaos.arm("stepper-step", times=2, after=1)
@@ -458,6 +459,102 @@ class TestFleetMigrateChaos:
         out = fr.run_to_completion()[rid]
         assert len(out) == 11 + 6
         fr.replicas[0].engine.pool.audit()
+
+
+# ---------------------------------------------------------------------------
+class TestLoraLoadChaos:
+    """Chaos site "lora-load" (ISSUE 19): fires in AdapterCache.acquire
+    between the registry fetch and the bank commit — the worst window,
+    where the adapter bytes exist host-side but no slot is consumed.
+    The drill proves (1) the cache books are untouched by the fault
+    (exact-partition audit, same table/free/evictions — no slot leaked
+    for a load that never landed), and (2) the ENGINE admission
+    rollback releases the KV blocks and requeues the request, so the
+    retried stream is token-identical to a never-faulted run."""
+
+    def _cfg(self):
+        return tiny_model(num_query_groups=2, compute_dtype=jnp.float32,
+                          remat_policy="none")
+
+    def test_acquire_fault_leaves_cache_books_untouched(self):
+        from megatronapp_tpu.inference.lora import (
+            AdapterCache, AdapterRegistry, LoraAdapter,
+        )
+        cfg = self._cfg()
+        reg = AdapterRegistry()
+        for i in range(3):
+            reg.register(LoraAdapter.random(f"t{i}", cfg, rank=4,
+                                            seed=i))
+        cache = AdapterCache(cfg, reg, max_resident=2, rank=4)
+        s0 = cache.acquire("t0")
+        cache.audit()
+        table = dict(cache._table)
+        free = list(cache._free)
+        evictions = cache.stats["evictions"]
+        chaos.arm("lora-load", times=1)
+        with pytest.raises(chaos.ChaosFault):
+            cache.acquire("t1")
+        cache.audit()                      # books still exact-partition
+        assert dict(cache._table) == table, "fault consumed a slot"
+        assert list(cache._free) == free, "fault touched the free list"
+        assert cache.stats["evictions"] == evictions
+        assert cache.stats["load_faults"] == 1
+        # Retry succeeds into the free slot; pins/audit stay clean.
+        s1 = cache.acquire("t1")
+        cache.audit()
+        assert s1 not in (0, s0)
+        cache.release(s0)
+        cache.release(s1)
+        cache.audit()
+
+    def test_admission_fault_requeues_and_stream_exact(self):
+        from megatronapp_tpu.inference.dynamic_engine import (
+            DynamicInferenceEngine,
+        )
+        from megatronapp_tpu.inference.engine import SamplingParams
+        from megatronapp_tpu.inference.lora import (
+            AdapterCache, AdapterRegistry, LoraAdapter,
+        )
+        from megatronapp_tpu.models.gpt import init_gpt_params
+        cfg = self._cfg()
+        params, _ = init_gpt_params(jax.random.PRNGKey(3), cfg)
+        prompt = np.arange(1, 14, dtype=np.int32)
+
+        def run(fault: bool):
+            reg = AdapterRegistry()
+            reg.register(LoraAdapter.random("tenant-a", cfg, rank=4,
+                                            seed=11))
+            cache = AdapterCache(cfg, reg, max_resident=2, rank=4)
+            eng = DynamicInferenceEngine(
+                params, cfg, max_batch=1, max_seq_len=64,
+                prefill_buckets=(16,), paged=True, block_size=8,
+                adapter_cache=cache)
+            rid = eng.add_request(prompt, 6,
+                                  SamplingParams(greedy=True),
+                                  adapter_id="tenant-a")
+            faults = 0
+            if fault:
+                chaos.arm("lora-load", times=1)
+            while eng.has_work:
+                try:
+                    eng.step()
+                except chaos.ChaosFault:
+                    faults += 1
+                    cache.audit()          # no slot consumed
+                    eng.pool.audit()       # admitted blocks rolled back
+                    assert eng.pool.blocks_in_use() == 0
+                    assert eng.slots[0] is None
+                    assert len(eng.waiting) == 1   # requeued, not lost
+                    assert cache.stats["load_faults"] == 1
+                cache.audit()
+                eng.pool.audit()
+            return eng.requests[rid].tokens.tolist(), faults
+
+        clean, _ = run(fault=False)
+        faulted, faults = run(fault=True)
+        assert faults == 1, "armed fault must fire during admission"
+        assert faulted == clean, (
+            "retried adapter load changed the emitted stream")
 
 
 # ---------------------------------------------------------------------------

@@ -93,16 +93,32 @@ def make_trace(seed: int = 0, n_requests: int = 24, tenants: int = 3,
 
 
 def replay(router, trace, slo_ttft_ms=None, slo_interval_ms=None,
-           max_steps: int = 100_000):
+           max_steps: int = 100_000, tenant_adapters=None):
     """Replay `trace` against `router` on the virtual step clock.
-    Returns {streams, ttft_hist, interval_hist, report} — streams maps
-    trace id -> emitted token list (the cross-leg parity surface),
-    histograms are live Histogram objects (the /metrics estimator), and
-    report is the JSON-ready gate summary."""
+    Returns {streams, ttft_hist, interval_hist, tenant_hists, report} —
+    streams maps trace id -> emitted token list (the cross-leg parity
+    surface), histograms are live Histogram objects (the /metrics
+    estimator), and report is the JSON-ready gate summary with a
+    per-tenant percentile/attainment section.
+
+    tenant_adapters (ISSUE 19): optional {tenant index -> adapter_id}.
+    When given, every submit carries its tenant's adapter_id plus a
+    ``tenant-<i>`` label — the multi-tenant LoRA workload over a
+    router/engine built with an AdapterCache. When None, no lora/tenant
+    kwargs are passed (bare engines without the plumbing stay
+    replayable)."""
     from megatronapp_tpu.utils.metrics import Histogram
 
-    ttft_hist = Histogram(lo=1e-2, hi=1e6, growth=1.25)
-    interval_hist = Histogram(lo=1e-2, hi=1e6, growth=1.25)
+    def _hist():
+        return Histogram(lo=1e-2, hi=1e6, growth=1.25)
+
+    ttft_hist = _hist()
+    interval_hist = _hist()
+    # Per-tenant latency split (keyed by the TRACE's tenant index, so
+    # it works even when the router is not tenant-aware).
+    tenant_ttft = {}
+    tenant_interval = {}
+    tenant_requests = {}
     pending = sorted(trace, key=lambda e: (e["arrive_step"], e["id"]))
     rid_to_ev = {}
     submit_t = {}
@@ -119,10 +135,16 @@ def replay(router, trace, slo_ttft_ms=None, slo_interval_ms=None,
                 f"steps ({len(finished)}/{len(rid_to_ev)} finished)")
         while pending and pending[0]["arrive_step"] <= step:
             ev = pending.pop(0)
-            rid = router.add_request(ev["prompt"], ev["max_new"])
+            kw = {}
+            if tenant_adapters is not None:
+                kw = {"adapter_id": tenant_adapters.get(ev["tenant"]),
+                      "tenant": f"tenant-{ev['tenant']}"}
+            rid = router.add_request(ev["prompt"], ev["max_new"], **kw)
             rid_to_ev[rid] = ev
             submit_t[rid] = time.monotonic()
             streams[ev["id"]] = []
+            tenant_requests[ev["tenant"]] = (
+                tenant_requests.get(ev["tenant"], 0) + 1)
         events = router.step()
         now = time.monotonic()
         for rid, tok in events["tokens"]:
@@ -130,10 +152,15 @@ def replay(router, trace, slo_ttft_ms=None, slo_interval_ms=None,
             if ev is None:
                 continue
             toks = streams[ev["id"]]
+            t = ev["tenant"]
             if not toks:
-                ttft_hist.observe((now - submit_t[rid]) * 1e3)
+                ttft = (now - submit_t[rid]) * 1e3
+                ttft_hist.observe(ttft)
+                tenant_ttft.setdefault(t, _hist()).observe(ttft)
             elif rid in last_tok_t:
-                interval_hist.observe((now - last_tok_t[rid]) * 1e3)
+                gap = (now - last_tok_t[rid]) * 1e3
+                interval_hist.observe(gap)
+                tenant_interval.setdefault(t, _hist()).observe(gap)
             last_tok_t[rid] = now
             toks.append(int(tok))
             if (ev["abort_after"] is not None and rid not in aborted
@@ -164,8 +191,30 @@ def replay(router, trace, slo_ttft_ms=None, slo_interval_ms=None,
     if slo_interval_ms is not None:
         report["interval_attainment"] = round(
             interval_hist.fraction_below(slo_interval_ms), 4)
+    tenants = {}
+    for t in sorted(tenant_requests):
+        entry = {"requests": tenant_requests[t]}
+        th = tenant_ttft.get(t)
+        ih = tenant_interval.get(t)
+        if th is not None:
+            entry["ttft_p99_ms"] = round(th.percentile(99), 3)
+            if slo_ttft_ms is not None:
+                entry["ttft_attainment"] = round(
+                    th.fraction_below(slo_ttft_ms), 4)
+        if ih is not None:
+            entry["interval_p99_ms"] = round(ih.percentile(99), 3)
+            if slo_interval_ms is not None:
+                entry["interval_attainment"] = round(
+                    ih.fraction_below(slo_interval_ms), 4)
+        if tenant_adapters is not None:
+            entry["adapter_id"] = tenant_adapters.get(t)
+        tenants[f"tenant-{t}"] = entry
+    report["tenants"] = tenants
     return {"streams": streams, "ttft_hist": ttft_hist,
-            "interval_hist": interval_hist, "report": report}
+            "interval_hist": interval_hist,
+            "tenant_hists": {"ttft": tenant_ttft,
+                             "interval": tenant_interval},
+            "report": report}
 
 
 def main(argv=None) -> int:
@@ -182,6 +231,11 @@ def main(argv=None) -> int:
     ap.add_argument("--abort-rate", type=float, default=0.0)
     ap.add_argument("--slo-ttft-ms", type=float, default=None)
     ap.add_argument("--slo-interval-ms", type=float, default=None)
+    ap.add_argument("--lora-adapters", type=int, default=0,
+                    help="generate this many random LoRA adapters into "
+                         "a temp dir and map tenant i -> adapter "
+                         "i%%N on every submit (0 = LoRA off)")
+    ap.add_argument("--lora-rank", type=int, default=4)
     ap.add_argument("--fleet-procs", type=int, default=2,
                     help="replica worker processes to spawn (0 = "
                          "replay against one in-process engine)")
@@ -205,6 +259,32 @@ def main(argv=None) -> int:
         arrival_gap=args.arrival_gap, burst_every=args.burst_every,
         burst_size=args.burst_size, abort_rate=args.abort_rate)
     spec = default_engine_spec(max_seq_len=64, max_batch=2)
+    tenant_adapters = None
+    if args.lora_adapters > 0:
+        import jax.numpy as jnp
+
+        from megatronapp_tpu.config.transformer_config import (
+            TransformerConfig,
+        )
+        from megatronapp_tpu.inference.lora import LoraAdapter
+
+        cfg = TransformerConfig(
+            num_layers=spec["num_layers"],
+            hidden_size=spec["hidden_size"],
+            num_attention_heads=spec["num_attention_heads"],
+            num_query_groups=spec["num_query_groups"],
+            vocab_size=spec["vocab_size"],
+            max_position_embeddings=spec["max_position_embeddings"],
+            compute_dtype=jnp.float32, remat_policy="none")
+        lora_dir = tempfile.mkdtemp(prefix="loadgen-lora-")
+        for i in range(args.lora_adapters):
+            LoraAdapter.random(
+                f"adapter-{i}", cfg, rank=args.lora_rank,
+                seed=100 + i).save(lora_dir)
+        spec.update(lora_dir=lora_dir, lora_rank=args.lora_rank,
+                    max_resident_adapters=max(4, args.lora_adapters))
+        tenant_adapters = {t: f"adapter-{t % args.lora_adapters}"
+                           for t in range(args.tenants)}
     if args.fleet_procs > 0:
         state_dir = args.state_dir or tempfile.mkdtemp(
             prefix="fleet-loadgen-")
@@ -215,7 +295,8 @@ def main(argv=None) -> int:
         try:
             out = replay(router, trace,
                          slo_ttft_ms=args.slo_ttft_ms,
-                         slo_interval_ms=args.slo_interval_ms)
+                         slo_interval_ms=args.slo_interval_ms,
+                         tenant_adapters=tenant_adapters)
             out["report"]["rpc"] = router.rpc_totals()
             out["report"]["supervisor_restarts"] = sum(
                 router.supervisor_restarts().values())
@@ -227,7 +308,8 @@ def main(argv=None) -> int:
     else:
         engine = build_engine_from_spec(spec)
         out = replay(engine, trace, slo_ttft_ms=args.slo_ttft_ms,
-                     slo_interval_ms=args.slo_interval_ms)
+                     slo_interval_ms=args.slo_interval_ms,
+                     tenant_adapters=tenant_adapters)
     print(json.dumps(out["report"]))
     return 0
 

@@ -164,6 +164,29 @@ def main():
                       "(--draft-load-dir not given) — acceptance will be "
                       "poor; outputs stay exact either way")
         spec = None if args.spec_method == "none" else args.spec_method
+
+        def make_adapter_cache():
+            # Multi-tenant LoRA serving (ISSUE 19): one HBM adapter
+            # cache PER ENGINE (fleet replicas each own their banks —
+            # the router's tenant affinity keeps a tenant's requests on
+            # the replica already holding its adapter).
+            if not args.lora_dir:
+                return None
+            from megatronapp_tpu.inference.lora import (
+                AdapterCache, AdapterRegistry,
+            )
+            registry = AdapterRegistry(args.lora_dir)
+            cache = AdapterCache(
+                cfg, registry,
+                max_resident=args.max_resident_adapters,
+                rank=args.lora_rank)
+            print(f"LoRA serving from {args.lora_dir}: "
+                  f"{len(registry.ids())} adapters on disk, rank "
+                  f"{args.lora_rank}, {args.max_resident_adapters} "
+                  f"resident ({cache.adapter_nbytes / 2**20:.2f} MiB "
+                  f"each)")
+            return cache
+
         if getattr(args, "fleet_procs", 0) > 0:
             # Cross-process fleet (ISSUE 18): N replica WORKER
             # PROCESSES behind the RPC router
@@ -189,7 +212,10 @@ def main():
                 max_seq_len=args.max_seq_len,
                 block_size=args.kv_block_size,
                 num_blocks=args.num_kv_blocks,
-                kv_cache_dtype=args.kv_cache_dtype)
+                kv_cache_dtype=args.kv_cache_dtype,
+                lora_dir=args.lora_dir,
+                lora_rank=args.lora_rank,
+                max_resident_adapters=args.max_resident_adapters)
             state_dir = tempfile.mkdtemp(prefix="fleet-state-")
             # Workers are fresh processes: telemetry / request tracing
             # opt-ins ride the env (utils/metrics.py MEGATRON_METRICS,
@@ -285,7 +311,8 @@ def main():
                     draft_params=draft_params, draft_cfg=draft_cfg,
                     prefill_chunk=args.prefill_chunk,
                     kv_cache_dtype=args.kv_cache_dtype,
-                    fused_decode=args.megakernel_decode)
+                    fused_decode=args.megakernel_decode,
+                    adapter_cache=make_adapter_cache())
 
             engine = FleetRouter(
                 engine_factory=replica_engine, num_replicas=n,
@@ -348,11 +375,19 @@ def main():
             spec_k=args.spec_k, draft_params=draft_params,
             draft_cfg=draft_cfg, prefill_chunk=args.prefill_chunk,
             ctx=tp_ctx, kv_cache_dtype=args.kv_cache_dtype,
-            fused_decode=args.megakernel_decode)
+            fused_decode=args.megakernel_decode,
+            adapter_cache=make_adapter_cache())
+        if args.lora_dir:
+            # Tenant SLO composition point: all tenants default to the
+            # "standard" class; operators assign premium/batch classes
+            # programmatically (inference/lora.py TenantSLO.assign).
+            from megatronapp_tpu.inference.lora import TenantSLO
+            engine.tenant_slo = TenantSLO()
         print(f"serving continuous batching on {args.host}:{args.port} "
               f"(paged={args.paged_kv_cache}, "
               f"kv={args.kv_cache_dtype}, tp={args.serve_tp}, "
               f"megakernel={engine.megakernel}, "
+              f"lora={'on' if args.lora_dir else 'off'}, "
               f"spec={engine.spec_method or 'off'})")
         TextGenerationServer(engine, args.host, args.port).run()
         return
