@@ -144,6 +144,19 @@ def _kv_quant_hook():
     return r if r.get("memory_decode") else None
 
 
+def _kv_spill_hook():
+    """KV capacity tiers A/B (tools/kv_spill_benchmark.py) on the CPU
+    backend — resident sessions at a fixed HBM block budget with vs
+    without the host-RAM spill tier (gate >= 2x, token-exact resume),
+    and the fleet-global prefix store's hit-rate/chunks-avoided vs
+    the storeless baseline, tracked round over round like the other
+    hooks."""
+    if os.environ.get("BENCH_KV_SPILL", "1") != "1":
+        return None
+    r = _run_child("--kv-spill", LOCAL_TIMEOUT_S, extra_env=CPU_ENV)
+    return r if r.get("capacity") else None
+
+
 def _megakernel_hook():
     """Megakernel decode + dispatch levers A/B
     (tools/megakernel_benchmark.py) on the CPU backend — decode
@@ -287,6 +300,9 @@ def _attach_overlap_hooks(res):
     kvq = _kv_quant_hook()
     if kvq:
         res.setdefault("extra", {})["kv_quant"] = kvq
+    kvs = _kv_spill_hook()
+    if kvs:
+        res.setdefault("extra", {})["kv_spill"] = kvs
     mkd = _megakernel_hook()
     if mkd:
         res.setdefault("extra", {})["megakernel"] = mkd
@@ -605,6 +621,13 @@ def kv_quant_main():
                          spec_k=4)))
 
 
+def kv_spill_main():
+    """KV capacity tiers A/B child (CPU env set by the parent)."""
+    from tools.kv_spill_benchmark import run
+    print(json.dumps(run(num_blocks=8, sessions=6, spill_mb=4.0,
+                         dtypes=("bf16",))))
+
+
 def megakernel_main():
     """megakernel decode + dispatch levers A/B child (CPU env set by
     the parent)."""
@@ -792,6 +815,8 @@ if __name__ == "__main__":
         spec_decode_main()
     elif "--kv-quant" in sys.argv:
         kv_quant_main()
+    elif "--kv-spill" in sys.argv:
+        kv_spill_main()
     elif "--disagg" in sys.argv:
         disagg_main()
     elif "--megakernel" in sys.argv:

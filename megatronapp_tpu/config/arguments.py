@@ -236,6 +236,39 @@ def add_serving_args(ap: argparse.ArgumentParser):
                         "slot); misses load from --lora-dir, evicting "
                         "the LRU unpinned resident — admission waits "
                         "when all N are pinned by in-flight requests")
+    # KV capacity tiers (ISSUE 20, inference/paged_cache.py).
+    g.add_argument("--kv-spill-host-mb", type=float, default=0.0,
+                   metavar="MB",
+                   help="host-RAM KV spill tier byte budget (0 = off): "
+                        "idle/low-priority sessions PARK — their pool "
+                        "blocks export to host memory (export_slot "
+                        "payloads, exact serialized bytes) and the "
+                        "blocks free — then resume token-exact through "
+                        "import_slot on the next token. Under pressure "
+                        "the engine prefers parking over preemption "
+                        "(a park costs an import, a preemption a "
+                        "re-prefill); needs --engine dynamic "
+                        "--paged-kv-cache")
+    g.add_argument("--kv-spill-watermark-blocks", type=int, default=0,
+                   metavar="N",
+                   help="park sessions whenever the pool's free+"
+                        "evictable block count drops below N (0 = park "
+                        "only under admission/decode pressure); parked "
+                        "sessions auto-resume FIFO once capacity "
+                        "recovers above the watermark (needs "
+                        "--kv-spill-host-mb)")
+    g.add_argument("--fleet-prefix-store-mb", type=float, default=0.0,
+                   metavar="MB",
+                   help="fleet-global prefix store capacity (0 = off): "
+                        "prefix blocks inserted by ANY replica are "
+                        "exported once into a shared host-RAM store "
+                        "(keyed by the same rolling block hashes as "
+                        "the prefix cache), and a replica admitting a "
+                        "prompt it misses locally imports the blocks "
+                        "instead of recomputing the prefill — hot "
+                        "prefixes cost once per fleet, not once per "
+                        "replica (LRU-bounded; needs --serve-fleet "
+                        "N>=2 or --fleet-procs N>=2)")
     # Telemetry spine (ISSUE 12).
     g.add_argument("--serving-metrics", action="store_true",
                    help="enable the telemetry registry "
@@ -404,6 +437,51 @@ def validate_serving_args(args, multi_latent_attention: bool = False):
             f"--max-resident-adapters must be >= 1 (got {max_res}); "
             "slot 0 is the reserved NULL adapter, so at least one "
             "managed slot is needed to serve any adapter at all")
+    # KV capacity tiers (ISSUE 20): same first-failed-predicate style.
+    spill_mb = getattr(args, "kv_spill_host_mb", 0.0)
+    if spill_mb < 0:
+        raise SystemExit(
+            f"--kv-spill-host-mb must be >= 0 (got {spill_mb}); it is "
+            "the spill tier's host byte budget (0 disables it)")
+    if spill_mb:
+        if getattr(args, "engine", "static") != "dynamic":
+            raise SystemExit(
+                "--kv-spill-host-mb requires --engine dynamic (park/"
+                "unpark is the dynamic engine's slot machinery)")
+        if not getattr(args, "paged_kv_cache", False):
+            raise SystemExit(
+                "--kv-spill-host-mb requires --paged-kv-cache (the "
+                "spill tier parks pool blocks via export_slot/"
+                "import_slot)")
+        if getattr(args, "serve_disagg", False):
+            raise SystemExit(
+                "--kv-spill-host-mb does not compose with "
+                "--serve-disagg yet: parking lives in the unified "
+                "engine's slot machinery; the disagg facade stages "
+                "prefills in a separate pool (serve the spill tier "
+                "from colocated dynamic engines or a fleet of them)")
+    watermark = getattr(args, "kv_spill_watermark_blocks", 0)
+    if watermark < 0:
+        raise SystemExit(
+            f"--kv-spill-watermark-blocks must be >= 0 (got "
+            f"{watermark}); it is a free-block low-water mark")
+    if watermark and not spill_mb:
+        raise SystemExit(
+            "--kv-spill-watermark-blocks needs --kv-spill-host-mb "
+            "(the watermark decides WHEN to park; the budget is WHERE "
+            "the parked bytes go — without a budget nothing can park)")
+    store_mb = getattr(args, "fleet_prefix_store_mb", 0.0)
+    if store_mb < 0:
+        raise SystemExit(
+            f"--fleet-prefix-store-mb must be >= 0 (got {store_mb}); "
+            "it is the store's host capacity (0 disables it)")
+    if store_mb and fleet < 2 and procs < 2:
+        raise SystemExit(
+            "--fleet-prefix-store-mb needs a fleet of >= 2 replicas "
+            "(--serve-fleet N>=2 or --fleet-procs N>=2): with one "
+            "replica the pool's own prefix cache already holds every "
+            "inserted block — a fleet-global store would only "
+            "duplicate it")
     if (getattr(args, "quantized_weights", False)
             and getattr(args, "engine", "static") == "mamba"):
         raise SystemExit(

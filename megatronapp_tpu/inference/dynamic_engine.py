@@ -48,7 +48,7 @@ import dataclasses
 import itertools
 import logging
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -59,7 +59,9 @@ from megatronapp_tpu.config.transformer_config import TransformerConfig
 from megatronapp_tpu.inference.engine import (
     SamplingParams, init_kv_cache, mask_padded_vocab,
 )
-from megatronapp_tpu.inference.paged_cache import PagedKVCache, cdiv
+from megatronapp_tpu.inference.paged_cache import (
+    HostSpillTier, PagedKVCache, cdiv,
+)
 from megatronapp_tpu.models.gpt import gpt_embed, gpt_head, gpt_rope_tables
 from megatronapp_tpu.trace.request_trace import get_request_tracer
 from megatronapp_tpu.transformer.block import layer_forward
@@ -420,7 +422,9 @@ class DynamicInferenceEngine:
                  prefill_chunk: int = 32, ctx=None, pool=None,
                  kv_cache_dtype: str = "bf16",
                  fused_decode: bool = False,
-                 adapter_cache=None):
+                 adapter_cache=None,
+                 spill_host_mb: float = 0.0,
+                 spill_watermark_blocks: int = 0):
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -559,6 +563,31 @@ class DynamicInferenceEngine:
         self.requests: Dict[int, Request] = {}
         self._aborted: List[Request] = []   # aborted mid-admission
         self._ids = itertools.count()
+
+        # Host-RAM KV spill tier (ISSUE 20): parked sessions hold their
+        # written KV as export_slot payloads in host memory instead of
+        # pool blocks — resume imports the bytes back (copy-exact, so
+        # the stream continues token-exact) rather than re-prefilling
+        # like a preemption. _parked maps rid -> payload in FIFO
+        # (= unpark) order; _held rids stay parked until the client
+        # asks for the next token (resume_request); _no_repark guards
+        # one step's unparked sessions from bouncing straight back out.
+        self.spill: Optional[HostSpillTier] = None
+        self.spill_watermark = int(spill_watermark_blocks)
+        if spill_host_mb:
+            if not paged:
+                raise ValueError(
+                    "spill_host_mb requires the paged backend (the "
+                    "spill tier parks pool blocks) — pass paged=True / "
+                    "--paged-kv-cache")
+            self.spill = HostSpillTier(int(spill_host_mb * (1 << 20)))
+        elif spill_watermark_blocks:
+            raise ValueError(
+                "spill_watermark_blocks without a spill budget does "
+                "nothing — set spill_host_mb / --kv-spill-host-mb too")
+        self._parked: "OrderedDict[int, dict]" = OrderedDict()
+        self._held: set = set()
+        self._no_repark: set = set()
 
         # Speculative decoding (inference/speculative.py).
         self.spec_method: Optional[str] = None
@@ -880,6 +909,16 @@ class DynamicInferenceEngine:
                 # Spans close when the same step's retire pass reclaims
                 # the slot (the one finish funnel).
                 self._rt.instant("expire", req.request_id)
+        for rid in list(self._parked):
+            req = self._parked[rid]["req"]
+            if overdue(req):
+                # Parked sessions hold no slot — marking finished lets
+                # the SAME step's _spill_policy sweep drop the spill
+                # entry and fire the finished event.
+                req.finished = True
+                expired.append(req.request_id)
+                self._tenant_inc(req.tenant, "expired")
+                self._rt.instant("expire", req.request_id)
         if expired:
             telemetry.inc("serving_deadline_expired", len(expired))
         return expired
@@ -913,6 +952,11 @@ class DynamicInferenceEngine:
             self._free_slot(slot)
             self.requests.pop(req.request_id, None)
             self._rt.finish(req.request_id, "abort")
+        for rid in list(self._parked):
+            req = self._parked[rid]["req"]
+            self._drop_parked(rid)
+            self.requests.pop(req.request_id, None)
+            self._rt.finish(req.request_id, "abort")
 
     def _free_slot(self, slot: int):
         """Clear every per-slot engine resource (request ref, length,
@@ -932,8 +976,8 @@ class DynamicInferenceEngine:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting) or any(
-            r is not None for r in self.slots)
+        return (bool(self.waiting) or bool(self._parked)
+                or any(r is not None for r in self.slots))
 
     def set_params(self, params):
         """Install new model params (rolling engine reload). Same pytree
@@ -1001,6 +1045,12 @@ class DynamicInferenceEngine:
         (the "fleet-migrate" chaos site)."""
         assert self.paged, "session export requires the paged backend"
         req = self.requests.get(rid)
+        if req is not None and not req.finished and rid in self._parked:
+            # A PARKED session migrates too (a drained/reloading replica
+            # must not strand its parked sessions): the spill payload IS
+            # the export_slot snapshot, handed over as-is — read-only
+            # here; release_exported drops the spill entry on commit.
+            return dict(self._parked[rid])
         if (req is None or req.finished or req.slot < 0
                 or self.slots[req.slot] is not req or not req.generated):
             return None
@@ -1065,6 +1115,14 @@ class DynamicInferenceEngine:
         weight-valid, so followers on THIS replica keep hitting it. The
         request itself now lives in the destination engine's books."""
         req = self.requests.pop(rid)
+        if rid in self._parked:
+            # A PARKED session migrated: its KV never re-entered this
+            # pool (export handed the spill payload over verbatim), so
+            # completion just drops the spill entry. Not an unpark —
+            # the session resumes on the destination, not here.
+            self._drop_parked(rid)
+            self._rt.instant("migrate-out", rid, slot=-1)
+            return
         # req.slot already points at the DESTINATION slot (import set
         # it) — find the source slot by identity.
         slot = next(i for i, r in enumerate(self.slots) if r is req)
@@ -1072,6 +1130,191 @@ class DynamicInferenceEngine:
                           int(self.lengths[slot]))
         self._free_slot(slot)
         self._rt.instant("migrate-out", rid, slot=slot)
+
+    # ---- host-RAM KV spill tier (ISSUE 20) -------------------------------
+    def _park(self, req: Request, hold: bool = False) -> bool:
+        """Move a RUNNING request's written KV to the host spill tier
+        and release its slot + pool blocks. The copy is the SAME
+        export_slot payload a migration ships (verbatim stored rows +
+        scales), so the resume path (_unpark → import_slot) restores
+        the pool bytes exactly and the stream continues token-exact for
+        every KV dtype — unlike preemption, which re-prefills. Returns
+        False with NOTHING mutated when the session is not parkable or
+        the tier's byte budget refuses the payload (the caller falls
+        back to preemption). `hold` marks a client-requested park
+        (tools/loadgen.py long-idle phases): the session stays parked
+        until resume_request, excluded from the auto-unpark pass."""
+        if (self.spill is None or req.finished or req.slot < 0
+                or self.slots[req.slot] is not req or not req.generated):
+            return False
+        rid = req.request_id
+        slot = req.slot
+        valid_len = int(self.lengths[slot])
+        payload = self.pool.export_slot(slot, valid_len)   # read-only
+        if not self.spill.would_fit(payload["nbytes"]):
+            self.spill.counters["rejects"] += 1
+            return False
+        # Chaos site "kv-spill" (park window): fires between the
+        # read-only host copy above and the page-table release below —
+        # nothing has mutated yet, so the rollback is "do nothing": the
+        # session keeps decoding in its slot, audit() passes, and the
+        # stream is unaffected (tests/test_resilience.py drill).
+        chaos.fire("kv-spill")
+        payload["req"] = req
+        assert self.spill.put(rid, payload)     # would_fit checked above
+        # Not preempted=True: full blocks stay prefix-cached while
+        # evictable (same as a retirement) and the preemption counters
+        # keep meaning "KV thrown away", which a park is not.
+        self.pool.release(slot, np.asarray(req.tokens), valid_len)
+        self._free_slot(slot)
+        req.slot = -1
+        self._parked[rid] = payload
+        if hold:
+            self._held.add(rid)
+        rt = self._rt
+        if rt.enabled:
+            rt.end("decode", rid)
+            rt.instant("park", rid, bytes=payload["nbytes"])
+        return True
+
+    def _unpark(self, rid: int) -> bool:
+        """Re-enter a parked session through the pool (import_slot) so
+        the next decode step continues its stream token-exact. Returns
+        False with the session STILL PARKED (and the pool untouched)
+        when no slot is free, the adapter bank is pinned full, or the
+        pool cannot host the rows right now — the policy retries next
+        step."""
+        payload = self._parked.get(rid)
+        if payload is None:
+            return False
+        req: Request = payload["req"]
+        slot = next((i for i in range(self.max_batch)
+                     if self.slots[i] is None), None)
+        if slot is None:
+            return False
+        aslot = 0
+        if self.adapters is not None:
+            from megatronapp_tpu.inference.lora import AdapterSlotsPinned
+            try:
+                aslot = self.adapters.acquire(req.adapter_id)
+            except AdapterSlotsPinned:
+                return False
+        if not self.pool.import_slot(slot, payload):
+            if self.adapters is not None:
+                self.adapters.release(aslot)
+            return False
+        try:
+            # Chaos site "kv-spill" (unpark mirror): fires between the
+            # pool import and the spill-entry release — the rollback
+            # returns the imported blocks to the pool and the session
+            # stays parked (its payload was never dropped), so audit()
+            # passes and a later resume is still token-exact.
+            chaos.fire("kv-spill")
+        except Exception:
+            self.pool.release(slot, np.asarray(req.tokens),
+                              int(payload["valid_len"]))
+            if self.adapters is not None:
+                self.adapters.release(aslot)
+            raise
+        self.row_adapter[slot] = aslot
+        valid_len = int(payload["valid_len"])
+        req.slot = slot
+        self.slots[slot] = req
+        self.lengths[slot] = valid_len
+        self.last_tokens[slot, 0] = req.generated[-1]
+        # Followers hit the resumed prompt blocks like locally-prefilled
+        # ones (mirror of import_request).
+        self.pool.register_prefix(slot, np.asarray(req.tokens), valid_len)
+        if self.proposer is not None:
+            self.proposer.on_admit(slot, req)
+        self.spill.pop(rid)                       # counts the unpark
+        del self._parked[rid]
+        self._held.discard(rid)
+        self._no_repark.add(rid)   # no park/unpark thrash within a step
+        rt = self._rt
+        if rt.enabled:
+            rt.instant("unpark", rid, slot=slot, length=valid_len)
+            rt.begin("decode", rid)
+        return True
+
+    def _drop_parked(self, rid: int):
+        """Remove a parked session's spill entry WITHOUT counting an
+        unpark (aborts, expiry, migration-out): only genuine resumes
+        count."""
+        self._parked.pop(rid, None)
+        self._held.discard(rid)
+        if self.spill is not None:
+            self.spill.pop(rid, unpark=False)
+
+    def park_request(self, rid: int) -> bool:
+        """Client-requested park of a long-idle session (held until
+        resume_request). True when the session is parked (or already
+        was)."""
+        req = self.requests.get(rid)
+        if req is None or req.finished:
+            return False
+        if rid in self._parked:
+            self._held.add(rid)
+            return True
+        return self._park(req, hold=True)
+
+    def resume_request(self, rid: int) -> bool:
+        """Unpark-on-next-token: the client wants this session's next
+        token, so clear its hold and try to re-enter the pool now (the
+        step policy retries if capacity refuses). True when the session
+        is known (parked or running)."""
+        if rid not in self._parked:
+            return rid in self.requests
+        self._held.discard(rid)
+        self._unpark(rid)     # best-effort now; _spill_policy retries
+        return True
+
+    def _park_for_pressure(self) -> bool:
+        """Park the lowest-priority running session (same victim order
+        as preemption: highest (priority, request_id) first). False when
+        nobody is parkable — the caller falls back to preemption."""
+        runners = sorted(
+            (r for r in self.slots
+             if r is not None and not r.finished and r.slot >= 0
+             and r.request_id not in self._no_repark),
+            key=lambda r: (r.priority, r.request_id))
+        for victim in reversed(runners):
+            if self._park(victim):
+                return True
+        return False
+
+    def _spill_policy(self):
+        """Per-step spill housekeeping, run after the expiry sweep and
+        before admission: (1) drop parked sessions finished by
+        abort/expiry so their finished events fire this step; (2)
+        auto-unpark (FIFO = park order) the non-held parked sessions
+        capacity allows — forced when the engine is otherwise idle so a
+        parked session can never stall forever; (3) watermark parking:
+        while available_blocks() sits below --kv-spill-watermark-blocks,
+        park lowest-priority sessions to keep decode/admission
+        headroom."""
+        if self.spill is None:
+            return
+        for rid in list(self._parked):
+            req = self._parked[rid]["req"]
+            if req.finished:
+                self._drop_parked(rid)
+                self._aborted.append(req)    # finished event this step
+        for rid in [r for r in self._parked if r not in self._held]:
+            payload = self._parked[rid]
+            need = cdiv(int(payload["valid_len"]) + 1,
+                        self.pool.block_size)
+            idle = (not self.waiting and
+                    all(r is None for r in self.slots))
+            if (not idle and self.pool.available_blocks() - need
+                    < self.spill_watermark):
+                break    # below-watermark unpark would thrash right back
+            if not self._unpark(rid):
+                break    # no slot / pool full; FIFO — don't skip ahead
+        if self.spill_watermark > 0:
+            while (self.pool.available_blocks() < self.spill_watermark
+                   and self._park_for_pressure()):
+                pass
 
     def _admit(self) -> List[Request]:
         admitted = []
@@ -1093,6 +1336,14 @@ class DynamicInferenceEngine:
                 # host this prompt now, keep FIFO order and wait for
                 # retirements/preemptions to free blocks.
                 plan = self.pool.admit(slot, req.tokens)
+                if plan is None and self.spill is not None:
+                    # Pressure path, spill preferred over waiting: park
+                    # idle-priority sessions (KV kept byte-exact in host
+                    # RAM) until the prompt fits — this is what lifts
+                    # concurrent sessions-at-budget past the HBM block
+                    # count.
+                    while plan is None and self._park_for_pressure():
+                        plan = self.pool.admit(slot, req.tokens)
                 if plan is None:
                     self.waiting.appendleft(req)
                     break
@@ -1343,6 +1594,15 @@ class DynamicInferenceEngine:
                     req.slot, int(self.lengths[req.slot])):
                 victim = next(r for r in reversed(runners)
                               if r.slot >= 0)
+                if (victim is not req and self.spill is not None
+                        and victim.request_id not in self._no_repark
+                        and self._park(victim)):
+                    # Spill preferred over preemption: the victim's KV
+                    # moved to host RAM byte-exact instead of being
+                    # thrown away — its resume costs an import, not a
+                    # re-prefill. Falls through to preemption when the
+                    # tier's budget refuses the payload.
+                    continue
                 self._preempt(victim, preempted)
                 if victim is req:
                     break
@@ -1376,6 +1636,9 @@ class DynamicInferenceEngine:
         (expired ⊆ finished: deadline-overdue requests aborted by this
         step's expiry sweep)."""
         expired = self.expire_overdue()
+        if self.spill is not None:
+            self._no_repark.clear()
+            self._spill_policy()
         admitted = self._admit()
         events = {"admitted": [r.request_id for r in admitted],
                   "tokens": [(r.request_id, r.generated[-1])
@@ -1709,6 +1972,10 @@ class DynamicInferenceEngine:
                     else 0.0),
                 **st,
             }
+        if self.spill is not None:
+            out["spill"] = {"watermark_blocks": self.spill_watermark,
+                            "held": len(self._held),
+                            **self.spill.stats()}
         if self.adapters is not None:
             out["lora"] = self.adapters.stats_snapshot()
         if self._tenant_stats:

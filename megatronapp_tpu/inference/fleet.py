@@ -94,7 +94,9 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from megatronapp_tpu.inference.paged_cache import prefix_block_keys
+from megatronapp_tpu.inference.paged_cache import (
+    FleetPrefixStore, cdiv, prefix_block_keys,
+)
 from megatronapp_tpu.trace.request_trace import (
     DECODE_PID, PREFILL_PID, get_request_tracer,
 )
@@ -218,7 +220,8 @@ class FleetRouter:
                  max_migrations_per_step: int = 1,
                  queue_weight: Optional[float] = None,
                  pressure_weight: Optional[float] = None,
-                 slo_weight: Optional[float] = None):
+                 slo_weight: Optional[float] = None,
+                 prefix_store_mb: float = 0.0):
         assert policy in ("affinity", "round_robin"), policy
         if engines is None:
             assert engine_factory is not None, (
@@ -282,12 +285,25 @@ class FleetRouter:
         self._reload = None                 # rolling-reload state
         self._params = None                 # latest reloaded params
         self.autoscaler = MeshSplitAutoscaler() if autoscale else None
+        # Fleet-global prefix store (ISSUE 20): exported prefix-block
+        # payloads keyed by the same rolling hashes as the affinity
+        # map — a replica that misses a hot prefix locally gathers the
+        # blocks from the store at admission instead of recomputing the
+        # prefill (in-process flavor; fleet_rpc.py ships the same
+        # payloads over the prefix_put/prefix_get verbs).
+        self.prefix_store = (FleetPrefixStore(int(prefix_store_mb
+                                                  * (1 << 20)))
+                             if prefix_store_mb else None)
         self.router_stats = {
             "migrations": 0, "migration_failures": 0,
             "migrated_kv_bytes": 0, "failovers": 0, "replica_deaths": 0,
             "reloads": 0, "replica_reloads": 0, "autoscale_rebuilds": 0,
             "autoscale_aborts": 0, "affinity_admissions": 0,
             "tenant_affinity_admissions": 0, "admissions": 0,
+            "prefix_store_admission_hits": 0,
+            "prefix_store_seeded_blocks": 0,
+            "prefix_store_seeded_bytes": 0,
+            "prefill_chunks_avoided": 0,
         }
         self._rt = get_request_tracer()
         # Fleet process rows aggregate every replica's events (spans
@@ -316,6 +332,17 @@ class FleetRouter:
                 self._affinity.move_to_end(key)
             while len(self._affinity) > self.affinity_capacity:
                 self._affinity.popitem(last=False)
+        if self.prefix_store is not None:
+            # Populate the fleet store from the same prefix-insert
+            # events: export each NEW block once (host gather), after
+            # which every replica serves it from host RAM.
+            pool = self.replicas[idx].engine.pool
+            for key in keys:
+                if self.prefix_store.has(key):
+                    continue
+                payload = pool.export_prefix_block(key)
+                if payload is not None:
+                    self.prefix_store.put(key, payload)
 
     def _flush_replica(self, idx: int):
         """Drop every affinity entry pointing at replica `idx` (its
@@ -324,6 +351,13 @@ class FleetRouter:
             stale = [k for k, v in self._affinity.items() if v == idx]
             for k in stale:
                 del self._affinity[k]
+        if self.prefix_store is not None:
+            # One replica's flush means a params reload is in flight (or
+            # it died mid-anything): stored blocks are no longer
+            # guaranteed to match the weights every replica will run, so
+            # the WHOLE store drops — it repopulates from the next
+            # prefix inserts, same as each pool's own prefix cache.
+            self.prefix_store.clear()
 
     def _note_tenant(self, key: Optional[str], idx: int):
         if key is None:
@@ -441,6 +475,8 @@ class FleetRouter:
                 raise RuntimeError(
                     "fleet has no live replica to admit into (every "
                     "replica is dead — drain windows queue instead)")
+            if self.prefix_store is not None:
+                self._seed_from_store(rep, prompt)
             rid = rep.engine.add_request(
                 prompt, max_new_tokens, sampling, eod_id=eod_id,
                 priority=priority, deadline_s=deadline_s, **extra)
@@ -449,6 +485,56 @@ class FleetRouter:
         self.router_stats["admissions"] += 1
         telemetry.inc("fleet_admissions")
         return rid
+
+    def _seed_from_store(self, rep: Replica, prompt: np.ndarray):
+        """Gather this prompt's missing leading prefix blocks from the
+        fleet store into the target replica's pool (import_prefix_block
+        — rc==0 LRU entries, exactly like a local insert) BEFORE
+        admission, so pool.admit() hits them and the chunked prefill
+        skips the covered tokens. Prefill-chunks-avoided is exact: the
+        chunk counts before/after seeding follow admit()'s own
+        cached-token arithmetic (len(leading hits) * block_size, capped
+        at p_len - 1 for the CoW case)."""
+        store = self.prefix_store
+        keys = prefix_block_keys(prompt, self.block_size, len(prompt))
+        if not keys:
+            return
+        eng = rep.engine
+        inner = getattr(eng, "engine", eng)   # disagg facade → inner
+        pool = eng.pool
+        local = 0                  # leading blocks already present
+        for k in keys:
+            if not pool.has_prefix(k):
+                break
+            local += 1
+        seeded = 0
+        chain = local              # leading present-or-seeded blocks
+        for k in keys[local:]:
+            if pool.has_prefix(k):
+                chain += 1
+                continue
+            payload = store.get(k)         # counts the hit/miss
+            if payload is None or not pool.import_prefix_block(
+                    k, payload):
+                break                      # only a LEADING run helps
+            chain += 1
+            seeded += 1
+            self.router_stats["prefix_store_seeded_blocks"] += 1
+            self.router_stats["prefix_store_seeded_bytes"] += (
+                payload["nbytes"])
+        if not seeded:
+            return
+        p_len = len(prompt)
+        chunk = int(getattr(inner, "prefill_chunk", 32))
+
+        def chunks_at(blocks_cached: int) -> int:
+            cached = min(blocks_cached * self.block_size, p_len - 1)
+            return cdiv(p_len - cached, chunk)
+
+        avoided = chunks_at(local) - chunks_at(chain)
+        self.router_stats["prefix_store_admission_hits"] += 1
+        self.router_stats["prefill_chunks_avoided"] += avoided
+        telemetry.inc("fleet_prefill_chunks_avoided", avoided)
 
     # ---- per-request forwarding ------------------------------------------
     def _owner_engine(self, rid: int):
@@ -468,6 +554,19 @@ class FleetRouter:
     def abort_request(self, request_id: int) -> Optional[str]:
         eng = self._owner_engine(request_id)
         return None if eng is None else eng.abort_request(request_id)
+
+    def park_request(self, request_id: int) -> bool:
+        """Forward a client park (long-idle session) to the owning
+        replica's spill tier; False when the owner has no spill tier
+        (disagg facade / spill off) or the session isn't parkable."""
+        eng = self._owner_engine(request_id)
+        fn = getattr(eng, "park_request", None)
+        return bool(fn and fn(request_id))
+
+    def resume_request(self, request_id: int) -> bool:
+        eng = self._owner_engine(request_id)
+        fn = getattr(eng, "resume_request", None)
+        return bool(fn and fn(request_id))
 
     def expire_overdue(self, now: Optional[float] = None) -> List[int]:
         expired: List[int] = []
@@ -1069,6 +1168,7 @@ class FleetRouter:
                 entry.update({
                     "active": sum(1 for s in eng.slots if s is not None),
                     "waiting": len(eng.waiting),
+                    "parked": len(getattr(eng, "_parked", ())),
                     "blocks_in_use": pool.blocks_in_use(),
                     "prefix_hit_tokens":
                         pool.stats["prefix_hit_tokens"],
@@ -1112,6 +1212,8 @@ class FleetRouter:
                 **self.router_stats,
             },
         }
+        if self.prefix_store is not None:
+            out["fleet"]["prefix_store"] = self.prefix_store.stats()
         if include_dispatch and live:
             try:
                 out["decode_dispatch"] = (

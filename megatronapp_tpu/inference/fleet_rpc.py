@@ -144,6 +144,10 @@ def default_engine_spec(**overrides) -> dict:
         "prefill_buckets": [16],
         "block_size": 8, "num_blocks": None,
         "kv_cache_dtype": "bf16",
+        "prefill_chunk": 32,
+        # Host-RAM KV spill tier (ISSUE 20): parked sessions per worker.
+        "kv_spill_host_mb": 0.0,
+        "kv_spill_watermark_blocks": 0,
         "platform": "cpu",          # worker JAX_PLATFORMS
         # Multi-tenant LoRA serving (ISSUE 19): a lora_dir of .npz
         # adapters gives every worker an AdapterCache over the same
@@ -199,7 +203,11 @@ def build_engine_from_spec(spec: dict):
         paged=True, block_size=spec["block_size"],
         num_blocks=spec.get("num_blocks"),
         kv_cache_dtype=spec.get("kv_cache_dtype", "bf16"),
-        adapter_cache=adapter_cache)
+        prefill_chunk=spec.get("prefill_chunk", 32),
+        adapter_cache=adapter_cache,
+        spill_host_mb=spec.get("kv_spill_host_mb", 0.0) or 0.0,
+        spill_watermark_blocks=(
+            spec.get("kv_spill_watermark_blocks", 0) or 0))
 
 
 # ---------------------------------------------------------------------------
@@ -251,13 +259,35 @@ def read_spec(state_dir: str, idx: int) -> dict:
         return json.load(f)
 
 
+def _host_is_local(host: str) -> bool:
+    """True for loopback/any-local names — the only hosts the spawn +
+    SIGKILL supervision model can actually manage."""
+    if host in ("", "localhost", "0.0.0.0", "::", "::1"):
+        return True
+    return host.startswith("127.")
+
+
 def read_addr(state_dir: str, idx: int) -> Optional[dict]:
     path = os.path.join(replica_dir(state_dir, idx), "addr.json")
     try:
         with open(path) as f:
-            return json.load(f)
+            addr = json.load(f)
     except (OSError, ValueError):
         return None
+    host = str(addr.get("host", ""))
+    if not _host_is_local(host):
+        # Fail LOUDLY at parse/attach time instead of silently assuming
+        # loopback: worker supervision is os.kill-based (SIGKILL +
+        # pid liveness) and spawn launches subprocesses on THIS machine,
+        # so a remote host in addr.json can neither be supervised nor
+        # respawned — the fleet would "work" until the first failure.
+        raise RuntimeError(
+            f"replica-{idx} addr.json lists non-local host {host!r}: "
+            "multi-host spawn not yet supported (worker spawn and "
+            "SIGKILL supervision assume every replica runs on this "
+            "machine). Run one fleet per host behind a front-end "
+            "instead.")
+    return addr
 
 
 def spawn_worker(state_dir: str, idx: int, incarnation: int,
@@ -548,6 +578,39 @@ class ReplicaServer:
             inner._free_slot(slot)
         return True
 
+    def _do_park(self, msg):
+        """Client/loadgen-requested park of a long-idle session into
+        this worker's host spill tier (False when spill is off)."""
+        fn = getattr(self.engine, "park_request", None)
+        return bool(fn and fn(msg["rid"]))
+
+    def _do_resume(self, msg):
+        fn = getattr(self.engine, "resume_request", None)
+        return bool(fn and fn(msg["rid"]))
+
+    def _do_prefix_put(self, msg):
+        """Seed one fleet-store prefix block into this worker's pool
+        (rc==0 LRU entry, hittable by the next admit). `dup` tells the
+        router the worker already held it — no bytes re-imported, and
+        the router's chunks-avoided accounting counts it as local."""
+        pool = getattr(self.engine, "pool", None)
+        if pool is None:
+            return {"ok": False, "dup": False}
+        key = msg["key"]
+        if pool.has_prefix(key):
+            return {"ok": True, "dup": True}
+        return {"ok": pool.import_prefix_block(key, msg["payload"]),
+                "dup": False}
+
+    def _do_prefix_get(self, msg):
+        """Export one prefix block's payload for the fleet store (None
+        when this pool no longer holds the key — it may have been
+        LRU-evicted between the step reply and this fetch)."""
+        pool = getattr(self.engine, "pool", None)
+        if pool is None:
+            return None
+        return pool.export_prefix_block(msg["key"])
+
     def _do_set_params(self, msg):
         self.engine.set_params(msg["params"])
         return True
@@ -714,7 +777,8 @@ class ProcessFleetRouter:
                  stale_after: float = 15.0,
                  base_port: int = 0,
                  spawn: bool = True,
-                 extra_env: Optional[dict] = None):
+                 extra_env: Optional[dict] = None,
+                 prefix_store_mb: float = 0.0):
         assert policy in ("affinity", "round_robin"), policy
         assert supervise in (None, "off", "thread", "process"), supervise
         self.state_dir = state_dir
@@ -738,12 +802,29 @@ class ProcessFleetRouter:
         self.pause_admission = False        # driver-facade compat
         self.paged = True
         self.tokenizer = None
+        # Fleet-global prefix store (ISSUE 20): the router pulls newly
+        # inserted prefix blocks off step replies (prefix_get) and
+        # pushes them into an admission target that misses locally
+        # (prefix_put) — the cross-process flavor of FleetRouter's
+        # in-process store, same payloads, same counters.
+        if prefix_store_mb:
+            from megatronapp_tpu.inference.paged_cache import (
+                FleetPrefixStore,
+            )
+            self.prefix_store = FleetPrefixStore(
+                int(prefix_store_mb * (1 << 20)))
+        else:
+            self.prefix_store = None
         self.router_stats = {
             "admissions": 0, "affinity_admissions": 0,
             "migrations": 0, "migration_failures": 0,
             "migrated_kv_bytes": 0, "failovers": 0,
             "replica_deaths": 0, "reattaches": 0,
             "rpc_rollbacks": 0, "resyncs": 0,
+            "prefix_store_admission_hits": 0,
+            "prefix_store_seeded_blocks": 0,
+            "prefix_store_seeded_bytes": 0,
+            "prefill_chunks_avoided": 0,
         }
         self.supervisor = None
         self._supervisor_proc: Optional[subprocess.Popen] = None
@@ -957,6 +1038,7 @@ class ProcessFleetRouter:
         with an idempotent evict, and the session re-enters admission —
         the rid was reserved router-side, so the retry is the SAME
         request and the stream it eventually emits is unchanged."""
+        self._seed_from_store(rep, sess.prompt)
         try:
             rep.client.call(
                 "submit", rid=sess.rid, prompt=sess.prompt,
@@ -984,6 +1066,63 @@ class ProcessFleetRouter:
         self._submit_to(self._admit_target(
             sess.prompt, affinity_key=sess.adapter_id or sess.tenant),
             sess)
+
+    def _seed_from_store(self, rep: _ProcReplica, prompt: np.ndarray):
+        """Push this prompt's leading prefix blocks from the fleet
+        store into the target worker's pool (prefix_put) before the
+        submit, so its admit() hits them instead of re-prefilling.
+        Best-effort and idempotent: a dup reply means the worker
+        already held the block (counts as local, not seeded), any
+        fault just stops the seeding — the submit path's own error
+        handling owns worker death. Chunks-avoided follows the engine's
+        chunked-prefill arithmetic exactly (leading cached blocks *
+        block_size, capped at p_len - 1)."""
+        store = self.prefix_store
+        if store is None:
+            return
+        from megatronapp_tpu.inference.paged_cache import (
+            cdiv, prefix_block_keys,
+        )
+        block_size = self.spec["block_size"]
+        keys = prefix_block_keys(prompt, block_size, len(prompt))
+        local = chain = seeded = 0
+        leading_local = True
+        for k in keys:
+            payload = store.get(k)          # counts the hit/miss
+            if payload is None:
+                break                       # only a LEADING run helps
+            try:
+                reply = rep.client.call("prefix_put", key=k,
+                                        payload=payload)
+            except chaos.ChaosFault:
+                break     # put may have landed (idempotent) — stop here
+            except (ConnectionError, EOFError, OSError, socket.timeout):
+                return    # submit's failover owns the dying worker
+            if not reply["ok"]:
+                break                       # worker pool full
+            if reply["dup"] and leading_local:
+                local += 1
+            else:
+                leading_local = False
+                seeded += 1
+                self.router_stats["prefix_store_seeded_blocks"] += 1
+                self.router_stats["prefix_store_seeded_bytes"] += (
+                    payload["nbytes"])
+            chain += 1
+            self._note_prefix(k, rep.idx)
+        if not seeded:
+            return
+        p_len = len(prompt)
+        chunk = int(self.spec.get("prefill_chunk", 32))
+
+        def chunks_at(blocks_cached: int) -> int:
+            cached = min(blocks_cached * block_size, p_len - 1)
+            return cdiv(p_len - cached, chunk)
+
+        avoided = chunks_at(local) - chunks_at(chain)
+        self.router_stats["prefix_store_admission_hits"] += 1
+        self.router_stats["prefill_chunks_avoided"] += avoided
+        telemetry.inc("fleet_prefill_chunks_avoided", avoided)
 
     def add_request(self, prompt_tokens, max_new_tokens: int,
                     sampling=None, eod_id: Optional[int] = None,
@@ -1067,6 +1206,34 @@ class ProcessFleetRouter:
         req.generated = list(sess.generated)
         req.finished = sess.finished
         return req
+
+    def park_request(self, rid: int) -> bool:
+        """Forward a client park to the owning worker's spill tier
+        (`park` verb). A lost ack counts as parked — the verb is
+        engine-side idempotent and resume_request tolerates both
+        states."""
+        rep = self._rep_of(rid)
+        if rep is None:
+            return False
+        try:
+            return bool(rep.client.call("park", rid=rid))
+        except chaos.ChaosFault:
+            return True
+        except (ConnectionError, EOFError, OSError, socket.timeout):
+            self._fail_rep(rep)
+            return False
+
+    def resume_request(self, rid: int) -> bool:
+        rep = self._rep_of(rid)
+        if rep is None:
+            return False
+        try:
+            return bool(rep.client.call("resume", rid=rid))
+        except chaos.ChaosFault:
+            return True
+        except (ConnectionError, EOFError, OSError, socket.timeout):
+            self._fail_rep(rep)
+            return False
 
     # -- live migration --------------------------------------------------------
     def migrate_request(self, rid: int,
@@ -1283,8 +1450,31 @@ class ProcessFleetRouter:
                     rep.hist = Histogram.from_state(r["hist"])
                 for key in r["prefix_keys"]:
                     self._note_prefix(key, rep.idx)
+                    if (self.prefix_store is not None
+                            and not self.prefix_store.has(key)):
+                        # Pull each NEW block's payload once (prefix_get
+                        # is read-only + idempotent, so a lost reply
+                        # just refetches on the next insert event).
+                        try:
+                            payload = rep.client.call("prefix_get",
+                                                      key=key)
+                        except chaos.ChaosFault:
+                            continue
+                        except (ConnectionError, EOFError, OSError,
+                                socket.timeout):
+                            self._fail_rep(rep)
+                            break
+                        if payload is not None:
+                            self.prefix_store.put(key, payload)
+                if rep.state == DEAD:
+                    continue
                 if r["flushed"]:
                     self._drop_affinity(rep.idx)
+                    if self.prefix_store is not None:
+                        # A worker-side flush means a params swap: the
+                        # store's blocks may hold KV from the OLD
+                        # weights — drop everything, fleet-wide.
+                        self.prefix_store.clear()
                 ev = r["events"]
                 for rid in ev["admitted"]:
                     sess = self._sessions.get(rid)
@@ -1426,7 +1616,7 @@ class ProcessFleetRouter:
                 entry["interval_p99_ms"] = round(
                     rep.hist.percentile(99), 3)
             replicas.append(entry)
-        return {
+        out = {
             "engine": "fleet",
             "paged": True,
             "max_batch": self.max_batch,
@@ -1450,6 +1640,9 @@ class ProcessFleetRouter:
                 **self.router_stats,
             },
         }
+        if self.prefix_store is not None:
+            out["fleet"]["prefix_store"] = self.prefix_store.stats()
+        return out
 
     def export_fleet_gauges(self, registry=telemetry):
         """Server /metrics hook: per-replica labeled gauges + the
@@ -1474,6 +1667,14 @@ class ProcessFleetRouter:
                 restarts.get(rep.idx, 0))
         registry.set_gauge("fleet_supervisor_restarts_total",
                            sum(restarts.values()))
+        if self.prefix_store is not None:
+            st = self.prefix_store.stats()
+            registry.set_gauge("fleet_prefix_store_entries",
+                               st["entries"])
+            registry.set_gauge("fleet_prefix_store_bytes",
+                               st["bytes_used"])
+            registry.set_gauge("fleet_prefix_store_hit_total",
+                               st["hits"])
 
     def merged_trace(self) -> dict:
         """ONE Chrome trace across every replica process + the router:

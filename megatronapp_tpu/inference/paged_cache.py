@@ -225,7 +225,8 @@ class PagedKVCache:
         self.stats = {"prefix_hit_tokens": 0, "prefill_tokens": 0,
                       "cow_copies": 0, "evictions": 0, "preemptions": 0,
                       "peak_blocks_in_use": 0, "handoff_transfers": 0,
-                      "slot_exports": 0, "slot_imports": 0}
+                      "slot_exports": 0, "slot_imports": 0,
+                      "prefix_block_exports": 0, "prefix_block_imports": 0}
         # Fleet-router hooks (inference/fleet.py): prefix_listener(keys)
         # fires with every batch of NEWLY registered prefix-block hashes
         # (the router's hash→replica affinity map is fed from these
@@ -689,3 +690,233 @@ class PagedKVCache:
         if preempted:
             self.stats["preemptions"] += 1
             telemetry.inc("paged_preemptions")
+
+    # ---- per-block prefix export/import (fleet prefix store) -------------
+    def has_prefix(self, key: bytes) -> bool:
+        """Whether a prefix-block hash is currently hittable in this
+        pool (the fleet router probes this before serving a store
+        payload — a locally-present block never crosses the wire)."""
+        return key in self._table
+
+    def export_prefix_block(self, key: bytes) -> Optional[dict]:
+        """READ-ONLY export of ONE cached prefix block's stored rows
+        (+ scales) for the FLEET-GLOBAL PREFIX STORE (ISSUE 20): the
+        block is shipped in export_slot discipline — verbatim stored
+        bytes in the storage dtype, exact nbytes off the addressable
+        arrays — so an import on any same-dtype pool is copy-exact.
+        Returns None when the hash is no longer hittable (evicted or
+        flushed between the insert event and the export). Nothing here
+        mutates the pool."""
+        import jax
+        blk = self._table.get(key)
+        if blk is None:
+            return None
+        rows = tuple(np.asarray(jax.device_get(p[:, blk]))
+                     for p in self.pages)
+        scales = (tuple(np.asarray(jax.device_get(s[:, blk]))
+                        for s in self.scales)
+                  if self.scales is not None else None)
+        nbytes = sum(r.nbytes for r in rows)
+        if scales is not None:
+            nbytes += sum(s.nbytes for s in scales)
+        self.stats["prefix_block_exports"] += 1
+        return {"kv_cache_dtype": self.kv_cache_dtype, "rows": rows,
+                "scales": scales, "block_size": self.block_size,
+                "nbytes": nbytes}
+
+    def import_prefix_block(self, key: bytes, payload: dict) -> bool:
+        """Install an `export_prefix_block` payload as a HITTABLE prefix
+        block: one fresh block is filled with the stored rows verbatim
+        and registered under `key` with refcount 0 on the LRU list —
+        exactly the state a locally-prefilled block reaches after its
+        last owner releases, so a subsequent admit() hits it like any
+        local prefix and the prefill starts past it (the
+        prefill-chunks-avoided win). ALL-OR-NOTHING: returns True when
+        the key is already present (idempotent), False when the pool
+        cannot supply a block, and rolls the allocation back on any
+        scatter fault — audit() passes either way."""
+        if payload["kv_cache_dtype"] != self.kv_cache_dtype:
+            raise ValueError(
+                f"cannot import a {payload['kv_cache_dtype']!r} prefix "
+                f"block into a {self.kv_cache_dtype!r} pool — the store "
+                "ships stored rows verbatim; every fleet replica must "
+                "run the same --kv-cache-dtype")
+        if payload["block_size"] != self.block_size:
+            raise ValueError(
+                f"prefix-block size mismatch: payload block_size="
+                f"{payload['block_size']} vs pool {self.block_size} — "
+                "prefix hashes only align across equal block sizes")
+        if not self.enable_prefix_caching:
+            return False
+        if key in self._table:
+            return True
+        blk = self._take_free()
+        if blk is None:
+            return False
+        try:
+            self.pages = tuple(p.at[:, blk].set(jnp.asarray(r))
+                               for p, r in zip(self.pages,
+                                               payload["rows"]))
+            if self.scales is not None:
+                self.scales = tuple(
+                    s.at[:, blk].set(jnp.asarray(r))
+                    for s, r in zip(self.scales, payload["scales"]))
+        except Exception:
+            # Partially-written rows are dead data in a returned block
+            # the next writer overwrites — bookkeeping stays clean.
+            self._free.append(blk)
+            raise
+        self._table[key] = blk
+        self._hash_of[blk] = key
+        self._lru[blk] = None       # rc==0, evictable, hittable
+        self.stats["prefix_block_imports"] += 1
+        telemetry.inc("fleet_prefix_blocks_imported")
+        return True
+
+
+class HostSpillTier:
+    """Host-RAM spill tier for PARKED sessions (ISSUE 20): a strict
+    byte-budgeted dict of `export_slot`-format payloads (numpy rows +
+    scales — already host-resident, exact nbytes off the serialized
+    arrays) keyed by request id. The tier never evicts: a parked
+    session is LIVE state, so `put` past the budget is refused and the
+    engine falls back to preemption (spill preferred, never forced).
+    Insertion order is the engine's unpark order (FIFO — the
+    least-recently-parked session resumes first)."""
+
+    def __init__(self, budget_bytes: int):
+        assert budget_bytes > 0, "spill tier needs a positive byte budget"
+        self.budget_bytes = int(budget_bytes)
+        self.bytes_used = 0
+        self._entries: OrderedDict = OrderedDict()   # rid -> payload
+        self.counters = {"parks": 0, "unparks": 0, "park_bytes": 0,
+                         "unpark_bytes": 0, "rejects": 0,
+                         "peak_bytes": 0, "peak_parked": 0}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, rid) -> bool:
+        return rid in self._entries
+
+    def would_fit(self, nbytes: int) -> bool:
+        return self.bytes_used + nbytes <= self.budget_bytes
+
+    def put(self, rid, payload: dict) -> bool:
+        """Park a payload. False (tier untouched, reject counted) when
+        the exact serialized bytes would exceed the budget."""
+        assert rid not in self._entries, f"request {rid} already parked"
+        nbytes = payload["nbytes"]
+        if not self.would_fit(nbytes):
+            self.counters["rejects"] += 1
+            return False
+        self._entries[rid] = payload
+        self.bytes_used += nbytes
+        self.counters["parks"] += 1
+        self.counters["park_bytes"] += nbytes
+        self.counters["peak_bytes"] = max(self.counters["peak_bytes"],
+                                          self.bytes_used)
+        self.counters["peak_parked"] = max(self.counters["peak_parked"],
+                                           len(self._entries))
+        telemetry.inc("kv_spill_parks")
+        telemetry.inc("kv_spill_park_bytes", nbytes)
+        return True
+
+    def get(self, rid) -> Optional[dict]:
+        return self._entries.get(rid)
+
+    def pop(self, rid, unpark: bool = True) -> Optional[dict]:
+        """Remove a parked payload (unpark=False for aborts/expiry —
+        only genuine resumes count as unparks)."""
+        payload = self._entries.pop(rid, None)
+        if payload is None:
+            return None
+        self.bytes_used -= payload["nbytes"]
+        if unpark:
+            self.counters["unparks"] += 1
+            self.counters["unpark_bytes"] += payload["nbytes"]
+            telemetry.inc("kv_spill_unparks")
+        return payload
+
+    def rids(self) -> List:
+        """Parked request ids, oldest (next to unpark) first."""
+        return list(self._entries)
+
+    def stats(self) -> dict:
+        return {"parked": len(self._entries),
+                "budget_bytes": self.budget_bytes,
+                "bytes_used": self.bytes_used, **self.counters}
+
+
+class FleetPrefixStore:
+    """Fleet-global prefix store (ISSUE 20): `export_prefix_block`
+    payloads keyed by the SAME rolling `prefix_block_keys` hashes the
+    pool's prefix cache and the routers' affinity maps use — so a store
+    hit is an exact-prefix match by construction. Bounded by bytes with
+    LRU eviction (a prefix block is derived state — unlike the spill
+    tier it may always be dropped and re-prefilled), with per-fleet
+    hit/byte counters. Both routers (inference/fleet.py in-process,
+    inference/fleet_rpc.py cross-process via the prefix_put/prefix_get
+    verbs) populate it from prefix-insert events and serve admissions
+    from it."""
+
+    def __init__(self, capacity_bytes: int):
+        assert capacity_bytes > 0, "prefix store needs a positive capacity"
+        self.capacity_bytes = int(capacity_bytes)
+        self.bytes_used = 0
+        self._entries: OrderedDict = OrderedDict()   # key -> payload
+        self.counters = {"puts": 0, "put_bytes": 0, "hits": 0,
+                         "hit_bytes": 0, "misses": 0, "evictions": 0,
+                         "flushes": 0, "peak_bytes": 0}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def has(self, key: bytes) -> bool:
+        return key in self._entries
+
+    def put(self, key: bytes, payload: dict) -> bool:
+        """Insert a block payload, evicting LRU entries to fit. A
+        payload larger than the whole store is refused (never counted
+        as resident)."""
+        if key in self._entries:
+            return True
+        nbytes = payload["nbytes"]
+        if nbytes > self.capacity_bytes:
+            return False
+        while self.bytes_used + nbytes > self.capacity_bytes:
+            _, old = self._entries.popitem(last=False)
+            self.bytes_used -= old["nbytes"]
+            self.counters["evictions"] += 1
+        self._entries[key] = payload
+        self.bytes_used += nbytes
+        self.counters["puts"] += 1
+        self.counters["put_bytes"] += nbytes
+        self.counters["peak_bytes"] = max(self.counters["peak_bytes"],
+                                          self.bytes_used)
+        telemetry.inc("fleet_prefix_store_put_bytes", nbytes)
+        return True
+
+    def get(self, key: bytes) -> Optional[dict]:
+        payload = self._entries.get(key)
+        if payload is None:
+            self.counters["misses"] += 1
+            return None
+        self._entries.move_to_end(key)
+        self.counters["hits"] += 1
+        self.counters["hit_bytes"] += payload["nbytes"]
+        telemetry.inc("fleet_prefix_store_hits")
+        return payload
+
+    def clear(self):
+        """Drop everything (params reload / replica death: stored
+        blocks hold KV from weights no longer guaranteed fleet-wide)."""
+        if self._entries:
+            self.counters["flushes"] += 1
+        self._entries.clear()
+        self.bytes_used = 0
+
+    def stats(self) -> dict:
+        return {"entries": len(self._entries),
+                "capacity_bytes": self.capacity_bytes,
+                "bytes_used": self.bytes_used, **self.counters}

@@ -802,6 +802,30 @@ class TextGenerationServer:
             telemetry.set_gauge("lora_adapters_pinned", lstats["pinned"])
             telemetry.set_gauge("lora_resident_bytes",
                                 adapters.resident_bytes())
+        spill = getattr(eng, "spill", None)
+        if spill is not None:
+            # Host-RAM KV spill tier (ISSUE 20): occupancy is state
+            # (parked sessions, exact resident bytes vs budget); the
+            # park/unpark COUNTERS accumulate at the tier's
+            # instrumented sites.
+            sstats = spill.stats()
+            telemetry.set_gauge("kv_spill_parked", sstats["parked"])
+            telemetry.set_gauge("kv_spill_bytes_used",
+                                sstats["bytes_used"])
+            telemetry.set_gauge("kv_spill_budget_bytes",
+                                sstats["budget_bytes"])
+        store = getattr(eng, "prefix_store", None)
+        if store is not None:
+            # Fleet-global prefix store (ISSUE 20): entry count and
+            # exact resident bytes; hit/miss/eviction counters
+            # accumulate inside the store.
+            pstats = store.stats()
+            telemetry.set_gauge("fleet_prefix_store_entries",
+                                pstats["entries"])
+            telemetry.set_gauge("fleet_prefix_store_bytes",
+                                pstats["bytes_used"])
+            telemetry.set_gauge("fleet_prefix_store_hit_total",
+                                pstats["hits"])
         tstats = getattr(eng, "_tenant_stats", None)
         if tstats:
             # Per-tenant SLO attainment gauges (bounded cardinality —

@@ -87,6 +87,19 @@ tests/test_resilience.py pins this registry against its drill list):
                              evict for migration, sessions-resync for a
                              lost step reply) — zero sessions lost,
                              pools audit() clean, streams unchanged.
+- ``kv-spill``               a host-RAM KV spill transfer dies in the
+                             worst window (dynamic_engine park/unpark,
+                             ISSUE 20): parking, between the read-only
+                             host copy (export_slot) and the page-table
+                             release — nothing has mutated, so the
+                             rollback is "do nothing" and the session
+                             keeps decoding in its slot; unparking (the
+                             mirror), between the destination
+                             import_slot and the spill-entry release —
+                             the imported blocks return to the pool and
+                             the session stays parked. Either way
+                             audit() passes and the resumed stream is
+                             token-exact.
 - ``lora-load``              a LoRA adapter fetch dies between reading
                              the adapter's weights from the registry
                              and committing them into the HBM bank
@@ -121,6 +134,7 @@ SITES = (
     "kv-quant-write",
     "fleet-migrate",
     "fleet-rpc",
+    "kv-spill",
     "lora-load",
 )
 

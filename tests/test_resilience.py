@@ -61,13 +61,14 @@ class TestChaosRegistry:
         TestSpeculativeVerifierChaos, kv-quant-write →
         TestKvQuantWriteChaos, fleet-migrate →
         TestFleetMigrateChaos, fleet-rpc →
-        tests/test_fleet_rpc.py::TestChaosRpc, lora-load →
+        tests/test_fleet_rpc.py::TestChaosRpc, kv-spill →
+        TestKvSpillChaos, lora-load →
         TestLoraLoadChaos)."""
         assert chaos.SITES == ("checkpoint-save", "local-checkpoint-save",
                                "step-nan", "stepper-step",
                                "paged-evict", "paged-cow", "spec-verify",
                                "kv-quant-write", "fleet-migrate",
-                               "fleet-rpc", "lora-load")
+                               "fleet-rpc", "kv-spill", "lora-load")
 
     def test_arm_fire_bounded_and_auto_disarm(self):
         chaos.arm("stepper-step", times=2, after=1)
@@ -459,6 +460,94 @@ class TestFleetMigrateChaos:
         out = fr.run_to_completion()[rid]
         assert len(out) == 11 + 6
         fr.replicas[0].engine.pool.audit()
+
+
+# ---------------------------------------------------------------------------
+class TestKvSpillChaos:
+    """Chaos site "kv-spill" (ISSUE 20): fires in the host-RAM spill
+    tier's two worst windows. Parking: between the read-only host copy
+    (export_slot) and the page-table release — nothing has mutated, so
+    the rollback is "do nothing" and the session keeps decoding in its
+    slot. Unparking (the mirror): between the destination import_slot
+    and the spill-entry release — the imported blocks return to the
+    pool and the session stays parked. Either way the pool audits
+    clean and the eventually-resumed stream is token-exact."""
+
+    def _engine(self, params, cfg, spill_mb=2.0):
+        from megatronapp_tpu.inference.dynamic_engine import (
+            DynamicInferenceEngine,
+        )
+        return DynamicInferenceEngine(
+            params, cfg, max_batch=2, max_seq_len=48,
+            prefill_buckets=(16,), paged=True, block_size=8,
+            spill_host_mb=spill_mb)
+
+    def _setup(self):
+        from megatronapp_tpu.inference.engine import SamplingParams
+        from megatronapp_tpu.models.gpt import init_gpt_params
+        cfg = tiny_model(num_query_groups=2,
+                         compute_dtype=jnp.float32,
+                         remat_policy="none")
+        params, _ = init_gpt_params(jax.random.PRNGKey(7), cfg)
+        prompt = np.arange(1, 12, dtype=np.int32)
+        sp = SamplingParams(greedy=True)
+        ref = self._engine(params, cfg)
+        ref_rid = ref.add_request(prompt, 8, sp)
+        ref_stream = ref.run_to_completion()[ref_rid].tolist()
+        eng = self._engine(params, cfg)
+        rid = eng.add_request(prompt, 8, sp)
+        streams = {rid: []}
+        while not streams[rid]:
+            for r, tok in eng.step()["tokens"]:
+                streams.setdefault(r, []).append(int(tok))
+        return eng, rid, streams, ref_stream, prompt
+
+    def _drain(self, eng, streams):
+        while eng.has_work:
+            for r, tok in eng.step()["tokens"]:
+                streams.setdefault(r, []).append(int(tok))
+
+    def test_park_fault_session_keeps_decoding(self):
+        eng, rid, streams, ref_stream, prompt = self._setup()
+        in_use = eng.pool.blocks_in_use()
+        chaos.arm("kv-spill", times=1)
+        with pytest.raises(chaos.ChaosFault):
+            eng.park_request(rid)
+        # The copy died before the page-table release: nothing moved.
+        assert rid not in eng._parked
+        assert eng.spill.stats()["parks"] == 0
+        assert eng.spill.stats()["bytes_used"] == 0
+        req = eng.requests[rid]
+        assert req.slot >= 0 and eng.slots[req.slot] is req
+        assert eng.pool.blocks_in_use() == in_use
+        eng.pool.audit()
+        # The retried park succeeds; the resumed stream is exact.
+        assert eng.park_request(rid)
+        assert eng.resume_request(rid)
+        self._drain(eng, streams)
+        eng.pool.audit()
+        assert streams[rid] == ref_stream[len(prompt):]
+
+    def test_unpark_fault_session_stays_parked(self):
+        eng, rid, streams, ref_stream, prompt = self._setup()
+        assert eng.park_request(rid)
+        parked_bytes = eng.spill.stats()["bytes_used"]
+        free = eng.pool.free_blocks()
+        chaos.arm("kv-spill", times=1)
+        with pytest.raises(chaos.ChaosFault):
+            eng.resume_request(rid)
+        # The mirror window: import_slot landed, then the transfer
+        # died — the imported blocks went back to the pool and the
+        # session is STILL parked, resumable later.
+        assert rid in eng._parked
+        assert eng.spill.stats()["bytes_used"] == parked_bytes
+        assert eng.spill.stats()["unparks"] == 0
+        assert eng.pool.free_blocks() == free
+        eng.pool.audit()
+        assert eng.resume_request(rid)
+        self._drain(eng, streams)
+        eng.pool.audit()
+        assert streams[rid] == ref_stream[len(prompt):]
 
 
 # ---------------------------------------------------------------------------

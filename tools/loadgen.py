@@ -57,11 +57,18 @@ def make_trace(seed: int = 0, n_requests: int = 24, tenants: int = 3,
                max_new_max: int = 12, arrival_gap: int = 2,
                burst_every: int = 8, burst_size: int = 3,
                abort_rate: float = 0.0, abort_after_min: int = 2,
-               vocab: int = 128):
+               idle_every: int = 0, idle_after: int = 2,
+               idle_steps: int = 6, vocab: int = 128):
     """Build the seeded event list. Each event:
-    {id, arrive_step, tenant, prompt, max_new, abort_after} — prompts
-    are tenant_prefix + per-request tail; abort_after is None or the
-    emitted-token count after which the client cancels."""
+    {id, arrive_step, tenant, prompt, max_new, abort_after,
+    idle_after, idle_steps} — prompts are tenant_prefix + per-request
+    tail; abort_after is None or the emitted-token count after which
+    the client cancels. Long-idle phases (ISSUE 20): every
+    ``idle_every``-th request goes idle after ``idle_after`` emitted
+    tokens — the client parks the session (host-RAM KV spill) and
+    resumes it ``idle_steps`` virtual steps later. Selection is
+    modular, not an extra RNG draw, so existing seeds replay the
+    exact same trace when idling is off."""
     rng = np.random.default_rng(seed)
     prefixes = [rng.integers(0, vocab, size=prefix_len).astype(np.int32)
                 for _ in range(tenants)]
@@ -81,11 +88,17 @@ def make_trace(seed: int = 0, n_requests: int = 24, tenants: int = 3,
             if abort_rate > 0 and rng.random() < abort_rate:
                 abort_after = int(rng.integers(
                     abort_after_min, max(abort_after_min + 1, max_new)))
+            idle = bool(idle_every
+                        and k % idle_every == idle_every - 1
+                        and abort_after is None
+                        and max_new > idle_after)
             events.append({
                 "id": k, "arrive_step": step, "tenant": tenant,
                 "prompt": np.concatenate(
                     [prefixes[tenant], tail.astype(np.int32)]),
                 "max_new": max_new, "abort_after": abort_after,
+                "idle_after": idle_after if idle else None,
+                "idle_steps": idle_steps,
             })
             k += 1
         step += arrival_gap
@@ -126,6 +139,8 @@ def replay(router, trace, slo_ttft_ms=None, slo_interval_ms=None,
     streams = {}
     aborted = set()
     finished = set()
+    idled = set()
+    parked = {}          # rid -> virtual step to resume at
     step = 0
     while pending or any(
             rid not in finished for rid in rid_to_ev):
@@ -145,6 +160,13 @@ def replay(router, trace, slo_ttft_ms=None, slo_interval_ms=None,
             streams[ev["id"]] = []
             tenant_requests[ev["tenant"]] = (
                 tenant_requests.get(ev["tenant"], 0) + 1)
+        for rid in [r for r, at in parked.items() if at <= step]:
+            # Long-idle phase over: the client comes back for its next
+            # token, which unparks the spilled KV (token-exact resume).
+            del parked[rid]
+            fn = getattr(router, "resume_request", None)
+            if fn is not None:
+                fn(rid)
         events = router.step()
         now = time.monotonic()
         for rid, tok in events["tokens"]:
@@ -167,6 +189,16 @@ def replay(router, trace, slo_ttft_ms=None, slo_interval_ms=None,
                     and len(toks) >= ev["abort_after"]):
                 aborted.add(rid)
                 router.abort_request(rid)
+            if (ev.get("idle_after") is not None and rid not in idled
+                    and rid not in aborted
+                    and len(toks) >= ev["idle_after"]):
+                # Client goes idle mid-stream: park the session so its
+                # KV spills to host RAM (routers without the spill tier
+                # just keep decoding — park_request returns False).
+                fn = getattr(router, "park_request", None)
+                if fn is not None and fn(rid):
+                    idled.add(rid)
+                    parked[rid] = step + int(ev.get("idle_steps", 1))
         for rid in events["finished"] + events["expired"]:
             if rid in rid_to_ev:
                 finished.add(rid)
@@ -180,6 +212,7 @@ def replay(router, trace, slo_ttft_ms=None, slo_interval_ms=None,
         "requests": len(rid_to_ev),
         "steps": step,
         "aborted": len(aborted),
+        "idled": len(idled),
         "tokens_out": sum(len(s) for s in streams.values()),
         "ttft_p50_ms": round(ttft_hist.percentile(50), 3),
         "ttft_p99_ms": round(ttft_hist.percentile(99), 3),
@@ -229,6 +262,18 @@ def main(argv=None) -> int:
     ap.add_argument("--burst-every", type=int, default=8)
     ap.add_argument("--burst-size", type=int, default=3)
     ap.add_argument("--abort-rate", type=float, default=0.0)
+    ap.add_argument("--idle-every", type=int, default=0,
+                    help="every Nth request goes idle mid-stream and "
+                         "is parked to the host-RAM spill tier "
+                         "(0 = no idle phases)")
+    ap.add_argument("--idle-after", type=int, default=2,
+                    help="emitted tokens before an idle request parks")
+    ap.add_argument("--idle-steps", type=int, default=6,
+                    help="virtual steps an idle request stays parked")
+    ap.add_argument("--kv-spill-host-mb", type=float, default=0.0,
+                    help="per-replica host-RAM spill budget (MiB); "
+                         "required for --idle-every to actually park")
+    ap.add_argument("--kv-spill-watermark-blocks", type=int, default=0)
     ap.add_argument("--slo-ttft-ms", type=float, default=None)
     ap.add_argument("--slo-interval-ms", type=float, default=None)
     ap.add_argument("--lora-adapters", type=int, default=0,
@@ -257,8 +302,14 @@ def main(argv=None) -> int:
         seed=args.seed, n_requests=args.requests,
         tenants=args.tenants, prefix_len=args.prefix_len,
         arrival_gap=args.arrival_gap, burst_every=args.burst_every,
-        burst_size=args.burst_size, abort_rate=args.abort_rate)
+        burst_size=args.burst_size, abort_rate=args.abort_rate,
+        idle_every=args.idle_every, idle_after=args.idle_after,
+        idle_steps=args.idle_steps)
     spec = default_engine_spec(max_seq_len=64, max_batch=2)
+    if args.kv_spill_host_mb:
+        spec.update(
+            kv_spill_host_mb=args.kv_spill_host_mb,
+            kv_spill_watermark_blocks=args.kv_spill_watermark_blocks)
     tenant_adapters = None
     if args.lora_adapters > 0:
         import jax.numpy as jnp

@@ -213,6 +213,10 @@ def main():
                 block_size=args.kv_block_size,
                 num_blocks=args.num_kv_blocks,
                 kv_cache_dtype=args.kv_cache_dtype,
+                prefill_chunk=args.prefill_chunk,
+                kv_spill_host_mb=args.kv_spill_host_mb,
+                kv_spill_watermark_blocks=(
+                    args.kv_spill_watermark_blocks),
                 lora_dir=args.lora_dir,
                 lora_rank=args.lora_rank,
                 max_resident_adapters=args.max_resident_adapters)
@@ -232,6 +236,7 @@ def main():
                 base_port=args.replica_rpc_port,
                 supervise=(None if args.supervisor == "off"
                            else args.supervisor),
+                prefix_store_mb=args.fleet_prefix_store_mb,
                 extra_env=worker_env)
             router.set_params(params)
             router.tokenizer = tok
@@ -312,13 +317,17 @@ def main():
                     prefill_chunk=args.prefill_chunk,
                     kv_cache_dtype=args.kv_cache_dtype,
                     fused_decode=args.megakernel_decode,
-                    adapter_cache=make_adapter_cache())
+                    adapter_cache=make_adapter_cache(),
+                    spill_host_mb=args.kv_spill_host_mb,
+                    spill_watermark_blocks=(
+                        args.kv_spill_watermark_blocks))
 
             engine = FleetRouter(
                 engine_factory=replica_engine, num_replicas=n,
                 migrate=args.fleet_migrate,
                 autoscale=args.fleet_autoscale,
-                slo_ms=args.decode_slo_ms)
+                slo_ms=args.decode_slo_ms,
+                prefix_store_mb=args.fleet_prefix_store_mb)
             print(f"serving FLEET of {n} "
                   f"{'disagg' if args.serve_disagg else 'dynamic'} "
                   f"replicas on {args.host}:{args.port} "
@@ -376,7 +385,9 @@ def main():
             draft_cfg=draft_cfg, prefill_chunk=args.prefill_chunk,
             ctx=tp_ctx, kv_cache_dtype=args.kv_cache_dtype,
             fused_decode=args.megakernel_decode,
-            adapter_cache=make_adapter_cache())
+            adapter_cache=make_adapter_cache(),
+            spill_host_mb=args.kv_spill_host_mb,
+            spill_watermark_blocks=args.kv_spill_watermark_blocks)
         if args.lora_dir:
             # Tenant SLO composition point: all tenants default to the
             # "standard" class; operators assign premium/batch classes
